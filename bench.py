@@ -1,0 +1,101 @@
+"""Headline benchmark: Ed25519 verifies/s on one TPU chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): 1,000,000 verifies/s = one AWS-F1 FPGA card
+(the reference's wiredancer offload) = ~33 Skylake cores of the reference's
+AVX-512 software path.  vs_baseline = value / 1e6.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_verify() -> dict:
+    import jax
+
+    from firedancer_tpu.ops.ed25519 import verify as fver
+    from firedancer_tpu.ops.ed25519 import golden
+
+    batch = 4096
+    msg_len = 128
+    rng = np.random.default_rng(42)
+    secret = rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+    pub = golden.public_from_secret(secret)
+    msgs = np.zeros((batch, msg_len), dtype=np.uint8)
+    sigs = np.zeros((batch, 64), dtype=np.uint8)
+    pubs = np.zeros((batch, 32), dtype=np.uint8)
+    lens = np.full((batch,), msg_len, dtype=np.int32)
+    # a handful of distinct messages signed for real; replicated to fill batch
+    n_real = 32
+    for i in range(n_real):
+        m = rng.integers(0, 256, msg_len, dtype=np.uint8)
+        s = golden.sign(secret, m.tobytes())
+        msgs[i::n_real] = m
+        sigs[i::n_real] = np.frombuffer(s, dtype=np.uint8)
+        pubs[i::n_real] = np.frombuffer(pub, dtype=np.uint8)
+
+    fn = jax.jit(fver.verify_batch)
+    ok = fn(msgs, lens, sigs, pubs)
+    ok.block_until_ready()
+    assert bool(np.asarray(ok).all()), "verify_batch rejected valid sigs"
+
+    n_iter = 8
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        ok = fn(msgs, lens, sigs, pubs)
+    ok.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = batch * n_iter / dt
+    return {
+        "metric": "ed25519_verifies_per_s_1chip",
+        "value": round(rate, 1),
+        "unit": "verify/s",
+        "vs_baseline": round(rate / 1_000_000, 4),
+    }
+
+
+def _bench_sha512_fallback() -> dict:
+    # Early-round fallback: SHA-512 hashing throughput (the verify k-digest).
+    import jax
+
+    from firedancer_tpu.ops import sha512 as fsha
+
+    batch, msg_len = 4096, 1296
+    rng = np.random.default_rng(0)
+    msgs = rng.integers(0, 256, size=(batch, msg_len), dtype=np.uint8)
+    lens = np.full((batch,), msg_len, dtype=np.int32)
+    fn = jax.jit(lambda m, l: fsha.sha512(m, l))
+    fn(msgs, lens).block_until_ready()
+    n_iter = 8
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = fn(msgs, lens)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = batch * n_iter / dt
+    return {
+        "metric": "sha512_hashes_per_s_1chip",
+        "value": round(rate, 1),
+        "unit": "hash/s",
+        "vs_baseline": round(rate / 1_000_000, 4),
+    }
+
+
+def main() -> None:
+    try:
+        result = _bench_verify()
+    except ImportError:
+        # verify kernel not built yet (early rounds); any real verify
+        # failure must surface loudly rather than fall back.
+        result = _bench_sha512_fallback()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

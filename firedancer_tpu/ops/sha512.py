@@ -1,0 +1,245 @@
+"""Batched SHA-512 for TPU, as pure JAX over uint32 pairs.
+
+The reference computes SHA-512 with AVX2 assembly and a 4/8-way batch API
+(behavior contract: /root/reference/src/ballet/sha512/fd_sha512.h:237-266).
+On TPU there is no native 64-bit datapath worth using, so every 64-bit word
+is a (hi, lo) pair of uint32 lanes and the batch axis is the vector axis —
+one sha512 per lane, thousands of lanes per call.
+
+Entry point: sha512(msgs, lens) -> (B, 64) uint8 digests, where msgs is a
+(B, max_len) uint8 array and lens the per-lane byte counts.  max_len is
+static; the block loop runs ceil((max_len+17)/128) iterations with per-lane
+masking, so all lanes cost the same as the longest possible message.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _icbrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 2) // 3 + 1)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            return x
+        x = y
+
+
+def _primes(n: int):
+    ps, c = [], 2
+    while len(ps) < n:
+        if all(c % p for p in ps):
+            ps.append(c)
+        c += 1
+    return ps
+
+
+def _gen_constants():
+    import math
+
+    ps = _primes(80)
+    k = [_icbrt(p << 192) & ((1 << 64) - 1) for p in ps]
+    h = [math.isqrt(p << 128) & ((1 << 64) - 1) for p in ps[:8]]
+    return k, h
+
+
+_K64, _H64 = _gen_constants()
+assert _K64[0] == 0x428A2F98D728AE22 and _H64[0] == 0x6A09E667F3BCC908
+
+_K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+_H_HI = np.array([h >> 32 for h in _H64], dtype=np.uint32)
+_H_LO = np.array([h & 0xFFFFFFFF for h in _H64], dtype=np.uint32)
+
+
+# -- 64-bit ops on (hi, lo) uint32 pairs ------------------------------------
+
+def _add64(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _add64n(*xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = _add64(acc, x)
+    return acc
+
+
+def _ror64(x, n):
+    h, l = x
+    if n == 0:
+        return x
+    if n < 32:
+        return ((h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n)))
+    if n == 32:
+        return (l, h)
+    n -= 32
+    return ((l >> n) | (h << (32 - n)), (h >> n) | (l << (32 - n)))
+
+
+def _shr64(x, n):
+    h, l = x
+    if n < 32:
+        return (h >> n, (l >> n) | (h << (32 - n)))
+    return (jnp.zeros_like(h), h >> (n - 32))
+
+
+def _xor64(a, b):
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _big_sigma0(x):
+    return _xor64(_xor64(_ror64(x, 28), _ror64(x, 34)), _ror64(x, 39))
+
+
+def _big_sigma1(x):
+    return _xor64(_xor64(_ror64(x, 14), _ror64(x, 18)), _ror64(x, 41))
+
+
+def _small_sigma0(x):
+    return _xor64(_xor64(_ror64(x, 1), _ror64(x, 8)), _shr64(x, 7))
+
+
+def _small_sigma1(x):
+    return _xor64(_xor64(_ror64(x, 19), _ror64(x, 61)), _shr64(x, 6))
+
+
+def _ch(e, f, g):
+    return ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
+
+
+def _maj(a, b, c):
+    return (
+        (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+        (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+    )
+
+
+def _compress_block(state, w_hi, w_lo):
+    """One SHA-512 compression.  state: (hi,lo) each (..., 8); w: (..., 16)."""
+    kh = jnp.asarray(_K_HI)
+    kl = jnp.asarray(_K_LO)
+
+    def round_body(carry, t):
+        (ah, al, wh, wl) = carry
+        # message schedule word for this round (rolling 16-word window)
+        def w16(_):
+            s0 = _small_sigma0((wh[..., 1], wl[..., 1]))
+            s1 = _small_sigma1((wh[..., 14], wl[..., 14]))
+            nh, nl = _add64n(
+                (wh[..., 0], wl[..., 0]), s0, (wh[..., 9], wl[..., 9]), s1
+            )
+            return nh, nl
+
+        def wlt16(_):
+            return wh[..., 0], wl[..., 0]
+
+        wt_h, wt_l = jax.lax.cond(t < 16, wlt16, w16, None)
+        # rotate window, append wt
+        wh2 = jnp.concatenate([wh[..., 1:], wt_h[..., None]], axis=-1)
+        wl2 = jnp.concatenate([wl[..., 1:], wt_l[..., None]], axis=-1)
+
+        a = (ah[..., 0], al[..., 0])
+        b = (ah[..., 1], al[..., 1])
+        c = (ah[..., 2], al[..., 2])
+        d = (ah[..., 3], al[..., 3])
+        e = (ah[..., 4], al[..., 4])
+        f = (ah[..., 5], al[..., 5])
+        g = (ah[..., 6], al[..., 6])
+        h = (ah[..., 7], al[..., 7])
+
+        kt = (kh[t], kl[t])
+        t1 = _add64n(h, _big_sigma1(e), _ch(e, f, g), kt, (wt_h, wt_l))
+        t2 = _add64(_big_sigma0(a), _maj(a, b, c))
+        new_e = _add64(d, t1)
+        new_a = _add64(t1, t2)
+
+        ah2 = jnp.stack(
+            [new_a[0], a[0], b[0], c[0], new_e[0], e[0], f[0], g[0]], axis=-1
+        )
+        al2 = jnp.stack(
+            [new_a[1], a[1], b[1], c[1], new_e[1], e[1], f[1], g[1]], axis=-1
+        )
+        return (ah2, al2, wh2, wl2), None
+
+    sh, sl = state
+    (fh, fl, _, _), _ = jax.lax.scan(
+        round_body, (sh, sl, w_hi, w_lo), jnp.arange(80, dtype=jnp.int32)
+    )
+    # feed-forward
+    lo = sl + fl
+    carry = (lo < sl).astype(jnp.uint32)
+    hi = sh + fh + carry
+    return (hi, lo)
+
+
+def _pad(msgs, lens, max_blocks):
+    """Build padded message buffer (B, max_blocks*128) uint8."""
+    b = msgs.shape[0]
+    total = max_blocks * 128
+    buf = jnp.zeros((b, total), dtype=jnp.uint8)
+    buf = buf.at[:, : msgs.shape[1]].set(msgs)
+    pos = jnp.arange(total, dtype=jnp.int32)[None, :]
+    lens_c = lens.astype(jnp.int32)[:, None]
+    buf = jnp.where(pos == lens_c, jnp.uint8(0x80), jnp.where(pos < lens_c, buf, 0))
+    # 128-bit big-endian bit length at the end of the last block; only the
+    # low 8 bytes can be nonzero for any message < 2^61 bytes.
+    nblocks = (lens_c + 17 + 127) // 128
+    len_off = nblocks * 128 - 8
+    pfe = pos - len_off
+    bitlen = lens_c * 8  # int32: fine for max_len < 2^28 bytes
+    shift = 8 * (7 - pfe)  # true bit offset of this length byte
+    len_byte = ((bitlen >> shift.clip(0, 31)) & 0xFF).astype(jnp.uint8)
+    # length bytes with shift > 31 are the high half of the 64-bit length,
+    # always zero under the max_len < 2^28 limit above
+    len_byte = jnp.where((pfe >= 0) & (pfe < 8) & (shift <= 31), len_byte, 0)
+    buf = jnp.where((pfe >= 0) & (pfe < 8), len_byte, buf)
+    return buf, nblocks[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _sha512_impl(msgs, lens, max_len):
+    b = msgs.shape[0]
+    max_blocks = (max_len + 17 + 127) // 128
+    buf, nblocks = _pad(msgs, lens, max_blocks)
+    # (B, max_blocks, 16, 8 bytes) big-endian words
+    by = buf.reshape(b, max_blocks, 16, 8).astype(jnp.uint32)
+    hi = (by[..., 0] << 24) | (by[..., 1] << 16) | (by[..., 2] << 8) | by[..., 3]
+    lo = (by[..., 4] << 24) | (by[..., 5] << 16) | (by[..., 6] << 8) | by[..., 7]
+
+    sh = jnp.broadcast_to(jnp.asarray(_H_HI), (b, 8))
+    sl = jnp.broadcast_to(jnp.asarray(_H_LO), (b, 8))
+
+    def block_body(state, blk):
+        sh, sl = state
+        nh, nl = _compress_block((sh, sl), hi[:, blk], lo[:, blk])
+        active = (blk < nblocks)[:, None]
+        return (jnp.where(active, nh, sh), jnp.where(active, nl, sl)), None
+
+    (sh, sl), _ = jax.lax.scan(
+        block_body, (sh, sl), jnp.arange(max_blocks, dtype=jnp.int32)
+    )
+    # big-endian serialize
+    out = jnp.zeros((b, 64), dtype=jnp.uint8)
+    for i in range(8):
+        for j, word in ((0, sh), (4, sl)):
+            w = word[:, i]
+            out = out.at[:, 8 * i + j + 0].set((w >> 24).astype(jnp.uint8))
+            out = out.at[:, 8 * i + j + 1].set((w >> 16).astype(jnp.uint8))
+            out = out.at[:, 8 * i + j + 2].set((w >> 8).astype(jnp.uint8))
+            out = out.at[:, 8 * i + j + 3].set(w.astype(jnp.uint8))
+    return out
+
+
+def sha512(msgs, lens):
+    """Batch SHA-512.  msgs: (B, max_len) uint8; lens: (B,) int. -> (B, 64)."""
+    msgs = jnp.asarray(msgs, dtype=jnp.uint8)
+    lens = jnp.asarray(lens, dtype=jnp.int32)
+    return _sha512_impl(msgs, lens, msgs.shape[1])

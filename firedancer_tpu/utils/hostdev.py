@@ -1,0 +1,40 @@
+"""Host device-platform bootstrap.
+
+The deployment environment's sitecustomize registers a single-chip 'axon' TPU
+platform and overrides the JAX_PLATFORMS env var, so getting a multi-device
+virtual CPU mesh (for tests and sharding dry runs) requires pinning the
+platform through jax.config BEFORE any jax backend initialization.  This is
+the single shared implementation; tests/conftest.py and parallel/dryrun.py
+both use it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def ensure_cpu_devices(n: int) -> None:
+    """Pin the CPU platform with >= n virtual devices.
+
+    Must be called before any jax backend initialization (jax.devices(),
+    jit execution, ...); afterwards the platform and device count are frozen
+    and this becomes a best-effort no-op.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; caller's assert will catch it

@@ -1,0 +1,16 @@
+"""Test harness config: force an 8-device virtual CPU mesh before any test
+imports jax.
+
+Multi-chip hardware is not available in CI; all sharding tests run on
+xla_force_host_platform_device_count=8 CPU devices.  Benchmarks (bench.py)
+run outside pytest on the real TPU chip.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from firedancer_tpu.utils.hostdev import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(8)

@@ -1,0 +1,99 @@
+"""Golden ed25519: RFC 8032 known-answer vectors + fd verify-rule edge cases."""
+
+import os
+
+from firedancer_tpu.ops.ed25519 import golden
+
+# RFC 8032 section 7.1 TEST 1 (empty message)
+RFC1_SECRET = bytes.fromhex(
+    "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+)
+RFC1_PUB = bytes.fromhex(
+    "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+)
+RFC1_SIG = bytes.fromhex(
+    "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+    "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+)
+
+# RFC 8032 section 7.1 TEST 2 (1-byte message 0x72)
+RFC2_SECRET = bytes.fromhex(
+    "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+)
+RFC2_PUB = bytes.fromhex(
+    "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+)
+RFC2_SIG = bytes.fromhex(
+    "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+    "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+)
+
+
+def test_rfc8032_keygen():
+    assert golden.public_from_secret(RFC1_SECRET) == RFC1_PUB
+    assert golden.public_from_secret(RFC2_SECRET) == RFC2_PUB
+
+
+def test_rfc8032_sign():
+    assert golden.sign(RFC1_SECRET, b"") == RFC1_SIG
+    assert golden.sign(RFC2_SECRET, b"\x72") == RFC2_SIG
+
+
+def test_rfc8032_verify():
+    assert golden.verify(b"", RFC1_SIG, RFC1_PUB) == golden.ERR_OK
+    assert golden.verify(b"\x72", RFC2_SIG, RFC2_PUB) == golden.ERR_OK
+    # wrong message
+    assert golden.verify(b"x", RFC1_SIG, RFC1_PUB) == golden.ERR_MSG
+    # corrupted sig R
+    bad = bytes([RFC1_SIG[0] ^ 1]) + RFC1_SIG[1:]
+    assert golden.verify(b"", bad, RFC1_PUB) != golden.ERR_OK
+
+
+def test_malleability_rejected():
+    """s' = s + L is a classic malleated sig: must be rejected (s >= L)."""
+    s = int.from_bytes(RFC1_SIG[32:], "little")
+    mall = RFC1_SIG[:32] + int.to_bytes(s + golden.L, 32, "little")
+    assert golden.verify(b"", mall, RFC1_PUB) == golden.ERR_SIG
+
+
+def test_small_order_rejected():
+    """Identity (order 1) and order-2/4/8 torsion points must be rejected."""
+    ident = golden.point_compress(golden.IDENT)
+    assert golden.is_small_order(golden.IDENT)
+    sig = ident + RFC1_SIG[32:]
+    # small-order R
+    assert golden.verify(b"", sig, RFC1_PUB) == golden.ERR_SIG
+    # small-order A
+    assert golden.verify(b"", RFC1_SIG, ident) == golden.ERR_PUBKEY
+    # order-2 point (0, -1)
+    two_tors = golden.point_compress((0, golden.P - 1))
+    assert golden.is_small_order((0, golden.P - 1))
+    assert golden.verify(b"", RFC1_SIG, two_tors) == golden.ERR_PUBKEY
+
+
+def test_sign_verify_roundtrip_random():
+    rng_msgs = [os.urandom(n) for n in (0, 1, 31, 32, 33, 200, 1232)]
+    secret = os.urandom(32)
+    pub = golden.public_from_secret(secret)
+    for m in rng_msgs:
+        sig = golden.sign(secret, m)
+        assert golden.verify(m, sig, pub) == golden.ERR_OK
+
+
+def test_decompress_negative_zero_rejected():
+    """x == 0 with sign bit set must fail decompression."""
+    enc = int.to_bytes(1 | (1 << 255), 32, "little")  # y=1, sign=1 -> x=0
+    assert golden.point_decompress(enc) is None
+
+
+def test_decompress_noncanonical_accepted():
+    """y >= p encodings decompress (dalek 2.x behavior the reference keeps)."""
+    # y = 3 decompresses; y = 3 + p < 2^255 encodes the same point
+    # non-canonically and must also decompress, to the same coordinates.
+    canon = golden.point_decompress(int.to_bytes(3, 32, "little"))
+    assert canon is not None
+    enc = int.to_bytes(3 + golden.P, 32, "little")
+    assert 3 + golden.P < 2**255
+    pt = golden.point_decompress(enc)
+    assert pt is not None
+    assert pt == canon and pt[1] == 3
