@@ -1,0 +1,317 @@
+"""GF(2^255-19) arithmetic on int32 limb vectors, batch-last layout.
+
+The reference implements curve25519 field arithmetic with 64-bit limbs and
+128-bit products (fiat-crypto backend, /root/reference/src/ballet/ed25519/ref/
+fd_f25519.c) or AVX-512 radix-2^43x6 IFMA limbs (avx512/fd_r43x6.h).  Neither
+maps to TPU: the VPU has no widening multiply and no 64-bit datapath.
+
+TPU-native design: radix 2^13, 20 limbs per element, int32 lanes.
+  * 13-bit limbs keep every schoolbook product < 2^26 and a 20-term
+    convolution column < 20 * 2^26.4 < 2^31, so plain int32 multiply-add is
+    exact -- no widening needed.
+  * An element is an array of shape (20, B): limb axis leading, batch axis
+    last so the batch maps onto VPU lanes (8x128) and every field op is a
+    handful of fused (20, B) vector ops.
+  * Representation is redundant ("loose"): limbs may exceed 13 bits and may
+    be negative (subtraction is lazy).  Carried values (mul/carry outputs)
+    have limbs in [-1218, 8801]; add/sub are lazy, and mul re-normalizes its
+    inputs, accepting any lazy chain with |limb| <= 2^17 (i.e. up to ~14
+    stacked additions of carried values) -- see mul's docstring for the
+    overflow analysis.
+  * 2^260 === 608 (mod p) folds conv columns >= 20 back down (608 = 19 << 5).
+
+Only `canonical()` (and the byte conversions built on it) produces the unique
+reduced form; everything else stays loose.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import golden
+
+RADIX = 13
+NLIMB = 20  # 260 bits
+MASK = (1 << RADIX) - 1
+FOLD = 608  # 2^260 mod p  (= 19 * 2^5)
+LOOSE_MAX = 1 << 17  # |limb| bound required at mul/carry input (see mul)
+
+P = golden.P
+D = golden.D
+SQRT_M1 = golden.SQRT_M1
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (python int <-> np limbs) for constants and tests
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (0 <= x < 2^260) -> (NLIMB,) int32 canonical limbs."""
+    assert 0 <= x < 1 << (RADIX * NLIMB)
+    return np.array(
+        [(x >> (RADIX * i)) & MASK for i in range(NLIMB)], dtype=np.int32
+    )
+
+
+def limbs_to_int(l) -> int:
+    """(NLIMB, ...) limbs -> python int (exact, handles loose/negative)."""
+    l = np.asarray(l)
+    assert l.shape[0] == NLIMB
+    flat = l.reshape(NLIMB, -1)
+    out = [
+        sum(int(flat[i, j]) << (RADIX * i) for i in range(NLIMB))
+        for j in range(flat.shape[1])
+    ]
+    return out[0] if len(out) == 1 else out
+
+
+def const(x: int) -> np.ndarray:
+    """Constant field element as (NLIMB, 1) limbs (broadcasts over batch)."""
+    return int_to_limbs(x % P).reshape(NLIMB, 1)
+
+
+ZERO = const(0)
+ONE = const(1)
+D_C = const(D)
+D2_C = const(2 * D)
+SQRT_M1_C = const(SQRT_M1)
+# 32*p = 2^260 - 608: added before canonicalization so loose negative limbs
+# cannot drive the value negative (|value| < 2^260 always holds for loose
+# elements with |limb| <= 2*LOOSE_MAX < 2^15).
+_P32 = int_to_limbs(32 * P).reshape(NLIMB, 1)
+_P_LIMBS = int_to_limbs(P).reshape(NLIMB, 1)
+
+
+# ---------------------------------------------------------------------------
+# Carry plumbing
+# ---------------------------------------------------------------------------
+
+def _pass(x):
+    """One parallel carry pass: x -> same value, limbs closer to 13-bit.
+
+    Returns (limbs, carry_out) where carry_out is the (signed) carry shifted
+    out of the top limb.  Arithmetic >> gives floor semantics, so negative
+    limbs carry correctly.
+    """
+    lo = x & MASK
+    hi = x >> RADIX
+    shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    return lo + shifted, hi[-1]
+
+
+def _carry20(x):
+    """Normalize a (NLIMB, B) loose value: two passes, 2^260-fold carries."""
+    x, co = _pass(x)
+    x = x.at[0].add(co * FOLD)
+    x, co = _pass(x)
+    x = x.at[0].add(co * FOLD)
+    return x
+
+
+def ripple(x):
+    """Exact sequential carry over NLIMB limbs: -> (limbs, carry_out).
+
+    Output limbs are in [0, 2^13); carry_out holds the (signed) overflow,
+    i.e. value == sum(limbs_i 2^13i) + carry_out 2^260.  Shared by field
+    canonicalization and the scalar (mod L) code.
+    """
+    out = []
+    carry = jnp.zeros_like(x[0])
+    for i in range(x.shape[0]):
+        v = x[i] + carry
+        out.append(v & MASK)
+        carry = v >> RADIX
+    return jnp.stack(out, axis=0), carry
+
+
+def _reduce_conv(c):
+    """(2*NLIMB+1, B) convolution columns -> (NLIMB, B) loose limbs."""
+    # Two parallel passes; the two zero pad limbs at the top absorb all
+    # carries, so both carry-outs are identically 0 (bound: columns < 2^31,
+    # so a pass moves at most 18 bits up one limb).
+    c, _ = _pass(c)
+    c, _ = _pass(c)
+    lo, hi = c[:NLIMB], c[NLIMB:]
+    # indices NLIMB..2*NLIMB fold with one (or for the top pad limb, two)
+    # applications of 2^260 === FOLD
+    lo = lo + FOLD * hi[:NLIMB]
+    lo = lo.at[0].add((FOLD * FOLD) * hi[NLIMB])
+    return _carry20(lo)
+
+
+# ---------------------------------------------------------------------------
+# Loose arithmetic
+# ---------------------------------------------------------------------------
+
+def add(a, b):
+    return a + b
+
+
+def sub(a, b):
+    return a - b
+
+
+def neg(a):
+    return -a
+
+
+def carry(a):
+    """Re-normalize a loose element to |limb| <= ~2^13."""
+    return _carry20(a)
+
+
+def mul(a, b):
+    """Field multiply.  Inputs may be lazy add/sub chains, |limb| <= 2^17.
+
+    Bound analysis: _carry20 on |x| <= 2^17 gives pass-1 limbs in
+    [-16, 8207], the 2^260-fold adds |co|*608 <= 9728 to limb 0, pass 2
+    lands in [-2, 8193] and the final fold widens that to [-1218, 8801].
+    The schoolbook convolution then accumulates <= 20 products of such
+    limbs per column: 20 * 8801^2 < 1.55e9 < 2^31 - exact in int32.
+    """
+    a = _carry20(a)
+    b = _carry20(b)
+    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    c = jnp.zeros((2 * NLIMB + 1,) + batch, dtype=jnp.int32)
+    for i in range(NLIMB):
+        c = c.at[i : i + NLIMB].add(a[i] * b)
+    return _reduce_conv(c)
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def mul_small(a, s: int):
+    """Multiply by a small python int, 0 <= s <= 2^13.
+
+    Input may be a lazy chain (|limb| <= 2^17, so the product stays < 2^30);
+    output is loose but within the mul input contract.
+    """
+    assert 0 <= s <= 1 << 13
+    return _carry20(a * jnp.int32(s))
+
+
+def _sqr_n(a, n: int):
+    if n <= 4:
+        for _ in range(n):
+            a = sqr(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, v: sqr(v), a)
+
+
+def pow_p58(z):
+    """z^((p-5)/8) = z^(2^252 - 3): the shared exponentiation chain.
+
+    Same ladder the reference uses for invert/sqrt
+    (/root/reference/src/ballet/ed25519/ref/fd_f25519.c pow22523 pattern,
+    re-derived from the standard ref10 chain).
+    """
+    z2 = sqr(z)  # 2
+    z4 = sqr(z2)  # 4
+    z8 = sqr(z4)  # 8
+    z9 = mul(z8, z)  # 9
+    z11 = mul(z9, z2)  # 11
+    z22 = sqr(z11)  # 22
+    z_5_0 = mul(z22, z9)  # 2^5 - 1
+    z_10_5 = _sqr_n(z_5_0, 5)
+    z_10_0 = mul(z_10_5, z_5_0)  # 2^10 - 1
+    z_20_10 = _sqr_n(z_10_0, 10)
+    z_20_0 = mul(z_20_10, z_10_0)  # 2^20 - 1
+    z_40_20 = _sqr_n(z_20_0, 20)
+    z_40_0 = mul(z_40_20, z_20_0)  # 2^40 - 1
+    z_50_10 = _sqr_n(z_40_0, 10)
+    z_50_0 = mul(z_50_10, z_10_0)  # 2^50 - 1
+    z_100_50 = _sqr_n(z_50_0, 50)
+    z_100_0 = mul(z_100_50, z_50_0)  # 2^100 - 1
+    z_200_100 = _sqr_n(z_100_0, 100)
+    z_200_0 = mul(z_200_100, z_100_0)  # 2^200 - 1
+    z_250_50 = _sqr_n(z_200_0, 50)
+    z_250_0 = mul(z_250_50, z_50_0)  # 2^250 - 1
+    z_252_2 = _sqr_n(z_250_0, 2)  # 2^252 - 4
+    return mul(z_252_2, z)  # 2^252 - 3
+
+
+def invert(z):
+    """z^(p-2) = z^(2^255 - 21): pow_p58 chain extended by 3 squarings."""
+    # p - 2 = 8 * (2^252 - 3) + 3  ->  (z^(2^252-3))^8 * z^3
+    t = _sqr_n(pow_p58(z), 3)
+    return mul(t, mul(sqr(z), z))
+
+
+# ---------------------------------------------------------------------------
+# Canonicalization, comparison, bytes
+# ---------------------------------------------------------------------------
+
+def canonical(a):
+    """Loose -> unique canonical limbs in [0, p), fully carried."""
+    # Normalize first so |value| < 2^248-ish, then make non-negative by
+    # adding 32p = 2^260 - 608.
+    x = _carry20(a) + _P32
+    x, carry_out = ripple(x)
+    # carry_out in [0, 2]: fold 2^260 -> 608 and ripple again (small).
+    x, _ = ripple(x.at[0].add(carry_out * FOLD))
+    # Now 0 <= x < 2^260.  Fold bits >= 255 (limb 19 holds bits 247..259):
+    for _ in range(2):
+        hi = x[NLIMB - 1] >> 8
+        x = x.at[NLIMB - 1].set(x[NLIMB - 1] & 0xFF)
+        x, _ = ripple(x.at[0].add(hi * 19))
+    # 0 <= x < 2^255: subtract p once if x >= p.
+    d, borrow = ripple(x - _P_LIMBS)
+    ge_p = borrow >= 0  # no net borrow out of the top
+    return jnp.where(ge_p[None], d, x)
+
+
+def eq(a, b):
+    """Exact field equality of two loose elements -> (B,) bool."""
+    return jnp.all(canonical(a) == canonical(b), axis=0)
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=0)
+
+
+def parity(a):
+    """Canonical low bit ("sign" bit of x in RFC 8032) -> (B,) int32 0/1."""
+    return canonical(a)[0] & 1
+
+
+def from_bytes(b):
+    """(B, 32) uint8 little-endian -> (NLIMB, B) limbs of the 255-bit value.
+
+    Bit 255 (the compression sign bit) is INCLUDED if set; callers mask it.
+    Result is canonical-shaped (13-bit limbs) but may be >= p (non-canonical
+    encodings are accepted, matching the reference).
+    """
+    b = b.astype(jnp.int32)
+    padded = jnp.concatenate(
+        [b, jnp.zeros(b.shape[:-1] + (2,), jnp.int32)], axis=-1
+    )
+    limbs = []
+    for k in range(NLIMB):
+        o = RADIX * k
+        byte0, shift = o >> 3, o & 7
+        window = (
+            padded[..., byte0]
+            | (padded[..., byte0 + 1] << 8)
+            | (padded[..., byte0 + 2] << 16)
+        )
+        limbs.append((window >> shift) & MASK)
+    return jnp.stack(limbs, axis=0)
+
+
+def to_bytes(a):
+    """Loose element -> canonical (B, 32) uint8 little-endian."""
+    x = canonical(a)
+    padded = jnp.concatenate([x, jnp.zeros_like(x[:1])], axis=0)
+    out = []
+    for j in range(32):
+        o = 8 * j
+        l0, sh = o // RADIX, o % RADIX
+        window = padded[l0] + (padded[l0 + 1] << RADIX)
+        out.append(((window >> sh) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)

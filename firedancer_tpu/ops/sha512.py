@@ -239,7 +239,15 @@ def _sha512_impl(msgs, lens, max_len):
 
 
 def sha512(msgs, lens):
-    """Batch SHA-512.  msgs: (B, max_len) uint8; lens: (B,) int. -> (B, 64)."""
+    """Batch SHA-512.  msgs: (B, max_len) uint8; lens: (B,) int. -> (B, 64).
+
+    Precondition: 0 <= lens[j] <= max_len for every lane (lanes violating it
+    get a well-formed but WRONG digest — the padding terminator would land
+    outside the buffer).  max_len must stay below 2^28 so the 128-bit length
+    field fits the int32 shift trick in _pad.
+    """
     msgs = jnp.asarray(msgs, dtype=jnp.uint8)
     lens = jnp.asarray(lens, dtype=jnp.int32)
+    if msgs.shape[1] >= 1 << 28:
+        raise ValueError(f"max_len {msgs.shape[1]} >= 2^28 unsupported")
     return _sha512_impl(msgs, lens, msgs.shape[1])

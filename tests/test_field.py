@@ -1,0 +1,105 @@
+"""GF(2^255-19) limb arithmetic vs exact python-int arithmetic."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from firedancer_tpu.ops.ed25519 import field as F
+from firedancer_tpu.ops.ed25519.golden import P, SQRT_M1
+
+
+def _rand_elems(rng, n):
+    """Random canonical ints incl. adversarial values near 0 and p."""
+    special = [0, 1, 2, P - 1, P - 2, (P - 1) // 2, SQRT_M1, P - 19]
+    vals = [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % P
+            for _ in range(n - len(special))]
+    return special + vals
+
+
+def _to_dev(vals):
+    return jnp.stack([jnp.asarray(F.int_to_limbs(v)) for v in vals], axis=-1)
+
+
+def test_roundtrip_int_limbs():
+    rng = np.random.default_rng(1)
+    vals = _rand_elems(rng, 32)
+    a = _to_dev(vals)
+    assert F.limbs_to_int(np.asarray(a)) == vals
+
+
+def test_add_sub_mul_vs_int():
+    rng = np.random.default_rng(2)
+    va = _rand_elems(rng, 64)
+    vb = list(reversed(_rand_elems(rng, 64)))
+    a, b = _to_dev(va), _to_dev(vb)
+    got_add = np.asarray(F.canonical(F.add(a, b)))
+    got_sub = np.asarray(F.canonical(F.sub(a, b)))
+    got_mul = np.asarray(F.canonical(F.mul(a, b)))
+    got_sqr = np.asarray(F.canonical(F.sqr(a)))
+    for j, (x, y) in enumerate(zip(va, vb)):
+        assert F.limbs_to_int(got_add[:, j]) == (x + y) % P
+        assert F.limbs_to_int(got_sub[:, j]) == (x - y) % P
+        assert F.limbs_to_int(got_mul[:, j]) == (x * y) % P
+        assert F.limbs_to_int(got_sqr[:, j]) == (x * x) % P
+
+
+def test_lazy_chains_stay_exact():
+    """add/sub results fed straight into mul (the point-formula pattern)."""
+    rng = np.random.default_rng(3)
+    va = _rand_elems(rng, 32)
+    vb = list(reversed(_rand_elems(rng, 32)))
+    a, b = _to_dev(va), _to_dev(vb)
+    # (a - b) * (a + b) == a^2 - b^2
+    lhs = F.mul(F.sub(a, b), F.add(a, b))
+    rhs = F.sub(F.sqr(a), F.sqr(b))
+    assert bool(np.asarray(F.eq(lhs, rhs)).all())
+    # deeper lazy chain: ((a+b) + (a-b)) * b == 2ab
+    lhs2 = F.mul(F.add(F.add(a, b), F.sub(a, b)), b)
+    rhs2 = F.mul(F.mul_small(a, 2), b)
+    assert bool(np.asarray(F.eq(lhs2, rhs2)).all())
+
+
+def test_invert_and_pow_p58():
+    rng = np.random.default_rng(4)
+    vals = [v for v in _rand_elems(rng, 24) if v != 0]
+    a = _to_dev(vals)
+    inv = np.asarray(F.canonical(F.invert(a)))
+    p58 = np.asarray(F.canonical(F.pow_p58(a)))
+    for j, v in enumerate(vals):
+        assert F.limbs_to_int(inv[:, j]) == pow(v, P - 2, P)
+        assert F.limbs_to_int(p58[:, j]) == pow(v, (P - 5) // 8, P)
+
+
+def test_bytes_roundtrip_and_noncanonical():
+    rng = np.random.default_rng(5)
+    vals = _rand_elems(rng, 32)
+    raw = np.stack(
+        [np.frombuffer(int(v).to_bytes(32, "little"), np.uint8) for v in vals]
+    )
+    limbs = F.from_bytes(jnp.asarray(raw))
+    for j, v in enumerate(vals):
+        assert F.limbs_to_int(np.asarray(limbs)[:, j]) == v
+    back = np.asarray(F.to_bytes(limbs))
+    assert (back == raw).all()
+    # non-canonical encodings (value in [p, 2^255)) reduce mod p
+    vals_nc = [P, P + 1, P + 18, 2**255 - 1]
+    raw_nc = np.stack(
+        [np.frombuffer(int(v).to_bytes(32, "little"), np.uint8) for v in vals_nc]
+    )
+    limbs_nc = F.from_bytes(jnp.asarray(raw_nc))
+    canon = np.asarray(F.canonical(limbs_nc))
+    for j, v in enumerate(vals_nc):
+        assert F.limbs_to_int(canon[:, j]) == v % P
+
+
+def test_parity_eq_zero():
+    vals = [0, 1, 2, P - 1, 5]
+    a = _to_dev(vals)
+    assert list(np.asarray(F.parity(a))) == [v % 2 for v in vals]
+    assert list(np.asarray(F.is_zero(a))) == [v == 0 for v in vals]
+    assert bool(np.asarray(F.eq(a, a)).all())
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

@@ -1,0 +1,119 @@
+"""Group ops vs the golden oracle: decompress parity fuzz, add/double,
+small-order detection, double-scalar-mul."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from firedancer_tpu.ops.ed25519 import golden
+from firedancer_tpu.ops.ed25519 import point as PT
+from firedancer_tpu.ops.ed25519.golden import B, L, P
+
+
+def _enc(pt) -> np.ndarray:
+    return np.frombuffer(golden.point_compress(pt), np.uint8)
+
+
+def _rand_points(rng, n):
+    return [
+        golden.scalar_mul(int(rng.integers(1, 2**62)) * 2**62 % L or 1, B)
+        for _ in range(n)
+    ]
+
+
+def _torsion_points():
+    """Nontrivial small-order points, derived (not hardcoded) via the oracle."""
+    pts = [golden.IDENT, (0, P - 1)]  # order 1, 2
+    y = 2
+    while len(pts) < 6:
+        cand = golden.point_decompress(int(y).to_bytes(32, "little"))
+        if cand is not None:
+            t = golden.scalar_mul(L, cand)
+            if t != golden.IDENT and t not in pts:
+                pts.append(t)
+                pts.append(golden.point_neg(t))
+        y += 1
+    return pts
+
+
+def test_decompress_fuzz_vs_golden():
+    rng = np.random.default_rng(11)
+    cases = [_enc(p) for p in _rand_points(rng, 12)]
+    # random strings (mostly invalid), non-canonical y >= p, sign-flipped
+    cases += [rng.integers(0, 256, 32, dtype=np.uint8) for _ in range(24)]
+    for j in range(20):
+        cases.append(np.frombuffer(int(P + j).to_bytes(32, "little"), np.uint8))
+    for j in range(4):  # negative-zero style encodings
+        v = [0, 1, P, 2**255 + 1][j]
+        cases.append(np.frombuffer(int(v).to_bytes(32, "little"), np.uint8))
+    raw = np.stack(cases)
+    pts, ok = PT.decompress(jnp.asarray(raw))
+    ok = np.asarray(ok)
+    comp = np.asarray(PT.compress(pts))
+    for j in range(raw.shape[0]):
+        ref = golden.point_decompress(raw[j].tobytes())
+        assert bool(ok[j]) == (ref is not None), f"lane {j}: ok mismatch"
+        if ref is not None:
+            assert comp[j].tobytes() == golden.point_compress(ref), f"lane {j}"
+
+
+def test_add_double_vs_golden():
+    rng = np.random.default_rng(12)
+    ps = _rand_points(rng, 8) + _torsion_points()[:4]
+    qs = list(reversed(_rand_points(rng, 8) + _torsion_points()[2:6]))
+    p_dev, okp = PT.decompress(jnp.asarray(np.stack([_enc(p) for p in ps])))
+    q_dev, okq = PT.decompress(jnp.asarray(np.stack([_enc(q) for q in qs])))
+    assert bool(np.asarray(okp).all()) and bool(np.asarray(okq).all())
+    got_add = np.asarray(PT.compress(PT.add(p_dev, q_dev)))
+    got_dbl = np.asarray(PT.compress(PT.double(p_dev)))
+    for j, (p, q) in enumerate(zip(ps, qs)):
+        assert got_add[j].tobytes() == golden.point_compress(
+            golden.point_add(p, q)
+        ), f"add lane {j}"
+        assert got_dbl[j].tobytes() == golden.point_compress(
+            golden.point_add(p, p)
+        ), f"dbl lane {j}"
+
+
+def test_small_order():
+    rng = np.random.default_rng(13)
+    tors = _torsion_points()
+    regular = _rand_points(rng, 6)
+    raw = np.stack([_enc(p) for p in tors + regular])
+    pts, ok = PT.decompress(jnp.asarray(raw))
+    assert bool(np.asarray(ok).all())
+    got = list(np.asarray(PT.is_small_order(pts)))
+    assert got == [True] * len(tors) + [False] * len(regular)
+
+
+def test_double_scalar_mul_vs_golden():
+    rng = np.random.default_rng(14)
+    n = 8
+    a_pts = _rand_points(rng, n)
+    ks = [int.from_bytes(rng.bytes(32), "little") % L for k in range(n)]
+    ss = [int.from_bytes(rng.bytes(32), "little") % L for k in range(n)]
+    ks[0], ss[0] = 0, 0  # identity edges
+    ks[1], ss[1] = 1, 0
+    a_dev, ok = PT.decompress(jnp.asarray(np.stack([_enc(p) for p in a_pts])))
+    assert bool(np.asarray(ok).all())
+
+    def nib(vals):
+        return jnp.asarray(
+            np.stack(
+                [[(v >> (4 * d)) & 15 for v in vals] for d in range(64)]
+            ).astype(np.int32)
+        )
+
+    acc = PT.double_scalar_mul(nib(ks), PT.build_neg_table(a_dev), nib(ss))
+    got = np.asarray(PT.compress(acc))
+    for j in range(n):
+        ref = golden.point_add(
+            golden.scalar_mul(ks[j], golden.point_neg(a_pts[j])),
+            golden.scalar_mul(ss[j], B),
+        )
+        assert got[j].tobytes() == golden.point_compress(ref), f"lane {j}"
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

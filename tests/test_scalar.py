@@ -1,0 +1,49 @@
+"""Scalar (mod L) arithmetic vs exact python ints."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from firedancer_tpu.ops.ed25519 import field as F
+from firedancer_tpu.ops.ed25519 import scalar as SC
+from firedancer_tpu.ops.ed25519.golden import L
+
+
+def test_is_canonical():
+    vals = [0, 1, L - 1, L, L + 1, 2**256 - 1, 2**252, L + 2**200]
+    raw = np.stack(
+        [np.frombuffer(int(v).to_bytes(32, "little"), np.uint8) for v in vals]
+    )
+    got = np.asarray(SC.is_canonical(SC.from_bytes(jnp.asarray(raw))))
+    assert list(got) == [v < L for v in vals]
+
+
+def test_reduce512_vs_int():
+    rng = np.random.default_rng(7)
+    vals = [0, 1, L, L - 1, 2**512 - 1, 2**252, (L - 1) * L] + [
+        int.from_bytes(rng.bytes(64), "little") for _ in range(29)
+    ]
+    raw = np.stack(
+        [np.frombuffer(int(v).to_bytes(64, "little"), np.uint8) for v in vals]
+    )
+    got = np.asarray(SC.reduce512(jnp.asarray(raw)))
+    for j, v in enumerate(vals):
+        assert F.limbs_to_int(got[:, j]) == v % L, f"lane {j}"
+
+
+def test_to_nibbles():
+    rng = np.random.default_rng(8)
+    vals = [int.from_bytes(rng.bytes(32), "little") for _ in range(8)]
+    raw = np.stack(
+        [np.frombuffer(int(v).to_bytes(32, "little"), np.uint8) for v in vals]
+    )
+    nib = np.asarray(SC.to_nibbles(SC.from_bytes(jnp.asarray(raw))))
+    assert nib.shape == (64, 8)
+    for j, v in enumerate(vals):
+        for d in range(64):
+            assert nib[d, j] == (v >> (4 * d)) & 15
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
