@@ -1,0 +1,98 @@
+"""Proof-of-History hash chain ops.
+
+Behavior contract: fd_poh_append / fd_poh_mixin
+(/root/reference/src/ballet/poh/fd_poh.c — iterated SHA-256 over a 32-byte
+state; mixin is SHA-256(state || mixin_32B)).
+
+PoH is inherently sequential (that is the point of the primitive), so a
+single chain cannot be data-parallelized.  The TPU-native angles:
+
+  * `append_n`: lax.scan of the single-compression fixed-32B SHA-256 path —
+    one compression per tick, all in registers/VMEM, no host round-trips for
+    an entire slot's worth of hashes in one dispatch.
+  * batch axis: many INDEPENDENT chains (e.g. verifying the PoH stream of a
+    whole block's entries, one lane per entry segment) run as lanes.
+    `verify_entries` below implements exactly that: given per-entry start
+    states, hash counts and mixins, validate every entry of a slot in
+    parallel — the replay-side PoH verification, which is the throughput-
+    critical direction (validators verify far more PoH than they generate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import sha256 as S
+
+
+def append_n(state32, n):
+    """Iterate state = SHA-256(state) n times (n static or traced scalar).
+
+    state32: (..., 32) uint8.  Returns (..., 32) uint8.
+    """
+    w = S.words_from_bytes(state32)
+
+    def body(_, w):
+        return S.sha256_words32(w)
+
+    w = jax.lax.fori_loop(0, n, body, w)
+    return S.bytes_from_words(w)
+
+
+def mixin(state32, mix32):
+    """state = SHA-256(state || mix): record an event into the chain."""
+    w = jnp.concatenate(
+        [S.words_from_bytes(state32), S.words_from_bytes(mix32)], axis=-1
+    )
+    return S.bytes_from_words(S.sha256_words64(w))
+
+
+@functools.partial(jax.jit, static_argnames=("max_hashcnt",))
+def _verify_entries_impl(start_states, hashcnts, mixins, has_mixin, max_hashcnt):
+    """Batch-verify PoH entries: one lane per entry.
+
+    start_states: (B, 32) uint8 — state before each entry
+    hashcnts:     (B,) int32    — ticks in the entry (>= 1)
+    mixins:       (B, 32) uint8 — entry mixin hash (ignored if not has_mixin)
+    has_mixin:    (B,) bool     — tick-only entries hash to the plain chain
+    max_hashcnt:  static upper bound on hashcnts
+
+    Returns (B, 32) uint8: the resulting end state per entry.  The caller
+    checks end_state[i] == start_state[i+1] chain linkage on host (a cheap
+    O(B) memcmp) — splitting it this way keeps the device step shape-static.
+
+    For a mixin entry the final hash is SHA-256(state || mixin) after
+    hashcnt-1 plain appends; a tick entry is hashcnt plain appends
+    (fd_poh semantics: the mixin consumes one hashcnt).
+    """
+    w = S.words_from_bytes(start_states)
+    plain_n = jnp.where(has_mixin, hashcnts - 1, hashcnts)
+
+    def body(i, w):
+        nw = S.sha256_words32(w)
+        return jnp.where((i < plain_n)[:, None], nw, w)
+
+    w = jax.lax.fori_loop(0, max_hashcnt, body, w)
+    mixed = S.sha256_words64(
+        jnp.concatenate([w, S.words_from_bytes(mixins)], axis=-1)
+    )
+    w = jnp.where(has_mixin[:, None], mixed, w)
+    return S.bytes_from_words(w)
+
+
+def verify_entries(start_states, hashcnts, mixins, has_mixin, max_hashcnt):
+    """See _verify_entries_impl; validates the hashcnt bound when concrete."""
+    import numpy as np
+
+    if not isinstance(hashcnts, jax.core.Tracer):
+        hc = np.asarray(hashcnts)
+        if hc.size and int(hc.max()) > max_hashcnt:
+            raise ValueError(
+                f"hashcnt {int(hc.max())} exceeds max_hashcnt {max_hashcnt}"
+            )
+    return _verify_entries_impl(
+        start_states, hashcnts, mixins, has_mixin, max_hashcnt
+    )

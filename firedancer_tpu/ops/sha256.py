@@ -1,0 +1,202 @@
+"""Batched SHA-256 for TPU, pure JAX over uint32 lanes.
+
+The reference computes SHA-256 with SHA-NI assembly plus a batch AVX API
+(behavior contract: /root/reference/src/ballet/sha256/fd_sha256.h).  SHA-256
+words are 32-bit, which maps directly onto TPU VPU lanes: one hash per lane,
+the batch axis is the vector axis.
+
+Entry points:
+  sha256(msgs, lens)        -> (B, 32) uint8 digests (variable length, padded)
+  sha256_fixed(words)       -> single-block fast path for exactly-32/64-byte
+                               inputs already packed as big-endian uint32 —
+                               the PoH/merkle building block (see poh.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+from .sha512 import _primes
+
+
+def _frac_root_bits(p: int, e: int) -> int:
+    # floor(frac(p^(1/e)) * 2^32) via integer nth-root of p << (32*e)
+    n = p << (32 * e)
+    x = 1 << ((n.bit_length() + e - 1) // e + 1)
+    while True:
+        y = ((e - 1) * x + n // x ** (e - 1)) // e
+        if y >= x:
+            break
+        x = y
+    return x & 0xFFFFFFFF
+
+
+_PS = _primes(64)
+_K32 = np.array([_frac_root_bits(p, 3) for p in _PS], dtype=np.uint32)
+_H32 = np.array([_frac_root_bits(p, 2) for p in _PS[:8]], dtype=np.uint32)
+assert _K32[0] == 0x428A2F98 and _H32[0] == 0x6A09E667
+
+
+def _ror(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_block(state, w):
+    """One SHA-256 compression.  state: (..., 8) uint32; w: (..., 16)."""
+    k = jnp.asarray(_K32)
+
+    def round_body(carry, t):
+        s, win = carry
+
+        def sched(_):
+            s0 = _ror(win[..., 1], 7) ^ _ror(win[..., 1], 18) ^ (win[..., 1] >> 3)
+            s1 = (
+                _ror(win[..., 14], 17)
+                ^ _ror(win[..., 14], 19)
+                ^ (win[..., 14] >> 10)
+            )
+            return win[..., 0] + s0 + win[..., 9] + s1
+
+        wt = jax.lax.cond(t < 16, lambda _: win[..., 0], sched, None)
+        win2 = jnp.concatenate([win[..., 1:], wt[..., None]], axis=-1)
+
+        a, b, c, d = s[..., 0], s[..., 1], s[..., 2], s[..., 3]
+        e, f, g, h = s[..., 4], s[..., 5], s[..., 6], s[..., 7]
+        s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k[t] + wt
+        s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        s2 = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g], axis=-1)
+        return (s2, win2), None
+
+    (final, _), _ = jax.lax.scan(
+        round_body, (state, w), jnp.arange(64, dtype=jnp.int32)
+    )
+    return state + final
+
+
+def _pad(msgs, lens, max_blocks):
+    """Padded message buffer (B, max_blocks*64) uint8 + per-lane block count."""
+    b = msgs.shape[0]
+    total = max_blocks * 64
+    buf = jnp.zeros((b, total), dtype=jnp.uint8)
+    buf = buf.at[:, : msgs.shape[1]].set(msgs)
+    pos = jnp.arange(total, dtype=jnp.int32)[None, :]
+    lens_c = lens.astype(jnp.int32)[:, None]
+    buf = jnp.where(pos == lens_c, jnp.uint8(0x80), jnp.where(pos < lens_c, buf, 0))
+    nblocks = (lens_c + 9 + 63) // 64
+    len_off = nblocks * 64 - 8
+    pfe = pos - len_off
+    bitlen = lens_c * 8  # < 2^31 for max_len < 2^28
+    shift = 8 * (7 - pfe)
+    len_byte = ((bitlen >> shift.clip(0, 31)) & 0xFF).astype(jnp.uint8)
+    len_byte = jnp.where((pfe >= 0) & (pfe < 8) & (shift <= 31), len_byte, 0)
+    buf = jnp.where((pfe >= 0) & (pfe < 8), len_byte, buf)
+    return buf, nblocks[:, 0]
+
+
+def _words_be(buf):
+    """(..., 4k) uint8 -> (..., k) big-endian uint32."""
+    by = buf.reshape(buf.shape[:-1] + (buf.shape[-1] // 4, 4)).astype(jnp.uint32)
+    return (by[..., 0] << 24) | (by[..., 1] << 16) | (by[..., 2] << 8) | by[..., 3]
+
+
+def _bytes_be(words):
+    """(..., k) uint32 -> (..., 4k) uint8 big-endian."""
+    out = jnp.stack(
+        [
+            (words >> 24).astype(jnp.uint8),
+            (words >> 16).astype(jnp.uint8),
+            (words >> 8).astype(jnp.uint8),
+            words.astype(jnp.uint8),
+        ],
+        axis=-1,
+    )
+    return out.reshape(words.shape[:-1] + (4 * words.shape[-1],))
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _sha256_impl(msgs, lens, max_len):
+    b = msgs.shape[0]
+    max_blocks = (max_len + 9 + 63) // 64
+    buf, nblocks = _pad(msgs, lens, max_blocks)
+    w = _words_be(buf).reshape(b, max_blocks, 16)
+    state = jnp.broadcast_to(jnp.asarray(_H32), (b, 8))
+
+    def block_body(state, blk):
+        ns = _compress_block(state, w[:, blk])
+        active = (blk < nblocks)[:, None]
+        return jnp.where(active, ns, state), None
+
+    state, _ = jax.lax.scan(
+        block_body, state, jnp.arange(max_blocks, dtype=jnp.int32)
+    )
+    return _bytes_be(state)
+
+
+def sha256(msgs, lens):
+    """Batch SHA-256.  msgs: (B, max_len) uint8; lens: (B,). -> (B, 32) uint8.
+
+    Same contract as sha512.sha512: lens[j] <= max_len < 2^28 per lane.
+    """
+    msgs = jnp.asarray(msgs, dtype=jnp.uint8)
+    lens = jnp.asarray(lens, dtype=jnp.int32)
+    if msgs.shape[1] >= 1 << 28:
+        raise ValueError(f"max_len {msgs.shape[1]} >= 2^28 unsupported")
+    return _sha256_impl(msgs, lens, msgs.shape[1])
+
+
+# ---------------------------------------------------------------------------
+# Fixed single/double-block word-level paths (PoH / merkle building blocks)
+# ---------------------------------------------------------------------------
+
+_INIT_WORDS = _H32
+
+# Precomputed padding block words for a 32-byte and 64-byte message.
+_PAD32 = np.zeros(8, dtype=np.uint32)  # appended to 8 msg words -> 1 block
+_PAD32[0] = 0x80000000
+_PAD32[7] = 32 * 8
+_PAD64 = np.zeros(16, dtype=np.uint32)  # standalone second block
+_PAD64[0] = 0x80000000
+_PAD64[15] = 64 * 8
+
+
+def sha256_words32(w8):
+    """SHA-256 of exactly-32-byte messages given as (..., 8) BE uint32 words.
+
+    Single compression (message + padding fit one block).  Returns (..., 8)
+    BE uint32 digest words.  This is the PoH `append` primitive.
+    """
+    pad = jnp.broadcast_to(jnp.asarray(_PAD32), w8.shape)
+    block = jnp.concatenate([w8, pad], axis=-1)
+    state = jnp.broadcast_to(jnp.asarray(_INIT_WORDS), w8.shape)
+    return _compress_block(state, block)
+
+
+def sha256_words64(w16):
+    """SHA-256 of exactly-64-byte messages as (..., 16) BE uint32 words.
+
+    Two compressions (32-byte padding tail).  This is the PoH `mixin` and
+    merkle inner-node primitive (modulo domain-separation prefixes).
+    """
+    state = jnp.broadcast_to(
+        jnp.asarray(_INIT_WORDS), w16.shape[:-1] + (8,)
+    )
+    state = _compress_block(state, w16)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD64), w16.shape)
+    return _compress_block(state, pad)
+
+
+def words_from_bytes(b):
+    return _words_be(jnp.asarray(b, jnp.uint8))
+
+
+def bytes_from_words(w):
+    return _bytes_be(w)

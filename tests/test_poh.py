@@ -1,0 +1,56 @@
+"""PoH chain ops vs a hashlib oracle."""
+
+import hashlib
+
+import jax
+import numpy as np
+
+from firedancer_tpu.ops import poh
+
+
+def _append_ref(state: bytes, n: int) -> bytes:
+    for _ in range(n):
+        state = hashlib.sha256(state).digest()
+    return state
+
+
+def _mixin_ref(state: bytes, mix: bytes) -> bytes:
+    return hashlib.sha256(state + mix).digest()
+
+
+def test_append_n():
+    rng = np.random.default_rng(0)
+    state = rng.integers(0, 256, size=(1, 32), dtype=np.uint8)
+    out = np.asarray(jax.jit(lambda s: poh.append_n(s, 17))(state))
+    assert out[0].tobytes() == _append_ref(state[0].tobytes(), 17)
+
+
+def test_mixin():
+    rng = np.random.default_rng(1)
+    state = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    mix = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    out = np.asarray(poh.mixin(state, mix))
+    for i in range(4):
+        assert out[i].tobytes() == _mixin_ref(
+            state[i].tobytes(), mix[i].tobytes()
+        )
+
+
+def test_verify_entries():
+    rng = np.random.default_rng(2)
+    b = 16
+    starts = rng.integers(0, 256, size=(b, 32), dtype=np.uint8)
+    hashcnts = rng.integers(1, 12, size=b).astype(np.int32)
+    mixins = rng.integers(0, 256, size=(b, 32), dtype=np.uint8)
+    has_mixin = rng.integers(0, 2, size=b).astype(bool)
+    out = np.asarray(
+        poh.verify_entries(starts, hashcnts, mixins, has_mixin, 12)
+    )
+    for i in range(b):
+        st = _append_ref(
+            starts[i].tobytes(),
+            int(hashcnts[i]) - (1 if has_mixin[i] else 0),
+        )
+        if has_mixin[i]:
+            st = _mixin_ref(st, mixins[i].tobytes())
+        assert out[i].tobytes() == st, f"lane {i}"

@@ -1,0 +1,58 @@
+"""JAX batch SHA-256 + fixed-block paths vs hashlib."""
+
+import hashlib
+
+import numpy as np
+
+from firedancer_tpu.ops import sha256 as fsha
+
+
+def _ref(msg: bytes) -> bytes:
+    return hashlib.sha256(msg).digest()
+
+
+def test_sha256_lengths():
+    # cover the 55/56/63/64 padding boundaries and beyond
+    lens = [0, 1, 3, 31, 32, 54, 55, 56, 63, 64, 65, 100, 119, 120, 127, 128,
+            129, 200, 300]
+    max_len = max(lens)
+    msgs = np.zeros((len(lens), max_len), dtype=np.uint8)
+    raw = []
+    rng = np.random.default_rng(99)
+    for i, n in enumerate(lens):
+        m = rng.integers(0, 256, size=n, dtype=np.uint8)
+        msgs[i, :n] = m
+        raw.append(m.tobytes())
+    out = np.asarray(fsha.sha256(msgs, np.array(lens)))
+    for i, m in enumerate(raw):
+        assert out[i].tobytes() == _ref(m), f"len {lens[i]}"
+
+
+def test_sha256_batch_random():
+    rng = np.random.default_rng(5)
+    b, max_len = 32, 1232  # txn MTU class
+    lens = rng.integers(0, max_len + 1, size=b)
+    msgs = rng.integers(0, 256, size=(b, max_len), dtype=np.uint8)
+    out = np.asarray(fsha.sha256(msgs, lens))
+    for i in range(b):
+        assert out[i].tobytes() == _ref(msgs[i, : lens[i]].tobytes())
+
+
+def test_sha256_words32():
+    rng = np.random.default_rng(11)
+    msgs = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+    out = np.asarray(
+        fsha.bytes_from_words(fsha.sha256_words32(fsha.words_from_bytes(msgs)))
+    )
+    for i in range(8):
+        assert out[i].tobytes() == _ref(msgs[i].tobytes())
+
+
+def test_sha256_words64():
+    rng = np.random.default_rng(12)
+    msgs = rng.integers(0, 256, size=(8, 64), dtype=np.uint8)
+    out = np.asarray(
+        fsha.bytes_from_words(fsha.sha256_words64(fsha.words_from_bytes(msgs)))
+    )
+    for i in range(8):
+        assert out[i].tobytes() == _ref(msgs[i].tobytes())

@@ -1,0 +1,123 @@
+"""Txn wire-format parser: round-trip + validation-rule rejection tests."""
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn
+
+
+def _mk(n_sig=1, n_acct=3, n_instr=1, version=txn.VLEGACY, ro_signed=0,
+        ro_unsigned=1, luts=(), data=b"\x01\x02\x03"):
+    rng = np.random.default_rng(n_sig * 1000 + n_acct * 100 + n_instr)
+    sigs = [rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+            for _ in range(n_sig)]
+    accts = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+             for _ in range(n_acct)]
+    bh = rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+    instrs = [(n_acct - 1, [0, 1], data) for _ in range(n_instr)]
+    return txn.build(sigs, accts, bh, instrs, ro_signed, ro_unsigned,
+                     version, luts)
+
+
+def test_roundtrip_legacy():
+    p = _mk(n_sig=2, n_acct=5, n_instr=3, ro_signed=1, ro_unsigned=2)
+    d = txn.parse(p)
+    assert d is not None
+    assert d.transaction_version == txn.VLEGACY
+    assert d.signature_cnt == 2
+    assert d.acct_addr_cnt == 5
+    assert d.instr_cnt == 3
+    assert d.readonly_signed_cnt == 1
+    assert d.readonly_unsigned_cnt == 2
+    assert len(d.signatures(p)) == 2
+    assert len(d.message(p)) == len(p) - d.message_off
+    # fee payer writable; signer 1 readonly; unsigned: 5-2=3 boundary
+    assert d.is_writable(0) and not d.is_writable(1)
+    assert d.is_writable(2)
+    assert not d.is_writable(3) and not d.is_writable(4)
+    assert d.writable_idxs() == [0, 2]
+
+
+def test_roundtrip_v0_with_luts():
+    rng = np.random.default_rng(0)
+    lut_addr = rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+    p = _mk(version=txn.V0, luts=[(lut_addr, [0, 5], [7])])
+    d = txn.parse(p)
+    assert d is not None
+    assert d.transaction_version == txn.V0
+    assert d.addr_table_lookup_cnt == 1
+    assert d.addr_table_adtl_writable_cnt == 2
+    assert d.addr_table_adtl_cnt == 3
+    assert d.total_acct_cnt == 6
+    lut = d.address_tables[0]
+    assert p[lut.addr_off:lut.addr_off + 32] == lut_addr
+    assert list(p[lut.writable_off:lut.writable_off + 2]) == [0, 5]
+
+
+def test_reject_cases():
+    good = _mk()
+    assert txn.parse(good) is not None
+    # trailing byte
+    assert txn.parse(good + b"\x00") is None
+    # truncations at every length
+    for cut in range(1, len(good)):
+        assert txn.parse(good[:cut]) is None, f"cut {cut} accepted"
+    # zero signatures
+    bad = bytes([0]) + good[1:]
+    assert txn.parse(bad) is None
+    # oversize payload
+    assert txn.parse(b"\x01" + b"\x00" * txn.MTU) is None
+    # header sig count mismatch (legacy)
+    d = txn.parse(good)
+    b = bytearray(good)
+    b[d.message_off] = 2
+    assert txn.parse(bytes(b)) is None
+    # readonly_signed >= signature_cnt
+    b = bytearray(good)
+    b[d.message_off + 1] = 1  # ro_signed == sig_cnt == 1
+    assert txn.parse(bytes(b)) is None
+    # program id == 0 (fee payer as program); instr layout is
+    # [program_id(1B), cu16 acct_cnt(1B here), accts...], so the pid byte
+    # sits 2 before acct_off
+    p0 = _mk(data=b"")
+    d0 = txn.parse(p0)
+    assert p0[d0.instr[0].acct_off - 2] == d0.instr[0].program_id
+    b = bytearray(p0)
+    b[d0.instr[0].acct_off - 2] = 0
+    assert txn.parse(bytes(b)) is None
+
+
+def test_reject_nonminimal_cu16():
+    # craft: acct_addr_cnt encoded as 2-byte 0x83 0x00 (non-minimal for 3)
+    good = _mk()
+    d = txn.parse(good)
+    off = d.message_off + 3  # legacy: header is 3 bytes, then cu16 acct cnt
+    assert good[off] == 3
+    bad = good[:off] + bytes([0x83, 0x00]) + good[off + 1:]
+    assert txn.parse(bad) is None
+
+
+def test_instr_acct_idx_out_of_range():
+    rng = np.random.default_rng(1)
+    sigs = [rng.integers(0, 256, 64, dtype=np.uint8).tobytes()]
+    accts = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+             for _ in range(3)]
+    bh = bytes(32)
+    p = txn.build(sigs, accts, bh, [(2, [0, 7], b"")], 0, 1)
+    assert txn.parse(p) is None  # acct idx 7 >= 3 accounts
+    p = txn.build(sigs, accts, bh, [(2, [0, 2], b"")], 0, 1)
+    assert txn.parse(p) is not None
+
+
+def test_extract_sigverify_batch():
+    payloads = [_mk(n_sig=2, n_acct=4), _mk(n_sig=1, n_acct=3)]
+    descs = [txn.parse(p) for p in payloads]
+    msgs, lens, sigs, pubs, idxs = txn.extract_sigverify_batch(
+        payloads, descs, max_msg_len=512
+    )
+    assert msgs.shape == (3, 512) and sigs.shape == (3, 64)
+    assert list(idxs) == [0, 0, 1]
+    d0 = descs[0]
+    assert sigs[1].tobytes() == payloads[0][d0.signature_off + 64:
+                                            d0.signature_off + 128]
+    assert pubs[1].tobytes() == d0.acct_addr(payloads[0], 1)
+    assert msgs[2, :lens[2]].tobytes() == descs[1].message(payloads[1])
