@@ -1,0 +1,27 @@
+"""tango — host-side IPC messaging layer (native C core + Python bindings).
+
+The reference's tango layer (src/tango/) is the spine of its tile pipeline:
+single-producer/multi-consumer shared-memory rings with credit-based flow
+control.  Ours keeps that ring discipline on the host (it is what feeds
+the TPU bridge tile its batches) and adds batch-drain entry points sized
+for device dispatch.  See native/fdt_tango.h for the full design notes.
+"""
+
+from firedancer_tpu.tango.rings import (  # noqa: F401
+    CHUNK_SZ,
+    CNC,
+    CNC_BOOT,
+    CNC_FAIL,
+    CNC_HALT,
+    CNC_RUN,
+    CTL_EOM,
+    CTL_ERR,
+    CTL_SOM,
+    DCache,
+    FRAG_DTYPE,
+    FSeq,
+    MCache,
+    TCache,
+    Workspace,
+    cr_avail,
+)

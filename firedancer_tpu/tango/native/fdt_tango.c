@@ -1,0 +1,409 @@
+/* fdt_tango.c — implementation.  See fdt_tango.h for the design notes and
+ * reference citations.  Original implementation (no reference code reused):
+ * C11 atomics express the publish/consume protocol the reference builds
+ * from compiler fences and SSE pair loads (src/tango/mcache/fd_mcache.h:288-310,
+ * consumer pattern src/disco/mux/fd_mux.c:561-594). */
+
+#include "fdt_tango.h"
+
+#include <stdatomic.h>
+#include <string.h>
+
+#define CACHELINE 64UL
+
+static inline int is_pow2( uint64_t x ) { return x && !( x & ( x - 1UL ) ); }
+
+/* ==== mcache ============================================================ */
+
+/* Header occupies two cachelines: line 0 = static geometry, line 1 = the
+   producer's published-seq watermark (kept away from geometry so consumer
+   polling of geometry never false-shares with the producer's stores). */
+typedef struct {
+  uint64_t magic;
+  uint64_t depth;
+  uint64_t seq0;
+  uint64_t _pad0[ 5 ];
+  _Atomic uint64_t seq_prod; /* next seq the producer will publish */
+  uint64_t _pad1[ 7 ];
+} fdt_mcache_hdr_t;
+
+#define FDT_MCACHE_MAGIC 0xf17eda2ce37a0001UL
+
+static inline fdt_frag_t * mcache_line( void * mcache ) {
+  return (fdt_frag_t *)( (char *)mcache + sizeof( fdt_mcache_hdr_t ) );
+}
+static inline fdt_frag_t const * mcache_line_c( void const * mcache ) {
+  return (fdt_frag_t const *)( (char const *)mcache + sizeof( fdt_mcache_hdr_t ) );
+}
+
+uint64_t fdt_mcache_align( void ) { return 128UL; }
+
+uint64_t fdt_mcache_footprint( uint64_t depth ) {
+  if( !is_pow2( depth ) || depth < 2UL ) return 0UL;
+  return sizeof( fdt_mcache_hdr_t ) + depth * sizeof( fdt_frag_t );
+}
+
+int fdt_mcache_new( void * mem, uint64_t depth, uint64_t seq0 ) {
+  if( !is_pow2( depth ) || depth < 2UL ) return -1;
+  fdt_mcache_hdr_t * h = (fdt_mcache_hdr_t *)mem;
+  memset( mem, 0, fdt_mcache_footprint( depth ) );
+  h->magic = FDT_MCACHE_MAGIC;
+  h->depth = depth;
+  h->seq0  = seq0;
+  atomic_store_explicit( &h->seq_prod, seq0, memory_order_release );
+  /* Mark every line as holding an "ancient" seq so consumers polling for
+     seq0.. see not-yet-published rather than garbage. */
+  fdt_frag_t * line = mcache_line( mem );
+  for( uint64_t i = 0; i < depth; i++ ) line[ i ].seq = seq0 - depth + i;
+  return 0;
+}
+
+uint64_t fdt_mcache_depth( void const * mcache ) {
+  return ( (fdt_mcache_hdr_t const *)mcache )->depth;
+}
+
+uint64_t fdt_mcache_seq_query( void const * mcache ) {
+  fdt_mcache_hdr_t const * h = (fdt_mcache_hdr_t const *)mcache;
+  return atomic_load_explicit( (_Atomic uint64_t *)&h->seq_prod,
+                               memory_order_acquire );
+}
+
+void fdt_mcache_publish( void * mcache, uint64_t seq, uint64_t sig,
+                         uint32_t chunk, uint16_t sz, uint16_t ctl,
+                         uint32_t tsorig, uint32_t tspub ) {
+  fdt_mcache_hdr_t * h = (fdt_mcache_hdr_t *)mcache;
+  uint64_t depth = h->depth;
+  fdt_frag_t * f = mcache_line( mcache ) + ( seq & ( depth - 1UL ) );
+  /* Invalidate the line first so a concurrent consumer mid-copy of the old
+     frag cannot validate against either the old or the new seq.  seq-1 is
+     never congruent to this line's seqs (depth >= 2 enforced at new). */
+  atomic_store_explicit( (_Atomic uint64_t *)&f->seq, seq - 1UL,
+                         memory_order_relaxed );
+  atomic_thread_fence( memory_order_release );
+  f->sig    = sig;
+  f->chunk  = chunk;
+  f->sz     = sz;
+  f->ctl    = ctl;
+  f->tsorig = tsorig;
+  f->tspub  = tspub;
+  atomic_thread_fence( memory_order_release );
+  atomic_store_explicit( (_Atomic uint64_t *)&f->seq, seq,
+                         memory_order_release );
+  atomic_store_explicit( &h->seq_prod, seq + 1UL, memory_order_release );
+}
+
+int fdt_mcache_poll( void const * mcache, uint64_t seq_expect,
+                     fdt_frag_t * out, uint64_t * out_seq_now ) {
+  fdt_mcache_hdr_t const * h = (fdt_mcache_hdr_t const *)mcache;
+  uint64_t depth = h->depth;
+  fdt_frag_t const * f = mcache_line_c( mcache ) + ( seq_expect & ( depth - 1UL ) );
+  uint64_t seq_found = atomic_load_explicit( (_Atomic uint64_t *)&f->seq,
+                                             memory_order_acquire );
+  if( seq_found != seq_expect ) {
+    if( out_seq_now ) *out_seq_now = seq_found;
+    /* signed distance: behind -> not yet published; ahead -> overrun */
+    return ( (int64_t)( seq_found - seq_expect ) < 0L ) ? -1 : 1;
+  }
+  /* speculative copy, then confirm the line wasn't overwritten under us */
+  fdt_frag_t tmp;
+  tmp.sig    = f->sig;
+  tmp.chunk  = f->chunk;
+  tmp.sz     = f->sz;
+  tmp.ctl    = f->ctl;
+  tmp.tsorig = f->tsorig;
+  tmp.tspub  = f->tspub;
+  atomic_thread_fence( memory_order_acquire );
+  uint64_t seq_check = atomic_load_explicit( (_Atomic uint64_t *)&f->seq,
+                                             memory_order_acquire );
+  if( seq_check != seq_expect ) {
+    if( out_seq_now ) *out_seq_now = seq_check;
+    return 1; /* torn: overwritten mid-copy */
+  }
+  tmp.seq = seq_expect;
+  *out = tmp;
+  return 0;
+}
+
+uint64_t fdt_mcache_drain( void const * mcache, uint64_t * seq_io,
+                           uint64_t max, fdt_frag_t * out,
+                           uint64_t * overrun_cnt ) {
+  uint64_t seq = *seq_io;
+  uint64_t n = 0;
+  while( n < max ) {
+    uint64_t seq_now;
+    int rc = fdt_mcache_poll( mcache, seq, out + n, &seq_now );
+    if( rc == 0 ) { n++; seq++; continue; }
+    if( rc < 0 ) break; /* caught up */
+    /* Overrun: resynchronize to the producer's current horizon minus the
+       ring depth (oldest frag still guaranteed live-ish), counting losses. */
+    uint64_t depth = fdt_mcache_depth( mcache );
+    uint64_t seq_prod = fdt_mcache_seq_query( mcache );
+    uint64_t seq_new = seq_prod > depth ? seq_prod - depth : 0UL;
+    if( (int64_t)( seq_new - seq ) <= 0L ) seq_new = seq + 1UL;
+    if( overrun_cnt ) *overrun_cnt += seq_new - seq;
+    seq = seq_new;
+  }
+  *seq_io = seq;
+  return n;
+}
+
+/* ==== dcache ============================================================ */
+
+uint64_t fdt_dcache_chunk_cnt( uint64_t sz ) {
+  return ( sz + FDT_CHUNK_SZ - 1UL ) / FDT_CHUNK_SZ;
+}
+
+uint64_t fdt_dcache_footprint( uint64_t mtu, uint64_t depth ) {
+  /* Compact ring discipline needs room for depth in-flight payloads plus
+     one mtu of slack so the wrap check never splits a payload. */
+  uint64_t chunk_per = fdt_dcache_chunk_cnt( mtu );
+  return ( chunk_per * ( depth + 2UL ) ) * FDT_CHUNK_SZ;
+}
+
+uint64_t fdt_dcache_compact_next( uint64_t chunk, uint64_t sz,
+                                  uint64_t mtu, uint64_t wmark_chunks ) {
+  uint64_t next = chunk + fdt_dcache_chunk_cnt( sz );
+  if( next + fdt_dcache_chunk_cnt( mtu ) > wmark_chunks ) next = 0UL;
+  return next;
+}
+
+void fdt_dcache_gather( void const * dcache_base, uint32_t const * chunks,
+                        uint16_t const * szs, uint64_t n, uint64_t width,
+                        uint8_t * out ) {
+  uint8_t const * base = (uint8_t const *)dcache_base;
+  for( uint64_t i = 0; i < n; i++ ) {
+    uint64_t sz = szs[ i ];
+    if( sz > width ) sz = width;
+    uint8_t * row = out + i * width;
+    memcpy( row, base + (uint64_t)chunks[ i ] * FDT_CHUNK_SZ, sz );
+    memset( row + sz, 0, width - sz );
+  }
+}
+
+/* ==== fseq ============================================================== */
+
+typedef struct {
+  _Atomic uint64_t seq;
+  uint64_t _pad[ 7 ];
+  _Atomic uint64_t diag[ 8 ];
+} fdt_fseq_t;
+
+uint64_t fdt_fseq_align( void ) { return CACHELINE; }
+uint64_t fdt_fseq_footprint( void ) { return sizeof( fdt_fseq_t ); }
+
+void fdt_fseq_new( void * mem, uint64_t seq0 ) {
+  fdt_fseq_t * f = (fdt_fseq_t *)mem;
+  memset( mem, 0, sizeof( fdt_fseq_t ) );
+  atomic_store_explicit( &f->seq, seq0, memory_order_release );
+}
+
+uint64_t fdt_fseq_query( void const * fseq ) {
+  return atomic_load_explicit( (_Atomic uint64_t *)&( (fdt_fseq_t const *)fseq )->seq,
+                               memory_order_acquire );
+}
+
+void fdt_fseq_update( void * fseq, uint64_t seq ) {
+  atomic_store_explicit( &( (fdt_fseq_t *)fseq )->seq, seq,
+                         memory_order_release );
+}
+
+uint64_t fdt_fseq_diag_query( void const * fseq, uint64_t idx ) {
+  return atomic_load_explicit(
+      (_Atomic uint64_t *)&( (fdt_fseq_t const *)fseq )->diag[ idx & 7UL ],
+      memory_order_relaxed );
+}
+
+void fdt_fseq_diag_add( void * fseq, uint64_t idx, uint64_t delta ) {
+  atomic_fetch_add_explicit( &( (fdt_fseq_t *)fseq )->diag[ idx & 7UL ], delta,
+                             memory_order_relaxed );
+}
+
+/* ==== fctl ============================================================== */
+
+uint64_t fdt_fctl_cr_avail( uint64_t seq_prod, uint64_t seq_cons_min,
+                            uint64_t cr_max ) {
+  /* Consumer has processed through seq_cons_min-1; producer may publish up
+     to seq_cons_min + cr_max - 1 without lapping it. */
+  uint64_t in_flight = seq_prod - seq_cons_min; /* mod-2^64 safe */
+  if( (int64_t)in_flight < 0L ) return cr_max;  /* consumer ahead: fresh */
+  return in_flight >= cr_max ? 0UL : cr_max - in_flight;
+}
+
+/* ==== cnc =============================================================== */
+
+typedef struct {
+  _Atomic uint64_t sig;
+  _Atomic uint64_t heartbeat;
+  uint64_t _pad[ 6 ];
+} fdt_cnc_t;
+
+uint64_t fdt_cnc_align( void ) { return CACHELINE; }
+uint64_t fdt_cnc_footprint( void ) { return sizeof( fdt_cnc_t ); }
+
+void fdt_cnc_new( void * mem ) {
+  memset( mem, 0, sizeof( fdt_cnc_t ) );
+  atomic_store_explicit( &( (fdt_cnc_t *)mem )->sig, FDT_CNC_SIG_BOOT,
+                         memory_order_release );
+}
+
+uint64_t fdt_cnc_signal_query( void const * cnc ) {
+  return atomic_load_explicit( (_Atomic uint64_t *)&( (fdt_cnc_t const *)cnc )->sig,
+                               memory_order_acquire );
+}
+
+void fdt_cnc_signal( void * cnc, uint64_t sig ) {
+  atomic_store_explicit( &( (fdt_cnc_t *)cnc )->sig, sig, memory_order_release );
+}
+
+void fdt_cnc_heartbeat( void * cnc, uint64_t now ) {
+  atomic_store_explicit( &( (fdt_cnc_t *)cnc )->heartbeat, now,
+                         memory_order_relaxed );
+}
+
+uint64_t fdt_cnc_heartbeat_query( void const * cnc ) {
+  return atomic_load_explicit(
+      (_Atomic uint64_t *)&( (fdt_cnc_t const *)cnc )->heartbeat,
+      memory_order_relaxed );
+}
+
+/* ==== tcache ============================================================ */
+
+/* Layout: [ hdr | ring: u64[depth] | map: u64[map_cnt] ].  The map is
+   key-only open addressing with linear probing; 0 means empty.  Deleting
+   (on ring eviction) uses the standard backward-shift so probe chains stay
+   intact.  Single-writer, so no atomics needed beyond the caller's own
+   serialization. */
+typedef struct {
+  uint64_t magic;
+  uint64_t depth;
+  uint64_t map_cnt;
+  uint64_t ring_cnt;  /* number of live entries (<= depth) */
+  uint64_t ring_head; /* next slot to write (oldest when full) */
+  uint64_t _pad[ 3 ];
+} fdt_tcache_hdr_t;
+
+#define FDT_TCACHE_MAGIC 0xf17eda2ce37a0002UL
+
+static inline uint64_t * tc_ring( void * t ) {
+  return (uint64_t *)( (char *)t + sizeof( fdt_tcache_hdr_t ) );
+}
+static inline uint64_t * tc_map( void * t ) {
+  fdt_tcache_hdr_t * h = (fdt_tcache_hdr_t *)t;
+  return tc_ring( t ) + h->depth;
+}
+
+uint64_t fdt_tcache_align( void ) { return CACHELINE; }
+
+uint64_t fdt_tcache_footprint( uint64_t depth, uint64_t map_cnt ) {
+  if( !depth || !is_pow2( map_cnt ) || map_cnt <= depth ) return 0UL;
+  return sizeof( fdt_tcache_hdr_t ) + ( depth + map_cnt ) * sizeof( uint64_t );
+}
+
+int fdt_tcache_new( void * mem, uint64_t depth, uint64_t map_cnt ) {
+  uint64_t fp = fdt_tcache_footprint( depth, map_cnt );
+  if( !fp ) return -1;
+  memset( mem, 0, fp );
+  fdt_tcache_hdr_t * h = (fdt_tcache_hdr_t *)mem;
+  h->magic   = FDT_TCACHE_MAGIC;
+  h->depth   = depth;
+  h->map_cnt = map_cnt;
+  return 0;
+}
+
+uint64_t fdt_tcache_depth( void const * tcache ) {
+  return ( (fdt_tcache_hdr_t const *)tcache )->depth;
+}
+
+void fdt_tcache_reset( void * tcache ) {
+  fdt_tcache_hdr_t * h = (fdt_tcache_hdr_t *)tcache;
+  h->ring_cnt  = 0;
+  h->ring_head = 0;
+  memset( tc_map( tcache ), 0, h->map_cnt * sizeof( uint64_t ) );
+  memset( tc_ring( tcache ), 0, h->depth * sizeof( uint64_t ) );
+}
+
+/* Avalanching mix so adversarial tags still spread over the map
+   (splitmix64 finalizer; public-domain construction). */
+static inline uint64_t tc_hash( uint64_t x ) {
+  x ^= x >> 30; x *= 0xbf58476d1ce4e5b9UL;
+  x ^= x >> 27; x *= 0x94d049bb133111ebUL;
+  x ^= x >> 31;
+  return x;
+}
+
+static inline int tc_map_query( uint64_t const * map, uint64_t mask,
+                                uint64_t tag ) {
+  uint64_t i = tc_hash( tag ) & mask;
+  for(;;) {
+    uint64_t k = map[ i ];
+    if( k == tag ) return 1;
+    if( !k ) return 0;
+    i = ( i + 1UL ) & mask;
+  }
+}
+
+static inline void tc_map_insert( uint64_t * map, uint64_t mask,
+                                  uint64_t tag ) {
+  uint64_t i = tc_hash( tag ) & mask;
+  while( map[ i ] ) i = ( i + 1UL ) & mask;
+  map[ i ] = tag;
+}
+
+static void tc_map_remove( uint64_t * map, uint64_t mask, uint64_t tag ) {
+  uint64_t i = tc_hash( tag ) & mask;
+  while( map[ i ] != tag ) {
+    if( !map[ i ] ) return; /* not present (tag 0 shenanigans) */
+    i = ( i + 1UL ) & mask;
+  }
+  /* backward-shift deletion */
+  uint64_t hole = i;
+  for(;;) {
+    i = ( i + 1UL ) & mask;
+    uint64_t k = map[ i ];
+    if( !k ) break;
+    uint64_t home = tc_hash( k ) & mask;
+    /* can k legally move into hole? yes iff hole is in [home, i) cyclically */
+    uint64_t d_hole = ( hole - home ) & mask;
+    uint64_t d_i    = ( i - home ) & mask;
+    if( d_hole <= d_i ) { map[ hole ] = k; hole = i; }
+  }
+  map[ hole ] = 0UL;
+}
+
+uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
+                           uint8_t * is_dup ) {
+  fdt_tcache_hdr_t * h = (fdt_tcache_hdr_t *)tcache;
+  uint64_t * ring = tc_ring( tcache );
+  uint64_t * map  = tc_map( tcache );
+  uint64_t mask   = h->map_cnt - 1UL;
+  uint64_t dups   = 0;
+  for( uint64_t i = 0; i < n; i++ ) {
+    uint64_t tag = tags[ i ];
+    if( !tag ) { is_dup[ i ] = 0; continue; } /* null tag: pass-through */
+    if( tc_map_query( map, mask, tag ) ) {
+      is_dup[ i ] = 1;
+      dups++;
+      continue;
+    }
+    is_dup[ i ] = 0;
+    if( h->ring_cnt == h->depth ) {
+      uint64_t old = ring[ h->ring_head ];
+      if( old ) tc_map_remove( map, mask, old );
+    } else {
+      h->ring_cnt++;
+    }
+    ring[ h->ring_head ] = tag;
+    h->ring_head = ( h->ring_head + 1UL ) % h->depth;
+    tc_map_insert( map, mask, tag );
+  }
+  return dups;
+}
+
+int fdt_tcache_query( void const * tcache, uint64_t tag ) {
+  fdt_tcache_hdr_t const * h = (fdt_tcache_hdr_t const *)tcache;
+  if( !tag ) return 0;
+  uint64_t const * map =
+      (uint64_t const *)( (char const *)tcache + sizeof( fdt_tcache_hdr_t ) ) +
+      h->depth;
+  return tc_map_query( map, h->map_cnt - 1UL, tag );
+}

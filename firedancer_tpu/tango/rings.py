@@ -1,0 +1,394 @@
+"""Python bindings for the native tango layer (fdt_tango.c).
+
+Objects live in caller-provided buffers — a numpy array for in-process
+topologies, or an mmap of a /dev/shm file for multi-process ones (see
+`Workspace`).  The bindings expose both one-frag operations (tests,
+low-rate tiles) and the batch drain/dedup entry points that feed the JAX
+bridge (thousands of frags per native call, one ctypes crossing).
+
+Reference semantics being mirrored: src/tango/fd_tango_base.h:4-110
+(seq/sig/ctl model), src/tango/tcache/fd_tcache.h (dedup cache),
+src/tango/fctl/fd_fctl.h (credit flow control).
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import mmap
+import os
+from pathlib import Path
+
+import numpy as np
+
+from firedancer_tpu.utils import cbuild
+
+# ---------------------------------------------------------------------------
+# library load
+
+_HERE = Path(__file__).parent
+
+
+def _load() -> ct.CDLL:
+    so = cbuild.build("fdt_tango", [_HERE / "native" / "fdt_tango.c"])
+    lib = ct.CDLL(str(so))
+    u64, u32, u16, i32, vp = (
+        ct.c_uint64,
+        ct.c_uint32,
+        ct.c_uint16,
+        ct.c_int,
+        ct.c_void_p,
+    )
+    sigs = {
+        "fdt_mcache_align": (u64, []),
+        "fdt_mcache_footprint": (u64, [u64]),
+        "fdt_mcache_new": (i32, [vp, u64, u64]),
+        "fdt_mcache_depth": (u64, [vp]),
+        "fdt_mcache_seq_query": (u64, [vp]),
+        "fdt_mcache_publish": (None, [vp, u64, u64, u32, u16, u16, u32, u32]),
+        "fdt_mcache_poll": (i32, [vp, u64, vp, vp]),
+        "fdt_mcache_drain": (u64, [vp, vp, u64, vp, vp]),
+        "fdt_dcache_footprint": (u64, [u64, u64]),
+        "fdt_dcache_chunk_cnt": (u64, [u64]),
+        "fdt_dcache_compact_next": (u64, [u64, u64, u64, u64]),
+        "fdt_dcache_gather": (None, [vp, vp, vp, u64, u64, vp]),
+        "fdt_fseq_footprint": (u64, []),
+        "fdt_fseq_new": (None, [vp, u64]),
+        "fdt_fseq_query": (u64, [vp]),
+        "fdt_fseq_update": (None, [vp, u64]),
+        "fdt_fseq_diag_query": (u64, [vp, u64]),
+        "fdt_fseq_diag_add": (None, [vp, u64, u64]),
+        "fdt_fctl_cr_avail": (u64, [u64, u64, u64]),
+        "fdt_cnc_footprint": (u64, []),
+        "fdt_cnc_new": (None, [vp]),
+        "fdt_cnc_signal_query": (u64, [vp]),
+        "fdt_cnc_signal": (None, [vp, u64]),
+        "fdt_cnc_heartbeat": (None, [vp, u64]),
+        "fdt_cnc_heartbeat_query": (u64, [vp]),
+        "fdt_tcache_footprint": (u64, [u64, u64]),
+        "fdt_tcache_new": (i32, [vp, u64, u64]),
+        "fdt_tcache_depth": (u64, [vp]),
+        "fdt_tcache_dedup": (u64, [vp, vp, u64, vp]),
+        "fdt_tcache_query": (i32, [vp, u64]),
+        "fdt_tcache_reset": (None, [vp]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+    return lib
+
+
+_lib = _load()
+
+CHUNK_SZ = 64
+CTL_SOM, CTL_EOM, CTL_ERR = 1, 2, 4
+
+FRAG_DTYPE = np.dtype(
+    {
+        "names": ["seq", "sig", "chunk", "sz", "ctl", "tsorig", "tspub"],
+        "formats": ["<u8", "<u8", "<u4", "<u2", "<u2", "<u4", "<u4"],
+        "offsets": [0, 8, 16, 20, 22, 24, 28],
+        "itemsize": 32,
+    }
+)
+
+
+def _ptr(buf: np.ndarray, off: int = 0) -> int:
+    assert buf.flags["C_CONTIGUOUS"]
+    return buf.ctypes.data + off
+
+
+# ---------------------------------------------------------------------------
+# workspace: a named shared-memory region both threads and processes can map
+
+
+class Workspace:
+    """A contiguous byte region holding tango objects.
+
+    In-process: backed by one page-aligned numpy buffer.  Cross-process:
+    backed by a /dev/shm file every participant mmaps (the reference's
+    hugetlbfs wksp model, src/util/wksp/fd_wksp.h:7-75, minus NUMA
+    placement — placement on TPU hosts matters far less than on the
+    reference's 32+-core NUMA boxes).  Allocation is an aligned bump
+    allocator with a name→offset table kept host-side.
+    """
+
+    def __init__(self, size: int, name: str | None = None):
+        self.size = int(size)
+        self.name = name
+        self._allocs: dict[str, tuple[int, int]] = {}
+        self._off = 64
+        if name is None:
+            self._mm = None
+            self.buf = np.zeros(self.size, dtype=np.uint8)
+        else:
+            path = f"/dev/shm/fdt_wksp_{name}"
+            self._fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+            os.ftruncate(self._fd, self.size)
+            self._mm = mmap.mmap(self._fd, self.size)
+            self.buf = np.frombuffer(self._mm, dtype=np.uint8)
+            self._path = path
+
+    def alloc(self, name: str, footprint: int, align: int = 128) -> np.ndarray:
+        off = (self._off + align - 1) & ~(align - 1)
+        if off + footprint > self.size:
+            raise MemoryError(f"workspace full allocating {name!r}")
+        self._off = off + footprint
+        self._allocs[name] = (off, footprint)
+        return self.buf[off : off + footprint]
+
+    def view(self, name: str) -> np.ndarray:
+        off, fp = self._allocs[name]
+        return self.buf[off : off + fp]
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self.buf = None
+            try:
+                self._mm.close()
+            except BufferError:
+                # numpy views of the mapping are still alive somewhere; the
+                # mapping stays valid until they are collected.  Unlinking
+                # the backing file below is still safe (POSIX semantics).
+                pass
+            os.close(self._fd)
+            self._mm = None
+
+    def unlink(self) -> None:
+        self.close()
+        if self.name is not None:
+            try:
+                os.unlink(self._path)
+            except FileNotFoundError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# mcache
+
+
+class MCache:
+    """Single-producer multi-consumer frag-metadata ring."""
+
+    def __init__(self, mem: np.ndarray, depth: int, seq0: int = 0, join: bool = False):
+        self.mem = mem
+        self.depth = depth
+        if not join:
+            if _lib.fdt_mcache_new(_ptr(mem), depth, seq0) != 0:
+                raise ValueError(f"bad mcache depth {depth}")
+
+    @staticmethod
+    def footprint(depth: int) -> int:
+        fp = _lib.fdt_mcache_footprint(depth)
+        if fp == 0:
+            raise ValueError(f"depth {depth} not a power of 2")
+        return fp
+
+    @classmethod
+    def create(cls, wksp: Workspace, name: str, depth: int, seq0: int = 0) -> "MCache":
+        return cls(wksp.alloc(name, cls.footprint(depth)), depth, seq0)
+
+    def seq_query(self) -> int:
+        return _lib.fdt_mcache_seq_query(_ptr(self.mem))
+
+    def publish(
+        self,
+        seq: int,
+        sig: int,
+        chunk: int = 0,
+        sz: int = 0,
+        ctl: int = CTL_SOM | CTL_EOM,
+        tsorig: int = 0,
+        tspub: int = 0,
+    ) -> None:
+        _lib.fdt_mcache_publish(_ptr(self.mem), seq, sig, chunk, sz, ctl, tsorig, tspub)
+
+    def poll(self, seq_expect: int):
+        """Returns (rc, frag, seq_now): rc 0=ok, -1=empty, 1=overrun."""
+        out = np.zeros(1, dtype=FRAG_DTYPE)
+        seq_now = ct.c_uint64(0)
+        rc = _lib.fdt_mcache_poll(
+            _ptr(self.mem), seq_expect, out.ctypes.data, ct.byref(seq_now)
+        )
+        return rc, (out[0] if rc == 0 else None), seq_now.value
+
+    def drain(self, seq: int, max_frags: int):
+        """Batch-consume. Returns (frags ndarray, new_seq, n_overrun)."""
+        out = np.zeros(max_frags, dtype=FRAG_DTYPE)
+        seq_io = ct.c_uint64(seq)
+        ovr = ct.c_uint64(0)
+        n = _lib.fdt_mcache_drain(
+            _ptr(self.mem), ct.byref(seq_io), max_frags, out.ctypes.data, ct.byref(ovr)
+        )
+        return out[:n], seq_io.value, ovr.value
+
+
+# ---------------------------------------------------------------------------
+# dcache
+
+
+class DCache:
+    """Chunk-addressed payload region with the compact ring discipline."""
+
+    def __init__(self, mem: np.ndarray, mtu: int, depth: int):
+        self.mem = mem
+        self.mtu = mtu
+        self.depth = depth
+        self.wmark_chunks = len(mem) // CHUNK_SZ
+        self.chunk = 0  # producer cursor
+
+    @staticmethod
+    def footprint(mtu: int, depth: int) -> int:
+        return _lib.fdt_dcache_footprint(mtu, depth)
+
+    @classmethod
+    def create(cls, wksp: Workspace, name: str, mtu: int, depth: int) -> "DCache":
+        return cls(wksp.alloc(name, cls.footprint(mtu, depth), align=CHUNK_SZ), mtu, depth)
+
+    def write(self, payload: np.ndarray) -> int:
+        """Producer: copy payload in at the cursor, return its chunk idx."""
+        sz = len(payload)
+        assert sz <= self.mtu
+        off = self.chunk * CHUNK_SZ
+        self.mem[off : off + sz] = payload
+        chunk = self.chunk
+        self.chunk = _lib.fdt_dcache_compact_next(
+            self.chunk, sz, self.mtu, self.wmark_chunks
+        )
+        return chunk
+
+    def read(self, chunk: int, sz: int) -> np.ndarray:
+        off = chunk * CHUNK_SZ
+        return self.mem[off : off + sz]
+
+    def read_batch(self, chunks: np.ndarray, szs: np.ndarray, width: int) -> np.ndarray:
+        """Gather payloads into a dense (n, width) u8 matrix (zero-padded) —
+        the shape the JAX bridge ships to the device.  One native call."""
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint32)
+        szs = np.ascontiguousarray(szs, dtype=np.uint16)
+        n = len(chunks)
+        out = np.empty((n, width), dtype=np.uint8)
+        _lib.fdt_dcache_gather(
+            _ptr(self.mem),
+            chunks.ctypes.data,
+            szs.ctypes.data,
+            n,
+            width,
+            out.ctypes.data,
+        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fseq / fctl / cnc
+
+
+class FSeq:
+    def __init__(self, mem: np.ndarray, seq0: int = 0, join: bool = False):
+        self.mem = mem
+        if not join:
+            _lib.fdt_fseq_new(_ptr(mem), seq0)
+
+    @staticmethod
+    def footprint() -> int:
+        return _lib.fdt_fseq_footprint()
+
+    @classmethod
+    def create(cls, wksp: Workspace, name: str, seq0: int = 0) -> "FSeq":
+        return cls(wksp.alloc(name, cls.footprint(), align=64), seq0)
+
+    def query(self) -> int:
+        return _lib.fdt_fseq_query(_ptr(self.mem))
+
+    def update(self, seq: int) -> None:
+        _lib.fdt_fseq_update(_ptr(self.mem), seq)
+
+    def diag(self, idx: int) -> int:
+        return _lib.fdt_fseq_diag_query(_ptr(self.mem), idx)
+
+    def diag_add(self, idx: int, delta: int) -> None:
+        _lib.fdt_fseq_diag_add(_ptr(self.mem), idx, delta)
+
+
+def cr_avail(seq_prod: int, seq_cons_min: int, cr_max: int) -> int:
+    return _lib.fdt_fctl_cr_avail(seq_prod, seq_cons_min, cr_max)
+
+
+CNC_BOOT, CNC_RUN, CNC_HALT, CNC_FAIL = 0, 1, 2, 3
+
+
+class CNC:
+    def __init__(self, mem: np.ndarray, join: bool = False):
+        self.mem = mem
+        if not join:
+            _lib.fdt_cnc_new(_ptr(mem))
+
+    @staticmethod
+    def footprint() -> int:
+        return _lib.fdt_cnc_footprint()
+
+    @classmethod
+    def create(cls, wksp: Workspace, name: str) -> "CNC":
+        return cls(wksp.alloc(name, cls.footprint(), align=64))
+
+    def signal_query(self) -> int:
+        return _lib.fdt_cnc_signal_query(_ptr(self.mem))
+
+    def signal(self, sig: int) -> None:
+        _lib.fdt_cnc_signal(_ptr(self.mem), sig)
+
+    def heartbeat(self, now: int) -> None:
+        _lib.fdt_cnc_heartbeat(_ptr(self.mem), now)
+
+    def heartbeat_query(self) -> int:
+        return _lib.fdt_cnc_heartbeat_query(_ptr(self.mem))
+
+
+# ---------------------------------------------------------------------------
+# tcache
+
+
+class TCache:
+    """Dedup tag cache: remembers the most recent `depth` unique tags."""
+
+    def __init__(self, mem: np.ndarray, depth: int, map_cnt: int, join: bool = False):
+        self.mem = mem
+        self.depth = depth
+        if not join:
+            if _lib.fdt_tcache_new(_ptr(mem), depth, map_cnt) != 0:
+                raise ValueError(f"bad tcache geometry {depth}/{map_cnt}")
+
+    @staticmethod
+    def map_cnt_for(depth: int) -> int:
+        m = 1
+        while m < 2 * depth + 1:
+            m <<= 1
+        return m
+
+    @staticmethod
+    def footprint(depth: int, map_cnt: int | None = None) -> int:
+        map_cnt = map_cnt or TCache.map_cnt_for(depth)
+        fp = _lib.fdt_tcache_footprint(depth, map_cnt)
+        if fp == 0:
+            raise ValueError(f"bad tcache geometry {depth}/{map_cnt}")
+        return fp
+
+    @classmethod
+    def create(cls, wksp: Workspace, name: str, depth: int) -> "TCache":
+        map_cnt = cls.map_cnt_for(depth)
+        return cls(wksp.alloc(name, cls.footprint(depth, map_cnt)), depth, map_cnt)
+
+    def dedup(self, tags: np.ndarray) -> np.ndarray:
+        """Query+insert a batch; returns bool mask of duplicates."""
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        is_dup = np.zeros(len(tags), dtype=np.uint8)
+        _lib.fdt_tcache_dedup(
+            _ptr(self.mem), tags.ctypes.data, len(tags), is_dup.ctypes.data
+        )
+        return is_dup.astype(bool)
+
+    def query(self, tag: int) -> bool:
+        return bool(_lib.fdt_tcache_query(_ptr(self.mem), tag))
+
+    def reset(self) -> None:
+        _lib.fdt_tcache_reset(_ptr(self.mem))

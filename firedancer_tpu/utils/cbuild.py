@@ -1,0 +1,50 @@
+"""Tiny native-build helper: compile C sources into a cached shared lib.
+
+Used by the tango layer (and any future native runtime component) to build
+its .so on first import.  The cache key is a hash of the source text +
+compile flags, so editing a .c file transparently rebuilds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_CC = os.environ.get("CC", "cc")
+_BASE_FLAGS = ["-O3", "-std=c11", "-fPIC", "-shared", "-Wall", "-Wextra", "-Werror"]
+
+
+def _cache_dir() -> Path:
+    d = Path(os.environ.get("FDT_CACHE_DIR", Path.home() / ".cache" / "fdt_native"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) -> Path:
+    """Compile `sources` into a shared library, returning its path."""
+    flags = _BASE_FLAGS + (extra_flags or [])
+    h = hashlib.sha256()
+    h.update(" ".join([_CC] + flags).encode())
+    for src in sources:
+        h.update(src.read_bytes())
+        # headers next to the source participate in the key
+        for hdr in sorted(src.parent.glob("*.h")):
+            h.update(hdr.read_bytes())
+    out = _cache_dir() / f"{name}-{h.hexdigest()[:16]}.so"
+    if out.exists():
+        return out
+    # build into a temp file then atomically rename, so concurrent importers
+    # (e.g. pytest-xdist workers) never load a half-written .so
+    fd, tmp = tempfile.mkstemp(dir=out.parent, suffix=".so")
+    os.close(fd)
+    cmd = [_CC, *flags, *map(str, sources), "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:  # pragma: no cover
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed:\n{' '.join(cmd)}\n{e.stderr}") from e
+    os.replace(tmp, out)
+    return out
