@@ -1,0 +1,14 @@
+"""disco — the tile framework: metrics, the mux run loop, topologies.
+
+TPU-native re-design of the reference's disco layer
+(src/disco/mux/fd_mux.c run loop, src/disco/topo/fd_topo.h declarative
+topology, src/disco/metrics/ shared-memory metrics).  The key deliberate
+difference: callbacks are BATCH-first (a tile sees an array of frags per
+loop iteration, not one frag per callback), because our hot tiles amortize
+work over device-sized batches and the per-frag work happens in native
+code or on the TPU, never in the Python loop body.
+"""
+
+from .metrics import Metrics, MetricsSchema  # noqa: F401
+from .mux import InLink, MuxCtx, OutLink, Tile, run_loop  # noqa: F401
+from .topo import Topology  # noqa: F401

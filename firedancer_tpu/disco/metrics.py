@@ -1,0 +1,130 @@
+"""Shared-memory metrics regions, one per tile.
+
+Reference model: src/disco/metrics/ — an XML schema compiled to typed
+per-tile offset tables, written lock-free by the owning tile via
+FD_MCNT_INC / FD_MGAUGE_SET / FD_MHIST_COPY macros and scraped by a
+monitor/metric tile reading the same shared memory.
+
+Here the schema is a plain Python object (no codegen step needed — Python
+IS the config language), but the storage contract is the same: a flat u64
+array in a workspace, single-writer, torn-read-tolerant, readable by any
+process mapping the workspace.  Histograms use the reference's shape: 16
+power-of-two buckets (src/util/hist/fd_histf.h) plus sum and count words.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+HIST_BUCKETS = 16
+_HIST_WORDS = HIST_BUCKETS + 2  # buckets + sum + count
+
+
+@dataclass(frozen=True)
+class MetricsSchema:
+    """Ordered metric names for one tile kind.
+
+    counters: monotone u64 counts (also used for gauges via set()).
+    hists: 16-bucket log2 histograms with sum/count.
+    """
+
+    counters: tuple[str, ...] = ()
+    hists: tuple[str, ...] = ()
+
+    # every tile gets these on top of its own schema
+    BASE_COUNTERS = (
+        "in_frags",
+        "in_bytes",
+        "out_frags",
+        "out_bytes",
+        "overrun_frags",
+        "backpressure_iters",
+        "housekeep_iters",
+        "loop_iters",
+    )
+    BASE_HISTS = ("batch_sz", "loop_ns")
+
+    def with_base(self) -> "MetricsSchema":
+        return MetricsSchema(
+            counters=MetricsSchema.BASE_COUNTERS + tuple(self.counters),
+            hists=MetricsSchema.BASE_HISTS + tuple(self.hists),
+        )
+
+    def footprint_words(self) -> int:
+        return len(self.counters) + _HIST_WORDS * len(self.hists)
+
+
+@dataclass
+class _Hist:
+    base: int
+
+
+class Metrics:
+    """A tile's metrics region: a u64 view into a workspace allocation."""
+
+    def __init__(self, mem_u8: np.ndarray, schema: MetricsSchema):
+        self.schema = schema
+        n = schema.footprint_words()
+        self.words = mem_u8[: n * 8].view(np.uint64)
+        self._slot: dict[str, int] = {}
+        off = 0
+        for c in schema.counters:
+            self._slot[c] = off
+            off += 1
+        self._hist: dict[str, _Hist] = {}
+        for h in schema.hists:
+            self._hist[h] = _Hist(off)
+            off += _HIST_WORDS
+
+    @staticmethod
+    def footprint(schema: MetricsSchema) -> int:
+        return schema.footprint_words() * 8
+
+    # -- writer side (owning tile only) ----------------------------------
+
+    def inc(self, name: str, v: int = 1) -> None:
+        self.words[self._slot[name]] += np.uint64(v)
+
+    def set(self, name: str, v: int) -> None:
+        self.words[self._slot[name]] = np.uint64(v)
+
+    def hist_sample(self, name: str, value: int) -> None:
+        h = self._hist[name]
+        b = min(max(int(value), 1).bit_length() - 1, HIST_BUCKETS - 1)
+        w = self.words
+        w[h.base + b] += np.uint64(1)
+        w[h.base + HIST_BUCKETS] += np.uint64(max(int(value), 0))
+        w[h.base + HIST_BUCKETS + 1] += np.uint64(1)
+
+    def hist_sample_many(self, name: str, values: np.ndarray) -> None:
+        h = self._hist[name]
+        v = np.maximum(np.asarray(values, dtype=np.int64), 1)
+        buckets = np.minimum(
+            np.floor(np.log2(v)).astype(np.int64), HIST_BUCKETS - 1
+        )
+        counts = np.bincount(buckets, minlength=HIST_BUCKETS).astype(np.uint64)
+        w = self.words
+        w[h.base : h.base + HIST_BUCKETS] += counts
+        w[h.base + HIST_BUCKETS] += np.uint64(int(v.sum()))
+        w[h.base + HIST_BUCKETS + 1] += np.uint64(len(v))
+
+    # -- reader side (any process) ---------------------------------------
+
+    def counter(self, name: str) -> int:
+        return int(self.words[self._slot[name]])
+
+    def hist(self, name: str) -> dict:
+        h = self._hist[name]
+        w = self.words
+        return {
+            "buckets": w[h.base : h.base + HIST_BUCKETS].tolist(),
+            "sum": int(w[h.base + HIST_BUCKETS]),
+            "count": int(w[h.base + HIST_BUCKETS + 1]),
+        }
+
+    def read(self) -> dict:
+        out = {c: self.counter(c) for c in self.schema.counters}
+        out.update({h: self.hist(h) for h in self.schema.hists})
+        return out

@@ -1,0 +1,232 @@
+"""Declarative topology: links + tiles + workspace layout + a runner.
+
+Reference model: src/disco/topo/fd_topo.h:28-230 (fd_topo_t = wksps,
+links, tiles, objs; built by fd_topob_*) and fd_topo_run.c (join
+workspaces → init → run loop).  The reference runs each tile as a
+sandboxed process over hugetlbfs shared memory; this build's default
+runner is one thread per tile over one process-local workspace (the
+reference's own tests use exactly this shape, e.g.
+src/disco/dedup/test_dedup.c:654-660), with the same objects working
+cross-process when the workspace is named (/dev/shm-backed, see
+tango.rings.Workspace).
+
+Fail-stop supervision mirrors run/run.c:264-270: any tile failure halts
+the whole topology.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from firedancer_tpu.tango import rings as R
+
+from .metrics import Metrics
+from .mux import InLink, MuxCtx, OutLink, Tile, run_loop
+
+
+@dataclass
+class LinkSpec:
+    name: str
+    depth: int
+    mtu: int = 0  # 0 = metadata-only link (no dcache)
+    producer: str | None = None
+    consumers: list[tuple[str, bool]] = field(default_factory=list)
+
+
+@dataclass
+class TileSpec:
+    tile: Tile
+    ins: list[tuple[str, bool]]  # (link name, reliable)
+    outs: list[str]
+    ctx: MuxCtx | None = None
+    thread: threading.Thread | None = None
+    error: BaseException | None = None
+
+
+class Topology:
+    """Build links and tiles, then run them on threads.
+
+    Usage:
+        topo = Topology()
+        topo.link("synth_verify", depth=1024, mtu=1280)
+        topo.tile(SynthTile(...), outs=["synth_verify"])
+        topo.tile(VerifyTile(...), ins=[("synth_verify", True)], outs=[...])
+        topo.start(); ...; topo.halt()
+    """
+
+    def __init__(self, name: str | None = None):
+        self.name = name
+        self.links: dict[str, LinkSpec] = {}
+        self.tiles: dict[str, TileSpec] = {}
+        self.wksp: R.Workspace | None = None
+        self._mcaches: dict[str, R.MCache] = {}
+        self._dcaches: dict[str, R.DCache] = {}
+        self._fseqs: dict[tuple[str, str], R.FSeq] = {}
+        self._cncs: dict[str, R.CNC] = {}
+        self._metrics: dict[str, Metrics] = {}
+
+    # ---- declaration ----------------------------------------------------
+
+    def link(self, name: str, depth: int, mtu: int = 0) -> None:
+        assert name not in self.links, f"duplicate link {name!r}"
+        self.links[name] = LinkSpec(name, depth, mtu)
+
+    def tile(
+        self,
+        tile: Tile,
+        ins: list[tuple[str, bool]] | None = None,
+        outs: list[str] | None = None,
+    ) -> None:
+        name = tile.name
+        assert name not in self.tiles, f"duplicate tile {name!r}"
+        ins = list(ins or [])
+        outs = list(outs or [])
+        for ln, reliable in ins:
+            self.links[ln].consumers.append((name, reliable))
+        for ln in outs:
+            spec = self.links[ln]
+            assert spec.producer is None, f"link {ln!r} has two producers"
+            spec.producer = name
+        self.tiles[name] = TileSpec(tile, ins, outs)
+
+    # ---- build ----------------------------------------------------------
+
+    def _footprint(self) -> int:
+        total = 4096
+        for ls in self.links.values():
+            total += R.MCache.footprint(ls.depth) + 256
+            if ls.mtu:
+                total += R.DCache.footprint(ls.mtu, ls.depth) + 256
+            total += (R.FSeq.footprint() + 128) * max(len(ls.consumers), 1)
+        for ts in self.tiles.values():
+            total += R.CNC.footprint() + 128
+            total += Metrics.footprint(ts.tile.schema.with_base()) + 256
+        return total
+
+    def build(self) -> None:
+        assert self.wksp is None, "already built"
+        self.wksp = R.Workspace(self._footprint(), name=self.name)
+        for ls in self.links.values():
+            self._mcaches[ls.name] = R.MCache.create(
+                self.wksp, f"mc_{ls.name}", ls.depth
+            )
+            if ls.mtu:
+                self._dcaches[ls.name] = R.DCache.create(
+                    self.wksp, f"dc_{ls.name}", ls.mtu, ls.depth
+                )
+            for cons, _rel in ls.consumers:
+                self._fseqs[(ls.name, cons)] = R.FSeq.create(
+                    self.wksp, f"fs_{ls.name}_{cons}"
+                )
+        for name, ts in self.tiles.items():
+            self._cncs[name] = R.CNC.create(self.wksp, f"cnc_{name}")
+            schema = ts.tile.schema.with_base()
+            mem = self.wksp.alloc(f"metrics_{name}", Metrics.footprint(schema))
+            self._metrics[name] = Metrics(mem, schema)
+        for name, ts in self.tiles.items():
+            ins = [
+                InLink(
+                    ln,
+                    self._mcaches[ln],
+                    self._dcaches.get(ln),
+                    self._fseqs[(ln, name)],
+                    reliable,
+                )
+                for ln, reliable in ts.ins
+            ]
+            outs = [
+                OutLink(
+                    ln,
+                    self._mcaches[ln],
+                    self._dcaches.get(ln),
+                    [
+                        self._fseqs[(ln, cons)]
+                        for cons, rel in self.links[ln].consumers
+                        if rel
+                    ],
+                )
+                for ln in ts.outs
+            ]
+            ts.ctx = MuxCtx(name, self._cncs[name], ins, outs, self._metrics[name])
+
+    # ---- run ------------------------------------------------------------
+
+    def _tile_main(self, ts: TileSpec, loop_kw: dict) -> None:
+        try:
+            run_loop(ts.tile, ts.ctx, **loop_kw)
+        except BaseException as e:  # noqa: BLE001 — fail-stop supervision
+            ts.error = e
+
+    def start(self, boot_timeout_s: float = 600.0, **loop_kw) -> None:
+        # default boot budget is generous: tile on_boot warms device
+        # compile caches, and first compiles are slow (tens of seconds)
+        if self.wksp is None:
+            self.build()
+        for name, ts in self.tiles.items():
+            t = threading.Thread(
+                target=self._tile_main, args=(ts, loop_kw), name=f"tile:{name}"
+            )
+            t.daemon = True
+            ts.thread = t
+            t.start()
+        # wait for every tile to reach RUN (or fail during boot)
+        deadline = time.monotonic() + boot_timeout_s
+        for name, ts in self.tiles.items():
+            while self._cncs[name].signal_query() == R.CNC_BOOT:
+                if ts.error is not None:
+                    self.halt()
+                    raise ts.error
+                if time.monotonic() > deadline:
+                    self.halt()
+                    raise TimeoutError(f"tile {name!r} stuck in BOOT")
+                time.sleep(1e-3)
+
+    def poll_failure(self) -> None:
+        """Fail-stop check: if any tile died, halt everything and re-raise."""
+        for name, ts in self.tiles.items():
+            if ts.error is not None:
+                self.halt()
+                raise RuntimeError(f"tile {name!r} failed") from ts.error
+
+    def halt(self, timeout_s: float = 30.0) -> None:
+        """Halt upstream-first so in-flight frags drain before consumers
+        stop."""
+        order = self._topo_order()
+        for name in order:
+            cnc = self._cncs.get(name)
+            if cnc is None:
+                continue
+            cnc.signal(R.CNC_HALT)
+            ts = self.tiles[name]
+            if ts.thread is not None:
+                ts.thread.join(timeout=timeout_s)
+
+    def _topo_order(self) -> list[str]:
+        """Tiles ordered producers-before-consumers (cycles broken by
+        declaration order)."""
+        order: list[str] = []
+        seen: set[str] = set()
+
+        def visit(name: str) -> None:
+            if name in seen:
+                return
+            seen.add(name)
+            for ln in self.tiles[name].ins:
+                prod = self.links[ln[0]].producer
+                if prod is not None and prod not in seen:
+                    visit(prod)
+            order.append(name)
+
+        for name in self.tiles:
+            visit(name)
+        return order
+
+    def metrics(self, tile_name: str) -> Metrics:
+        return self._metrics[tile_name]
+
+    def close(self) -> None:
+        if self.wksp is not None:
+            self.wksp.unlink()
+            self.wksp = None

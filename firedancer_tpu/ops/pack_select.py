@@ -1,0 +1,92 @@
+"""Device-side microblock candidate selection — the data-parallel
+reformulation of pack's conflict scheduling (SURVEY.md §7 phase 8).
+
+Reference model: the greedy scan in fd_pack_schedule_microblock_impl
+(/root/reference/src/ballet/pack/fd_pack.c:742-953): walk candidates in
+priority order; take a txn iff its writable accounts don't intersect any
+in-use account, its readable accounts don't intersect any write-in-use
+account, and it fits the remaining CU budget.
+
+The scan is inherently sequential (each pick updates the in-use set), but
+the sequential state is tiny — two bitset words vectors and a CU counter —
+so it maps cleanly onto a lax.scan whose per-step body is pure vector ops
+over the bitset words.  The expensive part (the W-word AND/OR/any per
+candidate) runs on the VPU; XLA unrolls the K-step scan into straight-line
+code with no host round-trips.
+
+The host commits the result after enforcing exact writer-cost caps
+(ballet/pack.py), so a speculative over-selection here never corrupts
+state — this kernel is a prefilter, exactly the split the build plan
+prescribes for grafting a sequential-greedy consensus algorithm onto an
+accelerator.
+
+Bitsets arrive as u64 words from the host engine and are split into u32
+halves on device (TPUs have no native 64-bit lanes)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("txn_limit",))
+def _select_impl(cand_rw, cand_w, in_use_rw, in_use_w, costs, cu_limit, txn_limit):
+    K = cand_rw.shape[0]
+
+    def step(carry, inp):
+        sel_rw, sel_w, cu_used, taken = carry
+        rw, w, c = inp
+        conflict = jnp.any((w & sel_rw) != 0) | jnp.any((rw & sel_w) != 0)
+        fits = (cu_used + c <= cu_limit) & (taken < txn_limit)
+        take = (~conflict) & fits
+        sel_rw = jnp.where(take, sel_rw | rw, sel_rw)
+        sel_w = jnp.where(take, sel_w | w, sel_w)
+        cu_used = jnp.where(take, cu_used + c, cu_used)
+        taken = taken + take.astype(jnp.int32)
+        return (sel_rw, sel_w, cu_used, taken), take
+
+    (_, _, _, _), takes = jax.lax.scan(
+        step,
+        (in_use_rw, in_use_w, jnp.int32(0), jnp.int32(0)),
+        (cand_rw, cand_w, costs),
+        length=K,
+    )
+    return takes
+
+
+def _split_u32(a64: np.ndarray) -> jnp.ndarray:
+    """(…, W) u64 -> (…, 2W) u32 little-endian halves (device-friendly)."""
+    return jnp.asarray(
+        np.ascontiguousarray(a64).view(np.uint32).reshape(a64.shape[:-1] + (-1,))
+    )
+
+
+def select_noconflict(
+    cand_rw: np.ndarray,
+    cand_w: np.ndarray,
+    in_use_rw: np.ndarray,
+    in_use_w: np.ndarray,
+    costs: np.ndarray,
+    cu_limit: int,
+    txn_limit: int,
+) -> np.ndarray:
+    """Greedy non-conflicting selection over priority-ordered candidates.
+
+    cand_rw/cand_w: (K, W) u64 account bitsets; in_use_*: (W,) u64;
+    costs: (K,) int (txn costs are < 2^28, so i32 math is exact).
+    Returns (K,) bool take mask.  Matches the host engine's sequential
+    greedy loop bit for bit (tests assert equality).
+    """
+    takes = _select_impl(
+        _split_u32(cand_rw),
+        _split_u32(cand_w),
+        _split_u32(in_use_rw),
+        _split_u32(in_use_w),
+        jnp.asarray(np.asarray(costs, np.int32)),
+        jnp.int32(int(min(cu_limit, 2**31 - 1))),
+        txn_limit,
+    )
+    return np.asarray(takes)

@@ -147,6 +147,21 @@ uint64_t fdt_mcache_drain( void const * mcache, uint64_t * seq_io,
   return n;
 }
 
+uint64_t fdt_mcache_publish_batch( void * mcache, uint64_t seq0,
+                                   uint64_t const * sigs,
+                                   uint32_t const * chunks,
+                                   uint16_t const * szs,
+                                   uint16_t const * ctls,
+                                   uint32_t tspub, uint64_t n ) {
+  for( uint64_t i = 0; i < n; i++ )
+    fdt_mcache_publish( mcache, seq0 + i, sigs[ i ],
+                        chunks ? chunks[ i ] : 0U,
+                        szs ? szs[ i ] : (uint16_t)0,
+                        ctls ? ctls[ i ] : (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                        tspub, tspub );
+  return seq0 + n;
+}
+
 /* ==== dcache ============================================================ */
 
 uint64_t fdt_dcache_chunk_cnt( uint64_t sz ) {
@@ -178,6 +193,22 @@ void fdt_dcache_gather( void const * dcache_base, uint32_t const * chunks,
     memcpy( row, base + (uint64_t)chunks[ i ] * FDT_CHUNK_SZ, sz );
     memset( row + sz, 0, width - sz );
   }
+}
+
+void fdt_dcache_scatter( void * dcache_base, uint64_t * chunk_io,
+                         uint64_t mtu, uint64_t wmark_chunks,
+                         uint8_t const * rows, uint16_t const * szs,
+                         uint64_t n, uint64_t width, uint32_t * out_chunks ) {
+  uint8_t * base  = (uint8_t *)dcache_base;
+  uint64_t  chunk = *chunk_io;
+  for( uint64_t i = 0; i < n; i++ ) {
+    uint64_t sz = szs[ i ];
+    if( sz > width ) sz = width;
+    memcpy( base + chunk * FDT_CHUNK_SZ, rows + i * width, sz );
+    out_chunks[ i ] = (uint32_t)chunk;
+    chunk = fdt_dcache_compact_next( chunk, sz, mtu, wmark_chunks );
+  }
+  *chunk_io = chunk;
 }
 
 /* ==== fseq ============================================================== */

@@ -96,6 +96,17 @@ uint64_t fdt_mcache_drain( void const * mcache, uint64_t * seq_io,
                            uint64_t max, fdt_frag_t * out,
                            uint64_t * overrun_cnt );
 
+/* Batch publish for bridge tiles: publish n frags at consecutive seqs
+   starting at seq0 (each release-ordered, so consumers may begin draining
+   the head of the batch while the tail is still being written).  Returns
+   seq0 + n.  Caller is responsible for flow control (n <= cr_avail). */
+uint64_t fdt_mcache_publish_batch( void * mcache, uint64_t seq0,
+                                   uint64_t const * sigs,
+                                   uint32_t const * chunks,
+                                   uint16_t const * szs,
+                                   uint16_t const * ctls,
+                                   uint32_t tspub, uint64_t n );
+
 /* ---- dcache: chunk-addressed payload region ---------------------------- */
 
 /* A dcache is just bytes; the compact circular bump allocation discipline
@@ -118,6 +129,17 @@ uint64_t fdt_dcache_compact_next( uint64_t chunk, uint64_t sz,
 void fdt_dcache_gather( void const * dcache_base, uint32_t const * chunks,
                         uint16_t const * szs, uint64_t n, uint64_t width,
                         uint8_t * out );
+
+/* Batch scatter for bridge tiles: the producer-side dual of gather.  Copy n
+   payloads (rows of a dense (n, width) matrix, row i holding szs[i] live
+   bytes) into the dcache using the compact circular discipline starting at
+   chunk index *chunk_io, recording each payload's chunk index in
+   out_chunks[i].  *chunk_io is advanced past the batch.  One native call
+   replaces n Python-side write()s. */
+void fdt_dcache_scatter( void * dcache_base, uint64_t * chunk_io,
+                         uint64_t mtu, uint64_t wmark_chunks,
+                         uint8_t const * rows, uint16_t const * szs,
+                         uint64_t n, uint64_t width, uint32_t * out_chunks );
 
 /* ---- fseq: consumer progress backchannel ------------------------------- */
 

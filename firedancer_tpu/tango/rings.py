@@ -47,6 +47,8 @@ def _load() -> ct.CDLL:
         "fdt_mcache_publish": (None, [vp, u64, u64, u32, u16, u16, u32, u32]),
         "fdt_mcache_poll": (i32, [vp, u64, vp, vp]),
         "fdt_mcache_drain": (u64, [vp, vp, u64, vp, vp]),
+        "fdt_mcache_publish_batch": (u64, [vp, u64, vp, vp, vp, vp, u32, u64]),
+        "fdt_dcache_scatter": (None, [vp, vp, u64, u64, vp, vp, u64, u64, vp]),
         "fdt_dcache_footprint": (u64, [u64, u64]),
         "fdt_dcache_chunk_cnt": (u64, [u64]),
         "fdt_dcache_compact_next": (u64, [u64, u64, u64, u64]),
@@ -222,6 +224,32 @@ class MCache:
         )
         return out[:n], seq_io.value, ovr.value
 
+    def publish_batch(
+        self,
+        seq0: int,
+        sigs: np.ndarray,
+        chunks: np.ndarray | None = None,
+        szs: np.ndarray | None = None,
+        ctls: np.ndarray | None = None,
+        tspub: int = 0,
+    ) -> int:
+        """Publish len(sigs) frags at consecutive seqs; returns the new seq."""
+        sigs = np.ascontiguousarray(sigs, dtype=np.uint64)
+        # converted copies must stay referenced until the native call returns
+        chunks = None if chunks is None else np.ascontiguousarray(chunks, np.uint32)
+        szs = None if szs is None else np.ascontiguousarray(szs, np.uint16)
+        ctls = None if ctls is None else np.ascontiguousarray(ctls, np.uint16)
+        return _lib.fdt_mcache_publish_batch(
+            _ptr(self.mem),
+            seq0,
+            sigs.ctypes.data,
+            None if chunks is None else chunks.ctypes.data,
+            None if szs is None else szs.ctypes.data,
+            None if ctls is None else ctls.ctypes.data,
+            tspub,
+            len(sigs),
+        )
+
 
 # ---------------------------------------------------------------------------
 # dcache
@@ -277,6 +305,34 @@ class DCache:
             out.ctypes.data,
         )
         return out
+
+    def write_batch(self, rows: np.ndarray, szs: np.ndarray) -> np.ndarray:
+        """Producer-side dual of read_batch: scatter n payloads (rows of a
+        dense (n, width) u8 matrix, row i holding szs[i] live bytes) into
+        the dcache at the cursor.  Returns the chunk index of each payload.
+        One native call."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        szs = np.ascontiguousarray(szs, dtype=np.uint16)
+        if len(szs) and int(szs.max()) > self.mtu:
+            raise ValueError(
+                f"payload sz {int(szs.max())} exceeds dcache mtu {self.mtu}"
+            )
+        n, width = rows.shape
+        out_chunks = np.empty(n, dtype=np.uint32)
+        chunk_io = ct.c_uint64(self.chunk)
+        _lib.fdt_dcache_scatter(
+            _ptr(self.mem),
+            ct.byref(chunk_io),
+            self.mtu,
+            self.wmark_chunks,
+            rows.ctypes.data,
+            szs.ctypes.data,
+            n,
+            width,
+            out_chunks.ctypes.data,
+        )
+        self.chunk = chunk_io.value
+        return out_chunks
 
 
 # ---------------------------------------------------------------------------

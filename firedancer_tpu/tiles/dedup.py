@@ -1,0 +1,43 @@
+"""Dedup tile: drops transactions whose signature tag was already seen.
+
+Reference model: src/app/fdctl/run/tiles/fd_dedup.c — a single tile
+downstream of all verify tiles applying one FD_TCACHE_INSERT per frag on
+the tango sig field (first 8 bytes of the ed25519 signature), with a
+multi-million-entry tag cache (default 4,194,302,
+src/app/fdctl/config/default.toml:760).  Here the whole drained batch is
+deduped in one native call (fdt_tcache_dedup) and survivors are forwarded
+in one scatter+publish."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.tango import rings as R
+
+
+class DedupTile(Tile):
+    schema = MetricsSchema(counters=("dup_txns",))
+
+    def __init__(self, *, depth: int = 1 << 22, name: str = "dedup"):
+        self.name = name
+        self.depth = depth
+        self._tc: R.TCache | None = None
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        map_cnt = R.TCache.map_cnt_for(self.depth)
+        mem = np.zeros(R.TCache.footprint(self.depth, map_cnt), dtype=np.uint8)
+        self._tc = R.TCache(mem, self.depth, map_cnt)
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        dup = self._tc.dedup(frags["sig"])
+        n_dup = int(dup.sum())
+        if n_dup:
+            ctx.metrics.inc("dup_txns", n_dup)
+        keep = ~dup
+        if not keep.any():
+            return
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags[keep])
+        ctx.publish(frags["sig"][keep], rows, frags["sz"][keep])

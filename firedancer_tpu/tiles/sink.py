@@ -1,0 +1,40 @@
+"""Sink tile: terminal consumer that counts (and optionally records) frags.
+
+Test/bench helper — the analog of the rx tiles the reference's multi-tile
+concurrency tests spawn (src/disco/dedup/test_dedup.c:654-660)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+
+class SinkTile(Tile):
+    schema = MetricsSchema(counters=("sunk_frags",))
+
+    def __init__(self, *, record: bool = False, name: str = "sink"):
+        self.name = name
+        self.record = record
+        self.sigs: list[np.ndarray] = []
+        self.payloads: list[np.ndarray] = []
+        self.lock = threading.Lock()
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        ctx.metrics.inc("sunk_frags", len(frags))
+        if self.record:
+            rows = ctx.ins[in_idx].gather(frags)
+            with self.lock:
+                self.sigs.append(frags["sig"].copy())
+                self.payloads.append(rows)
+
+    def all_sigs(self) -> np.ndarray:
+        with self.lock:
+            return (
+                np.concatenate(self.sigs)
+                if self.sigs
+                else np.zeros(0, dtype=np.uint64)
+            )

@@ -1,0 +1,100 @@
+"""Inter-tile wire format for the ingress pipeline.
+
+Reference model: the quic tile publishes payload + parsed fd_txn_t + sz as
+one dcache entry (FD_TPU_DCACHE_MTU = 2086, src/disco/fd_disco_base.h:31-35)
+so downstream tiles never re-parse.  Our equivalent is leaner: the parse
+descriptor fields the verify/dedup/pack tiles actually need are packed into
+a fixed 16-byte trailer appended to the raw txn bytes:
+
+    [ txn bytes (txn_sz)
+      | u16 sig_off | u16 pub_off | u16 msg_off | u16 msg_len | u16 txn_sz
+      | u8 sig_cnt | u8 acct_cnt | u8 ro_signed_cnt | u8 ro_unsigned_cnt
+      | u16 blockhash_off ]
+
+frag.sz = txn_sz + 16.  All trailer fields are little-endian, and every
+consumer extracts them with vectorized numpy gathers — no per-frag Python
+on the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+
+TRAILER_SZ = 16
+#: dcache MTU for pipeline links carrying txn+trailer payloads
+LINK_MTU = T.MTU + TRAILER_SZ
+
+
+def append_trailer(payload: bytes, desc: T.TxnDesc) -> bytes:
+    """Producer side (synth/quic tile): serialize the trailer."""
+    n = len(payload)
+    tr = np.zeros(TRAILER_SZ, dtype=np.uint8)
+    u16 = tr[:10].view("<u2")
+    u16[0] = desc.signature_off
+    u16[1] = desc.acct_addr_off
+    u16[2] = desc.message_off
+    u16[3] = n - desc.message_off
+    u16[4] = n
+    tr[10] = desc.signature_cnt
+    tr[11] = desc.acct_addr_cnt
+    tr[12] = desc.readonly_signed_cnt
+    tr[13] = desc.readonly_unsigned_cnt
+    tr[14:16].view("<u2")[0] = desc.recent_blockhash_off
+    return payload + tr.tobytes()
+
+
+def parse_trailers(rows: np.ndarray, szs: np.ndarray) -> dict[str, np.ndarray]:
+    """Vectorized trailer extraction from gathered (n, width) payload rows.
+
+    Returns int32 arrays per trailer field.
+    """
+    n = len(rows)
+    base = (szs.astype(np.int64) - TRAILER_SZ)[:, None]
+    idx = base + np.arange(TRAILER_SZ, dtype=np.int64)[None, :]
+    tb = rows[np.arange(n)[:, None], idx].astype(np.int32)
+    u16 = tb[:, 0:10:2] | (tb[:, 1:10:2] << 8)
+    return {
+        "sig_off": u16[:, 0],
+        "pub_off": u16[:, 1],
+        "msg_off": u16[:, 2],
+        "msg_len": u16[:, 3],
+        "txn_sz": u16[:, 4],
+        "sig_cnt": tb[:, 10],
+        "acct_cnt": tb[:, 11],
+        "ro_signed_cnt": tb[:, 12],
+        "ro_unsigned_cnt": tb[:, 13],
+        "bh_off": tb[:, 14] | (tb[:, 15] << 8),
+    }
+
+
+def expand_sig_lanes(rows: np.ndarray, tr: dict[str, np.ndarray], msg_width: int):
+    """Expand n txns into one verify lane per signature, fully vectorized.
+
+    Signer pubkey j signs the message with signature j (fd_txn_verify
+    behavior, /root/reference/src/app/fdctl/run/tiles/fd_verify.h:43-88).
+
+    Returns (msgs (L, msg_width) u8 zero-padded, lens (L,) i32,
+    sigs (L, 64) u8, pubs (L, 32) u8, txn_idx (L,) i32).
+    """
+    n = len(rows)
+    cnt = tr["sig_cnt"].astype(np.int64)
+    txn_idx = np.repeat(np.arange(n, dtype=np.int64), cnt)
+    lanes = len(txn_idx)
+    # per-lane signature index within its txn: 0..cnt[t]-1
+    starts = np.concatenate(([0], np.cumsum(cnt)[:-1]))
+    sig_j = np.arange(lanes, dtype=np.int64) - np.repeat(starts, cnt)
+
+    sig_base = tr["sig_off"].astype(np.int64)[txn_idx] + 64 * sig_j
+    sigs = rows[txn_idx[:, None], sig_base[:, None] + np.arange(64)]
+    pub_base = tr["pub_off"].astype(np.int64)[txn_idx] + 32 * sig_j
+    pubs = rows[txn_idx[:, None], pub_base[:, None] + np.arange(32)]
+
+    msg_off = tr["msg_off"].astype(np.int64)[txn_idx]
+    msg_len = tr["msg_len"].astype(np.int64)[txn_idx]
+    col = np.arange(msg_width, dtype=np.int64)[None, :]
+    src = np.minimum(msg_off[:, None] + col, rows.shape[1] - 1)
+    msgs = rows[txn_idx[:, None], src]
+    msgs = np.where(col < msg_len[:, None], msgs, 0).astype(np.uint8)
+    return msgs, msg_len.astype(np.int32), sigs, pubs, txn_idx.astype(np.int32)

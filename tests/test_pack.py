@@ -1,0 +1,311 @@
+"""Pack engine tests: cost/reward estimation, conflict-aware greedy
+scheduling, writer-cost caps, block budgets, completion/release, and
+host↔device select equivalence."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import compute_budget as CB
+from firedancer_tpu.ballet import pack as P
+from firedancer_tpu.ballet import txn as T
+
+
+def _mk_txn(
+    payer: bytes,
+    writables: list[bytes],
+    readonlys: list[bytes],
+    *,
+    cu_limit: int | None = None,
+    cu_price: int | None = None,
+    blockhash: bytes = bytes(32),
+    data: bytes = b"\x01" * 16,
+) -> bytes:
+    """One-signature txn touching the given accounts."""
+    program = b"\xaa" * 32
+    addrs = [payer] + writables + readonlys + [program]
+    instrs = []
+    cb_idx = None
+    if cu_limit is not None or cu_price is not None:
+        addrs.append(CB.COMPUTE_BUDGET_PROGRAM_ID)
+        cb_idx = len(addrs) - 1
+        if cu_limit is not None:
+            instrs.append((cb_idx, [], b"\x02" + int(cu_limit).to_bytes(4, "little")))
+        if cu_price is not None:
+            instrs.append((cb_idx, [], b"\x03" + int(cu_price).to_bytes(8, "little")))
+    acct_idxs = list(range(1 + len(writables) + len(readonlys)))
+    instrs.append((len(addrs) - 1 if cb_idx is None else cb_idx - 1, acct_idxs, data))
+    # readonly unsigned: the readonlys + program(s)
+    ro_unsigned = len(readonlys) + 1 + (1 if cb_idx is not None else 0)
+    body = T.build([bytes(64)], addrs, blockhash, instrs,
+                   readonly_unsigned_cnt=ro_unsigned)
+    assert T.parse(body) is not None
+    return body
+
+
+def _acct(i: int) -> bytes:
+    return bytes([i]) + bytes(31)
+
+
+# ---------------------------------------------------------------------------
+# compute budget / cost model
+
+
+def test_estimate_defaults():
+    tx = _mk_txn(_acct(1), [_acct(2)], [_acct(3)])
+    d = T.parse(tx)
+    est = CB.estimate(tx, d)
+    assert est.ok
+    assert est.cu_limit == CB.DEFAULT_INSTR_CU_LIMIT  # one non-budget instr
+    assert est.rewards == CB.FEE_PER_SIGNATURE
+    # 1 sig + 2 writable (payer+acct) + data/4 + bpf cu
+    expected = 720 + 2 * 300 + len(b"\x01" * 16) // 4 + est.cu_limit
+    assert est.cost == expected
+
+
+def test_estimate_cu_limit_and_price():
+    tx = _mk_txn(_acct(1), [], [], cu_limit=50_000, cu_price=2_000_000)
+    d = T.parse(tx)
+    est = CB.estimate(tx, d)
+    assert est.ok
+    assert est.cu_limit == 50_000
+    # rewards = 5000 + ceil(50_000 * 2_000_000 / 1e6) = 5000 + 100_000
+    assert est.rewards == 105_000
+
+
+def test_estimate_rejects_duplicate_budget_instr():
+    payer = _acct(1)
+    addrs = [payer, CB.COMPUTE_BUDGET_PROGRAM_ID]
+    ins = (1, [], b"\x02" + (1000).to_bytes(4, "little"))
+    body = T.build([bytes(64)], addrs, bytes(32), [ins, ins],
+                   readonly_unsigned_cnt=1)
+    d = T.parse(body)
+    assert d is not None
+    assert not CB.estimate(body, d).ok
+
+
+def test_budget_state_deprecated_request_units():
+    st = CB.BudgetState()
+    assert st.parse_instr(b"\x00" + (7000).to_bytes(4, "little") + (123).to_bytes(4, "little"))
+    # counts as both SET_CU and SET_FEE
+    assert not st.parse_instr(b"\x02" + (1).to_bytes(4, "little"))
+    rewards, cu = st.finalize(1)
+    assert rewards == 123 and cu == 7000
+
+
+# ---------------------------------------------------------------------------
+# pack engine
+
+
+def _pack(depth=64, **kw):
+    return P.Pack(depth, max_banks=4, **kw)
+
+
+def test_insert_and_schedule_nonconflicting():
+    pk = _pack()
+    for i in range(10):
+        tx = _mk_txn(_acct(10 + i), [_acct(100 + i)], [_acct(200)])
+        assert pk.insert(tx, sig_tag=i + 1) == "ok"
+    assert pk.pending_cnt == 10
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000, txn_limit=31)
+    assert mb is not None
+    # all 10 share only a READ-ONLY account -> no conflicts, all picked
+    assert len(mb.txn_idx) == 10
+    assert pk.inflight_cnt == 10 and pk.pending_cnt == 0
+    pk.microblock_complete(0, mb.handle)
+    assert pk.inflight_cnt == 0
+    assert (pk.bit_ref_rw == 0).all() and (pk.bit_ref_w == 0).all()
+    assert pk.in_use_rw.sum() == 0 and pk.in_use_w.sum() == 0
+
+
+def test_schedule_write_conflicts_serialize():
+    pk = _pack()
+    hot = _acct(50)
+    for i in range(4):
+        tx = _mk_txn(_acct(10 + i), [hot], [], cu_price=(4 - i) * 1_000_000)
+        assert pk.insert(tx) == "ok"
+    mb1 = pk.schedule_microblock(0, cu_limit=10_000_000)
+    assert mb1 is not None and len(mb1.txn_idx) == 1  # writers serialize
+    # highest priority txn (price 4M) won
+    assert pk.rewards[mb1.txn_idx[0]] == max(pk.rewards[pk.state > 0])
+    mb2 = pk.schedule_microblock(1, cu_limit=10_000_000)
+    assert mb2 is None or len(mb2.txn_idx) == 0 or mb2 is None
+    pk.microblock_complete(0, mb1.handle)
+    mb3 = pk.schedule_microblock(1, cu_limit=10_000_000)
+    assert mb3 is not None and len(mb3.txn_idx) == 1
+
+
+def test_read_write_conflict():
+    pk = _pack()
+    shared = _acct(60)
+    assert pk.insert(_mk_txn(_acct(1), [shared], [])) == "ok"  # writer
+    assert pk.insert(_mk_txn(_acct(2), [], [shared])) == "ok"  # reader
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+    assert len(mb.txn_idx) == 1  # reader blocked by writer (or vice versa)
+
+
+def test_readers_share():
+    pk = _pack()
+    shared = _acct(61)
+    for i in range(5):
+        assert pk.insert(_mk_txn(_acct(1 + i), [], [shared])) == "ok"
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+    assert len(mb.txn_idx) == 5
+
+
+def test_cu_limit_respected():
+    pk = _pack()
+    for i in range(6):
+        tx = _mk_txn(_acct(10 + i), [_acct(100 + i)], [], cu_limit=400_000)
+        assert pk.insert(tx) == "ok"
+    per_cost = int(pk.cost[pk.state == 1][0])
+    budget = int(per_cost * 2.5)
+    mb = pk.schedule_microblock(0, cu_limit=budget)
+    assert len(mb.txn_idx) == 2
+    assert mb.total_cost <= budget
+
+
+def test_writer_cost_cap():
+    pk = _pack(writer_cost_cap=1_000_000)
+    hot = _acct(70)
+    # each txn ~ cost 720+600+4+1_400_000? keep cu small so cost ~ small
+    for i in range(8):
+        tx = _mk_txn(_acct(10 + i), [hot], [], cu_limit=200_000)
+        assert pk.insert(tx) == "ok"
+    per_cost = int(pk.cost[pk.state == 1][0])
+    fit = 1_000_000 // per_cost
+    got = 0
+    # writers serialize, so schedule+complete repeatedly within one block
+    for _ in range(8):
+        mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+        if mb is None:
+            break
+        got += len(mb.txn_idx)
+        pk.microblock_complete(0, mb.handle)
+    assert got == fit  # cap blocked the rest
+    pk.end_block()
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+    assert mb is not None  # new block, cap reset
+
+
+def test_block_cost_limit():
+    pk = _pack(block_cost_limit=2_000_000)
+    for i in range(20):
+        tx = _mk_txn(_acct(10 + i), [_acct(100 + i)], [], cu_limit=900_000)
+        assert pk.insert(tx) == "ok"
+    total = 0
+    while True:
+        mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+        if mb is None:
+            break
+        total += mb.total_cost
+        pk.microblock_complete(0, mb.handle)
+    assert total <= 2_000_000
+
+
+def test_expiration():
+    pk = _pack()
+    assert pk.insert(_mk_txn(_acct(1), [_acct(2)], []), expires_at=100) == "ok"
+    assert pk.insert(_mk_txn(_acct(3), [_acct(4)], []), expires_at=300) == "ok"
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000, now=200)
+    assert len(mb.txn_idx) == 1
+    assert pk.expires_at[mb.txn_idx[0]] == 300
+    assert pk.pending_cnt == 0  # expired one was dropped
+
+
+def test_no_expiry_default_never_expires():
+    pk = _pack()
+    assert pk.insert(_mk_txn(_acct(1), [_acct(2)], [])) == "ok"
+    mb = pk.schedule_microblock(0, cu_limit=10_000_000, now=10**18)
+    assert mb is not None and len(mb.txn_idx) == 1
+
+
+def test_replacement_when_full():
+    pk = _pack(depth=4)
+    for i in range(4):
+        tx = _mk_txn(_acct(10 + i), [_acct(100 + i)], [], cu_price=1_000_000)
+        assert pk.insert(tx) == "ok"
+    # worse priority -> rejected full
+    lowtx = _mk_txn(_acct(30), [_acct(130)], [])
+    assert pk.insert(lowtx) == "full"
+    # better priority -> replaces the worst
+    hitx = _mk_txn(_acct(31), [_acct(131)], [], cu_price=50_000_000)
+    assert pk.insert(hitx) == "ok"
+    assert pk.pending_cnt == 4
+
+
+def test_insert_rejects_garbage():
+    pk = _pack()
+    assert pk.insert(b"\x00" * 40) == "parse"
+
+
+# ---------------------------------------------------------------------------
+# device prefilter equivalence
+
+
+def test_device_select_matches_host_greedy():
+    from firedancer_tpu.ops import pack_select
+
+    rng = np.random.default_rng(23)
+    K, W = 64, 4
+    for trial in range(5):
+        # sparse random bitsets: a few bits per candidate
+        cand_rw = np.zeros((K, W), dtype=np.uint64)
+        cand_w = np.zeros((K, W), dtype=np.uint64)
+        for i in range(K):
+            for b in rng.integers(0, W * 64, 4):
+                cand_rw[i, b >> 6] |= np.uint64(1) << np.uint64(b & 63)
+            for b in rng.integers(0, W * 64, 2):
+                w = np.uint64(1) << np.uint64(b & 63)
+                cand_w[i, b >> 6] |= w
+        cand_rw |= cand_w  # writes are also reads
+        in_use_rw = np.zeros(W, dtype=np.uint64)
+        in_use_w = np.zeros(W, dtype=np.uint64)
+        for b in rng.integers(0, W * 64, 8):
+            in_use_rw[b >> 6] |= np.uint64(1) << np.uint64(b & 63)
+        costs = rng.integers(1000, 500_000, K).astype(np.int64)
+        cu_limit = int(costs.sum() // 3)
+        txn_limit = 16
+
+        got = pack_select.select_noconflict(
+            cand_rw, cand_w, in_use_rw, in_use_w, costs, cu_limit, txn_limit
+        )
+
+        # host-side oracle: same greedy rules
+        sel_rw, sel_w = in_use_rw.copy(), in_use_w.copy()
+        cu, taken = 0, 0
+        want = np.zeros(K, dtype=bool)
+        for i in range(K):
+            c = int(costs[i])
+            if cu + c > cu_limit or taken >= txn_limit:
+                continue
+            if (cand_w[i] & sel_rw).any() or (cand_rw[i] & sel_w).any():
+                continue
+            want[i] = True
+            sel_rw |= cand_rw[i]
+            sel_w |= cand_w[i]
+            cu += c
+            taken += 1
+        assert (got == want).all(), f"trial {trial}"
+
+
+def test_schedule_with_device_select():
+    from firedancer_tpu.ops import pack_select
+
+    pk = _pack()
+    hot = _acct(80)
+    for i in range(12):
+        writables = [hot] if i % 3 == 0 else [_acct(100 + i)]
+        tx = _mk_txn(_acct(10 + i), writables, [], cu_price=(i + 1) * 100_000)
+        assert pk.insert(tx) == "ok"
+    # two engines, same inserts: device-assisted must match host-only
+    pk2 = _pack()
+    for i in range(12):
+        writables = [hot] if i % 3 == 0 else [_acct(100 + i)]
+        tx = _mk_txn(_acct(10 + i), writables, [], cu_price=(i + 1) * 100_000)
+        assert pk2.insert(tx) == "ok"
+    mb_host = pk.schedule_microblock(0, cu_limit=10_000_000)
+    mb_dev = pk2.schedule_microblock(
+        0, cu_limit=10_000_000, device_select=pack_select.select_noconflict
+    )
+    assert (np.sort(pk.sig_tag[mb_host.txn_idx]) == np.sort(pk2.sig_tag[mb_dev.txn_idx])).all()
+    assert (mb_host.txn_idx == mb_dev.txn_idx).all()
