@@ -227,12 +227,27 @@ class Pack:
         costs = self.cost[order].astype(np.int64)
 
         if device_select is not None:
+            # pad candidates to the fixed scan_limit shape so the jitted
+            # select kernel compiles once; sentinel rows carry a cost above
+            # any cu_limit, so they are never taken
+            K = len(order)
+            if K < scan_limit:
+                pad = scan_limit - K
+                cand_rw = np.concatenate(
+                    [cand_rw, np.zeros((pad, self.W), np.uint64)]
+                )
+                cand_w = np.concatenate(
+                    [cand_w, np.zeros((pad, self.W), np.uint64)]
+                )
+                costs = np.concatenate(
+                    [costs, np.full(pad, 1 << 30, np.int64)]
+                )
             take = np.asarray(
                 device_select(
                     cand_rw, cand_w, self.in_use_rw, self.in_use_w, costs,
                     cu_limit, txn_limit,
                 )
-            )
+            )[:K]
             picks = order[take]
         else:
             picks_l: list[int] = []

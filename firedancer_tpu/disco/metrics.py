@@ -100,15 +100,16 @@ class Metrics:
 
     def hist_sample_many(self, name: str, values: np.ndarray) -> None:
         h = self._hist[name]
-        v = np.maximum(np.asarray(values, dtype=np.int64), 1)
+        raw = np.asarray(values, dtype=np.int64)
+        v = np.maximum(raw, 1)  # bucketing floor only; sum uses raw values
         buckets = np.minimum(
             np.floor(np.log2(v)).astype(np.int64), HIST_BUCKETS - 1
         )
         counts = np.bincount(buckets, minlength=HIST_BUCKETS).astype(np.uint64)
         w = self.words
         w[h.base : h.base + HIST_BUCKETS] += counts
-        w[h.base + HIST_BUCKETS] += np.uint64(int(v.sum()))
-        w[h.base + HIST_BUCKETS + 1] += np.uint64(len(v))
+        w[h.base + HIST_BUCKETS] += np.uint64(int(np.maximum(raw, 0).sum()))
+        w[h.base + HIST_BUCKETS + 1] += np.uint64(len(raw))
 
     # -- reader side (any process) ---------------------------------------
 

@@ -26,6 +26,8 @@ reduced form; everything else stays loose.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 
 import jax
@@ -85,47 +87,101 @@ SQRT_M1_C = const(SQRT_M1)
 _P32 = int_to_limbs(32 * P).reshape(NLIMB, 1)
 _P_LIMBS = int_to_limbs(P).reshape(NLIMB, 1)
 
+# ---------------------------------------------------------------------------
+# Constant routing.  Pallas kernels cannot capture array constants — they
+# must arrive as kernel inputs.  All field/point code fetches its array
+# constants through c(name), which normally returns the module-level numpy
+# value but, inside a `const_scope({...})`, returns the kernel-provided
+# VMEM-resident slice instead.  (See pallas_kernel.py for the packing.)
+# ---------------------------------------------------------------------------
+
+_CONST_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "fdt_field_consts", default=None
+)
+
+_CONST_TABLE: dict[str, np.ndarray] = {}
+
+
+def register_const(name: str, value: np.ndarray) -> None:
+    _CONST_TABLE[name] = value
+
+
+def c(name: str):
+    o = _CONST_OVERRIDE.get()
+    if o is not None and name in o:
+        return o[name]
+    return jnp.asarray(_CONST_TABLE[name])
+
+
+@contextlib.contextmanager
+def const_scope(consts: dict):
+    tok = _CONST_OVERRIDE.set(consts)
+    try:
+        yield
+    finally:
+        _CONST_OVERRIDE.reset(tok)
+
+
+register_const("ONE", ONE)
+register_const("D2", D2_C)
+register_const("D", D_C)
+register_const("SQRT_M1", SQRT_M1_C)
+register_const("P32", _P32)
+register_const("P", _P_LIMBS)
+
 
 # ---------------------------------------------------------------------------
 # Carry plumbing
 # ---------------------------------------------------------------------------
 
+# NOTE on indexing style throughout this module: kernel-reachable code
+# uses ONLY static slices (x[i:i+1]), concatenate, and reshape — never
+# scalar integer indexing (x[i], x[-1]) or .at[] updates, because those
+# lower to dynamic_slice / dynamic_update_slice, which Mosaic (Pallas TPU)
+# cannot lower.  Carries therefore keep their (1, B) limb axis.
+
+
+def _add_at0(x, v):
+    """x with v (shape (1, B)) added to limb 0."""
+    return jnp.concatenate([x[0:1] + v, x[1:]], axis=0)
+
+
 def _pass(x):
     """One parallel carry pass: x -> same value, limbs closer to 13-bit.
 
-    Returns (limbs, carry_out) where carry_out is the (signed) carry shifted
-    out of the top limb.  Arithmetic >> gives floor semantics, so negative
-    limbs carry correctly.
+    Returns (limbs, carry_out (1, B)) where carry_out is the (signed)
+    carry shifted out of the top limb.  Arithmetic >> gives floor
+    semantics, so negative limbs carry correctly.
     """
     lo = x & MASK
     hi = x >> RADIX
     shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
-    return lo + shifted, hi[-1]
+    return lo + shifted, hi[-1:]
 
 
 def _carry20(x):
     """Normalize a (NLIMB, B) loose value: two passes, 2^260-fold carries."""
     x, co = _pass(x)
-    x = x.at[0].add(co * FOLD)
+    x = _add_at0(x, co * FOLD)
     x, co = _pass(x)
-    x = x.at[0].add(co * FOLD)
+    x = _add_at0(x, co * FOLD)
     return x
 
 
 def ripple(x):
     """Exact sequential carry over NLIMB limbs: -> (limbs, carry_out).
 
-    Output limbs are in [0, 2^13); carry_out holds the (signed) overflow,
-    i.e. value == sum(limbs_i 2^13i) + carry_out 2^260.  Shared by field
-    canonicalization and the scalar (mod L) code.
+    Output limbs are in [0, 2^13); carry_out (shape (1, B)) holds the
+    (signed) overflow, i.e. value == sum(limbs_i 2^13i) + carry_out 2^260.
+    Shared by field canonicalization and the scalar (mod L) code.
     """
     out = []
-    carry = jnp.zeros_like(x[0])
+    carry = jnp.zeros_like(x[0:1])
     for i in range(x.shape[0]):
-        v = x[i] + carry
+        v = x[i : i + 1] + carry
         out.append(v & MASK)
         carry = v >> RADIX
-    return jnp.stack(out, axis=0), carry
+    return jnp.concatenate(out, axis=0), carry
 
 
 def _reduce_conv(c):
@@ -139,7 +195,7 @@ def _reduce_conv(c):
     # indices NLIMB..2*NLIMB fold with one (or for the top pad limb, two)
     # applications of 2^260 === FOLD
     lo = lo + FOLD * hi[:NLIMB]
-    lo = lo.at[0].add((FOLD * FOLD) * hi[NLIMB])
+    lo = _add_at0(lo, (FOLD * FOLD) * hi[NLIMB : NLIMB + 1])
     return _carry20(lo)
 
 
@@ -176,9 +232,25 @@ def mul(a, b):
     a = _carry20(a)
     b = _carry20(b)
     batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    # broadcast constants ((NLIMB, 1) elements) to the full batch up front:
+    # a lanes-only broadcast here keeps the per-limb products from needing
+    # a both-axes (1,1)->(NLIMB,B) broadcast, which Mosaic cannot lower
+    if a.shape[1:] != batch:
+        a = jnp.broadcast_to(a, (a.shape[0],) + batch)
+    if b.shape[1:] != batch:
+        b = jnp.broadcast_to(b, (b.shape[0],) + batch)
+    # accumulate shifted products via zero-padding + add (static shapes
+    # only; .at[i:i+NLIMB].add would emit dynamic_update_slice, which has
+    # no Mosaic lowering)
     c = jnp.zeros((2 * NLIMB + 1,) + batch, dtype=jnp.int32)
     for i in range(NLIMB):
-        c = c.at[i : i + NLIMB].add(a[i] * b)
+        prod = jnp.broadcast_to(a[i : i + 1] * b, (NLIMB,) + batch)
+        parts = []
+        if i:  # zero-sized arrays don't lower under Mosaic
+            parts.append(jnp.zeros((i,) + batch, jnp.int32))
+        parts.append(prod)
+        parts.append(jnp.zeros((NLIMB + 1 - i,) + batch, jnp.int32))
+        c = c + jnp.concatenate(parts, axis=0)
     return _reduce_conv(c)
 
 
@@ -251,19 +323,21 @@ def canonical(a):
     """Loose -> unique canonical limbs in [0, p), fully carried."""
     # Normalize first so |value| < 2^248-ish, then make non-negative by
     # adding 32p = 2^260 - 608.
-    x = _carry20(a) + _P32
+    x = _carry20(a) + c("P32")
     x, carry_out = ripple(x)
     # carry_out in [0, 2]: fold 2^260 -> 608 and ripple again (small).
-    x, _ = ripple(x.at[0].add(carry_out * FOLD))
+    x, _ = ripple(_add_at0(x, carry_out * FOLD))
     # Now 0 <= x < 2^260.  Fold bits >= 255 (limb 19 holds bits 247..259):
     for _ in range(2):
-        hi = x[NLIMB - 1] >> 8
-        x = x.at[NLIMB - 1].set(x[NLIMB - 1] & 0xFF)
-        x, _ = ripple(x.at[0].add(hi * 19))
+        hi = x[NLIMB - 1 :] >> 8
+        x = jnp.concatenate(
+            [x[: NLIMB - 1], x[NLIMB - 1 :] & 0xFF], axis=0
+        )
+        x, _ = ripple(_add_at0(x, hi * 19))
     # 0 <= x < 2^255: subtract p once if x >= p.
-    d, borrow = ripple(x - _P_LIMBS)
-    ge_p = borrow >= 0  # no net borrow out of the top
-    return jnp.where(ge_p[None], d, x)
+    d, borrow = ripple(x - c("P"))
+    ge_p = borrow >= 0  # (1, B): no net borrow out of the top
+    return jnp.where(ge_p, d, x)
 
 
 def eq(a, b):

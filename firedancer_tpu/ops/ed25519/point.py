@@ -32,7 +32,7 @@ from . import golden
 
 def identity(batch: int):
     z = jnp.zeros((F.NLIMB, batch), jnp.int32)
-    one = jnp.broadcast_to(jnp.asarray(F.ONE), (F.NLIMB, batch))
+    one = jnp.broadcast_to(F.c("ONE"), (F.NLIMB, batch))
     return (z, one, one, z)
 
 
@@ -47,7 +47,7 @@ def add(p, q):
     x2, y2, z2, t2 = q
     a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
     b = F.mul(F.add(y1, x1), F.add(y2, x2))
-    c = F.mul(F.mul(t1, jnp.asarray(F.D2_C)), t2)
+    c = F.mul(F.mul(t1, F.c("D2")), t2)
     d = F.mul_small(F.mul(z1, z2), 2)
     e = F.sub(b, a)
     f = F.sub(d, c)
@@ -74,21 +74,28 @@ def double(p):
 # ---------------------------------------------------------------------------
 
 
-def decompress(b):
-    """(B, 32) uint8 -> (point, ok).
+def decompress_bytes(b):
+    """(B, 32) uint8 -> (y limbs (NLIMB, B), sign (1, B)) — the byte
+    parsing half of decompress (XLA side; byte gathers don't lower under
+    Mosaic)."""
+    sign = (b[..., 31:32] >> 7).astype(jnp.int32).T
+    b_masked = b.at[..., 31].set(b[..., 31] & 0x7F)
+    return F.from_bytes(b_masked), sign
+
+
+def decompress_limbs(y, sign):
+    """(y limbs, sign (1, B)) -> (point, ok (B,)) — the field-math half of
+    decompress; Mosaic-safe, runs inside the Pallas verify kernel.
 
     Matches the reference verify rules: non-canonical y (>= p) accepted,
     sqrt failure rejected, x == 0 with sign bit set ("negative zero")
     rejected.  Lanes with ok == False carry garbage coordinates; callers
     mask them out of the final verdict.
     """
-    sign = (b[..., 31] >> 7).astype(jnp.int32)
-    b_masked = b.at[..., 31].set(b[..., 31] & 0x7F)
-    y = F.from_bytes(b_masked)
-    one = jnp.asarray(F.ONE)
+    one = F.c("ONE")
     ysq = F.sqr(y)
     u = F.sub(ysq, one)
-    v = F.add(F.mul(jnp.asarray(F.D_C), ysq), one)
+    v = F.add(F.mul(F.c("D"), ysq), one)
     # candidate root x = u v^3 (u v^7)^((p-5)/8)   (ref10 trick)
     v3 = F.mul(F.sqr(v), v)
     v7 = F.mul(F.sqr(v3), v)
@@ -97,16 +104,23 @@ def decompress(b):
     vxx = F.mul(v, F.sqr(x))
     ok_direct = F.eq(vxx, u)
     ok_flip = F.eq(vxx, F.neg(u))
-    x = jnp.where(ok_flip[None], F.mul(x, jnp.asarray(F.SQRT_M1_C)), x)
+    x = jnp.where(ok_flip[None], F.mul(x, F.c("SQRT_M1")), x)
     ok = ok_direct | ok_flip
     # negative zero: x == 0 with sign bit set is not a valid encoding
     x_is_zero = F.is_zero(x)
-    ok = ok & ~(x_is_zero & (sign == 1))
+    ok = ok & ~(x_is_zero & jnp.squeeze(sign == 1, axis=0))
     # choose the root with matching parity
-    flip = (F.parity(x) != sign) & ~x_is_zero
-    x = jnp.where(flip[None], F.neg(x), x)
-    z = jnp.broadcast_to(one, x.shape)
+    par = F.canonical(x)[0:1] & 1  # (1, B)
+    flip = (par != sign) & ~x_is_zero[None]
+    x = jnp.where(flip, F.neg(x), x)
+    z = jnp.broadcast_to(jnp.asarray(one), x.shape)
     return (x, y, z, F.mul(x, y)), ok
+
+
+def decompress(b):
+    """(B, 32) uint8 -> (point, ok).  See decompress_limbs for rules."""
+    y, sign = decompress_bytes(b)
+    return decompress_limbs(y, sign)
 
 
 def compress(p):
@@ -166,6 +180,7 @@ def _build_base_table() -> np.ndarray:
 
 
 B_TABLE = _build_base_table()
+F.register_const("B_TABLE", B_TABLE)
 
 
 def build_neg_table(a_pt):
@@ -181,11 +196,16 @@ def build_neg_table(a_pt):
 
 def _lookup(table, idx):
     """table (16, 4, NLIMB, B or 1), idx (B,) -> point with batch B."""
-    sel = (jnp.arange(16, dtype=jnp.int32)[:, None] == idx[None, :]).astype(
-        jnp.int32
-    )  # (16, B)
+    # broadcasted_iota + static split keep this Mosaic-lowerable (1D iota
+    # and scalar integer indexing are not)
+    ent = jax.lax.broadcasted_iota(jnp.int32, (16, idx.shape[-1]), 0)
+    sel = (ent == idx[None, :]).astype(jnp.int32)  # (16, B)
+    if table.shape[-1] == 1:  # shared table: lanes-only broadcast first
+        table = jnp.broadcast_to(table, table.shape[:-1] + (idx.shape[-1],))
     coords = (table * sel[:, None, None, :]).sum(axis=0)  # (4, NLIMB, B)
-    return (coords[0], coords[1], coords[2], coords[3])
+    x, y, z, t = jnp.split(coords, 4, axis=0)
+    sq = lambda v: jnp.squeeze(v, axis=0)  # noqa: E731
+    return (sq(x), sq(y), sq(z), sq(t))
 
 
 def double_scalar_mul(k_nibbles, neg_a_table, s_nibbles):
@@ -196,7 +216,7 @@ def double_scalar_mul(k_nibbles, neg_a_table, s_nibbles):
     (/root/reference/src/ballet/ed25519/fd_ed25519_user.c:210-214).
     """
     batch = k_nibbles.shape[-1]
-    b_table = jnp.asarray(B_TABLE)
+    b_table = F.c("B_TABLE")
 
     def body(j, acc):
         idx = 63 - j

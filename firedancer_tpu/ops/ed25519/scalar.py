@@ -42,8 +42,8 @@ def from_bytes(b):
 
 def is_canonical(s):
     """(NLIMB, B) canonical-shaped limbs -> (B,) bool: s < L."""
-    _, borrow = _ripple(s - _L_LIMBS)
-    return borrow < 0
+    _, borrow = _ripple(s - _L_LIMBS)  # borrow: (1, B)
+    return jnp.squeeze(borrow, axis=0) < 0
 
 
 def _fold_once(lo, hi):
@@ -83,8 +83,8 @@ def reduce512(digest):
     # Fold the 20 high limbs, then repeatedly fold the single carry limb.
     v = _fold_once(x[:NLIMB], x[NLIMB:])
     for _ in range(5):
-        v, co = _ripple(v)
-        v = _fold_once(v, co[None, :])
+        v, co = _ripple(v)  # co: (1, B)
+        v = _fold_once(v, co)
     v, co = _ripple(v)  # co == 0 now (value < 2^260)
 
     # Final: value < 2^260.  Split at bit 252 (bit 5 of limb 19):
@@ -92,11 +92,11 @@ def reduce512(digest):
     hi = v[NLIMB - 1] >> 5
     lo = v.at[NLIMB - 1].set(v[NLIMB - 1] & 31)
     w = lo - hi[None, :] * _C_LIMBS  # products <= 2^8 * 2^13 = 2^21
-    w, carry = _ripple(w)
+    w, carry = _ripple(w)  # carry: (1, B)
     # carry in {-1, 0}: negative means w < 0 -> add L once (w > -2^134).
     neg = carry < 0
     w_fixed, _ = _ripple(w + _L_LIMBS)
-    return jnp.where(neg[None, :], w_fixed, w)
+    return jnp.where(neg, w_fixed, w)
 
 
 def to_nibbles(s):

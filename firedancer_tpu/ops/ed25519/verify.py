@@ -31,12 +31,40 @@ from . import point as PT
 from . import scalar as SC
 
 
-@functools.partial(jax.jit, static_argnames=("msg_len",))
-def _verify_impl(msgs, lens, sigs, pubs, msg_len):
+def _use_pallas() -> bool:
+    """The fused Pallas kernel runs the dsm hot loop on TPU; elsewhere the
+    plain XLA path is used (Pallas interpret mode is for tests only)."""
+    import os
+
+    env = os.environ.get("FDT_VERIFY_PALLAS")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "no", "off")
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("msg_len", "use_pallas"))
+def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
     del msg_len  # captured statically via msgs.shape
     # 1. canonical s
     s_limbs = SC.from_bytes(sigs[:, 32:])
     ok = SC.is_canonical(s_limbs)
+
+    # 4. k = SHA512(R || A || M) mod L  (steps 2/3 fold into the fused
+    # kernel on the pallas path)
+    cat = jnp.concatenate([sigs[:, :32], pubs, msgs], axis=1)
+    digest = _sha.sha512(cat, lens.astype(jnp.int32) + 64)
+    k_limbs = SC.reduce512(digest)
+
+    if use_pallas:
+        # steps 2+3+5 run fused in one Pallas kernel per batch tile
+        from . import pallas_kernel
+
+        a_y, a_sign = PT.decompress_bytes(pubs)
+        r_y, r_sign = PT.decompress_bytes(sigs[:, :32])
+        return ok & pallas_kernel.verify_core(
+            SC.to_nibbles(k_limbs), SC.to_nibbles(s_limbs),
+            a_y, a_sign, r_y, r_sign,
+        )
 
     # 2. decompress
     a_pt, a_ok = PT.decompress(pubs)
@@ -45,11 +73,6 @@ def _verify_impl(msgs, lens, sigs, pubs, msg_len):
 
     # 3. small order
     ok = ok & ~PT.is_small_order(a_pt) & ~PT.is_small_order(r_pt)
-
-    # 4. k = SHA512(R || A || M) mod L
-    cat = jnp.concatenate([sigs[:, :32], pubs, msgs], axis=1)
-    digest = _sha.sha512(cat, lens.astype(jnp.int32) + 64)
-    k_limbs = SC.reduce512(digest)
 
     # 5. [k](-A) + [s]B == R
     neg_a_table = PT.build_neg_table(a_pt)
@@ -69,4 +92,6 @@ def verify_batch(msgs, lens, sigs, pubs):
     sigs = jnp.asarray(sigs, jnp.uint8)
     pubs = jnp.asarray(pubs, jnp.uint8)
     lens = jnp.asarray(lens, jnp.int32)
-    return _verify_impl(msgs, lens, sigs, pubs, msgs.shape[1])
+    return _verify_impl(
+        msgs, lens, sigs, pubs, msgs.shape[1], use_pallas=_use_pallas()
+    )

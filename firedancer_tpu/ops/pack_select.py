@@ -80,13 +80,15 @@ def select_noconflict(
     Returns (K,) bool take mask.  Matches the host engine's sequential
     greedy loop bit for bit (tests assert equality).
     """
+    # cap below 2^30 so the scheduler's padding sentinels (cost 2^30)
+    # never fit and cu_used + c cannot overflow int32
     takes = _select_impl(
         _split_u32(cand_rw),
         _split_u32(cand_w),
         _split_u32(in_use_rw),
         _split_u32(in_use_w),
         jnp.asarray(np.asarray(costs, np.int32)),
-        jnp.int32(int(min(cu_limit, 2**31 - 1))),
+        jnp.int32(int(min(cu_limit, 2**30 - 1))),
         txn_limit,
     )
     return np.asarray(takes)

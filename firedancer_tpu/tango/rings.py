@@ -313,11 +313,14 @@ class DCache:
         One native call."""
         rows = np.ascontiguousarray(rows, dtype=np.uint8)
         szs = np.ascontiguousarray(szs, dtype=np.uint16)
-        if len(szs) and int(szs.max()) > self.mtu:
-            raise ValueError(
-                f"payload sz {int(szs.max())} exceeds dcache mtu {self.mtu}"
-            )
         n, width = rows.shape
+        if len(szs) and int(szs.max()) > min(self.mtu, width):
+            # a sz beyond the row width would publish a frag whose tail the
+            # consumer reads as stale dcache bytes — reject loudly
+            raise ValueError(
+                f"payload sz {int(szs.max())} exceeds "
+                f"min(dcache mtu {self.mtu}, row width {width})"
+            )
         out_chunks = np.empty(n, dtype=np.uint32)
         chunk_io = ct.c_uint64(self.chunk)
         _lib.fdt_dcache_scatter(

@@ -44,6 +44,10 @@ class VerifyTile(Tile):
         shape; right for steady full-rate ingress).  False pads to
         power-of-two buckets (log2(max_lanes) compiled shapes; cheaper on
         trickle traffic)."""
+        assert max_lanes & (max_lanes - 1) == 0, (
+            "max_lanes must be a power of two (pad buckets + warm compiles "
+            "assume it)"
+        )
         self.name = name
         self.msg_width = msg_width
         self.max_lanes = max_lanes

@@ -1,0 +1,93 @@
+"""Stage-by-stage profile of the Ed25519 verify kernel on the real chip.
+
+Usage: python scripts/profile_verify.py [batch]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, *args, n=8):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import sha512 as fsha
+    from firedancer_tpu.ops.ed25519 import field as F
+    from firedancer_tpu.ops.ed25519 import point as PT
+    from firedancer_tpu.ops.ed25519 import scalar as SC
+
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    rng = np.random.default_rng(0)
+    print(f"batch={B} devices={jax.devices()}")
+
+    msgs = rng.integers(0, 256, (B, 192), np.uint8)
+    lens = np.full(B, 192, np.int32)
+    t = timeit(jax.jit(lambda m, l: fsha.sha512(m, l)), msgs, lens)
+    print(f"sha512(192B): {t*1e3:8.2f} ms  {B/t:,.0f}/s")
+
+    pubs = rng.integers(0, 256, (B, 32), np.uint8)
+    dec = jax.jit(lambda b: PT.decompress(b))
+    t = timeit(dec, pubs)
+    print(f"decompress:   {t*1e3:8.2f} ms  {B/t:,.0f}/s")
+
+    # a valid point batch for the group ops
+    pt, _ = dec(pubs)
+    pt = jax.tree.map(lambda x: np.asarray(x), pt)
+
+    tbl = jax.jit(lambda p: PT.build_neg_table(p))
+    t = timeit(tbl, pt)
+    print(f"neg_table:    {t*1e3:8.2f} ms  {B/t:,.0f}/s")
+    table = jax.tree.map(np.asarray, tbl(pt))
+
+    k = rng.integers(0, 16, (64, B), np.int32)
+    s = rng.integers(0, 16, (64, B), np.int32)
+    dsm = jax.jit(lambda kk, tt, ss: PT.double_scalar_mul(kk, tt, ss))
+    t = timeit(dsm, k, jnp.asarray(table), s)
+    print(f"dsm:          {t*1e3:8.2f} ms  {B/t:,.0f}/s")
+
+    # micro: one field mul / sqr / carry
+    a = rng.integers(0, 8192, (F.NLIMB, B), np.int32)
+    b = rng.integers(0, 8192, (F.NLIMB, B), np.int32)
+    mulj = jax.jit(F.mul)
+    t = timeit(mulj, a, b, n=50)
+    print(f"field mul:    {t*1e6:8.1f} us  ({t/B*1e9:.2f} ns/lane)")
+
+    addj = jax.jit(lambda p, q: PT.add(p, q))
+    t = timeit(addj, pt, pt, n=20)
+    print(f"point add:    {t*1e6:8.1f} us")
+    dblj = jax.jit(lambda p: PT.double(p))
+    t = timeit(dblj, pt, n=20)
+    print(f"point double: {t*1e6:8.1f} us")
+
+    # the lookup alone
+    lk = jax.jit(lambda tt, idx: PT._lookup(tt, idx))
+    t = timeit(lk, jnp.asarray(table), k[0], n=50)
+    print(f"lookup:       {t*1e6:8.1f} us")
+
+    # full verify for reference
+    from firedancer_tpu.ops.ed25519 import verify as fver
+
+    sigs = rng.integers(0, 256, (B, 64), np.uint8)
+    vf = jax.jit(fver.verify_batch)
+    t = timeit(vf, msgs, lens, sigs, pubs)
+    print(f"verify_batch: {t*1e3:8.2f} ms  {B/t:,.0f}/s")
+
+
+if __name__ == "__main__":
+    main()
