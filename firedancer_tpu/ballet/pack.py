@@ -239,8 +239,10 @@ class Pack:
                 cand_w = np.concatenate(
                     [cand_w, np.zeros((pad, self.W), np.uint64)]
                 )
+                from firedancer_tpu.ops.pack_select import PAD_COST
+
                 costs = np.concatenate(
-                    [costs, np.full(pad, 1 << 30, np.int64)]
+                    [costs, np.full(pad, PAD_COST, np.int64)]
                 )
             take = np.asarray(
                 device_select(

@@ -101,7 +101,9 @@ class Metrics:
     def hist_sample_many(self, name: str, values: np.ndarray) -> None:
         h = self._hist[name]
         raw = np.asarray(values, dtype=np.int64)
-        v = np.maximum(raw, 1)  # bucketing floor only; sum uses raw values
+        # bucketing floors at 1; the sum clamps negatives to 0, matching
+        # hist_sample's max(value, 0) — NOT the raw values
+        v = np.maximum(raw, 1)
         buckets = np.minimum(
             np.floor(np.log2(v)).astype(np.int64), HIST_BUCKETS - 1
         )

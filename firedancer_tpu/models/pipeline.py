@@ -1,0 +1,199 @@
+"""The flagship multi-chip pipeline step: verify → dedup → pack prefilter
+over a 2-axis device mesh.
+
+This is the framework's "training step" analog — the unit the driver
+dry-runs over an n-device mesh.  Axes:
+
+  dp — data parallel: the transaction batch is sharded across chips;
+       each chip verifies its shard with the same kernel the single-chip
+       path uses (ops/ed25519).
+  mp — state parallel: the dedup membership filter (a bloom-style bitmask,
+       the device analog of the reference's tcache,
+       /root/reference/src/tango/tcache/fd_tcache.h) is sharded bitwise
+       across chips.
+
+Collectives (all under shard_map, riding ICI on real hardware):
+  * all_gather(tags, 'dp')  — every chip sees the full batch's dedup tags
+  * psum(hits, 'mp')        — membership answers combined across the
+                              bloom's shards
+  * psum(metrics, 'dp')     — global counters
+
+Deliberate divergence from the reference documented here: the reference's
+tcache is an exact evicting ring+map; the device filter is a bloom bitmask
+with epoch-based aging (clear on epoch roll) — false positives drop a
+valid txn with probability ~load_factor, never admit a duplicate.  The
+host tcache (tango) remains the exact authority on the host path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from firedancer_tpu.ops import pack_select
+from firedancer_tpu.ops.ed25519 import verify as fver
+
+#: bloom filter size in bits; must divide evenly across the mp axis
+BLOOM_BITS = 1 << 15
+
+
+def _hash_tags(tags):
+    """u32-pair tag hash -> bit index in [0, BLOOM_BITS).  (splitmix-style
+    avalanche on the low word, int32 ops only — TPU-lane friendly.)"""
+    x = tags.astype(jnp.uint32)
+    x ^= x >> 16
+    x = x * jnp.uint32(0x7FEB352D)
+    x ^= x >> 15
+    x = x * jnp.uint32(0x846CA68B)
+    x ^= x >> 16
+    return (x % jnp.uint32(BLOOM_BITS)).astype(jnp.int32)
+
+
+def make_step(mesh: Mesh):
+    """Build the jitted pipeline step for `mesh` (axes 'dp', 'mp')."""
+    mp = mesh.shape["mp"]
+    assert BLOOM_BITS % (32 * mp) == 0
+    words_per_shard = BLOOM_BITS // 32 // mp
+
+    def step(msgs, lens, sigs, pubs, tags, bloom):
+        """One ingress step on local shards.
+
+        msgs (Bl, W) u8, lens (Bl,), sigs (Bl, 64), pubs (Bl, 32),
+        tags (Bl,) u32 dedup tags — all dp-sharded;
+        bloom (words_per_shard,) u32 — mp-sharded bitmask.
+
+        Returns (keep (Bl,) bool, new bloom shard, global metrics (3,)).
+        """
+        ok = fver.verify_batch(msgs, lens, sigs, pubs)
+
+        # ---- dedup: bloom membership across the mp-sharded bitmask ----
+        all_tags = jax.lax.all_gather(tags, "dp", tiled=True)  # (Bg,)
+        bit = _hash_tags(all_tags)  # (Bg,) in [0, BLOOM_BITS)
+        word, off = bit // 32, bit % 32
+        shard_lo = jax.lax.axis_index("mp") * words_per_shard
+        local = word - shard_lo
+        in_shard = (local >= 0) & (local < words_per_shard)
+        lw = jnp.where(in_shard, local, 0)
+        hit_local = jnp.where(
+            in_shard, (bloom[lw] >> off.astype(jnp.uint32)) & 1, 0
+        )
+        hits = jax.lax.psum(hit_local, "mp")  # (Bg,) 0/1
+
+        # insert: OR the new bits into this chip's shard
+        onehot = (
+            (jax.lax.broadcasted_iota(jnp.int32, (words_per_shard,), 0)[None, :]
+             == lw[:, None])
+            & in_shard[:, None]
+        )
+        add_bits = jnp.where(
+            onehot,
+            (jnp.uint32(1) << off.astype(jnp.uint32))[:, None],
+            jnp.uint32(0),
+        )
+        new_bloom = bloom | jax.lax.reduce_or(add_bits, axes=(0,))
+
+        # my dp slice of the global hit vector
+        bl = tags.shape[0]
+        dp_i = jax.lax.axis_index("dp")
+        my_hits = jax.lax.dynamic_slice(hits, (dp_i * bl,), (bl,))
+        keep = ok & (my_hits == 0)
+
+        # ---- global metrics over dp ----
+        m = jnp.stack(
+            [
+                jnp.sum(ok.astype(jnp.int32)),
+                jnp.sum((~ok).astype(jnp.int32)),
+                jnp.sum((ok & (my_hits != 0)).astype(jnp.int32)),
+            ]
+        )
+        metrics = jax.lax.psum(m, "dp")
+        return keep, new_bloom, metrics
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                P("dp", None), P("dp"), P("dp", None), P("dp", None),
+                P("dp"), P("mp"),
+            ),
+            out_specs=(P("dp"), P("mp"), P()),
+            check_vma=False,
+        )
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("txn_limit",))
+def pack_prefilter(cand_rw32, cand_w32, in_use_rw32, in_use_w32, costs,
+                   cu_limit, txn_limit):
+    """Device pack-candidate selection (replicated; the greedy scan is a
+    tiny sequential program — see ops/pack_select.py)."""
+    return pack_select._select_impl(
+        cand_rw32, cand_w32, in_use_rw32, in_use_w32, costs, cu_limit,
+        txn_limit,
+    )
+
+
+# ---------------------------------------------------------------------------
+# dry run (driver entry: __graft_entry__.dryrun_multichip)
+# ---------------------------------------------------------------------------
+
+
+def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
+    """Jit + execute one full pipeline step over `mesh` on tiny shapes,
+    with real dp/mp shardings, plus the device pack prefilter."""
+    from firedancer_tpu.ops.ed25519 import golden
+
+    B = msgs.shape[0]
+    rng = np.random.default_rng(7)
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = golden.public_from_secret(sk)
+    sigs = np.zeros((B, 64), np.uint8)
+    pubs = np.tile(np.frombuffer(pk, np.uint8), (B, 1))
+    for i in range(B):
+        s = golden.sign(sk, msgs[i, : lens[i]].tobytes())
+        sigs[i] = np.frombuffer(s, np.uint8)
+    tags = sigs[:, :4].copy().view(np.uint32).reshape(B).astype(np.uint32)
+
+    mp = mesh.shape["mp"]
+    bloom = np.zeros(BLOOM_BITS // 32, np.uint32)
+
+    step = make_step(mesh)
+    sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    args = (
+        jax.device_put(msgs, sh(P("dp", None))),
+        jax.device_put(lens, sh(P("dp"))),
+        jax.device_put(sigs, sh(P("dp", None))),
+        jax.device_put(pubs, sh(P("dp", None))),
+        jax.device_put(tags, sh(P("dp"))),
+        jax.device_put(bloom, sh(P("mp"))),
+    )
+    keep, bloom1, metrics = step(*args)
+    jax.block_until_ready((keep, bloom1, metrics))
+    k0 = np.asarray(keep)
+    m0 = np.asarray(metrics)
+    assert k0.all(), "fresh valid txns must pass verify+dedup"
+    assert m0[0] == B and m0[1] == 0 and m0[2] == 0, m0
+
+    # second step with the SAME tags: bloom must now reject all of them
+    keep2, _, metrics2 = step(args[0], args[1], args[2], args[3], args[4],
+                              bloom1)
+    jax.block_until_ready((keep2, metrics2))
+    assert not np.asarray(keep2).any(), "duplicates must be dropped"
+    assert np.asarray(metrics2)[2] == B
+
+    # pack prefilter on the mesh (replicated inputs)
+    K, W2 = 16, 8
+    cand_rw = rng.integers(0, 2**31, (K, W2)).astype(np.uint32)
+    cand_w = cand_rw & rng.integers(0, 2**31, (K, W2)).astype(np.uint32)
+    take = pack_prefilter(
+        jnp.asarray(cand_rw), jnp.asarray(cand_w),
+        jnp.zeros(W2, jnp.uint32), jnp.zeros(W2, jnp.uint32),
+        jnp.full(K, 1000, jnp.int32), jnp.int32(1 << 20), 8,
+    )
+    jax.block_until_ready(take)
+    assert np.asarray(take).any()

@@ -350,8 +350,11 @@ def is_zero(a):
 
 
 def parity(a):
-    """Canonical low bit ("sign" bit of x in RFC 8032) -> (B,) int32 0/1."""
-    return canonical(a)[0] & 1
+    """Canonical low bit ("sign" bit of x in RFC 8032) -> (B,) int32 0/1.
+
+    Static-slice + squeeze form so it is kernel-reachable (see the
+    indexing NOTE above)."""
+    return jnp.squeeze(canonical(a)[0:1] & 1, axis=0)
 
 
 def from_bytes(b):

@@ -110,8 +110,7 @@ def decompress_limbs(y, sign):
     x_is_zero = F.is_zero(x)
     ok = ok & ~(x_is_zero & jnp.squeeze(sign == 1, axis=0))
     # choose the root with matching parity
-    par = F.canonical(x)[0:1] & 1  # (1, B)
-    flip = (par != sign) & ~x_is_zero[None]
+    flip = (F.parity(x)[None] != sign) & ~x_is_zero[None]
     x = jnp.where(flip, F.neg(x), x)
     z = jnp.broadcast_to(jnp.asarray(one), x.shape)
     return (x, y, z, F.mul(x, y)), ok

@@ -31,6 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+#: largest cu_limit the int32 device scan supports; PAD_COST sentinel rows
+#: (used by ballet/pack.py to pad candidates to a fixed compiled shape)
+#: exceed it by construction, so they are never taken and cu_used + cost
+#: cannot overflow int32
+CU_LIMIT_MAX = 2**30 - 1
+PAD_COST = 1 << 30
+
 
 @functools.partial(jax.jit, static_argnames=("txn_limit",))
 def _select_impl(cand_rw, cand_w, in_use_rw, in_use_w, costs, cu_limit, txn_limit):
@@ -80,15 +87,18 @@ def select_noconflict(
     Returns (K,) bool take mask.  Matches the host engine's sequential
     greedy loop bit for bit (tests assert equality).
     """
-    # cap below 2^30 so the scheduler's padding sentinels (cost 2^30)
-    # never fit and cu_used + c cannot overflow int32
+    if cu_limit > CU_LIMIT_MAX:
+        raise ValueError(
+            f"cu_limit {cu_limit} exceeds CU_LIMIT_MAX {CU_LIMIT_MAX}; a "
+            "silent clamp would diverge from the host greedy loop"
+        )
     takes = _select_impl(
         _split_u32(cand_rw),
         _split_u32(cand_w),
         _split_u32(in_use_rw),
         _split_u32(in_use_w),
         jnp.asarray(np.asarray(costs, np.int32)),
-        jnp.int32(int(min(cu_limit, 2**30 - 1))),
+        jnp.int32(int(cu_limit)),
         txn_limit,
     )
     return np.asarray(takes)

@@ -1,0 +1,66 @@
+"""Bank tile: executes scheduled microblocks and reports completion.
+
+Reference model: src/app/fdctl/run/tiles/fd_bank.c — receives microblocks
+from pack, executes them (in the reference via Rust FFI into Agave:
+fd_ext_bank_load_and_execute_txns, fd_bank.c:100-104), flags itself free
+through the busy fseq, and forwards the executed microblock to the poh
+tile for mixin.
+
+This build has no Agave; execution is the native stub `execute_txns`
+(parse + fee accounting), the seam where the flamenco runtime plugs in.
+Completion travels as a frag on the bank→pack ring (sig = bank<<32 |
+handle); the executed microblock is forwarded on the bank→poh ring.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ballet import compute_budget as CB
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+from . import pack as packtile
+
+
+def execute_txns(txns: list[np.ndarray]) -> int:
+    """Stub executor: parse + fee totals.  Returns lamports collected."""
+    fees = 0
+    for t in txns:
+        d = T.parse(bytes(t))
+        if d is None:
+            continue
+        fees += CB.FEE_PER_SIGNATURE * d.signature_cnt
+    return fees
+
+
+class BankTile(Tile):
+    """ins[0] = pack_bank microblocks; outs[0] = bank_pack completions,
+    outs[1] = bank_poh executed microblocks."""
+
+    schema = MetricsSchema(
+        counters=("executed_microblocks", "executed_txns", "fees_lamports"),
+    )
+
+    def __init__(self, bank_id: int, name: str | None = None):
+        self.bank_id = bank_id
+        self.name = name or f"bank{bank_id}"
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            buf = rows[i, : frags["sz"][i]]
+            handle, bank, txns = packtile.mb_decode(buf)
+            assert bank == self.bank_id
+            fees = execute_txns(txns)
+            ctx.metrics.inc("executed_microblocks")
+            ctx.metrics.inc("executed_txns", len(txns))
+            ctx.metrics.inc("fees_lamports", fees)
+            tag = np.array([(bank << 32) | handle], dtype=np.uint64)
+            # forward to poh first, then free the bank at pack
+            ctx.outs[1].publish(
+                tag, buf[None, :], np.array([len(buf)], dtype=np.uint16)
+            )
+            ctx.outs[0].publish(tag)

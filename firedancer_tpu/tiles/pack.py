@@ -1,0 +1,151 @@
+"""Pack tile: buffers verified txns and schedules microblocks to banks.
+
+Reference model: src/app/fdctl/run/tiles/fd_pack.c — during_frag inserts
+incoming txns into the pack engine; after_credit, when a bank is free and
+the microblock cadence (<= 2ms, MICROBLOCK_DURATION_NS fd_pack.c:26) has
+elapsed, emits fd_pack_schedule_next_microblock's output to that bank's
+ring and tracks completion via the bank-busy backchannel.
+
+Here the engine is ballet/pack.Pack (dense-array scheduler + optional TPU
+prefilter) and the completion backchannel is a reliable bank→pack ring
+carrying (bank, handle) frags.
+
+Microblock wire format (one frag per microblock on the pack_bank link):
+    [ u32 handle | u16 bank | u16 txn_cnt | txn_cnt * ( u16 sz | sz bytes ) ]
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from firedancer_tpu.ballet import pack as P
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+from . import wire
+
+MICROBLOCK_DURATION_NS = 2_000_000  # reference cadence: fd_pack.c:26
+MB_HDR = 8
+
+
+def mb_encode(handle: int, bank: int, rows: np.ndarray, szs: np.ndarray) -> np.ndarray:
+    n = len(szs)
+    total = MB_HDR + int(szs.sum()) + 2 * n
+    out = np.zeros(total, dtype=np.uint8)
+    out[0:4].view("<u4")[0] = handle
+    out[4:6].view("<u2")[0] = bank
+    out[6:8].view("<u2")[0] = n
+    off = MB_HDR
+    for i in range(n):
+        sz = int(szs[i])
+        out[off : off + 2].view("<u2")[0] = sz
+        out[off + 2 : off + 2 + sz] = rows[i, :sz]
+        off += 2 + sz
+    return out
+
+
+def mb_decode(buf: np.ndarray):
+    handle = int(buf[0:4].view("<u4")[0])
+    bank = int(buf[4:6].view("<u2")[0])
+    n = int(buf[6:8].view("<u2")[0])
+    txns = []
+    off = MB_HDR
+    for _ in range(n):
+        sz = int(buf[off : off + 2].view("<u2")[0])
+        txns.append(buf[off + 2 : off + 2 + sz])
+        off += 2 + sz
+    return handle, bank, txns
+
+
+class PackTile(Tile):
+    """ins[0] = dedup_pack txns; ins[1..] = bank completion rings;
+    outs[i] = pack_bank ring for bank i."""
+
+    schema = MetricsSchema(
+        counters=(
+            "inserted_txns",
+            "insert_rejected",
+            "microblocks",
+            "microblock_txns",
+            "completions",
+        ),
+    )
+
+    def __init__(
+        self,
+        n_banks: int,
+        *,
+        depth: int = 4096,
+        cu_limit: int = 1_500_000,
+        txn_limit: int = 31,
+        microblock_ns: int = MICROBLOCK_DURATION_NS,
+        use_device_select: bool = False,
+        name: str = "pack",
+    ):
+        self.name = name
+        self.n_banks = n_banks
+        self.cu_limit = cu_limit
+        self.txn_limit = txn_limit
+        self.microblock_ns = microblock_ns
+        self.engine = P.Pack(depth, max_banks=n_banks)
+        self.bank_free = [True] * n_banks
+        self._last_mb_ns = 0
+        self._dev_select = None
+        if use_device_select:
+            from firedancer_tpu.ops import pack_select
+
+            self._dev_select = pack_select.select_noconflict
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        if in_idx == 0:
+            il = ctx.ins[0]
+            rows = il.gather(frags)
+            tr = wire.parse_trailers(rows, frags["sz"].astype(np.int64))
+            n_ok = 0
+            for i in range(len(rows)):
+                payload = bytes(rows[i, : tr["txn_sz"][i]])
+                if self.engine.insert(payload, sig_tag=int(frags["sig"][i])) == "ok":
+                    n_ok += 1
+            ctx.metrics.inc("inserted_txns", n_ok)
+            if n_ok != len(rows):
+                ctx.metrics.inc("insert_rejected", len(rows) - n_ok)
+        else:
+            # completion ring: sig field carries (bank << 32) | handle
+            for sig in frags["sig"]:
+                bank = int(sig) >> 32
+                handle = int(sig) & 0xFFFFFFFF
+                self.engine.microblock_complete(bank, handle)
+                self.bank_free[bank] = True
+                ctx.metrics.inc("completions")
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        now = time.monotonic_ns()
+        if now - self._last_mb_ns < self.microblock_ns:
+            return
+        for bank in range(self.n_banks):
+            if not self.bank_free[bank]:
+                continue
+            mb = self.engine.schedule_microblock(
+                bank,
+                cu_limit=self.cu_limit,
+                txn_limit=self.txn_limit,
+                device_select=self._dev_select,
+            )
+            if mb is None:
+                continue
+            idx = mb.txn_idx
+            payload = mb_encode(
+                mb.handle, bank, self.engine.rows[idx], self.engine.szs[idx]
+            )
+            out = ctx.outs[bank]
+            out.publish(
+                np.array([(bank << 32) | mb.handle], dtype=np.uint64),
+                payload[None, :],
+                np.array([len(payload)], dtype=np.uint16),
+            )
+            self.bank_free[bank] = False
+            self._last_mb_ns = now
+            ctx.metrics.inc("microblocks")
+            ctx.metrics.inc("microblock_txns", len(idx))

@@ -1,0 +1,96 @@
+"""PoH tile: the proof-of-history clock, mixing executed microblocks into
+the hash chain.
+
+Reference model: src/app/fdctl/run/tiles/fd_poh.c — the validator's one
+sequential component: iterate state = SHA-256(state) continuously (500ns
+per hashcnt on mainnet), and on each executed microblock from a bank,
+mix its hash into the chain (one mixin consumes one hashcnt), emitting
+entries downstream (to shred in the reference).
+
+TPU-native shape: ticks are batched — after_credit runs `tick_batch`
+appends as ONE device dispatch (lax.fori_loop of the fixed-32B SHA-256
+compression, ops/poh.append_n) instead of one hash per loop iteration.
+Entries out carry (prev_state, hashcnt, mixin, state) so a downstream
+verifier can batch-check them (ops/poh.verify_entries).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.ops import poh as POH
+from firedancer_tpu.ops import sha256 as SHA
+
+ENTRY_SZ = 32 + 8 + 32 + 32  # prev_state | hashcnt u64 | mixin | state
+
+
+class PohTile(Tile):
+    """ins = bank_poh microblock rings; outs[0] = entries ring."""
+
+    schema = MetricsSchema(
+        counters=("hashcnt", "mixins", "entries"),
+    )
+
+    def __init__(self, *, tick_batch: int = 64, name: str = "poh"):
+        self.name = name
+        self.tick_batch = tick_batch
+        self.state = np.zeros(32, dtype=np.uint8)
+        self.hashcnt = 0
+        self._append = None
+        self._mixin = None
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        import functools
+
+        import jax
+
+        self._append = jax.jit(
+            functools.partial(POH.append_n, n=self.tick_batch)
+        )
+        self._mixin = jax.jit(POH.mixin)
+        # warm compiles
+        s = self.state[None, :]
+        np.asarray(self._append(s))
+        np.asarray(self._mixin(s, s))
+
+    def _emit(self, ctx: MuxCtx, prev, hashcnt, mix, state) -> None:
+        buf = np.zeros(ENTRY_SZ, dtype=np.uint8)
+        buf[0:32] = prev
+        buf[32:40].view("<u8")[0] = hashcnt
+        buf[40:72] = mix
+        buf[72:104] = state
+        ctx.publish(
+            np.array([hashcnt or 1], dtype=np.uint64),
+            buf[None, :],
+            np.array([ENTRY_SZ], dtype=np.uint16),
+        )
+        ctx.metrics.inc("entries")
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            mb = rows[i, : frags["sz"][i]]
+            # microblock hash = SHA-256 of its bytes (stand-in for the
+            # entry merkle root the reference mixes in)
+            mix = np.asarray(
+                SHA.sha256(mb[None, :], np.array([len(mb)], np.int32))
+            )[0]
+            prev = self.state.copy()
+            self.state = np.asarray(
+                self._mixin(self.state[None, :], mix[None, :])
+            )[0]
+            self.hashcnt += 1
+            ctx.metrics.inc("hashcnt")
+            ctx.metrics.inc("mixins")
+            self._emit(ctx, prev, 1, mix, self.state)
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        # batch-advance the clock: one device dispatch per tick_batch
+        prev = self.state.copy()
+        self.state = np.asarray(self._append(self.state[None, :]))[0]
+        self.hashcnt += self.tick_batch
+        ctx.metrics.inc("hashcnt", self.tick_batch)
+        self._emit(ctx, prev, self.tick_batch, np.zeros(32, np.uint8), self.state)
