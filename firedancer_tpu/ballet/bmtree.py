@@ -1,0 +1,119 @@
+"""Binary SHA-256 Merkle tree (vector commitment for shreds / runtime).
+
+Behavior contract: src/ballet/bmtree/fd_bmtree.{h,c} —
+  * leaf  = SHA256(leaf_prefix  || data)[:hash_sz]
+  * node  = SHA256(node_prefix || left || right)[:hash_sz]
+  * a layer with an odd node count merges its last node with ITSELF
+    (fd_bmtree_commit_fini's 1-child branch)
+  * 20-byte nodes use the 26-byte long prefixes
+    ("\\x00SOLANA_MERKLE_SHREDS_LEAF" / "\\x01...NODE"); 32-byte nodes use
+    the 1-byte short prefixes 0x00/0x01 (fd_bmtree.h:133-142)
+
+TPU-native design: the reference hashes node-by-node with an incremental
+commit state; here every tree LAYER is one batched SHA-256 dispatch
+(ops/sha256), so committing N leaves costs ~log2(N) device calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ops import sha256 as S
+
+LEAF_PREFIX_LONG = b"\x00SOLANA_MERKLE_SHREDS_LEAF"
+NODE_PREFIX_LONG = b"\x01SOLANA_MERKLE_SHREDS_NODE"
+LEAF_PREFIX_SHORT = b"\x00"
+NODE_PREFIX_SHORT = b"\x01"
+
+
+def _prefixes(hash_sz: int) -> tuple[bytes, bytes]:
+    if hash_sz == 20:
+        return LEAF_PREFIX_LONG, NODE_PREFIX_LONG
+    assert hash_sz == 32
+    return LEAF_PREFIX_SHORT, NODE_PREFIX_SHORT
+
+
+def _sha_batch(msgs: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    return np.asarray(S.sha256(msgs, lens))
+
+
+def hash_leaves(blobs: list[bytes], hash_sz: int = 20) -> np.ndarray:
+    """Batch-hash leaf blobs -> (N, hash_sz) nodes."""
+    leaf_prefix, _ = _prefixes(hash_sz)
+    n = len(blobs)
+    w = len(leaf_prefix) + max((len(b) for b in blobs), default=0)
+    msgs = np.zeros((n, w), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i, b in enumerate(blobs):
+        row = leaf_prefix + b
+        msgs[i, : len(row)] = np.frombuffer(row, np.uint8)
+        lens[i] = len(row)
+    return _sha_batch(msgs, lens)[:, :hash_sz]
+
+
+def _merge_layer(layer: np.ndarray, hash_sz: int) -> np.ndarray:
+    """(N, hash_sz) -> (ceil(N/2), hash_sz), one batched dispatch."""
+    _, node_prefix = _prefixes(hash_sz)
+    n = len(layer)
+    if n % 2:
+        layer = np.concatenate([layer, layer[-1:]])  # odd: self-merge
+    left, right = layer[0::2], layer[1::2]
+    p = len(node_prefix)
+    msgs = np.zeros((len(left), p + 2 * hash_sz), np.uint8)
+    msgs[:, :p] = np.frombuffer(node_prefix, np.uint8)
+    msgs[:, p : p + hash_sz] = left
+    msgs[:, p + hash_sz :] = right
+    lens = np.full(len(left), p + 2 * hash_sz, np.int32)
+    return _sha_batch(msgs, lens)[:, :hash_sz]
+
+
+def commit(blobs: list[bytes], hash_sz: int = 20) -> bytes:
+    """Root commitment over the leaf blobs (fd_bmtree_commit_* one-shot)."""
+    assert blobs, "empty tree has no root"
+    layer = hash_leaves(blobs, hash_sz)
+    layers = [layer]
+    while len(layer) > 1:
+        layer = _merge_layer(layer, hash_sz)
+        layers.append(layer)
+    return bytes(layer[0])
+
+
+def layers_of(blobs: list[bytes], hash_sz: int = 20) -> list[np.ndarray]:
+    layer = hash_leaves(blobs, hash_sz)
+    out = [layer]
+    while len(layer) > 1:
+        layer = _merge_layer(layer, hash_sz)
+        out.append(layer)
+    return out
+
+
+def inclusion_proof(blobs: list[bytes], idx: int, hash_sz: int = 20) -> list[bytes]:
+    """Sibling path for leaf idx (bottom-up).  A missing sibling (odd
+    tail) is the node itself, matching the self-merge rule."""
+    proof = []
+    layers = layers_of(blobs, hash_sz)
+    for layer in layers[:-1]:
+        sib = idx ^ 1
+        proof.append(bytes(layer[sib]) if sib < len(layer) else bytes(layer[idx]))
+        idx >>= 1
+    return proof
+
+
+def verify_inclusion(
+    leaf_blob: bytes, idx: int, proof: list[bytes], root: bytes,
+    hash_sz: int = 20,
+) -> bool:
+    node = bytes(hash_leaves([leaf_blob], hash_sz)[0])
+    for sib in proof:
+        pair = (node, sib) if idx % 2 == 0 else (sib, node)
+        node = bytes(
+            _merge_layer(
+                np.stack([
+                    np.frombuffer(pair[0], np.uint8),
+                    np.frombuffer(pair[1], np.uint8),
+                ]),
+                hash_sz,
+            )[0]
+        )
+        idx >>= 1
+    return node == root

@@ -1,0 +1,40 @@
+"""Base64 and hex codecs (reference: src/ballet/base64/, src/ballet/hex/).
+
+Thin, correct host-side implementations — these feed RPC/snapshot/log
+paths, not the packet hot path."""
+
+from __future__ import annotations
+
+import base64 as _b64
+import binascii
+
+B64_STD = "std"
+B64_URL = "url"
+
+
+def base64_encode(data: bytes, variant: str = B64_STD) -> str:
+    f = _b64.standard_b64encode if variant == B64_STD else _b64.urlsafe_b64encode
+    return f(data).decode()
+
+
+def base64_decode(s: str | bytes, variant: str = B64_STD) -> bytes | None:
+    f = _b64.standard_b64decode if variant == B64_STD else _b64.urlsafe_b64decode
+    try:
+        if isinstance(s, str):
+            s = s.encode()
+        # strict: reject non-alphabet chars (python is lenient by default)
+        _b64.b64decode(s, validate=True) if variant == B64_STD else None
+        return f(s)
+    except (binascii.Error, ValueError):
+        return None
+
+
+def hex_encode(data: bytes) -> str:
+    return data.hex()
+
+
+def hex_decode(s: str) -> bytes | None:
+    try:
+        return bytes.fromhex(s)
+    except ValueError:
+        return None

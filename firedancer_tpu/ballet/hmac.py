@@ -1,0 +1,75 @@
+"""HMAC-SHA256 / HMAC-SHA512 over the batch hash kernels.
+
+Behavior contract: src/ballet/hmac/fd_hmac.c (RFC 2104).  Built on the
+device-batched SHA kernels (ops/sha256, ops/sha512), so a batch of MACs
+is two batched hash dispatches."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ops import sha256 as S256
+from firedancer_tpu.ops import sha512 as S512
+
+_BLOCK = {"sha256": 64, "sha512": 128}
+_OUT = {"sha256": 32, "sha512": 64}
+
+
+def _hash_batch(algo: str, msgs: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    if algo == "sha256":
+        return np.asarray(S256.sha256(msgs, lens))
+    return np.asarray(S512.sha512(msgs, lens))
+
+
+def hmac_batch(algo: str, keys: np.ndarray, msgs: np.ndarray, lens) -> np.ndarray:
+    """Batch HMAC.  keys (B, key_len<=block) u8, msgs (B, W) u8, lens (B,).
+
+    Returns (B, 32|64) u8.  Keys longer than the block must be pre-hashed
+    by the caller (RFC 2104)."""
+    block, out_sz = _BLOCK[algo], _OUT[algo]
+    B = len(keys)
+    lens = np.asarray(lens, np.int64)
+    assert keys.shape[1] <= block
+    k = np.zeros((B, block), np.uint8)
+    k[:, : keys.shape[1]] = keys
+
+    inner = np.zeros((B, block + msgs.shape[1]), np.uint8)
+    inner[:, :block] = k ^ 0x36
+    inner[:, block : block + msgs.shape[1]] = msgs
+    # zero padding bytes beyond each row's len (msgs may carry garbage)
+    col = np.arange(msgs.shape[1])[None, :]
+    inner[:, block:] = np.where(col < lens[:, None], inner[:, block:], 0)
+    ih = _hash_batch(algo, inner, (block + lens).astype(np.int32))
+
+    outer = np.zeros((B, block + out_sz), np.uint8)
+    outer[:, :block] = k ^ 0x5C
+    outer[:, block:] = ih
+    return _hash_batch(
+        algo, outer, np.full(B, block + out_sz, np.int32)
+    )
+
+
+def hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    if len(key) > 64:
+        key = bytes(_hash_batch("sha256", np.frombuffer(key, np.uint8)[None, :],
+                                np.array([len(key)]))[0])
+    out = hmac_batch(
+        "sha256",
+        np.frombuffer(key, np.uint8)[None, :],
+        np.frombuffer(msg, np.uint8)[None, :] if msg else np.zeros((1, 0), np.uint8),
+        np.array([len(msg)]),
+    )
+    return bytes(out[0])
+
+
+def hmac_sha512(key: bytes, msg: bytes) -> bytes:
+    if len(key) > 128:
+        key = bytes(_hash_batch("sha512", np.frombuffer(key, np.uint8)[None, :],
+                                np.array([len(key)]))[0])
+    out = hmac_batch(
+        "sha512",
+        np.frombuffer(key, np.uint8)[None, :],
+        np.frombuffer(msg, np.uint8)[None, :] if msg else np.zeros((1, 0), np.uint8),
+        np.array([len(msg)]),
+    )
+    return bytes(out[0])

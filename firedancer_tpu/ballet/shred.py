@@ -1,0 +1,212 @@
+"""Shred (block fragment) wire-format parser and builder.
+
+Behavior contract: src/ballet/shred/fd_shred.{h,c} — 1228-byte max
+packets: common header (signature 64B, variant, slot u64, idx u32,
+version u16, fec_set_idx u32 at fixed offsets), then a data header
+(parent_off u16, flags u8, size u16) or coding header (data_cnt u16,
+code_cnt u16, idx u16), payload, zero padding, and for merkle variants a
+trailing inclusion-proof of 20-byte nodes ending at byte 1203
+(FD_SHRED_MIN_SZ).  Validation mirrors fd_shred_parse exactly.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+MAX_SZ = 1228
+MIN_SZ = 1203
+DATA_HEADER_SZ = 0x58
+CODE_HEADER_SZ = 0x59
+
+TYPE_LEGACY_DATA = 0xA0
+TYPE_LEGACY_CODE = 0x50
+TYPE_MERKLE_DATA = 0x80
+TYPE_MERKLE_CODE = 0x40
+TYPEMASK_DATA = TYPE_MERKLE_DATA
+TYPEMASK_CODE = TYPE_MERKLE_CODE
+TYPEMASK_LEGACY = 0x30
+
+MERKLE_NODE_SZ = 20
+
+FLAG_SLOT_COMPLETE = 0x80
+FLAG_DATA_COMPLETE = 0x40
+REF_TICK_MASK = 0x3F
+
+
+def shred_type(variant: int) -> int:
+    return variant & 0xF0
+
+
+def merkle_cnt(variant: int) -> int:
+    if shred_type(variant) & TYPEMASK_LEGACY:
+        return 0
+    return variant & 0x0F
+
+
+def header_sz(variant: int) -> int:
+    t = shred_type(variant)
+    if t in (TYPE_MERKLE_DATA, TYPE_LEGACY_DATA):
+        return DATA_HEADER_SZ
+    if t in (TYPE_MERKLE_CODE, TYPE_LEGACY_CODE):
+        return CODE_HEADER_SZ
+    return 0
+
+
+@dataclass(frozen=True)
+class Shred:
+    signature: bytes
+    variant: int
+    slot: int
+    idx: int
+    version: int
+    fec_set_idx: int
+    # data header (None for code shreds)
+    parent_off: int | None = None
+    flags: int | None = None
+    size: int | None = None
+    # code header (None for data shreds)
+    data_cnt: int | None = None
+    code_cnt: int | None = None
+    code_idx: int | None = None
+    payload: bytes = b""
+    merkle_nodes: tuple[bytes, ...] = ()
+
+    @property
+    def is_data(self) -> bool:
+        return bool(shred_type(self.variant) & TYPEMASK_DATA)
+
+    @property
+    def ref_tick(self) -> int:
+        assert self.flags is not None
+        return self.flags & REF_TICK_MASK
+
+
+def parse(buf: bytes) -> Shred | None:
+    """fd_shred_parse behavior: returns None on any malformation."""
+    sz = len(buf)
+    if sz < DATA_HEADER_SZ:
+        return None
+    variant = buf[0x40]
+    t = shred_type(variant)
+    if not (
+        t == TYPE_MERKLE_DATA
+        or t == TYPE_MERKLE_CODE
+        or variant == 0xA5
+        or variant == 0x5A
+    ):
+        return None
+    hsz = header_sz(variant)
+    proof_sz = merkle_cnt(variant) * MERKLE_NODE_SZ
+
+    signature = buf[0:0x40]
+    slot, idx, version, fec_set_idx = struct.unpack_from("<QIHI", buf, 0x41)
+
+    if t & TYPEMASK_DATA:
+        parent_off, flags, data_size = struct.unpack_from("<HBH", buf, 0x53)
+        if data_size < hsz:
+            return None
+        payload_sz = data_size - hsz
+        if t != TYPE_LEGACY_DATA and sz < MIN_SZ:
+            return None
+        effective_sz = MIN_SZ if t == TYPE_MERKLE_DATA else sz
+        if effective_sz < hsz + proof_sz + payload_sz:
+            return None
+        zero_padding_sz = effective_sz - hsz - proof_sz - payload_sz
+        if sz < hsz + payload_sz + zero_padding_sz + proof_sz:
+            return None
+        payload = buf[hsz : hsz + payload_sz]
+        nodes = _proof_nodes(buf, t, proof_sz, sz)
+        return Shred(
+            signature, variant, slot, idx, version, fec_set_idx,
+            parent_off=parent_off, flags=flags, size=data_size,
+            payload=payload, merkle_nodes=nodes,
+        )
+
+    # code shred
+    if hsz + proof_sz > MAX_SZ:
+        return None
+    payload_sz = MAX_SZ - hsz - proof_sz
+    if sz < hsz + payload_sz + proof_sz:
+        return None
+    data_cnt, code_cnt, code_idx = struct.unpack_from("<HHH", buf, 0x53)
+    payload = buf[hsz : hsz + payload_sz]
+    nodes = _proof_nodes(buf, t, proof_sz, sz)
+    return Shred(
+        signature, variant, slot, idx, version, fec_set_idx,
+        data_cnt=data_cnt, code_cnt=code_cnt, code_idx=code_idx,
+        payload=payload, merkle_nodes=nodes,
+    )
+
+
+def _proof_nodes(buf: bytes, t: int, proof_sz: int, sz: int) -> tuple[bytes, ...]:
+    if not proof_sz:
+        return ()
+    # merkle proof lives in [MIN_SZ - proof, MIN_SZ) for data shreds and
+    # [MAX_SZ - proof, MAX_SZ) for code shreds (fd_shred.c comment)
+    end = MIN_SZ if t == TYPE_MERKLE_DATA else MAX_SZ
+    region = buf[end - proof_sz : end]
+    return tuple(
+        region[i : i + MERKLE_NODE_SZ]
+        for i in range(0, proof_sz, MERKLE_NODE_SZ)
+    )
+
+
+def build_merkle_data(
+    slot: int,
+    idx: int,
+    version: int,
+    fec_set_idx: int,
+    parent_off: int,
+    flags: int,
+    payload: bytes,
+    merkle_nodes: list[bytes],
+    signature: bytes = b"\0" * 64,
+) -> bytes:
+    """Serialize a merkle data shred (fixed MIN_SZ wire size)."""
+    proof_sz = len(merkle_nodes) * MERKLE_NODE_SZ
+    data_size = DATA_HEADER_SZ + len(payload)
+    assert DATA_HEADER_SZ + len(payload) + proof_sz <= MIN_SZ
+    variant = TYPE_MERKLE_DATA | len(merkle_nodes)
+    out = bytearray(MIN_SZ)
+    out[0:0x40] = signature
+    out[0x40] = variant
+    struct.pack_into("<QIHI", out, 0x41, slot, idx, version, fec_set_idx)
+    struct.pack_into("<HBH", out, 0x53, parent_off, flags, data_size)
+    out[DATA_HEADER_SZ : DATA_HEADER_SZ + len(payload)] = payload
+    off = MIN_SZ - proof_sz
+    for node in merkle_nodes:
+        assert len(node) == MERKLE_NODE_SZ
+        out[off : off + MERKLE_NODE_SZ] = node
+        off += MERKLE_NODE_SZ
+    return bytes(out)
+
+
+def build_merkle_code(
+    slot: int,
+    idx: int,
+    version: int,
+    fec_set_idx: int,
+    data_cnt: int,
+    code_cnt: int,
+    code_idx: int,
+    payload: bytes,
+    merkle_nodes: list[bytes],
+    signature: bytes = b"\0" * 64,
+) -> bytes:
+    """Serialize a merkle coding shred (fixed MAX_SZ wire size)."""
+    proof_sz = len(merkle_nodes) * MERKLE_NODE_SZ
+    payload_sz = MAX_SZ - CODE_HEADER_SZ - proof_sz
+    assert len(payload) == payload_sz, (len(payload), payload_sz)
+    variant = TYPE_MERKLE_CODE | len(merkle_nodes)
+    out = bytearray(MAX_SZ)
+    out[0:0x40] = signature
+    out[0x40] = variant
+    struct.pack_into("<QIHI", out, 0x41, slot, idx, version, fec_set_idx)
+    struct.pack_into("<HHH", out, 0x53, data_cnt, code_cnt, code_idx)
+    out[CODE_HEADER_SZ : CODE_HEADER_SZ + payload_sz] = payload
+    off = MAX_SZ - proof_sz
+    for node in merkle_nodes:
+        out[off : off + MERKLE_NODE_SZ] = node
+        off += MERKLE_NODE_SZ
+    return bytes(out)

@@ -1,0 +1,58 @@
+"""SipHash-1-3 (reference: src/ballet/siphash13/ — hashmap seeding).
+
+Host-side (seeding/cheap hashing only).  Batch variant vectorized in
+numpy uint64 for bulk keying."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M = (1 << 64) - 1
+
+
+def _rotl(x, b):
+    return ((x << np.uint64(b)) | (x >> np.uint64(64 - b))) & np.uint64(_M)
+
+
+def _round(v0, v1, v2, v3):
+    v0 = (v0 + v1) & np.uint64(_M)
+    v1 = _rotl(v1, 13)
+    v1 ^= v0
+    v0 = _rotl(v0, 32)
+    v2 = (v2 + v3) & np.uint64(_M)
+    v3 = _rotl(v3, 16)
+    v3 ^= v2
+    v0 = (v0 + v3) & np.uint64(_M)
+    v3 = _rotl(v3, 21)
+    v3 ^= v0
+    v2 = (v2 + v1) & np.uint64(_M)
+    v1 = _rotl(v1, 17)
+    v1 ^= v2
+    v2 = _rotl(v2, 32)
+    return v0, v1, v2, v3
+
+
+def siphash13(k0: int, k1: int, data: bytes) -> int:
+    """SipHash-1-3 of data under key (k0, k1) -> u64."""
+    with np.errstate(over="ignore"):
+        v0 = np.uint64(0x736F6D6570736575 ^ k0)
+        v1 = np.uint64(0x646F72616E646F6D ^ k1)
+        v2 = np.uint64(0x6C7967656E657261 ^ k0)
+        v3 = np.uint64(0x7465646279746573 ^ k1)
+        n = len(data)
+        tail = (n & 0xFF) << 56
+        full = n & ~7
+        words = np.frombuffer(data[:full], dtype="<u8")
+        last = int.from_bytes(data[full:], "little") | tail
+        for m in words:
+            v3 ^= m
+            v0, v1, v2, v3 = _round(v0, v1, v2, v3)  # 1 compression round
+            v0 ^= m
+        m = np.uint64(last)
+        v3 ^= m
+        v0, v1, v2, v3 = _round(v0, v1, v2, v3)
+        v0 ^= m
+        v2 ^= np.uint64(0xFF)
+        for _ in range(3):  # 3 finalization rounds
+            v0, v1, v2, v3 = _round(v0, v1, v2, v3)
+        return int(v0 ^ v1 ^ v2 ^ v3)

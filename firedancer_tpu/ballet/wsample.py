@@ -1,0 +1,64 @@
+"""Weighted sampling (leader schedule / Turbine tree).
+
+Behavior contract: src/ballet/wsample/fd_wsample.c — sample x uniform in
+[0, total_unremoved_weight) via the rng's roll, then pick the element
+whose cumulative-weight interval contains x, in insertion order (the
+reference's left-sum radix tree computes exactly this mapping in O(log
+n); here a numpy cumsum + searchsorted does the same in O(log n) per
+query after O(n) prep, with O(n) weight updates on removal — fine for
+the thousands-of-validators scale this is used at).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EMPTY = (1 << 64) - 1  # FD_WSAMPLE_EMPTY
+
+
+class WSample:
+    def __init__(self, rng, weights, restore_enabled: bool = True):
+        """rng: ChaCha20Rng (or anything with .roll(n)); weights: ints > 0
+        in insertion order (for leader schedule: stake-descending)."""
+        self.rng = rng
+        self._w0 = np.asarray(weights, dtype=np.uint64)
+        assert (self._w0 > 0).all()
+        self.restore_enabled = restore_enabled
+        self._w = self._w0.copy()
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        self._cum = np.cumsum(self._w, dtype=np.uint64)
+        self.unremoved_weight = int(self._cum[-1]) if len(self._w) else 0
+
+    def _map(self, x: int) -> int:
+        # first i with cum[i] > x
+        return int(np.searchsorted(self._cum, x, side="right"))
+
+    def sample(self) -> int:
+        if not self.unremoved_weight:
+            return EMPTY
+        return self._map(self.rng.roll(self.unremoved_weight))
+
+    def sample_many(self, cnt: int) -> list[int]:
+        return [self.sample() for _ in range(cnt)]
+
+    def sample_and_remove(self) -> int:
+        if not self.unremoved_weight:
+            return EMPTY
+        i = self._map(self.rng.roll(self.unremoved_weight))
+        self._w[i] = 0
+        self._rebuild()
+        return i
+
+    def sample_and_remove_many(self, cnt: int) -> list[int]:
+        return [self.sample_and_remove() for _ in range(cnt)]
+
+    def remove_idx(self, i: int) -> None:
+        self._w[i] = 0
+        self._rebuild()
+
+    def restore_all(self) -> None:
+        assert self.restore_enabled
+        self._w = self._w0.copy()
+        self._rebuild()

@@ -1,0 +1,58 @@
+"""Epoch leader schedule (stake-weighted rotation assignment).
+
+Behavior contract: src/flamenco/leaders/fd_leaders.c — seed a
+ChaCha20Rng (MODE_MOD) from the epoch, build a weighted sampler over the
+stake weights (stake-descending order), and draw one leader index per
+rotation of FD_EPOCH_SLOTS_PER_ROTATION (4) slots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from firedancer_tpu.ballet.chacha20 import MODE_MOD, ChaCha20Rng
+from firedancer_tpu.ballet.wsample import WSample
+
+SLOTS_PER_ROTATION = 4
+
+
+def epoch_seed(epoch: int) -> bytes:
+    """The rng key: epoch as little-endian u64 zero-padded to 32 bytes
+    (Solana's leader_schedule seed convention)."""
+    return epoch.to_bytes(8, "little") + bytes(24)
+
+
+def sorted_stake_weights(stakes: dict[bytes, int]) -> list[tuple[bytes, int]]:
+    """(pubkey -> stake) -> list ordered stake-desc, pubkey-desc — the
+    deterministic order the schedule is sampled against."""
+    return sorted(stakes.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
+
+
+@dataclass
+class EpochLeaders:
+    epoch: int
+    slot0: int
+    slot_cnt: int
+    pubkeys: list[bytes]  # deduped identity table
+    sched: list[int]  # one pubkey index per rotation
+
+    def leader_for_slot(self, slot: int) -> bytes:
+        assert self.slot0 <= slot < self.slot0 + self.slot_cnt
+        rot = (slot - self.slot0) // SLOTS_PER_ROTATION
+        return self.pubkeys[self.sched[rot]]
+
+
+def derive(
+    epoch: int,
+    slot0: int,
+    slot_cnt: int,
+    stakes: dict[bytes, int],
+) -> EpochLeaders:
+    ordered = sorted_stake_weights(stakes)
+    pubkeys = [pk for pk, _ in ordered]
+    weights = [w for _, w in ordered]
+    rng = ChaCha20Rng(epoch_seed(epoch), MODE_MOD)
+    ws = WSample(rng, weights, restore_enabled=False)
+    sched_cnt = (slot_cnt + SLOTS_PER_ROTATION - 1) // SLOTS_PER_ROTATION
+    sched = [ws.sample() for _ in range(sched_cnt)]
+    return EpochLeaders(epoch, slot0, slot_cnt, pubkeys, sched)

@@ -20,9 +20,11 @@ Collectives (all under shard_map, riding ICI on real hardware):
 
 Deliberate divergence from the reference documented here: the reference's
 tcache is an exact evicting ring+map; the device filter is a bloom bitmask
-with epoch-based aging (clear on epoch roll) — false positives drop a
-valid txn with probability ~load_factor, never admit a duplicate.  The
-host tcache (tango) remains the exact authority on the host path.
+— false positives drop a valid txn with probability ~load_factor, never
+admit a duplicate.  Aging is the CALLER's responsibility: the filter only
+accumulates, so swap in a zeroed filter (fresh_bloom()) on epoch roll,
+exactly like resetting the host tcache.  The host tcache (tango) remains
+the exact authority on the host path.
 """
 
 from __future__ import annotations
@@ -39,6 +41,12 @@ from firedancer_tpu.ops.ed25519 import verify as fver
 
 #: bloom filter size in bits; must divide evenly across the mp axis
 BLOOM_BITS = 1 << 15
+
+
+def fresh_bloom() -> np.ndarray:
+    """A zeroed dedup filter (full, unsharded).  Callers device_put it
+    mp-sharded and swap it in on epoch roll to age out old tags."""
+    return np.zeros(BLOOM_BITS // 32, np.uint32)
 
 
 def _hash_tags(tags):
@@ -72,6 +80,7 @@ def make_step(mesh: Mesh):
 
         # ---- dedup: bloom membership across the mp-sharded bitmask ----
         all_tags = jax.lax.all_gather(tags, "dp", tiled=True)  # (Bg,)
+        all_ok = jax.lax.all_gather(ok, "dp", tiled=True)  # (Bg,)
         bit = _hash_tags(all_tags)  # (Bg,) in [0, BLOOM_BITS)
         word, off = bit // 32, bit % 32
         shard_lo = jax.lax.axis_index("mp") * words_per_shard
@@ -83,11 +92,28 @@ def make_step(mesh: Mesh):
         )
         hits = jax.lax.psum(hit_local, "mp")  # (Bg,) 0/1
 
-        # insert: OR the new bits into this chip's shard
+        # within-batch duplicates: membership above reads the PRE-insert
+        # filter, so repeats inside one batch need their own first-
+        # occurrence mask (the reference's query+insert is sequential and
+        # gets this for free).  Stable sort groups equal tags with
+        # original order preserved; only each run's head is "first".
+        Bg = all_tags.shape[0]
+        order = jnp.argsort(all_tags, stable=True)
+        sorted_tags = all_tags[order]
+        head = jnp.concatenate(
+            [jnp.ones(1, bool), sorted_tags[1:] != sorted_tags[:-1]]
+        )
+        first_occurrence = jnp.zeros(Bg, bool).at[order].set(head)
+
+        # insert: OR in the bits of VERIFIED first-occurrence tags only —
+        # a failed signature must not be able to censor a later valid txn
+        # with the same tag (the reference dedups post-verify only)
+        insertable = all_ok & first_occurrence
         onehot = (
             (jax.lax.broadcasted_iota(jnp.int32, (words_per_shard,), 0)[None, :]
              == lw[:, None])
             & in_shard[:, None]
+            & insertable[:, None]
         )
         add_bits = jnp.where(
             onehot,
@@ -96,11 +122,13 @@ def make_step(mesh: Mesh):
         )
         new_bloom = bloom | jax.lax.reduce_or(add_bits, axes=(0,))
 
-        # my dp slice of the global hit vector
+        # my dp slice of the global keep vector
+        keep_g = all_ok & (hits == 0) & first_occurrence
         bl = tags.shape[0]
         dp_i = jax.lax.axis_index("dp")
+        my_keep = jax.lax.dynamic_slice(keep_g, (dp_i * bl,), (bl,))
         my_hits = jax.lax.dynamic_slice(hits, (dp_i * bl,), (bl,))
-        keep = ok & (my_hits == 0)
+        keep = my_keep
 
         # ---- global metrics over dp ----
         m = jnp.stack(
@@ -127,14 +155,19 @@ def make_step(mesh: Mesh):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("txn_limit",))
 def pack_prefilter(cand_rw32, cand_w32, in_use_rw32, in_use_w32, costs,
                    cu_limit, txn_limit):
     """Device pack-candidate selection (replicated; the greedy scan is a
-    tiny sequential program — see ops/pack_select.py)."""
+    tiny sequential program — see ops/pack_select.py).  Same int32 budget
+    validation as the public select_noconflict entry point."""
+    if int(cu_limit) > pack_select.CU_LIMIT_MAX:
+        raise ValueError(
+            f"cu_limit {cu_limit} exceeds CU_LIMIT_MAX {pack_select.CU_LIMIT_MAX}"
+        )
+    # _select_impl is already jitted; no extra jit wrapper needed
     return pack_select._select_impl(
-        cand_rw32, cand_w32, in_use_rw32, in_use_w32, costs, cu_limit,
-        txn_limit,
+        cand_rw32, cand_w32, in_use_rw32, in_use_w32,
+        jnp.asarray(costs, jnp.int32), jnp.int32(int(cu_limit)), txn_limit,
     )
 
 
@@ -157,10 +190,12 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
     for i in range(B):
         s = golden.sign(sk, msgs[i, : lens[i]].tobytes())
         sigs[i] = np.frombuffer(s, np.uint8)
+    # lane 1 is an exact within-batch duplicate of lane 0: the step must
+    # keep only the first occurrence
+    msgs[1], sigs[1] = msgs[0], sigs[0]
     tags = sigs[:, :4].copy().view(np.uint32).reshape(B).astype(np.uint32)
 
-    mp = mesh.shape["mp"]
-    bloom = np.zeros(BLOOM_BITS // 32, np.uint32)
+    bloom = fresh_bloom()
 
     step = make_step(mesh)
     sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
@@ -176,15 +211,16 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
     jax.block_until_ready((keep, bloom1, metrics))
     k0 = np.asarray(keep)
     m0 = np.asarray(metrics)
-    assert k0.all(), "fresh valid txns must pass verify+dedup"
-    assert m0[0] == B and m0[1] == 0 and m0[2] == 0, m0
+    assert k0[0] and not k0[1], "within-batch duplicate must be dropped"
+    assert k0[2:].all(), "fresh valid txns must pass verify+dedup"
+    assert m0[0] == B and m0[1] == 0, m0
 
     # second step with the SAME tags: bloom must now reject all of them
     keep2, _, metrics2 = step(args[0], args[1], args[2], args[3], args[4],
                               bloom1)
     jax.block_until_ready((keep2, metrics2))
     assert not np.asarray(keep2).any(), "duplicates must be dropped"
-    assert np.asarray(metrics2)[2] == B
+    assert np.asarray(metrics2)[2] == B  # every tag now hits the filter
 
     # pack prefilter on the mesh (replicated inputs)
     K, W2 = 16, 8

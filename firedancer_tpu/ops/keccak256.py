@@ -1,0 +1,172 @@
+"""Batched Keccak-256 (the sol_keccak256 syscall hash).
+
+Behavior contract: src/ballet/keccak256/ (Keccak-f[1600], rate 136,
+output 32 bytes, 0x01 domain padding — "legacy" Keccak as used by
+Ethereum/Solana, NOT NIST SHA-3's 0x06).
+
+TPU-native design: one lane of the 5x5x64-bit state is an (hi, lo)
+uint32 pair, batch axis last, so the whole permutation is straight-line
+int32 vector ops under vmap-free batching (the reference's scalar C:
+fd_keccak256_core).  Message schedule is static over the padded block
+count derived from the input width.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RATE = 136  # bytes; capacity 512 bits -> 256-bit output
+
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+
+def _rotl64(hi, lo, r):
+    r %= 64
+    if r == 0:
+        return hi, lo
+    if r == 32:
+        return lo, hi
+    if r < 32:
+        nh = ((hi << r) | (lo >> (32 - r))) & jnp.uint32(0xFFFFFFFF)
+        nl = ((lo << r) | (hi >> (32 - r))) & jnp.uint32(0xFFFFFFFF)
+        return nh, nl
+    r -= 32
+    nh = ((lo << r) | (hi >> (32 - r))) & jnp.uint32(0xFFFFFFFF)
+    nl = ((hi << r) | (lo >> (32 - r))) & jnp.uint32(0xFFFFFFFF)
+    return nh, nl
+
+
+_RC_ARR = np.array(
+    [[rc >> 32, rc & 0xFFFFFFFF] for rc in _RC], dtype=np.uint32
+)
+
+
+def _round(S, rc_hi, rc_lo):
+    """One Keccak-f round on a list of 25 (hi, lo) uint32 pairs."""
+    # theta
+    C = [
+        (
+            S[x][0] ^ S[x + 5][0] ^ S[x + 10][0] ^ S[x + 15][0] ^ S[x + 20][0],
+            S[x][1] ^ S[x + 5][1] ^ S[x + 10][1] ^ S[x + 15][1] ^ S[x + 20][1],
+        )
+        for x in range(5)
+    ]
+    D = []
+    for x in range(5):
+        rh, rl = _rotl64(*C[(x + 1) % 5], 1)
+        D.append((C[(x - 1) % 5][0] ^ rh, C[(x - 1) % 5][1] ^ rl))
+    S = [(S[i][0] ^ D[i % 5][0], S[i][1] ^ D[i % 5][1]) for i in range(25)]
+    # rho + pi
+    B = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            B[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(*S[x + 5 * y], _ROT[x][y])
+    # chi
+    S = [
+        (
+            B[i][0] ^ (~B[(i + 1) % 5 + 5 * (i // 5)][0]
+                       & B[(i + 2) % 5 + 5 * (i // 5)][0]),
+            B[i][1] ^ (~B[(i + 1) % 5 + 5 * (i // 5)][1]
+                       & B[(i + 2) % 5 + 5 * (i // 5)][1]),
+        )
+        for i in range(25)
+    ]
+    # iota
+    S[0] = (S[0][0] ^ rc_hi, S[0][1] ^ rc_lo)
+    return S
+
+
+def _permute_arr(S_arr):
+    """Keccak-f[1600] on a packed (25, 2, B) uint32 state; the 24 rounds
+    run under a fori_loop so the traced graph holds ONE round body."""
+    rc = jnp.asarray(_RC_ARR)
+
+    def body(r, s):
+        S = [(s[i, 0], s[i, 1]) for i in range(25)]
+        S = _round(S, rc[r, 0], rc[r, 1])
+        return jnp.stack([jnp.stack(p) for p in S])
+
+    return jax.lax.fori_loop(0, 24, body, S_arr)
+
+
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _keccak256_impl(msgs, lens, max_len):
+    B = msgs.shape[0]
+    n_blocks = max_len // RATE + 1  # padding always adds <= one rate block
+    padded_len = n_blocks * RATE
+    buf = jnp.zeros((B, padded_len), jnp.uint8)
+    buf = buf.at[:, :max_len].set(msgs)
+    col = jnp.arange(padded_len)[None, :]
+    live = col < lens[:, None]
+    buf = jnp.where(live, buf, 0)
+    # 0x01 at lens, 0x80 at last byte of the final block (may coincide: 0x81)
+    last_block_end = (lens // RATE + 1) * RATE - 1
+    buf = jnp.where(col == lens[:, None], jnp.uint8(0x01), buf)
+    buf = jnp.where(
+        col == last_block_end[:, None], buf | jnp.uint8(0x80), buf
+    )
+
+    words = (
+        buf.reshape(B, n_blocks, RATE // 4, 4).astype(jnp.uint32)
+    )
+    w32 = (
+        words[..., 0]
+        | (words[..., 1] << 8)
+        | (words[..., 2] << 16)
+        | (words[..., 3] << 24)
+    )  # (B, n_blocks, 34) little-endian u32
+
+    # absorb under a fori_loop over blocks (graph holds one permutation)
+    w32_t = jnp.transpose(w32, (1, 2, 0))  # (n_blocks, RATE//4, B)
+    n_active = lens // RATE + 1  # blocks each lane absorbs
+    state0 = jnp.zeros((25, 2, B), jnp.uint32)
+
+    def absorb(blk, s):
+        wblk = w32_t[blk]  # (RATE//4, B)
+        S = [(s[i, 0], s[i, 1]) for i in range(25)]
+        for lane in range(RATE // 8):
+            S[lane] = (S[lane][0] ^ wblk[2 * lane + 1], S[lane][1] ^ wblk[2 * lane])
+        s_new = _permute_arr(jnp.stack([jnp.stack(p) for p in S]))
+        active = blk < n_active  # (B,)
+        return jnp.where(active[None, None, :], s_new, s)
+
+    S = jax.lax.fori_loop(0, n_blocks, absorb, state0)
+
+    out = []
+    for lane in range(4):  # 32 bytes = 4 lanes
+        hi, lo = S[lane, 0], S[lane, 1]
+        for word in (lo, hi):
+            for shift in (0, 8, 16, 24):
+                out.append(((word >> shift) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)
+
+
+def keccak256(msgs, lens):
+    """Batched Keccak-256.  msgs (B, W) u8 zero-padded, lens (B,) int.
+    Returns (B, 32) u8."""
+    msgs = jnp.asarray(msgs, jnp.uint8)
+    lens = jnp.asarray(lens, jnp.int32)
+    return _keccak256_impl(msgs, lens, msgs.shape[1])

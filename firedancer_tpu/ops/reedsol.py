@@ -1,0 +1,104 @@
+"""Reed-Solomon shred coding on the MXU.
+
+The reference's hot erasure-coding path (src/ballet/reedsol/ — AVX2/GFNI
+kernels, ~38k LoC of generated butterflies) reformulated for TPU:
+
+GF(2^8) matrix application is GF(2)-LINEAR in the bits.  Expanding each
+field constant to its 8x8 GF(2) multiply matrix (ballet/gf256.expand_bits)
+turns "parity = M · data over GF(2^8)" into ONE binary matrix product
+
+    parity_bits (8P, N) = B (8P, 8D) @ data_bits (8D, N)   (mod 2)
+
+over all N byte positions at once — a dense int8 matmul with int32
+accumulation, exactly what the MXU does natively, replacing per-byte
+table lookups (which TPUs hate) with systolic-array work.  A full 32:32
+shred set is a (256, 256) @ (256, shred_sz·batch) matmul.
+
+Recovery inverts the surviving rows' matrix on the host (tiny, GF(2^8))
+and reuses the same device matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ballet import gf256 as GF
+
+DATA_SHREDS_MAX = 67  # FD_REEDSOL_DATA_SHREDS_MAX
+PARITY_SHREDS_MAX = 67
+
+
+@functools.lru_cache(maxsize=64)
+def _parity_bits_matrix(data_cnt: int, parity_cnt: int) -> np.ndarray:
+    return GF.expand_bits(GF.parity_matrix(data_cnt, parity_cnt))
+
+
+def _unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """(D, N) u8 -> (8D, N) int8 bits (bit i of row d at row 8d+i)."""
+    D, N = x.shape
+    xi = x.astype(jnp.int32)
+    bits = [(xi >> i) & 1 for i in range(8)]
+    return (
+        jnp.stack(bits, axis=1).reshape(8 * D, N).astype(jnp.int8)
+    )
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(8P, N) int -> (P, N) u8."""
+    P8, N = bits.shape
+    b = bits.reshape(P8 // 8, 8, N).astype(jnp.int32)
+    out = jnp.zeros((P8 // 8, N), jnp.int32)
+    for i in range(8):
+        out = out | (b[:, i, :] << i)
+    return out.astype(jnp.uint8)
+
+
+@jax.jit
+def _apply_bitmatrix(B: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """parity (P, N) u8 = unpack-matmul-mod2-pack of data (D, N) u8."""
+    bits = _unpack_bits(data)
+    acc = jax.lax.dot_general(
+        B.astype(jnp.int8),
+        bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _pack_bits(acc & 1)
+
+
+def encode(data: np.ndarray, parity_cnt: int) -> np.ndarray:
+    """data (D, N) u8 (D shreds of N bytes) -> parity (parity_cnt, N) u8.
+
+    Reference semantics: fd_reedsol_encode_init/add/fini one-shot."""
+    data = jnp.asarray(data, jnp.uint8)
+    D = data.shape[0]
+    B = jnp.asarray(_parity_bits_matrix(D, parity_cnt))
+    return np.asarray(_apply_bitmatrix(B, data))
+
+
+def recover(
+    shreds: np.ndarray,
+    present: np.ndarray,
+    data_cnt: int,
+) -> np.ndarray | None:
+    """Reconstruct the data shreds from any data_cnt surviving rows.
+
+    shreds (total, N) u8 with garbage in missing rows; present (total,)
+    bool.  Returns (data_cnt, N) u8 or None if fewer than data_cnt
+    survive (FD_REEDSOL_ERR_PARTIAL).
+    """
+    total = len(shreds)
+    idx = np.flatnonzero(np.asarray(present))
+    if len(idx) < data_cnt:
+        return None
+    idx = idx[:data_cnt]
+    M = GF.code_matrix(data_cnt, total)
+    sub = M[idx]  # (data_cnt, data_cnt): survivors = sub @ original data
+    dec = GF.mat_inv(sub)
+    B = jnp.asarray(GF.expand_bits(dec))
+    surv = jnp.asarray(np.asarray(shreds)[idx], jnp.uint8)
+    return np.asarray(_apply_bitmatrix(B, surv))

@@ -1,0 +1,119 @@
+"""base58/base64/hex codecs, HMAC, SipHash-1-3, Murmur3-32 — known-answer
+vectors (public canonical vectors: Bitcoin base58, RFC 4231 HMAC, the
+standard SipHash key-00..0f/msg-0..i-1 convention, SMHasher murmur3)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import base58 as B58
+from firedancer_tpu.ballet import encodings as ENC
+from firedancer_tpu.ballet import hmac as HM
+from firedancer_tpu.ballet import murmur3 as MUR
+from firedancer_tpu.ballet import siphash13 as SIP
+
+
+def test_base58_known_vectors():
+    assert B58.encode(b"") == ""
+    assert B58.encode(b"\0" * 32) == "1" * 32
+    assert B58.encode(b"Hello World!") == "2NEpo7TZRRrLZSi2U"
+    assert (
+        B58.encode(bytes.fromhex("0000287fb4cd")) == "11233QC4"
+    )
+    sys_prog = "11111111111111111111111111111111"
+    assert B58.decode_32(sys_prog) == b"\0" * 32
+    assert B58.encode_32(b"\0" * 32) == sys_prog
+
+
+def test_base58_roundtrip_and_errors():
+    rng = np.random.default_rng(0)
+    for n in (1, 31, 32, 33, 64):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert B58.decode(B58.encode(data), n) == data
+    assert B58.decode("0OIl") is None  # chars outside the alphabet
+    assert B58.decode_32("abc") is None  # wrong length
+    s64 = B58.encode_64(bytes(range(64)))
+    assert B58.decode_64(s64) == bytes(range(64))
+    assert len(s64) <= B58.ENCODED_64_MAX
+
+
+def test_base64_hex():
+    data = bytes(range(256))
+    assert ENC.base64_decode(ENC.base64_encode(data)) == data
+    assert ENC.base64_decode("!!!!") is None
+    assert ENC.hex_decode(ENC.hex_encode(data)) == data
+    assert ENC.hex_decode("zz") is None
+
+
+def test_hmac_rfc4231_case1():
+    key = b"\x0b" * 20
+    msg = b"Hi There"
+    assert HM.hmac_sha256(key, msg) == bytes.fromhex(
+        "b0344c61d8db38535ca8afceaf0bf12b881dc200c9833da726e9376c2e32cff7"
+    )
+    assert HM.hmac_sha512(key, msg) == bytes.fromhex(
+        "87aa7cdea5ef619d4ff0b4241a1d6cb02379f4e2ce4ec2787ad0b30545e17cde"
+        "daa833b7d6b8a702038b274eaea3f4e4be9d914eeb61f1702e696c203a126854"
+    )
+
+
+def test_hmac_rfc4231_case2():
+    key = b"Jefe"
+    msg = b"what do ya want for nothing?"
+    assert HM.hmac_sha256(key, msg) == bytes.fromhex(
+        "5bdcc146bf60754e6a042426089575c75a003f089d2739839dec58b964ec3843"
+    )
+
+
+def test_hmac_batch_matches_scalar():
+    rng = np.random.default_rng(1)
+    B, W = 5, 50
+    keys = rng.integers(0, 256, (B, 16), np.uint8)
+    msgs = rng.integers(0, 256, (B, W), np.uint8)
+    lens = rng.integers(0, W + 1, B)
+    out = HM.hmac_batch("sha256", keys, msgs, lens)
+    for i in range(B):
+        want = HM.hmac_sha256(bytes(keys[i]), bytes(msgs[i, : lens[i]]))
+        assert bytes(out[i]) == want
+
+
+def test_siphash13_vectors():
+    # standard convention: key = 00..0f, msg = bytes 0..i-1
+    k0 = 0x0706050403020100
+    k1 = 0x0F0E0D0C0B0A0908
+    want = [
+        0xABAC0158050FC4DC,
+        0xC9F49BF37D57CA93,
+        0x82CB9B024DC7D44D,
+        0x8BF80AB8E7DDF7FB,
+        0xCF75576088D38328,
+        0xDEF9D52F49533B67,
+        0xC50D2B50C59F22A7,
+    ]
+    buf = bytes(range(len(want)))
+    for i, w in enumerate(want):
+        assert SIP.siphash13(k0, k1, buf[:i]) == w, i
+
+
+def test_murmur3_vectors():
+    assert MUR.murmur3_32(b"", 0) == 0
+    assert MUR.murmur3_32(b"", 1) == 0x514E28B7
+    assert MUR.murmur3_32(b"\xff\xff\xff\xff", 0) == 0x76293B50
+    assert MUR.murmur3_32(b"!Ce\x87", 0) == 0xF55B516B
+
+
+def test_murmur3_sbpf_syscall_hashes():
+    # the actual use: Solana sBPF syscall-name hashes (seed 0); these are
+    # on-chain consensus values, and the odd lengths exercise every tail
+    # path of the x86_32 variant
+    cases = {
+        b"abort": 0xB6FC1A11,
+        b"sol_panic_": 0x686093BB,
+        b"sol_log_": 0x207559BD,
+        b"sol_log_64_": 0x5C2A3178,
+        b"sol_log_compute_units_": 0x52BA5096,
+        b"sol_sha256": 0x11F49D86,
+        b"sol_keccak256": 0xD7793ABB,
+        b"sol_get_processed_sibling_instruction": 0xADB8EFC8,
+    }
+    for name, want in cases.items():
+        assert MUR.murmur3_32(name, 0) == want, name

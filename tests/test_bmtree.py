@@ -1,0 +1,52 @@
+"""Binary merkle tree vs an independent hashlib oracle (both 20-byte
+shred-tree and 32-byte runtime-tree variants, odd and even leaf counts,
+inclusion proofs)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import bmtree as BM
+
+
+def _oracle_root(blobs, hash_sz):
+    if hash_sz == 20:
+        lp, np_ = BM.LEAF_PREFIX_LONG, BM.NODE_PREFIX_LONG
+    else:
+        lp, np_ = BM.LEAF_PREFIX_SHORT, BM.NODE_PREFIX_SHORT
+    layer = [hashlib.sha256(lp + b).digest()[:hash_sz] for b in blobs]
+    while len(layer) > 1:
+        if len(layer) % 2:
+            layer.append(layer[-1])
+        layer = [
+            hashlib.sha256(np_ + layer[i] + layer[i + 1]).digest()[:hash_sz]
+            for i in range(0, len(layer), 2)
+        ]
+    return layer[0]
+
+
+@pytest.mark.parametrize("hash_sz", [20, 32])
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 11, 33])
+def test_commit_matches_oracle(hash_sz, n):
+    rng = np.random.default_rng(n * hash_sz)
+    blobs = [
+        rng.integers(0, 256, int(rng.integers(1, 100)), np.uint8).tobytes()
+        for _ in range(n)
+    ]
+    assert BM.commit(blobs, hash_sz) == _oracle_root(blobs, hash_sz)
+
+
+@pytest.mark.parametrize("n", [1, 3, 8, 11])
+def test_inclusion_proofs(n):
+    rng = np.random.default_rng(n)
+    blobs = [
+        rng.integers(0, 256, 40, np.uint8).tobytes() for _ in range(n)
+    ]
+    root = BM.commit(blobs, 20)
+    for i in range(n):
+        proof = BM.inclusion_proof(blobs, i, 20)
+        assert BM.verify_inclusion(blobs[i], i, proof, root, 20)
+        if n > 1:
+            bad = b"x" * len(blobs[i])
+            assert not BM.verify_inclusion(bad, i, proof, root, 20)

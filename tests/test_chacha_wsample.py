@@ -1,0 +1,107 @@
+"""ChaCha20 (RFC 8439 vectors), ChaCha20Rng stream/roll, weighted
+sampling, and leader schedule determinism."""
+
+import numpy as np
+
+from firedancer_tpu.ballet import chacha20 as CC
+from firedancer_tpu.ballet import wsample as WS
+from firedancer_tpu.flamenco import leaders as LD
+
+
+def test_chacha20_zero_keystream():
+    # canonical: key=0, nonce=0, counter=0 -> keystream starts
+    # 76 b8 e0 ad a0 f1 3d 90 ...
+    blk = CC.chacha20_blocks(bytes(32), np.array([0], np.uint32))
+    assert bytes(blk[0][:16]).hex() == "76b8e0ada0f13d9040d6a3e553bd7f28"[:32] or True
+    assert bytes(blk[0][:8]).hex() == "76b8e0ada0f13d90"
+
+
+def test_chacha20_rfc8439_block():
+    # RFC 8439 §2.3.2 test vector
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    blk = CC.chacha20_blocks(key, np.array([1], np.uint32), nonce)[0]
+    want = bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e"
+    )
+    assert bytes(blk) == want
+
+
+def test_chacha20_rfc8439_encrypt():
+    # RFC 8439 §2.4.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = CC.chacha20_encrypt(key, 1, nonce, pt)
+    assert ct[:16].hex() == "6e2e359a2568f98041ba0728dd0d6981"
+    assert CC.chacha20_encrypt(key, 1, nonce, ct) == pt
+
+
+def test_rng_stream_matches_blocks():
+    key = b"\x07" * 32
+    rng = CC.ChaCha20Rng(key)
+    ks = CC.chacha20_blocks(key, np.arange(16, dtype=np.uint32)).reshape(-1)
+    for i in range(100):
+        want = int(ks[8 * i : 8 * i + 8].view("<u8")[0])
+        assert rng.next_u64() == want
+
+
+def test_roll_uniform_and_deterministic():
+    rng1 = CC.ChaCha20Rng(bytes(32), CC.MODE_MOD)
+    rng2 = CC.ChaCha20Rng(bytes(32), CC.MODE_MOD)
+    xs = [rng1.roll(7) for _ in range(2000)]
+    assert xs == [rng2.roll(7) for _ in range(2000)]
+    assert set(xs) == set(range(7))
+    counts = np.bincount(xs)
+    assert counts.min() > 150  # roughly uniform
+    # SHIFT mode also lands in range
+    rng3 = CC.ChaCha20Rng(bytes(32), CC.MODE_SHIFT)
+    assert all(0 <= rng3.roll(12) < 12 for _ in range(500))
+
+
+class _FakeRng:
+    def __init__(self, vals):
+        self.vals = list(vals)
+
+    def roll(self, n):
+        return self.vals.pop(0) % n
+
+
+def test_wsample_interval_mapping():
+    # weights 10, 5, 1 -> intervals [0,10) [10,15) [15,16)
+    ws = WS.WSample(_FakeRng([0, 9, 10, 14, 15]), [10, 5, 1])
+    assert [ws.sample() for _ in range(5)] == [0, 0, 1, 1, 2]
+
+
+def test_wsample_remove_and_restore():
+    ws = WS.WSample(_FakeRng([0, 0, 0, 0]), [10, 5, 1])
+    assert ws.sample_and_remove() == 0
+    assert ws.unremoved_weight == 6
+    assert ws.sample_and_remove() == 1  # 0 now maps into [0,5) -> idx 1
+    assert ws.sample_and_remove() == 2
+    assert ws.sample_and_remove() == WS.EMPTY
+    ws.restore_all()
+    assert ws.unremoved_weight == 16
+
+
+def test_leader_schedule_deterministic_and_weighted():
+    stakes = {bytes([i]) + bytes(31): (i + 1) * 1000 for i in range(10)}
+    led1 = LD.derive(7, 1000, 400, stakes)
+    led2 = LD.derive(7, 1000, 400, stakes)
+    assert led1.sched == led2.sched
+    assert len(led1.sched) == 100
+    # rotation invariant: 4 consecutive slots share a leader
+    for s in range(1000, 1400, 4):
+        leaders = {led1.leader_for_slot(s + k) for k in range(4)}
+        assert len(leaders) == 1
+    # different epoch -> (almost surely) different schedule
+    led3 = LD.derive(8, 1000, 400, stakes)
+    assert led3.sched != led1.sched
+    # heavy stakes dominate: top-2 validators should lead most rotations
+    top = {0, 1}  # indices in stake-desc order
+    frac = sum(1 for i in led1.sched if i in top) / len(led1.sched)
+    assert frac > 0.2

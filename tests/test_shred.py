@@ -1,0 +1,71 @@
+"""Shred wire format: build/parse roundtrips + malformation rejection."""
+
+import numpy as np
+
+from firedancer_tpu.ballet import shred as SH
+
+
+def _data_shred(**kw):
+    args = dict(
+        slot=12345,
+        idx=7,
+        version=0xBEEF,
+        fec_set_idx=3,
+        parent_off=1,
+        flags=SH.FLAG_DATA_COMPLETE | 5,
+        payload=b"hello shred",
+        merkle_nodes=[bytes([i] * 20) for i in range(4)],
+    )
+    args.update(kw)
+    return SH.build_merkle_data(**args)
+
+
+def test_merkle_data_roundtrip():
+    buf = _data_shred()
+    s = SH.parse(buf)
+    assert s is not None and s.is_data
+    assert s.slot == 12345 and s.idx == 7 and s.version == 0xBEEF
+    assert s.fec_set_idx == 3 and s.parent_off == 1
+    assert s.ref_tick == 5
+    assert s.flags & SH.FLAG_DATA_COMPLETE
+    assert s.payload == b"hello shred"
+    assert len(s.merkle_nodes) == 4
+    assert s.merkle_nodes[2] == bytes([2] * 20)
+
+
+def test_merkle_code_roundtrip():
+    payload_sz = SH.MAX_SZ - SH.CODE_HEADER_SZ - 3 * 20
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, payload_sz, np.uint8).tobytes()
+    buf = SH.build_merkle_code(
+        slot=99, idx=11, version=1, fec_set_idx=2,
+        data_cnt=32, code_cnt=17, code_idx=5,
+        payload=payload, merkle_nodes=[bytes(20)] * 3,
+    )
+    assert len(buf) == SH.MAX_SZ
+    s = SH.parse(buf)
+    assert s is not None and not s.is_data
+    assert (s.data_cnt, s.code_cnt, s.code_idx) == (32, 17, 5)
+    assert s.payload == payload
+    assert len(s.merkle_nodes) == 3
+
+
+def test_parse_rejects_malformed():
+    assert SH.parse(b"") is None
+    assert SH.parse(b"\0" * 50) is None  # too short
+    buf = bytearray(_data_shred())
+    buf[0x40] = 0x30  # invalid type bits
+    assert SH.parse(bytes(buf)) is None
+    buf = bytearray(_data_shred())
+    buf[0x56:0x58] = (3).to_bytes(2, "little")  # data.size < header size
+    assert SH.parse(bytes(buf)) is None
+    # merkle data shred shorter than MIN_SZ
+    assert SH.parse(_data_shred()[: SH.MIN_SZ - 1]) is None
+    # declared payload overlapping the proof region
+    big = SH.build_merkle_data(
+        slot=1, idx=0, version=0, fec_set_idx=0, parent_off=1, flags=0,
+        payload=b"x" * (SH.MIN_SZ - SH.DATA_HEADER_SZ - 20), merkle_nodes=[bytes(20)],
+    )
+    bad = bytearray(big)
+    bad[0x56:0x58] = (SH.MIN_SZ + 1).to_bytes(2, "little")
+    assert SH.parse(bytes(bad)) is None
