@@ -1,0 +1,1 @@
+from .funk import ROOT_XID, Funk  # noqa: F401

@@ -1,0 +1,232 @@
+"""Batched BLAKE3 (account-delta hashing; reference: src/ballet/blake3/).
+
+TPU-native design: the compression function is straight-line int32
+vector ops with the batch axis last, like sha256/sha512.  A (B, W) input
+runs every lane's CHUNKS in parallel too (lanes × chunks flatten into
+one compression batch), then the per-lane chunk CVs fold up the binary
+tree one batched compression per layer — log2(chunks) dispatches total.
+
+Implements the plain hash mode (no key, no derive-key), output 32 bytes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+IV = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+CHUNK_START = 1 << 0
+CHUNK_END = 1 << 1
+PARENT = 1 << 2
+ROOT = 1 << 3
+
+_PERM = [2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8]
+
+CHUNK_LEN = 1024
+BLOCK_LEN = 64
+
+
+def _rotr(x, n):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _g(v, a, b, c, d, mx, my):
+    v[a] = v[a] + v[b] + mx
+    v[d] = _rotr(v[d] ^ v[a], 16)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 12)
+    v[a] = v[a] + v[b] + my
+    v[d] = _rotr(v[d] ^ v[a], 8)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 7)
+
+
+def _compress(cv, m, counter_lo, counter_hi, block_len, flags):
+    """cv: list of 8 (B,) u32; m: list of 16 (B,) u32; scalars (B,) u32.
+    Returns 8-word output CV (first half of the full 16-word output)."""
+    iv = [jnp.broadcast_to(jnp.uint32(IV[i]), cv[0].shape) for i in range(4)]
+    v = list(cv) + iv + [counter_lo, counter_hi, block_len, flags]
+    m = list(m)
+    for r in range(7):
+        _g(v, 0, 4, 8, 12, m[0], m[1])
+        _g(v, 1, 5, 9, 13, m[2], m[3])
+        _g(v, 2, 6, 10, 14, m[4], m[5])
+        _g(v, 3, 7, 11, 15, m[6], m[7])
+        _g(v, 0, 5, 10, 15, m[8], m[9])
+        _g(v, 1, 6, 11, 12, m[10], m[11])
+        _g(v, 2, 7, 8, 13, m[12], m[13])
+        _g(v, 3, 4, 9, 14, m[14], m[15])
+        if r != 6:
+            m = [m[_PERM[i]] for i in range(16)]
+    return [v[i] ^ v[i + 8] for i in range(8)]
+
+
+def _words(buf):
+    """(..., 64) u8 -> 16 little-endian (…,) u32 words."""
+    b = buf.astype(jnp.uint32)
+    return [
+        b[..., 4 * i]
+        | (b[..., 4 * i + 1] << 8)
+        | (b[..., 4 * i + 2] << 16)
+        | (b[..., 4 * i + 3] << 24)
+        for i in range(16)
+    ]
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _blake3_impl(msgs, lens, max_len):
+    B = msgs.shape[0]
+    n_chunks = max(1, (max_len + CHUNK_LEN - 1) // CHUNK_LEN)
+    padded = n_chunks * CHUNK_LEN
+    buf = jnp.zeros((B, padded), jnp.uint8)
+    buf = buf.at[:, :max_len].set(msgs)
+    col = jnp.arange(padded)[None, :]
+    buf = jnp.where(col < lens[:, None], buf, 0)
+
+    # ---- per-chunk CVs: lanes x chunks in one vector batch ----
+    # chunk c of lane b is live iff c*1024 < max(len,1)
+    lens1 = jnp.maximum(lens, 1)  # empty input still has chunk 0
+    blocks = buf.reshape(B, n_chunks, CHUNK_LEN // BLOCK_LEN, BLOCK_LEN)
+    n_blocks_per_chunk = CHUNK_LEN // BLOCK_LEN  # 16
+
+    cv = [
+        jnp.broadcast_to(jnp.uint32(IV[i]), (B, n_chunks)) for i in range(8)
+    ]
+    chunk_idx = jnp.broadcast_to(
+        jnp.arange(n_chunks, dtype=jnp.uint32)[None, :], (B, n_chunks)
+    )
+    # bytes of each chunk: clamp(len - 1024c, 0, 1024)
+    chunk_bytes = jnp.clip(
+        lens1[:, None] - chunk_idx.astype(jnp.int32) * CHUNK_LEN, 0, CHUNK_LEN
+    )
+    # blocks in chunk: ceil(bytes/64), min 1
+    blk_cnt = jnp.maximum((chunk_bytes + BLOCK_LEN - 1) // BLOCK_LEN, 1)
+
+    for blk in range(n_blocks_per_chunk):
+        m = _words(blocks[:, :, blk, :])
+        is_first = blk == 0
+        is_last_blk = blk_cnt - 1 == blk
+        blen = jnp.clip(
+            chunk_bytes - blk * BLOCK_LEN, 0, BLOCK_LEN
+        ).astype(jnp.uint32)
+        flags = (
+            (CHUNK_START if is_first else 0)
+            + jnp.where(is_last_blk, jnp.uint32(CHUNK_END), jnp.uint32(0))
+        )
+        out = _compress(
+            cv, m, chunk_idx, jnp.zeros_like(chunk_idx), blen,
+            flags.astype(jnp.uint32)
+            if not isinstance(flags, int)
+            else jnp.broadcast_to(jnp.uint32(flags), chunk_idx.shape),
+        )
+        active = blk < blk_cnt  # (B, n_chunks)
+        cv = [jnp.where(active, o, c) for o, c in zip(out, cv)]
+
+    # ---- fold chunk CVs up the tree, one batched compression/layer ----
+    n_live = (lens1 + CHUNK_LEN - 1) // CHUNK_LEN  # (B,) live chunk count
+    width = n_chunks
+    zero = jnp.zeros((B, max(width // 2, 1)), jnp.uint32)
+    while width > 1:
+        half = width // 2
+        left = [c[:, 0 : 2 * half : 2] for c in cv]
+        right = [c[:, 1 : 2 * half + 1 : 2] for c in cv]
+        m = left + right  # 16 words: left CV || right CV
+        z = zero[:, :half]
+        out = _compress(
+            [jnp.broadcast_to(jnp.uint32(IV[i]), (B, half)) for i in range(8)],
+            m,
+            z, z,
+            jnp.full((B, half), BLOCK_LEN, jnp.uint32),
+            jnp.full((B, half), PARENT, jnp.uint32),
+        )
+        # a parent at position p merges children 2p, 2p+1; if child 2p+1
+        # is beyond the live count, the left child passes through
+        pos = jnp.arange(half, dtype=jnp.int32)[None, :]
+        live_children = n_live[:, None] - 2 * pos  # how many of the pair
+        merged = [
+            jnp.where(live_children >= 2, o, l) for o, l in zip(out, left)
+        ]
+        odd_tail = width - 2 * half
+        if odd_tail:
+            merged = [
+                jnp.concatenate([mo, c[:, width - 1 :]], axis=1)
+                for mo, c in zip(merged, cv)
+            ]
+        cv = merged
+        n_live = jnp.where(
+            n_live > 1, (n_live + 1) // 2, n_live
+        )
+        width = half + odd_tail
+
+    # NOTE: simple-binary-fold differs from blake3's left-subtree rule
+    # when the chunk count is not a power of two; restrict max chunks.
+    root_cv = [c[:, 0] for c in cv]
+
+    # ---- root finalization: re-run the LAST compression with ROOT ----
+    # For the single-chunk case the chunk's last block is the root block;
+    # for multi-chunk the final parent is.  Handled by recomputing: the
+    # tree fold above kept pre-ROOT CVs; we recompute the final merge
+    # with the ROOT flag when n_chunks > 1, and for single-chunk lanes
+    # the chunk loop must have had ROOT on its last block.  To keep one
+    # code path, the implementation above is wrapped by blake3() which
+    # dispatches on static chunk count.
+    return root_cv
+
+
+def _finalize_words(words8):
+    out = []
+    for w in words8:
+        for shift in (0, 8, 16, 24):
+            out.append(((w >> shift) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _blake3_single_chunk(msgs, lens, max_len):
+    """<= 1024-byte inputs: one chunk, ROOT on its last block."""
+    B = msgs.shape[0]
+    padded = CHUNK_LEN
+    buf = jnp.zeros((B, padded), jnp.uint8)
+    buf = buf.at[:, : min(max_len, padded)].set(msgs[:, :padded])
+    col = jnp.arange(padded)[None, :]
+    buf = jnp.where(col < lens[:, None], buf, 0)
+    blocks = buf.reshape(B, CHUNK_LEN // BLOCK_LEN, BLOCK_LEN)
+
+    cv = [jnp.broadcast_to(jnp.uint32(IV[i]), (B,)) for i in range(8)]
+    nb = jnp.maximum((lens + BLOCK_LEN - 1) // BLOCK_LEN, 1)
+    zero = jnp.zeros((B,), jnp.uint32)
+    for blk in range(CHUNK_LEN // BLOCK_LEN):
+        m = _words(blocks[:, blk, :])
+        blen = jnp.clip(lens - blk * BLOCK_LEN, 0, BLOCK_LEN).astype(jnp.uint32)
+        is_last = nb - 1 == blk
+        flags = (
+            jnp.uint32(CHUNK_START if blk == 0 else 0)
+            + jnp.where(is_last, jnp.uint32(CHUNK_END | ROOT), jnp.uint32(0))
+        )
+        out = _compress(cv, m, zero, zero, blen, flags)
+        active = blk < nb
+        cv = [jnp.where(active, o, c) for o, c in zip(out, cv)]
+    return _finalize_words(cv)
+
+
+def blake3(msgs, lens):
+    """Batched BLAKE3-256.  msgs (B, W) u8 zero-padded, lens (B,) int.
+
+    Currently supports W <= 1024 (single-chunk inputs — the account-hash
+    hot case); multi-chunk tree hashing is staged in _blake3_impl and
+    gated off until the left-subtree fold matches the spec for non-power-
+    of-two chunk counts."""
+    msgs = jnp.asarray(msgs, jnp.uint8)
+    lens = jnp.asarray(lens, jnp.int32)
+    assert msgs.shape[1] <= CHUNK_LEN, "multi-chunk inputs not yet supported"
+    return _blake3_single_chunk(msgs, lens, msgs.shape[1])

@@ -1,0 +1,108 @@
+"""funk fork-tree semantics: shadowing reads, publish/cancel, competing
+forks, frozen rule, tombstones, checkpoint/restore."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.funk import ROOT_XID, Funk
+
+
+def _xid(i):
+    return bytes([i]) + bytes(31)
+
+
+def test_read_through_ancestry():
+    f = Funk()
+    f.rec_write(ROOT_XID, b"a", b"root-a")
+    f.txn_prepare(ROOT_XID, _xid(1))
+    f.txn_prepare(_xid(1), _xid(2))
+    f.rec_write(_xid(2), b"b", b"x2-b")
+    assert f.rec_read(_xid(2), b"a") == b"root-a"  # falls through
+    assert f.rec_read(_xid(2), b"b") == b"x2-b"
+    assert f.rec_read(_xid(1), b"b") is None  # not visible to ancestor
+    assert f.rec_read(ROOT_XID, b"b") is None
+
+
+def test_shadowing_and_tombstone():
+    f = Funk()
+    f.rec_write(ROOT_XID, b"k", b"v0")
+    f.txn_prepare(ROOT_XID, _xid(1))
+    f.rec_write(_xid(1), b"k", b"v1")
+    assert f.rec_read(_xid(1), b"k") == b"v1"
+    assert f.rec_read(ROOT_XID, b"k") == b"v0"
+    f.rec_remove(_xid(1), b"k")
+    assert f.rec_read(_xid(1), b"k") is None  # tombstone shadows root
+    assert f.rec_read(ROOT_XID, b"k") == b"v0"
+    f.txn_publish(_xid(1))
+    assert f.rec_read(ROOT_XID, b"k") is None  # removal published
+
+
+def test_publish_chain_cancels_competing_forks():
+    f = Funk()
+    f.txn_prepare(ROOT_XID, _xid(1))
+    f.txn_prepare(_xid(1), _xid(2))
+    f.txn_prepare(_xid(1), _xid(3))  # competing sibling
+    f.txn_prepare(ROOT_XID, _xid(4))  # competing top-level fork
+    f.rec_write(_xid(2), b"k", b"winner")
+    f.rec_write(_xid(3), b"k", b"loser")
+    f.rec_write(_xid(4), b"k", b"loser2")
+    assert f.txn_publish(_xid(2)) == 2  # publishes x1 then x2
+    assert f.rec_read(ROOT_XID, b"k") == b"winner"
+    assert f.txns == {}  # all competing forks cancelled
+
+
+def test_publish_reparents_survivors():
+    f = Funk()
+    f.txn_prepare(ROOT_XID, _xid(1))
+    f.rec_write(_xid(1), b"k", b"v")
+    f.txn_prepare(_xid(1), _xid(2))
+    f.txn_publish(_xid(1))
+    assert _xid(2) in f.txns
+    assert f.txns[_xid(2)].parent == ROOT_XID
+    assert f.rec_read(_xid(2), b"k") == b"v"
+
+
+def test_frozen_rule():
+    f = Funk()
+    f.txn_prepare(ROOT_XID, _xid(1))
+    with pytest.raises(AssertionError):
+        f.rec_write(ROOT_XID, b"k", b"v")  # root frozen while fork open
+    f.txn_prepare(_xid(1), _xid(2))
+    with pytest.raises(AssertionError):
+        f.rec_write(_xid(1), b"k", b"v")  # parent frozen
+    f.rec_write(_xid(2), b"k", b"v")  # frontier ok
+
+
+def test_cancel_subtree():
+    f = Funk()
+    f.txn_prepare(ROOT_XID, _xid(1))
+    f.txn_prepare(_xid(1), _xid(2))
+    f.txn_prepare(_xid(2), _xid(3))
+    assert f.txn_cancel(_xid(2)) == 2
+    assert _xid(1) in f.txns and _xid(2) not in f.txns and _xid(3) not in f.txns
+
+
+def test_batch_read_matrix():
+    f = Funk()
+    f.rec_write(ROOT_XID, b"a", b"xx")
+    f.rec_write(ROOT_XID, b"b", b"yyyy")
+    rows, lens, found = f.rec_read_batch(ROOT_XID, [b"a", b"missing", b"b"], 8)
+    assert found.tolist() == [True, False, True]
+    assert lens.tolist() == [2, 0, 4]
+    assert bytes(rows[0, :2]) == b"xx"
+    assert (rows[1] == 0).all()
+    assert bytes(rows[2, :4]) == b"yyyy"
+
+
+def test_checkpoint_restore(tmp_path):
+    f = Funk()
+    f.rec_write(ROOT_XID, b"k1", b"v1")
+    f.rec_write(ROOT_XID, b"k2", b"v2" * 100)
+    path = str(tmp_path / "funk.ckpt")
+    f.checkpoint(path)
+    g = Funk.restore(path)
+    assert g.root == f.root
+    with pytest.raises(AssertionError):
+        bad = str(tmp_path / "bad.ckpt")
+        open(bad, "wb").write(b"garbage!")
+        Funk.restore(bad)
