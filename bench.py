@@ -6,6 +6,13 @@ Prints exactly one JSON line:
 Baseline (BASELINE.md): 1,000,000 verifies/s = one AWS-F1 FPGA card
 (the reference's wiredancer offload) = ~33 Skylake cores of the reference's
 AVX-512 software path.  vs_baseline = value / 1e6.
+
+Measurement notes (PROFILE.md): this environment reaches the TPU through
+the axon tunnel, which (a) does not synchronize on block_until_ready —
+sync must be a device-to-host copy — and (b) charges a fixed ~120 ms per
+execution, so the rate is measured on one huge device-resident batch per
+execution with the fixed cost amortized.  Two distinct input sets defeat
+any execution-level caching.
 """
 
 from __future__ import annotations
@@ -16,44 +23,52 @@ import time
 import numpy as np
 
 
-def _bench_verify() -> dict:
-    import jax
-
-    from firedancer_tpu.ops.ed25519 import verify as fver
+def _make_inputs(rng, batch, msg_len, n_real=64):
     from firedancer_tpu.ops.ed25519 import golden
 
-    # large batch amortizes dispatch + the XLA prologue; the Pallas verify
-    # core streams it through VMEM in TILE-sized grid steps
-    batch = 32768
-    msg_len = 128
-    rng = np.random.default_rng(42)
     secret = rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
     pub = golden.public_from_secret(secret)
     msgs = np.zeros((batch, msg_len), dtype=np.uint8)
     sigs = np.zeros((batch, 64), dtype=np.uint8)
     pubs = np.zeros((batch, 32), dtype=np.uint8)
     lens = np.full((batch,), msg_len, dtype=np.int32)
-    # a handful of distinct messages signed for real; replicated to fill batch
-    n_real = 32
+    # distinct messages signed for real; replicated to fill the batch
     for i in range(n_real):
         m = rng.integers(0, 256, msg_len, dtype=np.uint8)
         s = golden.sign(secret, m.tobytes())
         msgs[i::n_real] = m
         sigs[i::n_real] = np.frombuffer(s, dtype=np.uint8)
         pubs[i::n_real] = np.frombuffer(pub, dtype=np.uint8)
+    return msgs, lens, sigs, pubs
+
+
+def _bench_verify() -> dict:
+    import jax
+
+    from firedancer_tpu.ops.ed25519 import verify as fver
+
+    batch = 524288
+    msg_len = 128
+    rng = np.random.default_rng(42)
+    # three distinct input sets: warm on the first, time ONLY the other two
+    # (a timed repeat of the warmup execution could be served from the
+    # tunnel's execution cache and report a bogus near-RTT time)
+    sets = [
+        tuple(jax.device_put(x) for x in _make_inputs(rng, batch, msg_len))
+        for _ in range(3)
+    ]
 
     fn = jax.jit(fver.verify_batch)
-    ok = fn(msgs, lens, sigs, pubs)
-    ok.block_until_ready()
-    assert bool(np.asarray(ok).all()), "verify_batch rejected valid sigs"
+    ok = np.asarray(fn(*sets[0]))  # warm compile + correctness gate
+    assert ok.all(), "verify_batch rejected valid sigs"
 
-    n_iter = 4
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
-        ok = fn(msgs, lens, sigs, pubs)
-    ok.block_until_ready()
-    dt = time.perf_counter() - t0
-    rate = batch * n_iter / dt
+    best = float("inf")
+    for s in sets[1:]:
+        t0 = time.perf_counter()
+        out = fn(*s)
+        np.asarray(out)  # the only reliable sync on this platform
+        best = min(best, time.perf_counter() - t0)
+    rate = batch / best
     return {
         "metric": "ed25519_verifies_per_s_1chip",
         "value": round(rate, 1),
@@ -73,12 +88,12 @@ def _bench_sha512_fallback() -> dict:
     msgs = rng.integers(0, 256, size=(batch, msg_len), dtype=np.uint8)
     lens = np.full((batch,), msg_len, dtype=np.int32)
     fn = jax.jit(lambda m, l: fsha.sha512(m, l))
-    fn(msgs, lens).block_until_ready()
+    np.asarray(fn(msgs, lens))
     n_iter = 8
     t0 = time.perf_counter()
     for _ in range(n_iter):
         out = fn(msgs, lens)
-    out.block_until_ready()
+    np.asarray(out)
     dt = time.perf_counter() - t0
     rate = batch * n_iter / dt
     return {

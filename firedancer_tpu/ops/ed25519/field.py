@@ -168,6 +168,23 @@ def _carry20(x):
     return x
 
 
+def carry1(x):
+    """One-pass cheap carry for |limb| <= 2^17: output |limb| <= 8209.
+
+    One _pass leaves limbs in [-16, 8191+16] except limb 0, which absorbs
+    the 2^260 fold (|co * FOLD| <= 16*608 = 9728, so |limb0| <= 17919); a
+    single extra mask step on limb 0 pushes its carry (|.| <= 2) into limb
+    1.  Bounds verified by tests/test_field_bounds.py.  ~7 row-ops vs ~14
+    for _carry20.
+    """
+    x, co = _pass(x)
+    x = _add_at0(x, co * FOLD)
+    l0 = x[0:1]
+    lo0 = l0 & MASK
+    hi0 = l0 >> RADIX
+    return jnp.concatenate([lo0, x[1:2] + hi0, x[2:]], axis=0)
+
+
 def ripple(x):
     """Exact sequential carry over NLIMB limbs: -> (limbs, carry_out).
 
@@ -220,42 +237,134 @@ def carry(a):
     return _carry20(a)
 
 
+def _bcast2(a, b):
+    """Broadcast two limb arrays to a common batch (lanes-only broadcasts;
+    a both-axes (1,1)->(NLIMB,B) broadcast has no Mosaic lowering)."""
+    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    if a.shape[1:] != batch:
+        a = jnp.broadcast_to(a, (a.shape[0],) + batch)
+    if b.shape[1:] != batch:
+        b = jnp.broadcast_to(b, (b.shape[0],) + batch)
+    return a, b, batch
+
+
+def _placed_sum(parts, total, batch):
+    """Sum of (offset, (rows,B) array) placed in a (total,B) frame.
+
+    Zero padding is via concat of zeros (static shapes only;
+    .at[o:o+r].add would emit dynamic_update_slice, which has no Mosaic
+    lowering); zero-sized pieces are skipped (Mosaic cannot lower them).
+    """
+    out = None
+    for off, arr in parts:
+        pieces = []
+        if off:
+            pieces.append(jnp.zeros((off,) + batch, jnp.int32))
+        pieces.append(arr)
+        tail = total - off - arr.shape[0]
+        if tail:
+            pieces.append(jnp.zeros((tail,) + batch, jnp.int32))
+        v = jnp.concatenate(pieces, axis=0) if len(pieces) > 1 else pieces[0]
+        out = v if out is None else out + v
+    return out
+
+
+def _conv_half(a, b, batch):
+    """Schoolbook convolution columns of two (H, B) halves -> (2H-1, B)."""
+    h = a.shape[0]
+    parts = []
+    for i in range(h):
+        prod = jnp.broadcast_to(a[i : i + 1] * b, (h,) + batch)
+        parts.append((i, prod))
+    return _placed_sum(parts, 2 * h - 1, batch)
+
+
+def _sqr_half(a, batch):
+    """Squaring columns of an (H, B) half -> (2H-1, B): i<j products
+    doubled via a precomputed 2a, diagonal squared once (~55 products for
+    H=10 vs 100 for the generic conv)."""
+    h = a.shape[0]
+    a2 = a + a
+    parts = []
+    for i in range(h):
+        row = (
+            jnp.concatenate([a[i : i + 1], a2[i + 1 :]], axis=0)
+            if i + 1 < h
+            else a[i : i + 1]
+        )
+        prod = jnp.broadcast_to(a[i : i + 1] * row, (h - i,) + batch)
+        parts.append((2 * i, prod))
+    return _placed_sum(parts, 2 * h - 1, batch)
+
+
+_H = NLIMB // 2
+
+
+def _conv_k1(a, b, batch):
+    """(NLIMB, B) x (NLIMB, B) -> (2*NLIMB+1, B) columns, one level of
+    subtractive Karatsuba: 3 half-convs (300 products) instead of 400.
+
+    a*b = z0 + x^H (z0 + z2 + m) + x^2H z2  with  z0 = a0 b0,
+    z2 = a1 b1, m = (a0 - a1)(b1 - b0).  Inputs must be carried
+    (|limb| in [-1218, 8801]); all int32 intermediates proven in
+    tests/test_field_bounds.py.
+    """
+    a0, a1 = a[:_H], a[_H:]
+    b0, b1 = b[:_H], b[_H:]
+    z0 = _conv_half(a0, b0, batch)
+    z2 = _conv_half(a1, b1, batch)
+    m = _conv_half(a0 - a1, b1 - b0, batch)
+    mid = (z0 + z2) + m
+    return _placed_sum(
+        [(0, z0), (2 * _H, z2), (_H, mid)], 2 * NLIMB + 1, batch
+    )
+
+
+def _sqr_k1(a, batch):
+    """Squaring columns via Karatsuba: mid = z0 + z2 - (a0-a1)^2."""
+    a0, a1 = a[:_H], a[_H:]
+    z0 = _sqr_half(a0, batch)
+    z2 = _sqr_half(a1, batch)
+    ms = _sqr_half(a0 - a1, batch)
+    mid = (z0 + z2) - ms
+    return _placed_sum(
+        [(0, z0), (2 * _H, z2), (_H, mid)], 2 * NLIMB + 1, batch
+    )
+
+
+def mul_rr(a, b):
+    """Raw field multiply: NO input normalization.
+
+    Caller contract: per-column products must fit int32 — satisfied when
+    max|a_limb| * max|b_limb| * NLIMB < 2^31 AND both operands are within
+    the Karatsuba analysis of tests/test_field_bounds.py (carried values,
+    their 2-term lazy sums/differences after carry1, etc.).  Point
+    formulas in point.py are written against those proven bounds.
+    """
+    a, b, batch = _bcast2(a, b)
+    return _reduce_conv(_conv_k1(a, b, batch))
+
+
+def sqr_rr(a):
+    """Raw squaring (no input normalization; see mul_rr contract)."""
+    batch = a.shape[1:]
+    return _reduce_conv(_sqr_k1(a, batch))
+
+
 def mul(a, b):
     """Field multiply.  Inputs may be lazy add/sub chains, |limb| <= 2^17.
 
     Bound analysis: _carry20 on |x| <= 2^17 gives pass-1 limbs in
     [-16, 8207], the 2^260-fold adds |co|*608 <= 9728 to limb 0, pass 2
     lands in [-2, 8193] and the final fold widens that to [-1218, 8801].
-    The schoolbook convolution then accumulates <= 20 products of such
-    limbs per column: 20 * 8801^2 < 1.55e9 < 2^31 - exact in int32.
+    The Karatsuba convolution bounds are machine-checked in
+    tests/test_field_bounds.py.
     """
-    a = _carry20(a)
-    b = _carry20(b)
-    batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
-    # broadcast constants ((NLIMB, 1) elements) to the full batch up front:
-    # a lanes-only broadcast here keeps the per-limb products from needing
-    # a both-axes (1,1)->(NLIMB,B) broadcast, which Mosaic cannot lower
-    if a.shape[1:] != batch:
-        a = jnp.broadcast_to(a, (a.shape[0],) + batch)
-    if b.shape[1:] != batch:
-        b = jnp.broadcast_to(b, (b.shape[0],) + batch)
-    # accumulate shifted products via zero-padding + add (static shapes
-    # only; .at[i:i+NLIMB].add would emit dynamic_update_slice, which has
-    # no Mosaic lowering)
-    c = jnp.zeros((2 * NLIMB + 1,) + batch, dtype=jnp.int32)
-    for i in range(NLIMB):
-        prod = jnp.broadcast_to(a[i : i + 1] * b, (NLIMB,) + batch)
-        parts = []
-        if i:  # zero-sized arrays don't lower under Mosaic
-            parts.append(jnp.zeros((i,) + batch, jnp.int32))
-        parts.append(prod)
-        parts.append(jnp.zeros((NLIMB + 1 - i,) + batch, jnp.int32))
-        c = c + jnp.concatenate(parts, axis=0)
-    return _reduce_conv(c)
+    return mul_rr(_carry20(a), _carry20(b))
 
 
 def sqr(a):
-    return mul(a, a)
+    return sqr_rr(_carry20(a))
 
 
 def mul_small(a, s: int):
@@ -269,50 +378,54 @@ def mul_small(a, s: int):
 
 
 def _sqr_n(a, n: int):
+    """n raw squarings (input must be carried; outputs are carried)."""
     if n <= 4:
         for _ in range(n):
-            a = sqr(a)
+            a = sqr_rr(a)
         return a
-    return jax.lax.fori_loop(0, n, lambda _, v: sqr(v), a)
+    return jax.lax.fori_loop(0, n, lambda _, v: sqr_rr(v), a)
 
 
 def pow_p58(z):
     """z^((p-5)/8) = z^(2^252 - 3): the shared exponentiation chain.
 
-    Same ladder the reference uses for invert/sqrt
-    (/root/reference/src/ballet/ed25519/ref/fd_f25519.c pow22523 pattern,
-    re-derived from the standard ref10 chain).
+    Input must be carried (a mul/sqr output).  Same ladder the reference
+    uses for invert/sqrt (/root/reference/src/ballet/ed25519/ref/
+    fd_f25519.c pow22523 pattern, re-derived from the standard ref10
+    chain).
     """
-    z2 = sqr(z)  # 2
-    z4 = sqr(z2)  # 4
-    z8 = sqr(z4)  # 8
-    z9 = mul(z8, z)  # 9
-    z11 = mul(z9, z2)  # 11
-    z22 = sqr(z11)  # 22
-    z_5_0 = mul(z22, z9)  # 2^5 - 1
+    z2 = sqr_rr(z)  # 2
+    z4 = sqr_rr(z2)  # 4
+    z8 = sqr_rr(z4)  # 8
+    z9 = mul_rr(z8, z)  # 9
+    z11 = mul_rr(z9, z2)  # 11
+    z22 = sqr_rr(z11)  # 22
+    z_5_0 = mul_rr(z22, z9)  # 2^5 - 1
     z_10_5 = _sqr_n(z_5_0, 5)
-    z_10_0 = mul(z_10_5, z_5_0)  # 2^10 - 1
+    z_10_0 = mul_rr(z_10_5, z_5_0)  # 2^10 - 1
     z_20_10 = _sqr_n(z_10_0, 10)
-    z_20_0 = mul(z_20_10, z_10_0)  # 2^20 - 1
+    z_20_0 = mul_rr(z_20_10, z_10_0)  # 2^20 - 1
     z_40_20 = _sqr_n(z_20_0, 20)
-    z_40_0 = mul(z_40_20, z_20_0)  # 2^40 - 1
+    z_40_0 = mul_rr(z_40_20, z_20_0)  # 2^40 - 1
     z_50_10 = _sqr_n(z_40_0, 10)
-    z_50_0 = mul(z_50_10, z_10_0)  # 2^50 - 1
+    z_50_0 = mul_rr(z_50_10, z_10_0)  # 2^50 - 1
     z_100_50 = _sqr_n(z_50_0, 50)
-    z_100_0 = mul(z_100_50, z_50_0)  # 2^100 - 1
+    z_100_0 = mul_rr(z_100_50, z_50_0)  # 2^100 - 1
     z_200_100 = _sqr_n(z_100_0, 100)
-    z_200_0 = mul(z_200_100, z_100_0)  # 2^200 - 1
+    z_200_0 = mul_rr(z_200_100, z_100_0)  # 2^200 - 1
     z_250_50 = _sqr_n(z_200_0, 50)
-    z_250_0 = mul(z_250_50, z_50_0)  # 2^250 - 1
+    z_250_0 = mul_rr(z_250_50, z_50_0)  # 2^250 - 1
     z_252_2 = _sqr_n(z_250_0, 2)  # 2^252 - 4
-    return mul(z_252_2, z)  # 2^252 - 3
+    return mul_rr(z_252_2, z)  # 2^252 - 3
 
 
 def invert(z):
-    """z^(p-2) = z^(2^255 - 21): pow_p58 chain extended by 3 squarings."""
+    """z^(p-2) = z^(2^255 - 21): pow_p58 chain extended by 3 squarings.
+
+    Input must be carried (a mul/sqr output or canonical limbs)."""
     # p - 2 = 8 * (2^252 - 3) + 3  ->  (z^(2^252-3))^8 * z^3
     t = _sqr_n(pow_p58(z), 3)
-    return mul(t, mul(sqr(z), z))
+    return mul_rr(t, mul_rr(sqr_rr(z), z))
 
 
 # ---------------------------------------------------------------------------

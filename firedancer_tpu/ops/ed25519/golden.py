@@ -127,6 +127,44 @@ def is_small_order(p) -> bool:
     return q == IDENT
 
 
+def small_order_blocklist() -> list[bytes]:
+    """Every 32-byte encoding point_decompress accepts that decodes to a
+    small-order point (canonical and non-canonical y, both sign bits).
+
+    Derived, not hardcoded: enumerate the 8-torsion subgroup, then probe
+    each candidate encoding through point_decompress itself.  Used by
+    verify to reject small-order A/R with a byte compare instead of
+    [8]P == identity point math (reference behavior contract:
+    fd_ed25519_user.c:154-198 small-order rejection).
+    """
+    # find an order-8 generator: L * (any point) lies in the torsion group
+    torsion = set()
+    y = 2
+    while True:
+        cand = point_decompress(int(y).to_bytes(32, "little"))
+        if cand is not None:
+            t = scalar_mul(L, cand)
+            q, order = t, 1
+            while q != IDENT:
+                q = point_add(q, t)
+                order += 1
+            if order == 8:
+                torsion = {scalar_mul(i, t) for i in range(8)}
+                break
+        y += 1
+    out = []
+    for x, ty in sorted(torsion):
+        for y_enc in (ty, ty + P):
+            if y_enc >= 1 << 255:
+                continue
+            for sign in (0, 1):
+                enc = int.to_bytes(y_enc | (sign << 255), 32, "little")
+                got = point_decompress(enc)
+                if got is not None and is_small_order(got):
+                    out.append(enc)
+    return sorted(set(out))
+
+
 # ---------------------------------------------------------------------------
 # Sign / verify
 # ---------------------------------------------------------------------------

@@ -1,25 +1,27 @@
 """Pallas TPU kernel for the Ed25519 verify hot loop.
 
-The double-scalar-mul [k](-A) + [s]B == R is ~90% of verify time and is a
-64-iteration loop of ~50 field multiplies over (NLIMB, B) int32 limb
-arrays.  Under plain XLA each step's intermediates round-trip through HBM
-scheduling; here the whole loop runs in ONE kernel per batch tile with the
-accumulator, the per-lane window table for -A, and every temporary resident
-in VMEM — the memory locality the reference gets from AVX-512 register
-blocking (avx512/fd_r43x6_ge.c) and wiredancer gets from on-die BRAM, done
-the TPU way.
+The double-scalar-mul [k](-A) + [s]B is ~90% of verify time: a 64-iteration
+loop of field multiplies over (NLIMB, B) int32 limb arrays.  Under plain XLA
+each step's intermediates round-trip through HBM scheduling; here the whole
+loop runs in ONE kernel per batch tile with the accumulator, the per-lane
+signed-window table for -A, and every temporary resident in VMEM — the
+memory locality the reference gets from AVX-512 register blocking
+(avx512/fd_r43x6_ge.c) and wiredancer gets from on-die BRAM, done the TPU
+way.
 
 The kernel body simply calls the existing point.py/field.py batch code on
 VMEM-resident values: the math is written once and runs under XLA (tests,
 CPU interpret mode) or Mosaic (TPU) unchanged.
 
 Grid = batch tiles; Pallas pipelines each tile's HBM→VMEM input DMA behind
-the previous tile's compute.
+the previous tile's compute.  PROFILE.md records the measured cost model
+(VPU multiply-issue bound) that drove the op-count choices in point.py.
 """
 
 from __future__ import annotations
 
 import functools
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -30,21 +32,17 @@ from . import field as F
 from . import point as PT
 
 NL = F.NLIMB
-#: lanes per grid step: the (16,4,NL,TILE) window table plus the loop
-#: temporaries must fit VMEM (~16MB); tunable via env for experiments
-import os as _os
-
+#: lanes per grid step; tunable via env for experiments
 TILE = int(_os.environ.get("FDT_PALLAS_TILE", "256"))
 
-# array constants the kernel math needs, packed into one (rows, 1) input
-# (Pallas kernels cannot capture array constants)
+# array constants the kernel math needs, packed into one (rows, TILE) input
+# (Pallas kernels cannot capture array constants; batch-dim-1 elements would
+# force (1,1)->(sublane,lane) broadcasts Mosaic can't lower, so every
+# constant arrives already lane-wide)
 _CONST_NAMES = ("ONE", "D2", "D", "SQRT_M1", "P32", "P")
 
 
 def _pack_consts():
-    """Constants pre-broadcast to TILE lanes: batch-dim-1 elements inside
-    the kernel force (1,1)->(sublane,lane) broadcasts Mosaic can't lower,
-    so every constant arrives already lane-wide."""
     import numpy as np
 
     parts = [
@@ -52,7 +50,7 @@ def _pack_consts():
         for n in _CONST_NAMES
     ]
     parts.append(
-        np.tile(F._CONST_TABLE["B_TABLE"].reshape(-1, 1), (1, TILE))
+        np.tile(F._CONST_TABLE["B_TABLE9"].reshape(-1, 1), (1, TILE))
     )
     return np.ascontiguousarray(np.concatenate(parts, axis=0), dtype=np.int32)
 
@@ -63,24 +61,25 @@ def _unpack_consts(c_ref):
     for n in _CONST_NAMES:
         out[n] = c_ref[off : off + NL, :]
         off += NL
-    out["B_TABLE"] = c_ref[off : off + 16 * 4 * NL, :].reshape(16, 4, NL, TILE)
+    out["B_TABLE9"] = c_ref[off : off + 9 * 3 * NL, :].reshape(9, 3, NL, TILE)
     return out
 
 
 def _verify_core_kernel(c_ref, k_ref, s_ref, ay_ref, ry_ref, ok_ref):
-    """Decompress A and R, reject small-order points, run the Strauss
-    double-scalar-mul, and compare against R — the entire verify hot path
-    after byte parsing/hashing, fused over one VMEM-resident batch tile.
+    """Decompress A and R, run the signed-window Strauss double-scalar-mul,
+    and compare against R — the entire verify hot path after byte
+    parsing/hashing/small-order blocklisting, fused over one VMEM-resident
+    batch tile.
 
-    ay_ref/ry_ref rows: NL y-limbs then 1 sign row."""
+    ay_ref/ry_ref rows: NL y-limbs then 1 sign row.  k_ref/s_ref: (64, B)
+    signed digits in [-8, 7]."""
     with F.const_scope(_unpack_consts(c_ref)):
         a_pt, a_ok = PT.decompress_limbs(ay_ref[:NL, :], ay_ref[NL : NL + 1, :])
         r_pt, r_ok = PT.decompress_limbs(ry_ref[:NL, :], ry_ref[NL : NL + 1, :])
         ok = a_ok & r_ok
-        ok = ok & ~PT.is_small_order(a_pt) & ~PT.is_small_order(r_pt)
 
-        neg_a_table = PT.build_neg_table(a_pt)
-        b_table = F.c("B_TABLE")
+        neg_a_table = PT.build_neg_table9(a_pt)
+        b_table = F.c("B_TABLE9")
 
         # the double_scalar_mul loop, with the per-iteration digit rows
         # read straight from the VMEM refs (values cannot be dynamically
@@ -89,9 +88,14 @@ def _verify_core_kernel(c_ref, k_ref, s_ref, ay_ref, ry_ref, ok_ref):
             idx = 63 - j
             kd = jnp.squeeze(k_ref[pl.ds(idx, 1), :], axis=0)
             sd = jnp.squeeze(s_ref[pl.ds(idx, 1), :], axis=0)
-            acc = PT.double(PT.double(PT.double(PT.double(acc))))
-            acc = PT.add(acc, PT._lookup(neg_a_table, kd))
-            acc = PT.add(acc, PT._lookup(b_table, sd))
+            acc = PT.double(acc, with_t=False)
+            acc = PT.double(acc, with_t=False)
+            acc = PT.double(acc, with_t=False)
+            acc = PT.double(acc, with_t=True)
+            acc = PT.add_niels(acc, PT.lookup9(neg_a_table, kd), with_t=True)
+            acc = PT.add_niels_affine(
+                acc, PT.lookup9_affine(b_table, sd), with_t=False
+            )
             return acc
 
         acc = jax.lax.fori_loop(0, 64, body, PT.identity(TILE))
@@ -100,15 +104,16 @@ def _verify_core_kernel(c_ref, k_ref, s_ref, ay_ref, ry_ref, ok_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def verify_core(k_nibbles, s_nibbles, a_y, a_sign, r_y, r_sign, *, interpret=False):
-    """Fused decompress + small-order reject + ([k](-A) + [s]B == R).
+def verify_core(k_digits, s_digits, a_y, a_sign, r_y, r_sign, *, interpret=False):
+    """Fused decompress + ([k](-A) + [s]B == R).
 
-    k_nibbles, s_nibbles: (64, B) int32 radix-16 digits; a_y, r_y:
-    (NL, B) y limbs; a_sign, r_sign: (1, B) sign bits (from
-    point.decompress_bytes).  B is padded to a TILE multiple internally.
-    Returns (B,) bool.
+    k_digits, s_digits: (64, B) int32 signed radix-16 digits in [-8, 7]
+    (scalar.to_signed_digits); a_y, r_y: (NL, B) y limbs; a_sign, r_sign:
+    (1, B) sign bits (from point.decompress_bytes).  B is padded to a TILE
+    multiple internally.  Small-order rejection happens in the caller's
+    prologue (byte blocklist).  Returns (B,) bool.
     """
-    B = k_nibbles.shape[-1]
+    B = k_digits.shape[-1]
     Bp = ((B + TILE - 1) // TILE) * TILE
 
     def pad(x):
@@ -116,8 +121,8 @@ def verify_core(k_nibbles, s_nibbles, a_y, a_sign, r_y, r_sign, *, interpret=Fal
 
     a_cat = pad(jnp.concatenate([a_y, a_sign], axis=0))
     r_cat = pad(jnp.concatenate([r_y, r_sign], axis=0))
-    k_n = pad(k_nibbles)
-    s_n = pad(s_nibbles)
+    k_n = pad(k_digits)
+    s_n = pad(s_digits)
 
     consts = jnp.asarray(_pack_consts())
     grid = (Bp // TILE,)

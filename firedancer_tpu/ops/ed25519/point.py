@@ -11,9 +11,16 @@ scalar code (/root/reference/src/ballet/ed25519/ref/fd_curve25519.c, behavior
 contract only).
 
 Scalar multiplication is a Strauss/Shamir interleaved double-scalar-mul with
-4-bit windows: 64 iterations of (4 doublings + 2 table additions), table of
-B multiples precomputed on host, table of -A multiples built on device per
-batch element.
+SIGNED 4-bit windows (digits in [-8, 7], scalar.to_signed_digits): 64
+iterations of (4 doublings + 2 table additions) against 9-entry tables in
+"niels" form (Y+X, Y-X, 2dT, 2Z) -- negation of a niels point is a
+swap + T negate, so the signed window halves table size and build cost.
+The T coordinate is only produced where the next op consumes it (3 of 4
+doublings and the second add per iteration skip it).
+
+Carry discipline: operands are kept inside the machine-checked interval
+contract of field.mul_rr (tests/test_field_bounds.py); F.carry1 one-pass
+normalizations are inserted exactly where that analysis requires.
 """
 
 from __future__ import annotations
@@ -41,32 +48,92 @@ def negate(p):
     return (F.neg(x), y, z, F.neg(t))
 
 
+def double(p, with_t: bool = True):
+    """Unified extended doubling (dbl-2008-hwcd, a=-1).
+
+    Input coords must be carried (mul outputs / canonical limbs).  When
+    with_t is False the T output is zeros (1 mul saved); only valid when
+    the consumer ignores T (another doubling, or the final eq check).
+    """
+    x, y, z, _ = p
+    a = F.sqr_rr(x)
+    b = F.sqr_rr(y)
+    c2 = F.sqr_rr(z)
+    e = F.carry1(F.sqr_rr(F.carry1(x + y)) - a - b)
+    g = b - a
+    f = F.carry1(g - c2 - c2)
+    h = F.carry1(-(a + b))
+    t3 = F.mul_rr(e, h) if with_t else jnp.zeros_like(a)
+    return (F.mul_rr(e, f), F.mul_rr(g, h), F.mul_rr(f, g), t3)
+
+
 def add(p, q):
-    """Unified extended addition (add-2008-hwcd-3, a=-1, k=2d)."""
+    """Unified extended addition (add-2008-hwcd-3, a=-1, k=2d) of two full
+    extended points.  Used for table building and generic composition; the
+    dsm hot loop uses add_niels/add_niels_affine instead."""
     x1, y1, z1, t1 = p
     x2, y2, z2, t2 = q
-    a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
-    b = F.mul(F.add(y1, x1), F.add(y2, x2))
-    c = F.mul(F.mul(t1, F.c("D2")), t2)
-    d = F.mul_small(F.mul(z1, z2), 2)
-    e = F.sub(b, a)
-    f = F.sub(d, c)
-    g = F.add(d, c)
-    h = F.add(b, a)
-    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    a = F.mul_rr(y1 - x1, F.carry1(y2 - x2))
+    b = F.mul_rr(F.carry1(y1 + x1), F.carry1(y2 + x2))
+    c = F.mul_rr(F.mul_rr(t1, F.c("D2")), t2)
+    zz = F.mul_rr(z1, z2)
+    e = F.carry1(b - a)
+    f = F.carry1(zz + zz - c)
+    g = F.carry1(zz + zz + c)
+    h = F.carry1(b + a)
+    return (F.mul_rr(e, f), F.mul_rr(g, h), F.mul_rr(f, g), F.mul_rr(e, h))
 
 
-def double(p):
-    """Unified extended doubling (dbl-2008-hwcd, a=-1)."""
-    x, y, z, _ = p
-    a = F.sqr(x)
-    b = F.sqr(y)
-    c = F.mul_small(F.sqr(z), 2)
-    e = F.sub(F.sub(F.sqr(F.add(x, y)), a), b)
-    g = F.sub(b, a)  # D + B with D = -A
-    f = F.sub(g, c)
-    h = F.neg(F.add(a, b))  # D - B
-    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+# ---------------------------------------------------------------------------
+# Niels-form table entries
+# ---------------------------------------------------------------------------
+
+
+def to_niels(p):
+    """Extended point -> (Y+X, Y-X, 2dT, 2Z), all carried."""
+    x, y, z, t = p
+    return (
+        F.carry(y + x),
+        F.carry(y - x),
+        F.mul_rr(t, F.c("D2")),
+        F.carry(z + z),
+    )
+
+
+def identity_niels(batch: int):
+    one = jnp.broadcast_to(F.c("ONE"), (F.NLIMB, batch))
+    return (one, one, jnp.zeros_like(one), one + one)
+
+
+def add_niels(p, e, with_t: bool = True):
+    """p + e where e = (Y+X, Y-X, 2dT, 2Z) niels form (projective)."""
+    x1, y1, z1, t1 = p
+    ypx, ymx, t2d, z2e = e
+    a = F.mul_rr(y1 - x1, ymx)
+    b = F.mul_rr(F.carry1(y1 + x1), ypx)
+    c = F.mul_rr(t1, t2d)
+    d2 = F.mul_rr(z1, z2e)
+    ec = F.carry1(b - a)
+    f = d2 - c
+    g = F.carry1(d2 + c)
+    h = F.carry1(b + a)
+    t3 = F.mul_rr(ec, h) if with_t else jnp.zeros_like(a)
+    return (F.mul_rr(ec, f), F.mul_rr(g, h), F.mul_rr(f, g), t3)
+
+
+def add_niels_affine(p, e, with_t: bool = False):
+    """p + e where e = (y+x, y-x, 2dxy) affine niels (Z == 1 implicit)."""
+    x1, y1, z1, t1 = p
+    ypx, ymx, t2d = e
+    a = F.mul_rr(y1 - x1, ymx)
+    b = F.mul_rr(F.carry1(y1 + x1), ypx)
+    c = F.mul_rr(t1, t2d)
+    ec = F.carry1(b - a)
+    f = F.carry1(z1 + z1 - c)
+    g = F.carry1(z1 + z1 + c)
+    h = F.carry1(b + a)
+    t3 = F.mul_rr(ec, h) if with_t else jnp.zeros_like(a)
+    return (F.mul_rr(ec, f), F.mul_rr(g, h), F.mul_rr(f, g), t3)
 
 
 # ---------------------------------------------------------------------------
@@ -93,18 +160,18 @@ def decompress_limbs(y, sign):
     mask them out of the final verdict.
     """
     one = F.c("ONE")
-    ysq = F.sqr(y)
-    u = F.sub(ysq, one)
-    v = F.add(F.mul(F.c("D"), ysq), one)
+    ysq = F.sqr_rr(y)
+    u = ysq - one
+    v = F.carry1(F.mul_rr(F.c("D"), ysq) + one)
     # candidate root x = u v^3 (u v^7)^((p-5)/8)   (ref10 trick)
-    v3 = F.mul(F.sqr(v), v)
-    v7 = F.mul(F.sqr(v3), v)
-    t = F.pow_p58(F.mul(u, v7))
-    x = F.mul(F.mul(u, v3), t)
-    vxx = F.mul(v, F.sqr(x))
+    v3 = F.mul_rr(F.sqr_rr(v), v)
+    v7 = F.mul_rr(F.sqr_rr(v3), v)
+    t = F.pow_p58(F.mul_rr(F.carry1(u), v7))
+    x = F.mul_rr(F.mul_rr(F.carry1(u), v3), t)
+    vxx = F.mul_rr(v, F.sqr_rr(x))
     ok_direct = F.eq(vxx, u)
     ok_flip = F.eq(vxx, F.neg(u))
-    x = jnp.where(ok_flip[None], F.mul(x, F.c("SQRT_M1")), x)
+    x = jnp.where(ok_flip[None], F.mul_rr(x, F.c("SQRT_M1")), x)
     ok = ok_direct | ok_flip
     # negative zero: x == 0 with sign bit set is not a valid encoding
     x_is_zero = F.is_zero(x)
@@ -112,8 +179,11 @@ def decompress_limbs(y, sign):
     # choose the root with matching parity
     flip = (F.parity(x)[None] != sign) & ~x_is_zero[None]
     x = jnp.where(flip, F.neg(x), x)
+    # x is carried up to sign; negation keeps |limb| bounds symmetric, and
+    # carry1 restores the carried contract for downstream raw muls
+    x = F.carry1(x)
     z = jnp.broadcast_to(jnp.asarray(one), x.shape)
-    return (x, y, z, F.mul(x, y)), ok
+    return (x, y, z, F.mul_rr(x, F.carry1(y))), ok
 
 
 def decompress(b):
@@ -125,15 +195,20 @@ def decompress(b):
 def compress(p):
     """Point -> (B, 32) uint8 canonical encoding (via one inversion)."""
     x, y, z, _ = p
-    zinv = F.invert(z)
-    xa = F.canonical(F.mul(x, zinv))
-    yb = F.to_bytes(F.mul(y, zinv))
+    zinv = F.invert(F.carry1(z))
+    xa = F.canonical(F.mul_rr(F.carry1(x), zinv))
+    yb = F.to_bytes(F.mul_rr(F.carry1(y), zinv))
     return yb.at[..., 31].set(yb[..., 31] | ((xa[0] & 1) << 7).astype(jnp.uint8))
 
 
 def is_small_order(p):
-    """(B,) bool: the point's order divides 8 ([8]P == identity)."""
-    q = double(double(double(p)))
+    """(B,) bool: the point's order divides 8 ([8]P == identity).
+
+    The verify path rejects small-order A/R by byte blocklist in the
+    prologue instead (golden.small_order_blocklist); this point-math form
+    remains for generic use and tests.
+    """
+    q = double(double(double(p, with_t=False), with_t=False), with_t=False)
     x8, y8, z8, _ = q
     return F.is_zero(x8) & F.eq(y8, z8)
 
@@ -147,7 +222,10 @@ def eq_external(acc, r):
     """
     xa, ya, za, _ = acc
     xr, yr, _, _ = r
-    return F.eq(F.mul(xr, za), xa) & F.eq(F.mul(yr, za), ya)
+    zc = F.carry1(za)
+    return F.eq(F.mul_rr(F.carry1(xr), zc), xa) & F.eq(
+        F.mul_rr(F.carry1(yr), zc), ya
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -155,73 +233,111 @@ def eq_external(acc, r):
 # ---------------------------------------------------------------------------
 
 
-def _host_point_limbs(pt) -> np.ndarray:
-    """Affine python-int point -> (4, NLIMB, 1) extended canonical limbs."""
-    x, y = pt
-    return np.stack(
-        [
-            F.int_to_limbs(x).reshape(F.NLIMB, 1),
-            F.int_to_limbs(y).reshape(F.NLIMB, 1),
-            F.int_to_limbs(1).reshape(F.NLIMB, 1),
-            F.int_to_limbs(x * y % golden.P).reshape(F.NLIMB, 1),
-        ]
-    )
-
-
-def _build_base_table() -> np.ndarray:
-    """(16, 4, NLIMB, 1): i*B for i in 0..15, host-computed via the oracle."""
-    rows = [_host_point_limbs((0, 1))]
-    acc = golden.B
-    for _ in range(15):
-        rows.append(_host_point_limbs(acc))
+def _build_base_table9() -> np.ndarray:
+    """(9, 3, NLIMB, 1): affine niels (y+x, y-x, 2dxy) of i*B, i in 0..8,
+    host-computed via the golden oracle (canonical limbs)."""
+    rows = []
+    acc = (0, 1)  # identity
+    for i in range(9):
+        x, y = acc
+        rows.append(
+            np.stack(
+                [
+                    F.int_to_limbs((y + x) % golden.P).reshape(F.NLIMB, 1),
+                    F.int_to_limbs((y - x) % golden.P).reshape(F.NLIMB, 1),
+                    F.int_to_limbs(
+                        2 * golden.D * x % golden.P * y % golden.P
+                    ).reshape(F.NLIMB, 1),
+                ]
+            )
+        )
         acc = golden.point_add(acc, golden.B)
     return np.stack(rows)
 
 
-B_TABLE = _build_base_table()
-F.register_const("B_TABLE", B_TABLE)
+B_TABLE9 = _build_base_table9()
+F.register_const("B_TABLE9", B_TABLE9)
 
 
-def build_neg_table(a_pt):
-    """Device table (16, 4, NLIMB, B) of i*(-A) for i in 0..15."""
+def build_neg_table9(a_pt):
+    """Device table (9, 4, NLIMB, B): niels form of i*(-A) for i in 0..8."""
     na = negate(a_pt)
-    entries = [identity(a_pt[0].shape[-1]), na]
-    for i in range(2, 16):
-        entries.append(
-            double(entries[i // 2]) if i % 2 == 0 else add(entries[i - 1], na)
-        )
+    pts = [na]  # 1
+    pts.append(double(pts[0]))  # 2
+    pts.append(add(pts[1], na))  # 3
+    pts.append(double(pts[1]))  # 4
+    pts.append(add(pts[3], na))  # 5
+    pts.append(double(pts[2]))  # 6
+    pts.append(add(pts[5], na))  # 7
+    pts.append(double(pts[3]))  # 8
+    batch = a_pt[0].shape[-1]
+    entries = [identity_niels(batch)] + [to_niels(p) for p in pts]
     return jnp.stack([jnp.stack(e) for e in entries])
 
 
-def _lookup(table, idx):
-    """table (16, 4, NLIMB, B or 1), idx (B,) -> point with batch B."""
-    # broadcasted_iota + static split keep this Mosaic-lowerable (1D iota
-    # and scalar integer indexing are not)
-    ent = jax.lax.broadcasted_iota(jnp.int32, (16, idx.shape[-1]), 0)
-    sel = (ent == idx[None, :]).astype(jnp.int32)  # (16, B)
-    if table.shape[-1] == 1:  # shared table: lanes-only broadcast first
-        table = jnp.broadcast_to(table, table.shape[:-1] + (idx.shape[-1],))
+def lookup9(table, digit):
+    """table (9, 4, NLIMB, B), digit (B,) in [-8, 8] -> niels entry tuple.
+
+    Signed window: entry |digit| is gathered by masked sum, negation
+    (swap Y+X <-> Y-X, negate 2dT) applied where digit < 0."""
+    batch = digit.shape[-1]
+    absd = jnp.abs(digit)
+    ent = jax.lax.broadcasted_iota(jnp.int32, (9, batch), 0)
+    sel = (ent == absd[None, :]).astype(jnp.int32)  # (9, B)
     coords = (table * sel[:, None, None, :]).sum(axis=0)  # (4, NLIMB, B)
-    x, y, z, t = jnp.split(coords, 4, axis=0)
-    sq = lambda v: jnp.squeeze(v, axis=0)  # noqa: E731
-    return (sq(x), sq(y), sq(z), sq(t))
+    ypx, ymx, t2d, z2e = (
+        jnp.squeeze(v, axis=0) for v in jnp.split(coords, 4, axis=0)
+    )
+    neg = (digit < 0)[None, :]
+    return (
+        jnp.where(neg, ymx, ypx),
+        jnp.where(neg, ypx, ymx),
+        jnp.where(neg, -t2d, t2d),
+        z2e,
+    )
 
 
-def double_scalar_mul(k_nibbles, neg_a_table, s_nibbles):
-    """[k](-A) + [s]B with 4-bit interleaved windows.
+def lookup9_affine(table, digit):
+    """table (9, 3, NLIMB, B or 1), digit (B,) -> affine niels tuple."""
+    batch = digit.shape[-1]
+    absd = jnp.abs(digit)
+    ent = jax.lax.broadcasted_iota(jnp.int32, (9, batch), 0)
+    sel = (ent == absd[None, :]).astype(jnp.int32)
+    if table.shape[-1] == 1:  # shared table: lanes-only broadcast first
+        table = jnp.broadcast_to(table, table.shape[:-1] + (batch,))
+    coords = (table * sel[:, None, None, :]).sum(axis=0)  # (3, NLIMB, B)
+    ypx, ymx, t2d = (
+        jnp.squeeze(v, axis=0) for v in jnp.split(coords, 3, axis=0)
+    )
+    neg = (digit < 0)[None, :]
+    return (
+        jnp.where(neg, ymx, ypx),
+        jnp.where(neg, ypx, ymx),
+        jnp.where(neg, -t2d, t2d),
+    )
 
-    k_nibbles, s_nibbles: (64, B) int32 radix-16 digits, LSB first.
-    Behavior contract: fd_ed25519_double_scalar_mul_base
-    (/root/reference/src/ballet/ed25519/fd_ed25519_user.c:210-214).
+
+def double_scalar_mul(k_digits, neg_a_table9, s_digits):
+    """[k](-A) + [s]B with signed 4-bit interleaved windows.
+
+    k_digits, s_digits: (64, B) int32 digits in [-8, 7], LSB first (from
+    scalar.to_signed_digits).  Behavior contract:
+    fd_ed25519_double_scalar_mul_base (/root/reference/src/ballet/ed25519/
+    fd_ed25519_user.c:210-214).
     """
-    batch = k_nibbles.shape[-1]
-    b_table = F.c("B_TABLE")
+    batch = k_digits.shape[-1]
+    b_table = F.c("B_TABLE9")
 
     def body(j, acc):
         idx = 63 - j
-        acc = double(double(double(double(acc))))
-        acc = add(acc, _lookup(neg_a_table, k_nibbles[idx]))
-        acc = add(acc, _lookup(b_table, s_nibbles[idx]))
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=True)
+        acc = add_niels(acc, lookup9(neg_a_table9, k_digits[idx]), with_t=True)
+        acc = add_niels_affine(
+            acc, lookup9_affine(b_table, s_digits[idx]), with_t=False
+        )
         return acc
 
     return jax.lax.fori_loop(0, 64, body, identity(batch))

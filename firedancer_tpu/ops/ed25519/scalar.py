@@ -113,3 +113,21 @@ def to_nibbles(s):
         window = padded[l0] + (padded[l0 + 1] << RADIX)
         out.append((window >> sh) & 15)
     return jnp.stack(out, axis=0)
+
+
+# sum_i 8 * 16^i for i in 0..63: adding this value makes every nibble of the
+# sum equal (original nibble + 8 + incoming carry), so signed digits fall out
+# of one limb add + ripple + nibble extract (no 64-step sequential recode).
+_EIGHTS = F.int_to_limbs(sum(8 << (4 * i) for i in range(64))).reshape(
+    NLIMB, 1
+)
+
+
+def to_signed_digits(s):
+    """Canonical-shaped (NLIMB, B) limbs -> (64, B) digits in [-8, 7] with
+    s == sum_i d_i 16^i.  Exact for s < 2^253 (any canonical scalar); lanes
+    with larger non-canonical s produce garbage digits but those lanes are
+    already rejected by is_canonical.
+    """
+    t, _ = _ripple(s + jnp.asarray(_EIGHTS))
+    return to_nibbles(t) - 8

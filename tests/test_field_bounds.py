@@ -1,0 +1,394 @@
+"""Interval-arithmetic proof that the raw-multiply carry discipline in
+ops/ed25519/{field,point}.py never overflows int32.
+
+field.mul_rr/sqr_rr perform NO input normalization; point.py inserts
+F.carry1 exactly where needed.  This test mirrors the limb-level structure
+of those functions with per-limb [lo, hi] int64 intervals and asserts that
+every product, every partial column sum (in the same accumulation order as
+the jnp code), and every carry intermediate stays inside int32.  If a
+formula in point.py changes its carry discipline, the mirror here must be
+updated to match -- the shapes of both are kept deliberately parallel.
+
+It also proves closure: the coordinate intervals coming out of every point
+op are contained in the "carried" interval assumed on input, so the dsm
+loop is safe at any iteration count.
+"""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.ed25519 import field as F
+
+NL = F.NLIMB
+RADIX = F.RADIX
+MASK = F.MASK
+FOLD = F.FOLD
+I32_MIN, I32_MAX = -(2**31), 2**31 - 1
+
+
+class IV:
+    """Per-limb interval: lo/hi int64 arrays of shape (n,)."""
+
+    def __init__(self, lo, hi):
+        self.lo = np.asarray(lo, np.int64)
+        self.hi = np.asarray(hi, np.int64)
+        assert self.lo.shape == self.hi.shape
+        assert np.all(self.lo <= self.hi)
+
+    @property
+    def n(self):
+        return self.lo.shape[0]
+
+    def assert32(self, what=""):
+        assert np.all(self.lo >= I32_MIN) and np.all(self.hi <= I32_MAX), (
+            what,
+            int(self.lo.min()),
+            int(self.hi.max()),
+        )
+        return self
+
+    def __add__(self, o):
+        return IV(self.lo + o.lo, self.hi + o.hi).assert32("add")
+
+    def __sub__(self, o):
+        return IV(self.lo - o.hi, self.hi - o.lo).assert32("sub")
+
+    def __neg__(self):
+        return IV(-self.hi, -self.lo)
+
+    def __getitem__(self, sl):
+        return IV(self.lo[sl], self.hi[sl])
+
+    def scale(self, k: int):
+        v = IV(
+            np.minimum(self.lo * k, self.hi * k),
+            np.maximum(self.lo * k, self.hi * k),
+        )
+        return v.assert32("scale")
+
+    def hull(self, o):
+        n = max(self.n, o.n)
+
+        def pad(x, fill):
+            return np.concatenate([x, np.full(n - len(x), fill, np.int64)])
+
+        return IV(
+            np.minimum(pad(self.lo, 0), pad(o.lo, 0)),
+            np.maximum(pad(self.hi, 0), pad(o.hi, 0)),
+        )
+
+    def contains(self, o):
+        return np.all(self.lo <= o.lo) and np.all(self.hi >= o.hi)
+
+    @staticmethod
+    def concat(*ivs):
+        return IV(
+            np.concatenate([v.lo for v in ivs]),
+            np.concatenate([v.hi for v in ivs]),
+        )
+
+    @staticmethod
+    def zeros(n):
+        return IV(np.zeros(n, np.int64), np.zeros(n, np.int64))
+
+    @staticmethod
+    def uniform(n, lo, hi):
+        return IV(np.full(n, lo, np.int64), np.full(n, hi, np.int64))
+
+
+def _prod_iv(a: IV, b: IV) -> IV:
+    """Interval of elementwise a*b (broadcasting row against rows)."""
+    cands = [
+        a.lo * b.lo,
+        a.lo * b.hi,
+        a.hi * b.lo,
+        a.hi * b.hi,
+    ]
+    v = IV(np.minimum.reduce(cands), np.maximum.reduce(cands))
+    return v.assert32("product")
+
+
+# --- mirrors of field.py carry plumbing (same structure, interval domain)
+
+
+def ipass(x: IV):
+    # lo = x & MASK: sound over-approximation [0, MASK] unless interval
+    # lies within one aligned 2^13 block
+    same_block = (x.lo >> RADIX) == (x.hi >> RADIX)
+    lo_lo = np.where(same_block, x.lo & MASK, 0)
+    lo_hi = np.where(same_block, x.hi & MASK, MASK)
+    lo = IV(lo_lo, lo_hi)
+    hi = IV(x.lo >> RADIX, x.hi >> RADIX)
+    shifted = IV.concat(IV.zeros(1), hi[:-1])
+    return (lo + shifted).assert32("pass"), hi[-1:]
+
+
+def iadd_at0(x: IV, v: IV):
+    return IV.concat(x[0:1] + v, x[1:])
+
+
+def icarry20(x: IV):
+    # domain: any int32 (intermediates are checked by assert32 below)
+    x, co = ipass(x)
+    x = iadd_at0(x, co.scale(FOLD))
+    x, co = ipass(x)
+    return iadd_at0(x, co.scale(FOLD)).assert32("carry20")
+
+
+def icarry1(x: IV):
+    x, co = ipass(x)
+    x = iadd_at0(x, co.scale(FOLD))
+    l0 = x[0:1]
+    same_block = (l0.lo >> RADIX) == (l0.hi >> RADIX)
+    lo0 = IV(
+        np.where(same_block, l0.lo & MASK, 0),
+        np.where(same_block, l0.hi & MASK, MASK),
+    )
+    hi0 = IV(l0.lo >> RADIX, l0.hi >> RADIX)
+    return IV.concat(lo0, x[1:2] + hi0, x[2:]).assert32("carry1")
+
+
+def iplaced_sum(parts, total):
+    out = None
+    for off, arr in parts:
+        v = IV.concat(
+            *([IV.zeros(off)] if off else []),
+            arr,
+            *(
+                [IV.zeros(total - off - arr.n)]
+                if total - off - arr.n
+                else []
+            ),
+        )
+        out = v if out is None else (out + v).assert32("placed_sum")
+    return out
+
+
+def iconv_half(a: IV, b: IV):
+    h = a.n
+    parts = []
+    for i in range(h):
+        row = _prod_iv(IV(a.lo[i : i + 1], a.hi[i : i + 1]), b)
+        parts.append((i, row))
+    return iplaced_sum(parts, 2 * h - 1)
+
+
+def isqr_half(a: IV):
+    h = a.n
+    a2 = a + a
+    parts = []
+    for i in range(h):
+        ai = a[i : i + 1]
+        row_src = IV.concat(ai, a2[i + 1 :]) if i + 1 < h else ai
+        parts.append((2 * i, _prod_iv(ai, row_src)))
+    return iplaced_sum(parts, 2 * h - 1)
+
+
+H = NL // 2
+
+# Karatsuba note on interval soundness: the computed mid = (z0 + z2) + m
+# cancels algebraically to the cross-term columns (a0 b1 + a1 b0), but
+# interval addition cannot see the cancellation (the dependency problem)
+# and would raise a false alarm.  A signed int32 binary add is exact
+# whenever its TRUE result fits int32, so it suffices to check (a) every
+# product site, (b) the one genuine intermediate z0 + z2, and (c) the true
+# values of mid and of the final columns via direct enclosures of the
+# algebraically equal expressions.  The returned enclosure is the plain
+# schoolbook conv interval, which bounds the true columns.
+
+
+def iconv_full(a: IV, b: IV):
+    n = a.n
+    parts = [(i, _prod_iv(a[i : i + 1], b)) for i in range(n)]
+    return iplaced_sum(parts, 2 * n + 1)
+
+
+def iconv_k1(a: IV, b: IV):
+    a0, a1 = a[:H], a[H:]
+    b0, b1 = b[:H], b[H:]
+    z0 = iconv_half(a0, b0)  # (a) product sites + column sums
+    z2 = iconv_half(a1, b1)
+    iconv_half(a0 - a1, b1 - b0)  # (a) the m-term product sites
+    (z0 + z2).assert32("k1 z0+z2")  # (b)
+    (iconv_half(a0, b1) + iconv_half(a1, b0)).assert32("k1 mid true")  # (c)
+    return iconv_full(a, b)  # (c) final columns
+
+
+def isqr_k1(a: IV):
+    a0, a1 = a[:H], a[H:]
+    z0 = isqr_half(a0)  # (a)
+    z2 = isqr_half(a1)
+    isqr_half(a0 - a1)  # (a)
+    (z0 + z2).assert32("k1s z0+z2")  # (b)
+    iconv_half(a0, a1).scale(2).assert32("k1s mid true")  # (c)
+    return iconv_full(a, a)  # (c) final columns
+
+
+def ireduce_conv(c: IV):
+    c, _ = ipass(c)
+    c, _ = ipass(c)
+    lo, hi = c[:NL], c[NL:]
+    lo = lo + hi[:NL].scale(FOLD)
+    lo = iadd_at0(lo, hi[NL : NL + 1].scale(FOLD * FOLD))
+    return icarry20(lo)
+
+
+def imul_rr(a: IV, b: IV):
+    return ireduce_conv(iconv_k1(a, b))
+
+
+def isqr_rr(a: IV):
+    return ireduce_conv(isqr_k1(a))
+
+
+# --- point formula mirrors --------------------------------------------------
+
+CANON = IV.uniform(NL, 0, MASK)
+
+
+def idouble(p):
+    x, y, z, _ = p
+    a = isqr_rr(x)
+    b = isqr_rr(y)
+    c2 = isqr_rr(z)
+    e = icarry1(isqr_rr(icarry1(x + y)) - a - b)
+    g = b - a
+    f = icarry1(g - c2 - c2)
+    h = icarry1(-(a + b))
+    return (imul_rr(e, f), imul_rr(g, h), imul_rr(f, g), imul_rr(e, h))
+
+
+def iadd(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = imul_rr(y1 - x1, icarry1(y2 - x2))
+    b = imul_rr(icarry1(y1 + x1), icarry1(y2 + x2))
+    c = imul_rr(imul_rr(t1, CANON), t2)
+    zz = imul_rr(z1, z2)
+    e = icarry1(b - a)
+    f = icarry1(zz + zz - c)
+    g = icarry1(zz + zz + c)
+    h = icarry1(b + a)
+    return (imul_rr(e, f), imul_rr(g, h), imul_rr(f, g), imul_rr(e, h))
+
+
+def iadd_niels(p, e):
+    x1, y1, z1, t1 = p
+    ypx, ymx, t2d, z2e = e
+    a = imul_rr(y1 - x1, ymx)
+    b = imul_rr(icarry1(y1 + x1), ypx)
+    c = imul_rr(t1, t2d)
+    d2 = imul_rr(z1, z2e)
+    ec = icarry1(b - a)
+    f = d2 - c
+    g = icarry1(d2 + c)
+    h = icarry1(b + a)
+    return (imul_rr(ec, f), imul_rr(g, h), imul_rr(f, g), imul_rr(ec, h))
+
+
+def iadd_niels_affine(p, e):
+    x1, y1, z1, t1 = p
+    ypx, ymx, t2d = e
+    a = imul_rr(y1 - x1, ymx)
+    b = imul_rr(icarry1(y1 + x1), ypx)
+    c = imul_rr(t1, t2d)
+    ec = icarry1(b - a)
+    f = icarry1(z1 + z1 - c)
+    g = icarry1(z1 + z1 + c)
+    h = icarry1(b + a)
+    return (imul_rr(ec, f), imul_rr(g, h), imul_rr(f, g), imul_rr(ec, h))
+
+
+def _niels_entries(c: IV):
+    """Interval of each niels coordinate after to_niels + lookup9.
+
+    ypx/ymx are carry20 outputs (negation is a SWAP, no sign flip) hulled
+    with the identity entry [0, 2]; t2d is a mul output hulled with its
+    negation (lookup9 flips its sign); z2e is a carry20 output hulled with
+    the identity's 2."""
+    small = IV.uniform(NL, 0, 2)
+    ypx = icarry20(c + c).hull(small)
+    t2d_pos = imul_rr(c, CANON)
+    t2d = t2d_pos.hull(-t2d_pos).hull(small)
+    z2e = icarry20(c + c).hull(small)
+    return ypx, t2d, z2e
+
+
+def point_fixpoint():
+    """Smallest self-consistent coordinate interval: closed under every
+    point op used by the dsm loop (with table entries derived from it),
+    and containing canonical limbs (identity / decompressed inputs)."""
+    c = CANON
+    for _ in range(10):
+        p = (c, c, c, c)
+        outs = []
+        outs += list(idouble(p))
+        outs += list(iadd(p, p))
+        swap, t2d, z2e = _niels_entries(c)
+        outs += list(iadd_niels(p, (swap, swap, t2d, z2e)))
+        outs += list(iadd_niels_affine(p, (swap, swap, t2d)))
+        # decompressed points: x is carry1(+-carried), y canonical,
+        # z one, t = x*y
+        xn = icarry1(c.hull(-c))
+        outs += [xn, imul_rr(xn, icarry1(CANON))]
+        nxt = CANON
+        for o in outs:
+            nxt = nxt.hull(o)
+        if c.contains(nxt):
+            return c
+        c = c.hull(nxt)
+    raise AssertionError("point coordinate interval did not converge")
+
+
+PCOORD = point_fixpoint()
+
+
+def _point():
+    return (PCOORD, PCOORD, PCOORD, PCOORD)
+
+
+def test_fixpoint_holds():
+    # converged: one more application of every op stays inside PCOORD
+    p = _point()
+    swap, t2d, z2e = _niels_entries(PCOORD)
+    for coord in (
+        list(idouble(p))
+        + list(iadd(p, p))
+        + list(iadd_niels(p, (swap, swap, t2d, z2e)))
+        + list(iadd_niels_affine(p, (swap, swap, t2d)))
+    ):
+        assert PCOORD.contains(coord)
+    assert PCOORD.contains(imul_rr(PCOORD, PCOORD))
+    assert PCOORD.contains(isqr_rr(PCOORD))
+
+
+def test_decompress_chain():
+    y = CANON  # from_bytes output
+    ysq = isqr_rr(y)
+    u = ysq - CANON
+    v = icarry1(imul_rr(CANON, ysq) + CANON)
+    v3 = imul_rr(isqr_rr(v), v)
+    v7 = imul_rr(isqr_rr(v3), v)
+    uc = icarry1(u)
+    t = imul_rr(uc, v7)  # pow_p58 input; chain itself is mul/sqr of carried
+    x = imul_rr(imul_rr(uc, v3), imul_rr(t, t))
+    imul_rr(v, isqr_rr(x))
+    # post-where x: hull with negation, then carry1; T = x * carry1(y)
+    xn = icarry1(PCOORD.hull(-PCOORD))
+    assert PCOORD.contains(imul_rr(xn, icarry1(CANON)))
+
+
+def test_eq_external_inputs():
+    # canonical() accepts |limb| <= 2^17: all eq inputs are raw subs or
+    # carried values
+    for v in (PCOORD, PCOORD - PCOORD, PCOORD.hull(-PCOORD)):
+        assert np.all(np.abs(v.lo) <= 1 << 17)
+        assert np.all(np.abs(v.hi) <= 1 << 17)
+    zc = icarry1(PCOORD)
+    imul_rr(icarry1(PCOORD), zc)
+
+
+def test_mul_generic_contract():
+    # F.mul accepts any |limb| <= 2^17 via carry20 on both sides
+    loose = IV.uniform(NL, -(1 << 17), 1 << 17)
+    imul_rr(icarry20(loose), icarry20(loose))

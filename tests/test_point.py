@@ -98,14 +98,21 @@ def test_double_scalar_mul_vs_golden():
     a_dev, ok = PT.decompress(jnp.asarray(np.stack([_enc(p) for p in a_pts])))
     assert bool(np.asarray(ok).all())
 
-    def nib(vals):
-        return jnp.asarray(
-            np.stack(
-                [[(v >> (4 * d)) & 15 for v in vals] for d in range(64)]
-            ).astype(np.int32)
-        )
+    def digits(vals):
+        """Signed radix-16 digits computed host-side (independent of
+        scalar.to_signed_digits, which is tested separately)."""
+        out = []
+        for v in vals:
+            ds, carry = [], 0
+            for d in range(64):
+                w = ((v >> (4 * d)) & 15) + carry
+                carry = 1 if w >= 8 else 0
+                ds.append(w - 16 * carry)
+            assert carry == 0
+            out.append(ds)
+        return jnp.asarray(np.asarray(out, np.int32).T)
 
-    acc = PT.double_scalar_mul(nib(ks), PT.build_neg_table(a_dev), nib(ss))
+    acc = PT.double_scalar_mul(digits(ks), PT.build_neg_table9(a_dev), digits(ss))
     got = np.asarray(PT.compress(acc))
     for j in range(n):
         ref = golden.point_add(
