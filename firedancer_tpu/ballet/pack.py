@@ -44,6 +44,25 @@ MAX_BANK_TILES = 62
 
 _FREE, _PENDING, _INFLIGHT = 0, 1, 2
 
+from . import base58 as _b58  # noqa: E402
+
+#: the on-chain Vote program id (reference: fd_pack classifies txns whose
+#: single instruction targets this program as "simple votes" and schedules
+#: them through the dedicated vote lane, fd_pack.c pending_votes treap)
+VOTE_PROGRAM_ID = _b58.decode("Vote111111111111111111111111111111111111111")
+assert VOTE_PROGRAM_ID is not None and len(VOTE_PROGRAM_ID) == 32
+
+
+def is_simple_vote(payload: bytes, desc: T.TxnDesc) -> bool:
+    """Single-instruction txn invoking the Vote program (the reference's
+    is_simple_vote_transaction shape test)."""
+    if desc.instr_cnt != 1:
+        return False
+    ins = desc.instr[0]
+    if ins.program_id >= desc.acct_addr_cnt:
+        return False
+    return bytes(desc.acct_addr(payload, ins.program_id)) == VOTE_PROGRAM_ID
+
 
 def _hash_acct(key: bytes) -> int:
     """Account pubkey -> stable 64-bit hash (splitmix64 finalizer over the
@@ -95,6 +114,7 @@ class Pack:
         self.expires_at = np.zeros(P, dtype=np.uint64)
         self.state = np.zeros(P, dtype=np.uint8)
         self.sig_tag = np.zeros(P, dtype=np.uint64)
+        self.is_vote = np.zeros(P, dtype=bool)
         # hashed account-conflict bitsets
         self.bs_rw = np.zeros((P, self.W), dtype=np.uint64)
         self.bs_w = np.zeros((P, self.W), dtype=np.uint64)
@@ -109,6 +129,8 @@ class Pack:
 
         self.writer_costs: dict[bytes, int] = {}
         self.cumulative_block_cost = 0
+        self.cumulative_vote_cost = 0
+        self.vote_cost_limit = MAX_VOTE_COST_PER_BLOCK
         self.outstanding: dict[int, list[_Microblock]] = {
             b: [] for b in range(max_banks)
         }
@@ -171,6 +193,7 @@ class Pack:
         self.expires_at[slot] = expires_at
         self.sig_tag[slot] = sig_tag
         self.state[slot] = _PENDING
+        self.is_vote[slot] = is_simple_vote(payload, desc)
 
         w_idx = desc.writable_idxs()
         keys_w = [bytes(desc.acct_addr(payload, j)) for j in w_idx]
@@ -184,44 +207,18 @@ class Pack:
 
     # ---- scheduling -----------------------------------------------------
 
-    def schedule_microblock(
-        self,
-        bank: int,
-        *,
-        cu_limit: int = 1_500_000,
-        txn_limit: int = 31,
-        now: int = 0,
-        scan_limit: int = 1024,
-        device_select=None,
-    ) -> _Microblock | None:
-        """Greedy-select a non-conflicting microblock for `bank`
-        (fd_pack_schedule_next_microblock behavior, fd_pack.c:1029 /
-        742-953).  device_select, when given, is the TPU prefilter
-        (ops/pack_select.select_noconflict) used speculatively; the host
-        still enforces writer-cost caps and block budgets before
-        committing."""
-        if self.cumulative_block_cost >= self.block_cost_limit:
-            return None
-        cu_limit = min(
-            cu_limit, self.block_cost_limit - self.cumulative_block_cost
+    def _select_pass(
+        self, cands, cu_limit, txn_limit, scan_limit, device_select,
+        sel_rw, sel_w,
+    ) -> list[int]:
+        """One greedy selection pass over `cands` (pool slots) against the
+        running conflict state sel_rw/sel_w (mutated in place)."""
+        if cu_limit <= 0 or txn_limit <= 0 or not len(cands):
+            return []
+        pr = self.rewards[cands].astype(np.float64) / np.maximum(
+            self.cost[cands].astype(np.float64), 1.0
         )
-        pending = np.flatnonzero(self.state == _PENDING)
-        if now:
-            # expires_at == 0 means "no expiry requested"
-            exp = self.expires_at[pending]
-            live = (exp >= now) | (exp == 0)
-            expired = pending[~live]
-            if len(expired):
-                self._release_slots(expired)
-            pending = pending[live]
-        if not len(pending):
-            return None
-
-        pr = self.rewards[pending].astype(np.float64) / np.maximum(
-            self.cost[pending].astype(np.float64), 1.0
-        )
-        order = pending[np.argsort(-pr, kind="stable")][:scan_limit]
-
+        order = cands[np.argsort(-pr, kind="stable")][:scan_limit]
         cand_rw = self.bs_rw[order]
         cand_w = self.bs_w[order]
         costs = self.cost[order].astype(np.int64)
@@ -246,38 +243,113 @@ class Pack:
                 )
             take = np.asarray(
                 device_select(
-                    cand_rw, cand_w, self.in_use_rw, self.in_use_w, costs,
+                    cand_rw, cand_w, sel_rw.copy(), sel_w.copy(), costs,
                     cu_limit, txn_limit,
                 )
             )[:K]
-            picks = order[take]
-        else:
-            picks_l: list[int] = []
-            sel_rw = self.in_use_rw.copy()
-            sel_w = self.in_use_w.copy()
-            cu_used = 0
-            for j, slot in enumerate(order):
-                c = int(costs[j])
-                if cu_used + c > cu_limit:
-                    continue
-                if (cand_w[j] & sel_rw).any() or (cand_rw[j] & sel_w).any():
-                    continue
-                picks_l.append(int(slot))
-                sel_rw |= cand_rw[j]
-                sel_w |= cand_w[j]
-                cu_used += c
-                if len(picks_l) >= txn_limit:
-                    break
-            picks = np.array(picks_l, dtype=np.int64)
+            picks = [int(s) for s in order[take]]
+            for slot in picks:
+                sel_rw |= self.bs_rw[slot]
+                sel_w |= self.bs_w[slot]
+            return picks
+
+        picks_l: list[int] = []
+        cu_used = 0
+        for j, slot in enumerate(order):
+            c = int(costs[j])
+            if cu_used + c > cu_limit:
+                continue
+            if (cand_w[j] & sel_rw).any() or (cand_rw[j] & sel_w).any():
+                continue
+            picks_l.append(int(slot))
+            sel_rw |= cand_rw[j]
+            sel_w |= cand_w[j]
+            cu_used += c
+            if len(picks_l) >= txn_limit:
+                break
+        return picks_l
+
+    def schedule_microblock(
+        self,
+        bank: int,
+        *,
+        cu_limit: int = 1_500_000,
+        txn_limit: int = 31,
+        vote_fraction: float = 0.25,
+        now: int = 0,
+        scan_limit: int = 1024,
+        device_select=None,
+    ) -> _Microblock | None:
+        """Greedy-select a non-conflicting microblock for `bank`
+        (fd_pack_schedule_next_microblock behavior, fd_pack.c:1029 /
+        742-953): VOTES FIRST with `vote_fraction` of the CU budget,
+        capped by the per-block vote cost limit (MAX_VOTE_COST_PER_BLOCK,
+        fd_pack.h:20), then non-votes with the remainder.  device_select,
+        when given, is the TPU prefilter (ops/pack_select.select_noconflict)
+        used speculatively; the host still enforces writer-cost caps and
+        block budgets before committing."""
+        if self.cumulative_block_cost >= self.block_cost_limit:
+            return None
+        cu_limit = min(
+            cu_limit, self.block_cost_limit - self.cumulative_block_cost
+        )
+        pending = np.flatnonzero(self.state == _PENDING)
+        if now:
+            # expires_at == 0 means "no expiry requested"
+            exp = self.expires_at[pending]
+            live = (exp >= now) | (exp == 0)
+            expired = pending[~live]
+            if len(expired):
+                self._release_slots(expired)
+            pending = pending[live]
+        if not len(pending):
+            return None
+
+        votes = pending[self.is_vote[pending]]
+        nonvotes = pending[~self.is_vote[pending]]
+        vote_budget = min(
+            int(cu_limit * vote_fraction),
+            self.vote_cost_limit - self.cumulative_vote_cost,
+        )
+        # votes also get only a vote_fraction share of the txn SLOTS while
+        # non-votes are pending: cheap votes must not be able to fill all
+        # 31 slots of every microblock on txn count alone (divergence note:
+        # the reference splits CUs only; its slot pressure differs because
+        # votes and non-votes come from separate treaps per call)
+        vote_txn_limit = txn_limit
+        if len(nonvotes):
+            vote_txn_limit = max(1, int(txn_limit * vote_fraction))
+        sel_rw = self.in_use_rw.copy()
+        sel_w = self.in_use_w.copy()
+        # vote lane always uses the host greedy loop: the candidate set is
+        # tiny and the device prefilter's fixed scan_limit shape would pay
+        # a full 1024-row scan for it
+        vote_picks = self._select_pass(
+            votes, vote_budget, vote_txn_limit, scan_limit, None,
+            sel_rw, sel_w,
+        )
+        vote_cost = int(self.cost[vote_picks].sum()) if vote_picks else 0
+        # device pass keeps the STATIC txn_limit (it is a static jit arg;
+        # varying it would recompile); the host commit loop below enforces
+        # the remaining dynamic slot budget
+        nv_picks = self._select_pass(
+            nonvotes, cu_limit - vote_cost, txn_limit,
+            scan_limit, device_select, sel_rw, sel_w,
+        )
+        picks = vote_picks + nv_picks
 
         # host-side exact enforcement: writer cost caps (+ re-derive
-        # budgets when the device speculated)
+        # budgets when the device speculated); votes enforce the vote
+        # budget exactly
         final: list[int] = []
         cu_used = 0
+        vote_used = 0
         for slot in picks:
             slot = int(slot)
             c = int(self.cost[slot])
             if cu_used + c > cu_limit:
+                continue
+            if self.is_vote[slot] and vote_used + c > vote_budget:
                 continue
             over = False
             for k in self.writable_keys[slot]:
@@ -288,10 +360,13 @@ class Pack:
                 continue
             final.append(slot)
             cu_used += c
+            if self.is_vote[slot]:
+                vote_used += c
             if len(final) >= txn_limit:
                 break
         if not final:
             return None
+        self.cumulative_vote_cost += vote_used
 
         idx = np.array(final, dtype=np.int64)
         for slot in final:
@@ -367,3 +442,4 @@ class Pack:
         assert all(not v for v in self.outstanding.values())
         self.writer_costs.clear()
         self.cumulative_block_cost = 0
+        self.cumulative_vote_cost = 0

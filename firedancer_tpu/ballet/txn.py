@@ -19,8 +19,9 @@ The parser runs on the ingest host path (verify/dedup/pack tiles).  Batched
 fixed-field extraction for the device (signature/pubkey/message slices) is in
 `extract_sigverify_batch`, which the verify tile uses to build TPU batches.
 
-This module is pure Python over bytes/numpy — the native C fast path lives in
-native/ (same descriptor layout); tests cross-check the two.
+This module is pure Python over bytes/numpy; per-txn parse runs on the
+control path only (ingest tiles parse once, then every consumer reads the
+trailer fields — tiles/wire.py — with vectorized gathers).
 """
 
 from __future__ import annotations

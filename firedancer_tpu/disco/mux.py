@@ -99,12 +99,16 @@ class MuxCtx:
         ins: list[InLink],
         outs: list[OutLink],
         metrics: Metrics,
+        wksp: R.Workspace | None = None,
     ):
         self.name = name
         self.cnc = cnc
         self.ins = ins
         self.outs = outs
         self.metrics = metrics
+        #: the topology's shared workspace — tiles allocate observable
+        #: state (tcaches etc.) here so a monitor process can map it
+        self.wksp = wksp
         self.credits = 0  # refreshed by the loop before each callback round
         self.halted = False
 
@@ -113,6 +117,14 @@ class MuxCtx:
             if o.name == name:
                 return o
         raise KeyError(name)
+
+    def alloc(self, name: str, footprint: int) -> np.ndarray:
+        """Observable tile state: allocated in the shared workspace when
+        the topology provides one (so a monitor process can map it), else
+        process-local memory (standalone tile tests)."""
+        if self.wksp is not None:
+            return self.wksp.alloc(f"{self.name}_{name}", footprint)
+        return np.zeros(footprint, dtype=np.uint8)
 
     def publish(self, sigs, rows=None, szs=None, ctls=None) -> int:
         """Publish to every out link (the common single-out case)."""
@@ -133,6 +145,11 @@ class Tile:
 
     name = "tile"
     schema = MetricsSchema()
+
+    def wksp_footprint(self) -> int:
+        """Bytes of shared-workspace state this tile allocates in on_boot
+        (beyond links/metrics, which the topology accounts for itself)."""
+        return 0
 
     def on_boot(self, ctx: MuxCtx) -> None: ...
 

@@ -103,6 +103,7 @@ class Topology:
         for ts in self.tiles.values():
             total += R.CNC.footprint() + 128
             total += Metrics.footprint(ts.tile.schema.with_base()) + 256
+            total += ts.tile.wksp_footprint() + 256
         return total
 
     def build(self) -> None:
@@ -149,7 +150,10 @@ class Topology:
                 )
                 for ln in ts.outs
             ]
-            ts.ctx = MuxCtx(name, self._cncs[name], ins, outs, self._metrics[name])
+            ts.ctx = MuxCtx(
+                name, self._cncs[name], ins, outs, self._metrics[name],
+                wksp=self.wksp,
+            )
 
     # ---- run ------------------------------------------------------------
 

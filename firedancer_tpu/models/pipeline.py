@@ -19,12 +19,17 @@ Collectives (all under shard_map, riding ICI on real hardware):
   * psum(metrics, 'dp')     — global counters
 
 Deliberate divergence from the reference documented here: the reference's
-tcache is an exact evicting ring+map; the device filter is a bloom bitmask
-— false positives drop a valid txn with probability ~load_factor, never
-admit a duplicate.  Aging is the CALLER's responsibility: the filter only
-accumulates, so swap in a zeroed filter (fresh_bloom()) on epoch roll,
-exactly like resetting the host tcache.  The host tcache (tango) remains
-the exact authority on the host path.
+tcache is an exact evicting ring+map; the device filter is a k-hash bloom
+pair — false positives drop a valid txn (never admit a duplicate), and
+aging is a DOUBLE-BUFFER: membership consults current|previous, inserts go
+to current only, and when current has absorbed ~the reference's tcache
+depth (4,194,302 sigs, default.toml:760) of MISSES the host rotates
+previous<-current and zeroes current (AgingBloom).  The worst case for
+false positives is just before rotation, when current|previous holds up
+to 2*AGE_CAPACITY tags; BLOOM_BITS = 2^28 with N_HASH = 4 keeps even that
+peak at ~2e-4 (measured on the full pair in tests/test_dedup_scale.py),
+against the <1e-3 budget.  The host tcache (tango) remains the exact
+authority on the host path.
 """
 
 from __future__ import annotations
@@ -39,26 +44,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from firedancer_tpu.ops import pack_select
 from firedancer_tpu.ops.ed25519 import verify as fver
 
-#: bloom filter size in bits; must divide evenly across the mp axis
-BLOOM_BITS = 1 << 15
+#: bloom filter size in bits (power of two; must divide across mp); sized
+#: for the pre-rotation worst case of 2*AGE_CAPACITY resident tags
+BLOOM_BITS = 1 << 28
+#: hash probes per tag
+N_HASH = 4
+#: inserts before the host rotates the double buffer (reference tcache
+#: depth, src/app/fdctl/config/default.toml:760)
+AGE_CAPACITY = 4_194_302
 
 
 def fresh_bloom() -> np.ndarray:
     """A zeroed dedup filter (full, unsharded).  Callers device_put it
-    mp-sharded and swap it in on epoch roll to age out old tags."""
+    mp-sharded; AgingBloom handles the epoch rotation."""
     return np.zeros(BLOOM_BITS // 32, np.uint32)
 
 
-def _hash_tags(tags):
-    """u32-pair tag hash -> bit index in [0, BLOOM_BITS).  (splitmix-style
-    avalanche on the low word, int32 ops only — TPU-lane friendly.)"""
-    x = tags.astype(jnp.uint32)
-    x ^= x >> 16
+def _mix(x):
+    x = x ^ (x >> 16)
     x = x * jnp.uint32(0x7FEB352D)
-    x ^= x >> 15
+    x = x ^ (x >> 15)
     x = x * jnp.uint32(0x846CA68B)
-    x ^= x >> 16
-    return (x % jnp.uint32(BLOOM_BITS)).astype(jnp.int32)
+    return x ^ (x >> 16)
+
+
+def _tag_bits(tags2):
+    """(B, 2) u32 tag words -> (N_HASH, B) int32 bit indices via double
+    hashing: bit_i = (h1 + i*h2) mod BLOOM_BITS (h2 odd)."""
+    lo = tags2[:, 0].astype(jnp.uint32)
+    hi = tags2[:, 1].astype(jnp.uint32)
+    h1 = _mix(lo ^ _mix(hi))
+    h2 = _mix(hi + jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+    i = jnp.arange(N_HASH, dtype=jnp.uint32)[:, None]
+    idx = (h1[None, :] + i * h2[None, :]) & jnp.uint32(BLOOM_BITS - 1)
+    return idx.astype(jnp.int32)
 
 
 def make_step(mesh: Mesh):
@@ -67,70 +86,85 @@ def make_step(mesh: Mesh):
     assert BLOOM_BITS % (32 * mp) == 0
     words_per_shard = BLOOM_BITS // 32 // mp
 
-    def step(msgs, lens, sigs, pubs, tags, bloom):
+    def step(msgs, lens, sigs, pubs, tags2, cur, prev):
         """One ingress step on local shards.
 
         msgs (Bl, W) u8, lens (Bl,), sigs (Bl, 64), pubs (Bl, 32),
-        tags (Bl,) u32 dedup tags — all dp-sharded;
-        bloom (words_per_shard,) u32 — mp-sharded bitmask.
+        tags2 (Bl, 2) u32 dedup tag words — all dp-sharded;
+        cur/prev (words_per_shard,) u32 — mp-sharded aging bloom pair.
 
-        Returns (keep (Bl,) bool, new bloom shard, global metrics (3,)).
+        Returns (keep (Bl,) bool, new current shard, metrics (4,):
+        [verified, failed, dup_hits, inserted]).
         """
         ok = fver.verify_batch(msgs, lens, sigs, pubs)
 
-        # ---- dedup: bloom membership across the mp-sharded bitmask ----
-        all_tags = jax.lax.all_gather(tags, "dp", tiled=True)  # (Bg,)
+        # ---- dedup: N_HASH-probe membership across current|previous ----
+        all_tags = jax.lax.all_gather(tags2, "dp", tiled=True)  # (Bg, 2)
         all_ok = jax.lax.all_gather(ok, "dp", tiled=True)  # (Bg,)
-        bit = _hash_tags(all_tags)  # (Bg,) in [0, BLOOM_BITS)
-        word, off = bit // 32, bit % 32
+        bits = _tag_bits(all_tags)  # (N_HASH, Bg)
+        word, off = bits >> 5, (bits & 31).astype(jnp.uint32)
         shard_lo = jax.lax.axis_index("mp") * words_per_shard
         local = word - shard_lo
         in_shard = (local >= 0) & (local < words_per_shard)
         lw = jnp.where(in_shard, local, 0)
-        hit_local = jnp.where(
-            in_shard, (bloom[lw] >> off.astype(jnp.uint32)) & 1, 0
-        )
-        hits = jax.lax.psum(hit_local, "mp")  # (Bg,) 0/1
+        both = cur | prev
+        probe = jnp.where(in_shard, (both[lw] >> off) & 1, 0)
+        probe = jax.lax.psum(probe, "mp")  # (N_HASH, Bg): each bit 0/1
+        hits = jnp.min(probe, axis=0)  # bloom hit iff ALL probes set
 
         # within-batch duplicates: membership above reads the PRE-insert
         # filter, so repeats inside one batch need their own first-
         # occurrence mask (the reference's query+insert is sequential and
-        # gets this for free).  Stable sort groups equal tags with
-        # original order preserved; only each run's head is "first".
+        # gets this for free).  Stable sort on the combined 64-bit tag
+        # groups equal tags with original order preserved.
         Bg = all_tags.shape[0]
-        order = jnp.argsort(all_tags, stable=True)
-        sorted_tags = all_tags[order]
-        head = jnp.concatenate(
-            [jnp.ones(1, bool), sorted_tags[1:] != sorted_tags[:-1]]
-        )
+        # exact 64-bit grouping with 32-bit sorts: two-pass stable lexsort
+        # (sort by lo, then stably by hi) puts equal (hi, lo) tags adjacent
+        order1 = jnp.argsort(all_tags[:, 0], stable=True)
+        order = order1[jnp.argsort(all_tags[order1, 1], stable=True)]
+        st = all_tags[order]
+        same = jnp.all(st[1:] == st[:-1], axis=1)
+        head = jnp.concatenate([jnp.ones(1, bool), ~same])
         first_occurrence = jnp.zeros(Bg, bool).at[order].set(head)
 
-        # insert: OR in the bits of VERIFIED first-occurrence tags only —
-        # a failed signature must not be able to censor a later valid txn
-        # with the same tag (the reference dedups post-verify only)
+        # insert into CURRENT only: VERIFIED first-occurrence tags — a
+        # failed signature must not be able to censor a later valid txn
+        # with the same tag (the reference dedups post-verify only).
+        # Scatter-free OR: flatten the probe bit indices, drop entries
+        # outside this shard / not insertable, dedup exact bit repeats by
+        # sort, then segment-sum single-bit words (sum == OR once each
+        # (word, bit) pair is unique).
         insertable = all_ok & first_occurrence
-        onehot = (
-            (jax.lax.broadcasted_iota(jnp.int32, (words_per_shard,), 0)[None, :]
-             == lw[:, None])
-            & in_shard[:, None]
-            & insertable[:, None]
+        lbit = jnp.where(
+            in_shard & insertable[None, :],
+            (lw << 5) | off.astype(jnp.int32),
+            jnp.int32(words_per_shard * 32),  # sentinel: sorts last
+        ).reshape(-1)
+        sl = jnp.sort(lbit)
+        uniq = jnp.concatenate([jnp.ones(1, bool), sl[1:] != sl[:-1]])
+        valid = uniq & (sl < words_per_shard * 32)
+        vals = jnp.where(
+            valid, jnp.uint32(1) << (sl & 31).astype(jnp.uint32), 0
         )
-        add_bits = jnp.where(
-            onehot,
-            (jnp.uint32(1) << off.astype(jnp.uint32))[:, None],
-            jnp.uint32(0),
-        )
-        new_bloom = bloom | jax.lax.reduce_or(add_bits, axes=(0,))
+        seg = jnp.where(valid, sl >> 5, 0)
+        delta = jax.ops.segment_sum(
+            vals, seg, num_segments=words_per_shard
+        ).astype(jnp.uint32)
+        new_cur = cur | delta
 
         # my dp slice of the global keep vector
         keep_g = all_ok & (hits == 0) & first_occurrence
-        bl = tags.shape[0]
+        bl = tags2.shape[0]
         dp_i = jax.lax.axis_index("dp")
         my_keep = jax.lax.dynamic_slice(keep_g, (dp_i * bl,), (bl,))
         my_hits = jax.lax.dynamic_slice(hits, (dp_i * bl,), (bl,))
         keep = my_keep
 
-        # ---- global metrics over dp ----
+        # ---- metrics: [verified, failed, dup_hits] psum'd over dp;
+        # inserted counts only MISSES (tags not already present) so
+        # duplicate-heavy traffic does not rotate the aging buffer early
+        # (the reference tcache likewise inserts only on miss); computed
+        # from all-gathered values, already identical on every device
         m = jnp.stack(
             [
                 jnp.sum(ok.astype(jnp.int32)),
@@ -138,8 +172,14 @@ def make_step(mesh: Mesh):
                 jnp.sum((ok & (my_hits != 0)).astype(jnp.int32)),
             ]
         )
-        metrics = jax.lax.psum(m, "dp")
-        return keep, new_bloom, metrics
+        new_tags = insertable & (hits == 0)
+        metrics = jnp.concatenate(
+            [
+                jax.lax.psum(m, "dp"),
+                jnp.sum(new_tags.astype(jnp.int32))[None],
+            ]
+        )
+        return keep, new_cur, metrics
 
     return jax.jit(
         jax.shard_map(
@@ -147,12 +187,42 @@ def make_step(mesh: Mesh):
             mesh=mesh,
             in_specs=(
                 P("dp", None), P("dp"), P("dp", None), P("dp", None),
-                P("dp"), P("mp"),
+                P("dp", None), P("mp"), P("mp"),
             ),
             out_specs=(P("dp"), P("mp"), P()),
             check_vma=False,
         )
     )
+
+
+class AgingBloom:
+    """Host-side owner of the double-buffered device filter.
+
+    Rotation mirrors the reference's bounded tcache history: once `cur`
+    has absorbed AGE_CAPACITY tags, previous <- current and current is
+    zeroed, so the filter always remembers between AGE_CAPACITY and
+    2*AGE_CAPACITY of the most recent tags."""
+
+    def __init__(self, mesh: Mesh):
+        self._sharding = NamedSharding(mesh, P("mp"))
+        self.cur = jax.device_put(fresh_bloom(), self._sharding)
+        self.prev = jax.device_put(fresh_bloom(), self._sharding)
+        self.inserted = 0
+        self.rotations = 0
+
+    def buffers(self):
+        return self.cur, self.prev
+
+    def update(self, new_cur, metrics) -> None:
+        """Adopt the step's output filter + account inserts; rotate at
+        capacity."""
+        self.cur = new_cur
+        self.inserted += int(np.asarray(metrics)[3])
+        if self.inserted >= AGE_CAPACITY:
+            self.prev = self.cur
+            self.cur = jax.device_put(fresh_bloom(), self._sharding)
+            self.inserted = 0
+            self.rotations += 1
 
 
 def pack_prefilter(cand_rw32, cand_w32, in_use_rw32, in_use_w32, costs,
@@ -193,9 +263,9 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
     # lane 1 is an exact within-batch duplicate of lane 0: the step must
     # keep only the first occurrence
     msgs[1], sigs[1] = msgs[0], sigs[0]
-    tags = sigs[:, :4].copy().view(np.uint32).reshape(B).astype(np.uint32)
+    tags2 = sigs[:, :8].copy().view(np.uint32).reshape(B, 2).astype(np.uint32)
 
-    bloom = fresh_bloom()
+    bloom = AgingBloom(mesh)  # production filter size (BLOOM_BITS = 2^27)
 
     step = make_step(mesh)
     sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
@@ -204,20 +274,21 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
         jax.device_put(lens, sh(P("dp"))),
         jax.device_put(sigs, sh(P("dp", None))),
         jax.device_put(pubs, sh(P("dp", None))),
-        jax.device_put(tags, sh(P("dp"))),
-        jax.device_put(bloom, sh(P("mp"))),
+        jax.device_put(tags2, sh(P("dp", None))),
     )
-    keep, bloom1, metrics = step(*args)
-    jax.block_until_ready((keep, bloom1, metrics))
+    keep, cur1, metrics = step(*args, *bloom.buffers())
+    jax.block_until_ready((keep, cur1, metrics))
     k0 = np.asarray(keep)
     m0 = np.asarray(metrics)
     assert k0[0] and not k0[1], "within-batch duplicate must be dropped"
     assert k0[2:].all(), "fresh valid txns must pass verify+dedup"
     assert m0[0] == B and m0[1] == 0, m0
+    assert m0[3] == B - 1  # B txns, one within-batch duplicate
+    bloom.update(cur1, metrics)
 
-    # second step with the SAME tags: bloom must now reject all of them
-    keep2, _, metrics2 = step(args[0], args[1], args[2], args[3], args[4],
-                              bloom1)
+    # second step with the SAME tags: the filter must now reject all of
+    # them (membership consults current|previous either side of rotation)
+    keep2, _, metrics2 = step(*args, *bloom.buffers())
     jax.block_until_ready((keep2, metrics2))
     assert not np.asarray(keep2).any(), "duplicates must be dropped"
     assert np.asarray(metrics2)[2] == B  # every tag now hits the filter

@@ -25,10 +25,13 @@ class DedupTile(Tile):
         self.depth = depth
         self._tc: R.TCache | None = None
 
+    def wksp_footprint(self) -> int:
+        return R.TCache.footprint(self.depth, R.TCache.map_cnt_for(self.depth))
+
     def on_boot(self, ctx: MuxCtx) -> None:
         map_cnt = R.TCache.map_cnt_for(self.depth)
-        mem = np.zeros(R.TCache.footprint(self.depth, map_cnt), dtype=np.uint8)
-        self._tc = R.TCache(mem, self.depth, map_cnt)
+        fp = R.TCache.footprint(self.depth, map_cnt)
+        self._tc = R.TCache(ctx.alloc("tcache", fp), self.depth, map_cnt)
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         dup = self._tc.dedup(frags["sig"])

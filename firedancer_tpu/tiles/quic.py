@@ -1,0 +1,130 @@
+"""QUIC + legacy-UDP ingress tile: the wire edge of the TPU pipeline.
+
+Reference model: src/app/fdctl/run/tiles/fd_quic.c — a QUIC server whose
+completed TPU streams are reassembled (src/disco/quic/fd_tpu.h) and
+published as parsed txn + trailer frags to the verify tiles, plus the
+legacy non-QUIC UDP path (fd_quic.c:148-170) where one datagram = one raw
+txn.  This build listens on two UDP ports (QUIC and legacy) through the
+waltz.udpsock burst interface; stream reassembly lives inside
+waltz.quic.Connection and the txn parse/trailer format is shared with the
+synth tile (tiles/wire.py), so downstream tiles cannot tell wire ingress
+from synthetic ingress.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.waltz import quic as Q
+from firedancer_tpu.waltz.udpsock import UdpSock
+
+from . import wire
+
+
+class QuicIngressTile(Tile):
+    """Terminates QUIC (and legacy UDP) and publishes txn+trailer frags."""
+
+    name = "quic"
+    schema = MetricsSchema(
+        counters=(
+            "rx_dgrams",
+            "tx_dgrams",
+            "rx_txns_quic",
+            "rx_txns_udp",
+            "parse_fail_txns",
+            "conns_opened",
+        ),
+    )
+
+    def __init__(
+        self,
+        identity_secret: bytes,
+        *,
+        quic_addr=("127.0.0.1", 0),
+        udp_addr=("127.0.0.1", 0),
+        burst: int = 256,
+    ):
+        self.identity_secret = identity_secret
+        self._quic_addr_req = quic_addr
+        self._udp_addr_req = udp_addr
+        self.burst = burst
+        self.quic_sock: UdpSock | None = None
+        self.udp_sock: UdpSock | None = None
+        self.server: Q.QuicServer | None = None
+        self._backlog: list[bytes] = []  # parsed txn+trailer payloads
+
+    # bound addresses, available after on_boot (ports may be ephemeral)
+    @property
+    def quic_addr(self):
+        return self.quic_sock.addr
+
+    @property
+    def udp_addr(self):
+        return self.udp_sock.addr
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        self.quic_sock = UdpSock(self._quic_addr_req)
+        self.udp_sock = UdpSock(self._udp_addr_req)
+        self.server = Q.QuicServer(self.identity_secret)
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        if self.quic_sock:
+            self.quic_sock.close()
+        if self.udp_sock:
+            self.udp_sock.close()
+
+    def _ingest_txn(self, ctx: MuxCtx, raw: bytes, counter: str) -> None:
+        desc = T.parse(raw)
+        if desc is None:
+            ctx.metrics.inc("parse_fail_txns")
+            return
+        self._backlog.append(wire.append_trailer(raw, desc))
+        ctx.metrics.inc(counter)
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        n_conns = len(self.server.conns)
+        # legacy UDP: one datagram = one txn (fd_quic.c legacy path)
+        for data, _addr in self.udp_sock.recv_burst(self.burst):
+            ctx.metrics.inc("rx_dgrams")
+            self._ingest_txn(ctx, data, "rx_txns_udp")
+
+        # QUIC datagrams
+        out_pkts = []
+        touched = []
+        for data, addr in self.quic_sock.recv_burst(self.burst):
+            ctx.metrics.inc("rx_dgrams")
+            conn = self.server.on_datagram(data, addr)
+            if conn is not None:
+                touched.append((conn, addr))
+        for conn, addr in touched:
+            for d in conn.datagrams_out():
+                out_pkts.append((d, addr))
+            if conn.txns:
+                for raw in conn.txns:
+                    self._ingest_txn(ctx, raw, "rx_txns_quic")
+                conn.txns.clear()
+        if out_pkts:
+            ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
+        if len(self.server.conns) > n_conns:
+            ctx.metrics.inc("conns_opened", len(self.server.conns) - n_conns)
+
+        # publish backlog within credit budget
+        if not self._backlog or ctx.credits <= 0:
+            return
+        take = self._backlog[: ctx.credits]
+        self._backlog = self._backlog[ctx.credits :]
+        n = len(take)
+        rows = np.zeros((n, wire.LINK_MTU), np.uint8)
+        szs = np.zeros(n, np.uint16)
+        for i, payload in enumerate(take):
+            rows[i, : len(payload)] = np.frombuffer(payload, np.uint8)
+            szs[i] = len(payload)
+        tr = wire.parse_trailers(rows, szs.astype(np.int64))
+        sig0 = rows[np.arange(n)[:, None], tr["sig_off"][:, None] + np.arange(8)]
+        tags = sig0.astype(np.uint64) @ (
+            np.uint64(1) << (np.uint64(8) * np.arange(8, dtype=np.uint64))
+        )
+        ctx.publish(tags, rows, szs)

@@ -56,6 +56,13 @@ class VerifyTile(Tile):
         self._tc: R.TCache | None = None
         self._fn = None
 
+    def wksp_footprint(self) -> int:
+        if not self.pre_dedup:
+            return 0
+        return R.TCache.footprint(
+            PRE_DEDUP_DEPTH, R.TCache.map_cnt_for(PRE_DEDUP_DEPTH)
+        )
+
     def on_boot(self, ctx: MuxCtx) -> None:
         import jax
 
@@ -65,8 +72,8 @@ class VerifyTile(Tile):
         if self.pre_dedup:
             depth = PRE_DEDUP_DEPTH
             map_cnt = R.TCache.map_cnt_for(depth)
-            mem = np.zeros(R.TCache.footprint(depth, map_cnt), dtype=np.uint8)
-            self._tc = R.TCache(mem, depth, map_cnt)
+            fp = R.TCache.footprint(depth, map_cnt)
+            self._tc = R.TCache(ctx.alloc("tcache", fp), depth, map_cnt)
         # warm the compile caches for every lane bucket so steady state
         # never hits a compile stall (first compile is slow on TPU)
         buckets = (

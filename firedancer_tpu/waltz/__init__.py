@@ -1,0 +1,7 @@
+"""waltz — networking layer: UDP sockets (aio), minimal TLS 1.3, QUIC.
+
+Reference layer map: /root/reference/src/waltz/ (xdp, quic, tls, aio, ip,
+udpsock).  This build's equivalents are socket-based (no AF_XDP in this
+environment) with the same layering: aio packet interface -> QUIC server
+with TPU stream reassembly -> txn frags into the verify pipeline.
+"""

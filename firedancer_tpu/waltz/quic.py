@@ -1,0 +1,570 @@
+"""Minimal QUIC v1 (RFC 9000/9001) for the Solana TPU ingress path.
+
+Reference: /root/reference/src/waltz/quic/fd_quic.c — connection lifecycle,
+Initial/Handshake/1-RTT packet protection, CRYPTO-stream handshake via the
+TLS engine, and client-initiated unidirectional streams each carrying one
+transaction (FIN marks the end), which is exactly how the Solana TPU
+protocol uses QUIC.  Independent re-implementation of that scope from the
+RFCs; packet protection uses ballet.aes, the handshake uses waltz.tls.
+
+Scope notes (documented divergences, all irrelevant to the loopback/LAN
+ingress use): no version negotiation, no Retry/anti-amplification, no loss
+recovery/retransmission (lossless-link assumption; the reference's pkt_meta
+loss tracking has no analog yet), no key update, no connection migration.
+
+Sans-IO: Connection.datagrams_out() drains UDP payloads to send; feed
+received payloads via Connection.on_datagram().
+"""
+
+from __future__ import annotations
+
+import os
+
+from firedancer_tpu.ballet import aes as A
+from firedancer_tpu.waltz import tls
+
+INITIAL_SALT_V1 = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
+VERSION = 1
+
+INITIAL, HANDSHAKE, APPLICATION = tls.INITIAL, tls.HANDSHAKE, tls.APPLICATION
+
+# long-header packet types (bits 4-5 of the first byte)
+_PT_INITIAL, _PT_0RTT, _PT_HANDSHAKE, _PT_RETRY = 0, 1, 2, 3
+_LEVEL_BY_PT = {_PT_INITIAL: INITIAL, _PT_HANDSHAKE: HANDSHAKE}
+_PT_BY_LEVEL = {INITIAL: _PT_INITIAL, HANDSHAKE: _PT_HANDSHAKE}
+
+MAX_DATAGRAM = 1200
+
+
+# ---------------------------------------------------------------------------
+# varints
+# ---------------------------------------------------------------------------
+
+
+def vi_enc(n: int) -> bytes:
+    if n < 1 << 6:
+        return bytes([n])
+    if n < 1 << 14:
+        return (n | 0x4000).to_bytes(2, "big")
+    if n < 1 << 30:
+        return (n | 0x80000000).to_bytes(4, "big")
+    return (n | 0xC000000000000000).to_bytes(8, "big")
+
+
+def vi_dec(buf: bytes, off: int) -> tuple[int, int]:
+    first = buf[off]
+    ln = 1 << (first >> 6)
+    val = int.from_bytes(buf[off : off + ln], "big") & ((1 << (8 * ln - 2)) - 1)
+    return val, off + ln
+
+
+# ---------------------------------------------------------------------------
+# packet protection
+# ---------------------------------------------------------------------------
+
+
+class Keys:
+    """AEAD + header-protection keys for one direction at one level."""
+
+    def __init__(self, secret: bytes):
+        self.aead = A.AesGcm(
+            tls.hkdf_expand_label(secret, "quic key", b"", 16)
+        )
+        self.iv = tls.hkdf_expand_label(secret, "quic iv", b"", 12)
+        self.hp = A.key_expand(tls.hkdf_expand_label(secret, "quic hp", b"", 16))
+
+    def nonce(self, pn: int) -> bytes:
+        n = int.from_bytes(self.iv, "big") ^ pn
+        return n.to_bytes(12, "big")
+
+    def hp_mask(self, sample: bytes) -> bytes:
+        return A.encrypt_block(self.hp, sample)[:5]
+
+
+def initial_secrets(dcid: bytes) -> tuple[bytes, bytes]:
+    """(client secret, server secret) for the Initial level."""
+    initial = tls.hkdf_extract(INITIAL_SALT_V1, dcid)
+    c = tls.hkdf_expand_label(initial, "client in", b"", 32)
+    s = tls.hkdf_expand_label(initial, "server in", b"", 32)
+    return c, s
+
+
+def _pn_decode(truncated: int, pn_len: int, largest: int) -> int:
+    """RFC 9000 appendix A packet-number recovery."""
+    expected = largest + 1
+    win = 1 << (8 * pn_len)
+    hwin = win // 2
+    cand = (expected & ~(win - 1)) | truncated
+    if cand <= expected - hwin and cand < (1 << 62) - win:
+        return cand + win
+    if cand > expected + hwin and cand >= win:
+        return cand - win
+    return cand
+
+
+# ---------------------------------------------------------------------------
+# frame-level helpers
+# ---------------------------------------------------------------------------
+
+
+class CryptoStream:
+    """In-order reassembly of one CRYPTO stream (per level)."""
+
+    def __init__(self):
+        self.delivered = 0
+        self.pending: dict[int, bytes] = {}
+
+    def insert(self, off: int, data: bytes) -> bytes:
+        self.pending[off] = max(
+            self.pending.get(off, b""), data, key=len
+        )
+        out = b""
+        while True:
+            # find a chunk covering `delivered`
+            hit = None
+            for o, d in self.pending.items():
+                if o <= self.delivered < o + len(d):
+                    hit = (o, d)
+                    break
+                if o == self.delivered and not d:
+                    hit = (o, d)
+                    break
+            if hit is None:
+                return out
+            o, d = hit
+            del self.pending[o]
+            take = d[self.delivered - o :]
+            out += take
+            self.delivered += len(take)
+
+
+class StreamBuf:
+    """Reassembly of one client->server unidirectional stream."""
+
+    __slots__ = ("chunks", "fin_size", "size")
+
+    def __init__(self):
+        self.chunks: dict[int, bytes] = {}
+        self.fin_size = -1
+        self.size = 0
+
+    def insert(self, off: int, data: bytes, fin: bool) -> bytes | None:
+        """Returns the complete payload once FIN and all bytes are in."""
+        if data:
+            self.chunks[off] = max(self.chunks.get(off, b""), data, key=len)
+        if fin:
+            self.fin_size = off + len(data)
+        if self.fin_size < 0:
+            return None
+        # contiguity check
+        have = 0
+        while True:
+            nxt = None
+            for o, d in self.chunks.items():
+                if o <= have < o + len(d):
+                    nxt = o + len(d)
+                    break
+            if nxt is None:
+                break
+            have = max(have, nxt)
+        if have < self.fin_size:
+            return None
+        out = bytearray(self.fin_size)
+        for o, d in self.chunks.items():
+            out[o : o + len(d)] = d[: max(0, self.fin_size - o)]
+        return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# connection
+# ---------------------------------------------------------------------------
+
+
+class Connection:
+    """One QUIC connection endpoint (sans-IO)."""
+
+    def __init__(self, is_server: bool, engine, scid: bytes, dcid: bytes):
+        self.is_server = is_server
+        self.tls = engine
+        self.scid = scid
+        self.dcid = dcid
+        self.keys_rx: dict[int, Keys] = {}
+        self.keys_tx: dict[int, Keys] = {}
+        self.pn_tx = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
+        self.largest_rx = {INITIAL: -1, HANDSHAKE: -1, APPLICATION: -1}
+        self.rx_pns: dict[int, list[int]] = {INITIAL: [], HANDSHAKE: [], APPLICATION: []}
+        self.crypto_rx = {INITIAL: CryptoStream(), HANDSHAKE: CryptoStream(), APPLICATION: CryptoStream()}
+        self.crypto_tx_off = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
+        self.streams: dict[int, StreamBuf] = {}
+        self.txns: list[bytes] = []  # completed stream payloads (server)
+        self.established = False
+        self.closed = False
+        self._out: list[bytes] = []
+        self._pending_frames: dict[int, list[bytes]] = {INITIAL: [], HANDSHAKE: [], APPLICATION: []}
+        self._next_uni_stream = 2  # client: uni stream ids 2, 6, 10, ...
+        self.peer_identity = None
+
+    # -- key install ---------------------------------------------------------
+
+    def _install_initial(self, dcid: bytes) -> None:
+        c, s = initial_secrets(dcid)
+        if self.is_server:
+            self.keys_rx[INITIAL] = Keys(c)
+            self.keys_tx[INITIAL] = Keys(s)
+        else:
+            self.keys_rx[INITIAL] = Keys(s)
+            self.keys_tx[INITIAL] = Keys(c)
+
+    def _install_from_tls(self) -> None:
+        for level in (HANDSHAKE, APPLICATION):
+            if level in self.tls.secrets and level not in self.keys_tx:
+                c, s = self.tls.secrets[level]
+                if self.is_server:
+                    self.keys_rx[level] = Keys(c)
+                    self.keys_tx[level] = Keys(s)
+                else:
+                    self.keys_rx[level] = Keys(s)
+                    self.keys_tx[level] = Keys(c)
+
+    # -- receive path --------------------------------------------------------
+
+    def on_datagram(self, data: bytes) -> None:
+        off = 0
+        while off < len(data) and not self.closed:
+            first = data[off]
+            if first == 0:  # padding between coalesced packets
+                off += 1
+                continue
+            try:
+                if first & 0x80:
+                    consumed = self._rx_long(data, off)
+                else:
+                    consumed = self._rx_short(data, off)
+            except (IndexError, ValueError):
+                return  # malformed packet: drop the rest of the datagram
+            if consumed <= 0:
+                return
+            off += consumed
+            # keys derived from a packet earlier in this datagram must be
+            # live before the next coalesced packet (Initial(SH) and the
+            # Handshake flight typically share one datagram)
+            self._install_from_tls()
+        self._drive()
+
+    def _rx_long(self, data: bytes, off: int) -> int:
+        pt = (data[off] >> 4) & 3
+        o = off + 5
+        dcil = data[o]
+        dcid = data[o + 1 : o + 1 + dcil]
+        o += 1 + dcil
+        scil = data[o]
+        scid = data[o + 1 : o + 1 + scil]
+        o += 1 + scil
+        if pt == _PT_INITIAL:
+            tok_len, o = vi_dec(data, o)
+            o += tok_len
+        elif pt not in _LEVEL_BY_PT:
+            return -1  # retry/0-rtt unsupported
+        length, o = vi_dec(data, o)
+        level = _LEVEL_BY_PT[pt]
+        if level == INITIAL and INITIAL not in self.keys_rx:
+            self._install_initial(dcid)
+        if not self.is_server and level == INITIAL and scid:
+            self.dcid = scid  # adopt server-chosen CID
+        pkt_end = o + length
+        self._decrypt_and_process(data[off:pkt_end], o - off, level)
+        return pkt_end - off
+
+    def _rx_short(self, data: bytes, off: int) -> int:
+        # short header: flags + dcid (our scid length) + pn; runs to dgram end
+        pn_off = off + 1 + len(self.scid)
+        self._decrypt_and_process(data[off:], pn_off - off, APPLICATION)
+        return len(data) - off
+
+    def _decrypt_and_process(self, pkt: bytes, pn_off: int, level: int) -> None:
+        keys = self.keys_rx.get(level)
+        if keys is None:
+            return  # keys not yet available; drop (lossless-link assumption)
+        buf = bytearray(pkt)
+        sample = bytes(buf[pn_off + 4 : pn_off + 20])
+        if len(sample) < 16:
+            return
+        mask = keys.hp_mask(sample)
+        if buf[0] & 0x80:
+            buf[0] ^= mask[0] & 0x0F
+        else:
+            buf[0] ^= mask[0] & 0x1F
+        pn_len = (buf[0] & 0x03) + 1
+        for i in range(pn_len):
+            buf[pn_off + i] ^= mask[1 + i]
+        truncated = int.from_bytes(buf[pn_off : pn_off + pn_len], "big")
+        pn = _pn_decode(truncated, pn_len, self.largest_rx[level])
+        header = bytes(buf[: pn_off + pn_len])
+        payload = keys.aead.decrypt(
+            keys.nonce(pn), bytes(buf[pn_off + pn_len :]), header
+        )
+        if payload is None:
+            return
+        self.largest_rx[level] = max(self.largest_rx[level], pn)
+        if self._on_frames(level, payload):
+            # only ack-eliciting packets are queued for acknowledgement
+            # (acking pure-ACK packets would ping-pong forever)
+            self.rx_pns[level].append(pn)
+
+    def _on_frames(self, level: int, payload: bytes) -> bool:
+        """Process frames; returns True if any frame was ack-eliciting."""
+        eliciting = False
+        off = 0
+        n = len(payload)
+        while off < n:
+            ft = payload[off]
+            if ft not in (0x00, 0x02, 0x03):
+                eliciting = True
+            if ft == 0x00:  # PADDING
+                off += 1
+            elif ft == 0x01:  # PING
+                off += 1
+            elif ft in (0x02, 0x03):  # ACK
+                off += 1
+                _, off = vi_dec(payload, off)  # largest
+                _, off = vi_dec(payload, off)  # delay
+                cnt, off = vi_dec(payload, off)
+                _, off = vi_dec(payload, off)  # first range
+                for _ in range(cnt):
+                    _, off = vi_dec(payload, off)
+                    _, off = vi_dec(payload, off)
+                if ft == 0x03:
+                    for _ in range(3):
+                        _, off = vi_dec(payload, off)
+            elif ft == 0x06:  # CRYPTO
+                off += 1
+                coff, off = vi_dec(payload, off)
+                clen, off = vi_dec(payload, off)
+                data = payload[off : off + clen]
+                off += clen
+                try:
+                    self.tls.feed(level, self.crypto_rx[level].insert(coff, data))
+                except tls.TlsError:
+                    self.closed = True
+                    return eliciting
+            elif 0x08 <= ft <= 0x0F:  # STREAM
+                has_off = bool(ft & 0x04)
+                has_len = bool(ft & 0x02)
+                fin = bool(ft & 0x01)
+                off += 1
+                sid, off = vi_dec(payload, off)
+                soff = 0
+                if has_off:
+                    soff, off = vi_dec(payload, off)
+                if has_len:
+                    slen, off = vi_dec(payload, off)
+                else:
+                    slen = n - off
+                data = payload[off : off + slen]
+                off += slen
+                buf = self.streams.setdefault(sid, StreamBuf())
+                done = buf.insert(soff, data, fin)
+                if done is not None:
+                    self.txns.append(done)
+                    del self.streams[sid]
+            elif ft in (0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17):
+                off += 1  # flow-control / blocked frames: type + varints
+                nargs = {0x11: 2, 0x15: 2}.get(ft, 1)
+                for _ in range(nargs):
+                    _, off = vi_dec(payload, off)
+            elif ft == 0x18:  # NEW_CONNECTION_ID
+                off += 1
+                _, off = vi_dec(payload, off)
+                _, off = vi_dec(payload, off)
+                cl = payload[off]
+                off += 1 + cl + 16
+            elif ft in (0x1C, 0x1D):  # CONNECTION_CLOSE
+                self.closed = True
+                return eliciting
+            elif ft == 0x1E:  # HANDSHAKE_DONE
+                off += 1
+                self.established = True
+            else:
+                self.closed = True  # unknown frame: fatal per RFC
+                return eliciting
+        return eliciting
+
+    # -- send path -----------------------------------------------------------
+
+    def _drive(self) -> None:
+        """Flush TLS output + ACKs into packets."""
+        self._install_from_tls()
+        while self.tls.out_queue:
+            level, msg = self.tls.out_queue.pop(0)
+            frame = (
+                b"\x06"
+                + vi_enc(self.crypto_tx_off[level])
+                + vi_enc(len(msg))
+                + msg
+            )
+            self.crypto_tx_off[level] += len(msg)
+            self._pending_frames[level].append(frame)
+        self._install_from_tls()
+        if (
+            self.is_server
+            and self.tls.handshake_complete
+            and not self.established
+            and APPLICATION in self.keys_tx
+        ):
+            self.peer_identity = self.tls.peer_identity
+            self._pending_frames[APPLICATION].append(b"\x1e")  # HANDSHAKE_DONE
+            self.established = True
+        # ACK every level with new packets
+        for level in (INITIAL, HANDSHAKE, APPLICATION):
+            if self.rx_pns[level] and level in self.keys_tx:
+                largest = self.largest_rx[level]
+                ack = b"\x02" + vi_enc(largest) + vi_enc(0) + vi_enc(0) + vi_enc(0)
+                self._pending_frames[level].append(ack)
+                self.rx_pns[level] = []
+        self._flush()
+
+    def _flush(self) -> None:
+        """Coalesce pending frames into protected packets/datagrams."""
+        datagram = b""
+        for level in (INITIAL, HANDSHAKE, APPLICATION):
+            frames = self._pending_frames[level]
+            if not frames or level not in self.keys_tx:
+                continue
+            self._pending_frames[level] = []
+            payload = b"".join(frames)
+            pkt = self._build_packet(level, payload)
+            if len(datagram) + len(pkt) > MAX_DATAGRAM:
+                if datagram:
+                    self._out.append(self._pad_if_initial(datagram))
+                datagram = b""
+            datagram += pkt
+        if datagram:
+            self._out.append(self._pad_if_initial(datagram))
+
+    def _pad_if_initial(self, dgram: bytes) -> bytes:
+        # datagrams containing Initial packets must be >= 1200 bytes
+        if dgram and (dgram[0] & 0xF0) == 0xC0 and len(dgram) < MAX_DATAGRAM:
+            return dgram + b"\0" * (MAX_DATAGRAM - len(dgram))
+        return dgram
+
+    def _build_packet(self, level: int, payload: bytes) -> bytes:
+        keys = self.keys_tx[level]
+        pn = self.pn_tx[level]
+        self.pn_tx[level] += 1
+        pn_len = 2
+        pn_bytes = (pn & 0xFFFF).to_bytes(2, "big")
+        # AEAD adds 16; ensure sample coverage for header protection
+        if len(payload) + 16 < 20 - pn_len:
+            payload = payload + b"\0" * (20 - pn_len - 16 - len(payload))
+        if level == APPLICATION:
+            first = 0x40 | (pn_len - 1)
+            header = bytes([first]) + self.dcid + pn_bytes
+        else:
+            first = 0xC0 | (_PT_BY_LEVEL[level] << 4) | (pn_len - 1)
+            length = len(payload) + 16 + pn_len
+            header = (
+                bytes([first])
+                + VERSION.to_bytes(4, "big")
+                + bytes([len(self.dcid)])
+                + self.dcid
+                + bytes([len(self.scid)])
+                + self.scid
+                + (vi_enc(0) if level == INITIAL else b"")
+                + vi_enc(length)
+                + pn_bytes
+            )
+        sealed = keys.aead.encrypt(keys.nonce(pn), payload, header)
+        pkt = bytearray(header + sealed)
+        pn_off = len(header) - pn_len
+        mask = keys.hp_mask(bytes(pkt[pn_off + 4 : pn_off + 20]))
+        if pkt[0] & 0x80:
+            pkt[0] ^= mask[0] & 0x0F
+        else:
+            pkt[0] ^= mask[0] & 0x1F
+        for i in range(pn_len):
+            pkt[pn_off + i] ^= mask[1 + i]
+        return bytes(pkt)
+
+    def datagrams_out(self) -> list[bytes]:
+        out, self._out = self._out, []
+        return out
+
+    # -- client API ----------------------------------------------------------
+
+    def send_txn(self, txn: bytes) -> None:
+        """Open the next unidirectional stream carrying one txn (client)."""
+        assert not self.is_server
+        sid = self._next_uni_stream
+        self._next_uni_stream += 4
+        frame = (
+            bytes([0x08 | 0x04 | 0x02 | 0x01])  # STREAM with OFF/LEN/FIN
+            + vi_enc(sid)
+            + vi_enc(0)
+            + vi_enc(len(txn))
+            + txn
+        )
+        self._pending_frames[APPLICATION].append(frame)
+        self._flush()
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+
+_TP_DEFAULT = (
+    vi_enc(0x04) + vi_enc(4) + (1 << 24).to_bytes(4, "big")  # initial_max_data
+    + vi_enc(0x07) + vi_enc(4) + (1 << 20).to_bytes(4, "big")  # max_stream_data_uni
+    + vi_enc(0x09) + vi_enc(4) + (1 << 16).to_bytes(4, "big")  # max_streams_uni
+    + vi_enc(0x03) + vi_enc(2) + (1452 | 0x4000).to_bytes(2, "big")  # max_udp
+)
+
+
+class QuicServer:
+    """Multi-connection QUIC server endpoint (sans-IO; sockets live in the
+    net tile)."""
+
+    def __init__(self, identity_secret: bytes):
+        self.identity_secret = identity_secret
+        self.conns: dict[bytes, Connection] = {}  # by our scid
+        self.by_addr: dict = {}
+
+    def on_datagram(self, data: bytes, addr) -> Connection | None:
+        conn = self.by_addr.get(addr)
+        if conn is None:
+            if len(data) < 7 or not (data[0] & 0x80):
+                return None  # short header / runt for unknown conn
+            if 6 + data[5] + 1 > len(data):
+                return None  # malformed CID lengths
+            scid = os.urandom(8)
+            tp = (
+                vi_enc(0x00) + vi_enc(len(data[6 : 6 + data[5]]))
+                + data[6 : 6 + data[5]]  # original_destination_connection_id
+                + vi_enc(0x0F) + vi_enc(len(scid)) + scid
+                + _TP_DEFAULT
+            )
+            engine = tls.TlsServer(self.identity_secret, transport_params=tp)
+            # client's SCID becomes our DCID
+            dcil = data[5]
+            o = 6 + dcil
+            scil = data[o]
+            client_scid = data[o + 1 : o + 1 + scil]
+            conn = Connection(True, engine, scid, client_scid)
+            self.conns[scid] = conn
+            self.by_addr[addr] = conn
+        conn.on_datagram(data)
+        return conn
+
+
+class QuicClient:
+    """Single-connection QUIC client (tests + bench txn sender)."""
+
+    def __init__(self):
+        self.scid = os.urandom(8)
+        initial_dcid = os.urandom(8)
+        tp = (
+            vi_enc(0x0F) + vi_enc(len(self.scid)) + self.scid + _TP_DEFAULT
+        )
+        engine = tls.TlsClient(transport_params=tp)
+        self.conn = Connection(False, engine, self.scid, initial_dcid)
+        self.conn._install_initial(initial_dcid)
+        self.conn._drive()  # emits the Initial(ClientHello)

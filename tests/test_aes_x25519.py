@@ -1,0 +1,90 @@
+"""AES-GCM and X25519 vs RFC vectors and the system `cryptography` lib
+(cross-check only — the implementations under test are our own)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import aes as A
+from firedancer_tpu.ballet import x25519 as X
+
+
+def test_aes128_fips197_vector():
+    # FIPS-197 appendix C.1 style check, recomputed with cryptography
+    key = bytes(range(16))
+    pt = bytes(range(0, 32, 2))
+    ks = A.key_expand(key)
+    got = A.encrypt_block(ks, pt)
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+    assert got == enc.update(pt)
+
+
+def test_aes256_blocks_batch():
+    rng = np.random.default_rng(1)
+    key = rng.integers(0, 256, 32, np.uint8).tobytes()
+    blocks = rng.integers(0, 256, (64, 16), np.uint8)
+    ks = A.key_expand(key)
+    got = A.encrypt_blocks(ks, blocks)
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+    want = enc.update(blocks.tobytes())
+    assert got.tobytes() == want
+
+
+@pytest.mark.parametrize("klen", [16, 32])
+@pytest.mark.parametrize("ptlen,aadlen", [(0, 0), (13, 0), (16, 20), (97, 5)])
+def test_aes_gcm_roundtrip_and_crosscheck(klen, ptlen, aadlen):
+    rng = np.random.default_rng(klen * 100 + ptlen)
+    key = rng.integers(0, 256, klen, np.uint8).tobytes()
+    iv = rng.integers(0, 256, 12, np.uint8).tobytes()
+    pt = rng.integers(0, 256, ptlen, np.uint8).tobytes()
+    aad = rng.integers(0, 256, aadlen, np.uint8).tobytes()
+
+    g = A.AesGcm(key)
+    ct = g.encrypt(iv, pt, aad)
+    assert g.decrypt(iv, ct, aad) == pt
+    # corrupt tag -> reject
+    bad = ct[:-1] + bytes([ct[-1] ^ 1])
+    assert g.decrypt(iv, bad, aad) is None
+
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    want = AESGCM(key).encrypt(iv, pt, aad)
+    assert ct == want
+
+
+def test_x25519_rfc7748_vectors():
+    # RFC 7748 section 5.2 test vector 1
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    want = bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    assert X.x25519(k, u) == want
+
+
+def test_x25519_dh_agreement():
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 256, 32, np.uint8).tobytes()
+    b = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pa, pb = X.public_key(a), X.public_key(b)
+    assert X.x25519(a, pb) == X.x25519(b, pa)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+
+    priv = X25519PrivateKey.from_private_bytes(a)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PublicKey,
+    )
+
+    shared = priv.exchange(X25519PublicKey.from_public_bytes(pb))
+    assert shared == X.x25519(a, pb)

@@ -9,6 +9,8 @@ import pytest
 
 from firedancer_tpu.ballet import bmtree as BM
 
+pytestmark = pytest.mark.slow
+
 
 def _oracle_root(blobs, hash_sz):
     if hash_sz == 20:

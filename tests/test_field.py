@@ -8,6 +8,8 @@ import jax.numpy as jnp
 from firedancer_tpu.ops.ed25519 import field as F
 from firedancer_tpu.ops.ed25519.golden import P, SQRT_M1
 
+pytestmark = pytest.mark.slow
+
 
 def _rand_elems(rng, n):
     """Random canonical ints incl. adversarial values near 0 and p."""

@@ -3,6 +3,9 @@
 import os
 
 from firedancer_tpu.ops.ed25519 import golden
+import pytest
+
+pytestmark = pytest.mark.slow
 
 # RFC 8032 section 7.1 TEST 1 (empty message)
 RFC1_SECRET = bytes.fromhex(

@@ -3,6 +3,9 @@
 import numpy as np
 
 from firedancer_tpu.ops import keccak256 as K
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 # -- minimal independent scalar Keccak-256 oracle (public algorithm) -----

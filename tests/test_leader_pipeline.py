@@ -16,6 +16,9 @@ from firedancer_tpu.tiles.pack import PackTile, mb_decode, mb_encode
 from firedancer_tpu.tiles.poh import PohTile
 from firedancer_tpu.tiles.sink import SinkTile
 from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+import pytest
+
+pytestmark = pytest.mark.slow
 
 MB_MTU = 40_000
 

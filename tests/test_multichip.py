@@ -9,6 +9,8 @@ from jax.sharding import Mesh
 
 from firedancer_tpu.models import pipeline
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("dp,mp", [(4, 2), (8, 1), (2, 2)])
 def test_pipeline_step_meshes(dp, mp):

@@ -11,6 +11,9 @@ from firedancer_tpu.ops.ed25519 import pallas_kernel as PK
 from firedancer_tpu.ops.ed25519 import point as PT
 from firedancer_tpu.ops.ed25519 import scalar as SC
 from firedancer_tpu.ops.ed25519 import verify as V
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def test_verify_core_interpret_matches_xla():

@@ -15,6 +15,9 @@ from firedancer_tpu.tiles.dedup import DedupTile
 from firedancer_tpu.tiles.sink import SinkTile
 from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
 from firedancer_tpu.tiles.verify import VerifyTile
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def test_ingress_pipeline_end_to_end():

@@ -6,6 +6,9 @@ import jax
 import numpy as np
 
 from firedancer_tpu.ops import poh
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def _append_ref(state: bytes, n: int) -> bytes:

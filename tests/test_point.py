@@ -10,6 +10,8 @@ from firedancer_tpu.ops.ed25519 import golden
 from firedancer_tpu.ops.ed25519 import point as PT
 from firedancer_tpu.ops.ed25519.golden import B, L, P
 
+pytestmark = pytest.mark.slow
+
 
 def _enc(pt) -> np.ndarray:
     return np.frombuffer(golden.point_compress(pt), np.uint8)

@@ -1,0 +1,96 @@
+"""QUIC loopback: handshake, stream txn delivery, packet protection."""
+
+import numpy as np
+
+from firedancer_tpu.waltz import quic, tls
+
+
+def _pump(client_conn, server, addr=("127.0.0.1", 9000)):
+    """Shuttle datagrams both ways until quiescent."""
+    sconn = None
+    for _ in range(16):
+        moved = False
+        for d in client_conn.datagrams_out():
+            sconn = server.on_datagram(d, addr) or sconn
+            moved = True
+        if sconn:
+            for d in sconn.datagrams_out():
+                client_conn.on_datagram(d)
+                moved = True
+        if not moved:
+            break
+    return sconn
+
+
+def test_quic_handshake_and_txn_delivery():
+    rng = np.random.default_rng(21)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    sconn = _pump(client.conn, server)
+
+    assert sconn is not None
+    assert sconn.tls.handshake_complete
+    assert client.conn.tls.handshake_complete
+    assert client.conn.established  # HANDSHAKE_DONE received
+    # client learned the validator identity key via the TLS cert
+    from firedancer_tpu.ops.ed25519 import golden
+
+    assert client.conn.tls.peer_identity == golden.public_from_secret(identity)
+
+    # send transactions on unidirectional streams, one per stream
+    txns = [rng.integers(0, 256, n, np.uint8).tobytes() for n in (1, 193, 1232)]
+    for t in txns:
+        client.conn.send_txn(t)
+    _pump(client.conn, server)
+    assert sconn.txns == txns
+
+
+def test_quic_txn_across_datagrams():
+    # a txn larger than one datagram must arrive via multiple STREAM frames
+    rng = np.random.default_rng(22)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    sconn = _pump(client.conn, server)
+    big = rng.integers(0, 256, 1232, np.uint8).tobytes()
+    # split manually into two stream frames with offsets
+    sid = client.conn._next_uni_stream
+    client.conn._next_uni_stream += 4
+    for off, chunk, fin in ((0, big[:700], False), (700, big[700:], True)):
+        f = (
+            bytes([0x08 | 0x04 | 0x02 | (0x01 if fin else 0)])
+            + quic.vi_enc(sid)
+            + quic.vi_enc(off)
+            + quic.vi_enc(len(chunk))
+            + chunk
+        )
+        client.conn._pending_frames[quic.APPLICATION].append(f)
+        client.conn._flush()
+    _pump(client.conn, server)
+    assert sconn.txns == [big]
+
+
+def test_quic_garbage_and_tamper_rejected():
+    rng = np.random.default_rng(23)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    dgrams = client.conn.datagrams_out()
+    # tampered initial: flip a byte in the AEAD-protected region
+    bad = bytearray(dgrams[0])
+    bad[len(bad) // 2] ^= 0xFF
+    sconn = server.on_datagram(bytes(bad), ("127.0.0.1", 1))
+    assert sconn is not None and not sconn.tls.handshake_complete
+    assert not sconn.datagrams_out()  # decrypt failed -> nothing to say
+    # pure garbage doesn't crash the server
+    assert server.on_datagram(b"\x00" * 50, ("127.0.0.1", 2)) is None
+    g = rng.integers(0, 256, 300, np.uint8).tobytes()
+    server.on_datagram(bytes([0xC0]) + g, ("127.0.0.1", 3))
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 63, 64, 16383, 16384, 2**29, 2**61 - 1):
+        enc = quic.vi_enc(v)
+        got, off = quic.vi_dec(enc, 0)
+        assert got == v and off == len(enc)

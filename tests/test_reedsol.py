@@ -8,6 +8,8 @@ import pytest
 from firedancer_tpu.ballet import gf256 as GF
 from firedancer_tpu.ops import reedsol as RS
 
+pytestmark = pytest.mark.slow
+
 
 def test_gf_field_axioms():
     rng = np.random.default_rng(0)

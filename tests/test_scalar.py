@@ -9,6 +9,8 @@ from firedancer_tpu.ops.ed25519 import field as F
 from firedancer_tpu.ops.ed25519 import scalar as SC
 from firedancer_tpu.ops.ed25519.golden import L
 
+pytestmark = pytest.mark.slow
+
 
 def test_is_canonical():
     vals = [0, 1, L - 1, L, L + 1, 2**256 - 1, 2**252, L + 2**200]

@@ -5,6 +5,9 @@ import hashlib
 import numpy as np
 
 from firedancer_tpu.ops import sha256 as fsha
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def _ref(msg: bytes) -> bytes:

@@ -6,6 +6,9 @@ import os
 import numpy as np
 
 from firedancer_tpu.ops import sha512 as fsha
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def _ref(msg: bytes) -> bytes:

@@ -8,6 +8,8 @@ from firedancer_tpu.ballet import shred as SH
 from firedancer_tpu.disco import fec_resolver as FR
 from firedancer_tpu.disco import shredder as SD
 
+pytestmark = pytest.mark.slow
+
 
 def _mk(version=0x1234):
     sd = SD.Shredder(version)

@@ -13,6 +13,8 @@ from firedancer_tpu.ops.ed25519 import golden
 from firedancer_tpu.ops.ed25519 import verify as V
 from firedancer_tpu.ops.ed25519.golden import L
 
+pytestmark = pytest.mark.slow
+
 
 def _torsion_encoding():
     """A nontrivial small-order point encoding, derived via the oracle."""
