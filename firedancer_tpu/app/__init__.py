@@ -1,0 +1,5 @@
+"""app — CLI, config, monitor: the fdctl/fddev layer of this build.
+
+Reference: /root/reference/src/app/ (fdctl configure/run/monitor, fddev
+bench).  Entry point: python -m firedancer_tpu.app.fdtctl
+"""

@@ -1,0 +1,103 @@
+"""TOML config -> ingress topology.
+
+Reference model: src/app/fdctl/config.c:577-760 — a TOML file (defaults in
+config/default.toml) parsed into a typed config, from which the topology
+(workspaces, links, tiles, connections) is derived programmatically.
+Python 3.11+ ships tomllib, so no vendored parser is needed.
+
+Config shape (all keys optional; defaults below):
+
+    name = "fdt"                     # workspace name (monitor attaches)
+    [tiles.quic]
+    quic_port = 0                    # 0 = ephemeral
+    udp_port = 0
+    [tiles.verify]
+    count = 1                        # horizontal seq-sharded replicas
+    max_lanes = 4096
+    msg_width = 1232
+    [tiles.dedup]
+    signature_cache_size = 4194302   # default.toml:760
+    [links]
+    depth = 1024
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+from firedancer_tpu.disco import Topology
+from firedancer_tpu.tiles import wire
+from firedancer_tpu.tiles.dedup import DedupTile
+from firedancer_tpu.tiles.quic import QuicIngressTile
+from firedancer_tpu.tiles.sink import SinkTile
+from firedancer_tpu.tiles.verify import VerifyTile
+
+
+@dataclass
+class Config:
+    name: str = "fdt"
+    quic_port: int = 0
+    udp_port: int = 0
+    verify_count: int = 1
+    verify_max_lanes: int = 4096
+    verify_msg_width: int = 1232
+    dedup_depth: int = 4_194_302
+    link_depth: int = 1024
+    raw: dict = field(default_factory=dict)
+
+
+def parse(text: str) -> Config:
+    doc = tomllib.loads(text)
+    t = doc.get("tiles", {})
+    q = t.get("quic", {})
+    v = t.get("verify", {})
+    d = t.get("dedup", {})
+    return Config(
+        name=doc.get("name", "fdt"),
+        quic_port=q.get("quic_port", 0),
+        udp_port=q.get("udp_port", 0),
+        verify_count=v.get("count", 1),
+        verify_max_lanes=v.get("max_lanes", 4096),
+        verify_msg_width=v.get("msg_width", 1232),
+        dedup_depth=d.get("signature_cache_size", 4_194_302),
+        link_depth=doc.get("links", {}).get("depth", 1024),
+        raw=doc,
+    )
+
+
+def build_ingress_topology(
+    cfg: Config, identity_secret: bytes
+) -> tuple[Topology, QuicIngressTile]:
+    """The production ingress shape: quic -> N seq-sharded verify ->
+    dedup -> sink (reference connection map, config.c:681-712)."""
+    topo = Topology(name=cfg.name)
+    qt = QuicIngressTile(
+        identity_secret,
+        quic_addr=("0.0.0.0", cfg.quic_port),
+        udp_addr=("0.0.0.0", cfg.udp_port),
+    )
+    depth = cfg.link_depth
+    topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
+    topo.tile(qt, outs=["quic_verify"])
+    n = cfg.verify_count
+    for i in range(n):
+        topo.link(f"verify{i}_dedup", depth=depth, mtu=wire.LINK_MTU)
+        vt = VerifyTile(
+            msg_width=cfg.verify_msg_width,
+            max_lanes=cfg.verify_max_lanes,
+            shard=(i, n) if n > 1 else None,
+            name=f"verify{i}",
+        )
+        topo.tile(
+            vt, ins=[("quic_verify", True)], outs=[f"verify{i}_dedup"]
+        )
+    topo.link("dedup_sink", depth=depth, mtu=wire.LINK_MTU)
+    dedup = DedupTile(depth=cfg.dedup_depth)
+    topo.tile(
+        dedup,
+        ins=[(f"verify{i}_dedup", True) for i in range(n)],
+        outs=["dedup_sink"],
+    )
+    topo.tile(SinkTile(), ins=[("dedup_sink", True)])
+    return topo, qt

@@ -1,0 +1,84 @@
+"""fdtctl — run / monitor CLI.
+
+Reference model: the fdctl binary (src/app/fdctl/main.c): `run` boots the
+topology from a config file, `monitor` attaches to a running one and
+prints live rates.  Usage:
+
+    python -m firedancer_tpu.app.fdtctl run --config cfg.toml [--keyfile k]
+    python -m firedancer_tpu.app.fdtctl monitor --name fdt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def cmd_run(args) -> int:
+    from firedancer_tpu.app import config as C
+    from firedancer_tpu.app.monitor import Monitor
+
+    text = open(args.config).read() if args.config else ""
+    cfg = C.parse(text)
+    if args.keyfile:
+        identity = open(args.keyfile, "rb").read()[:32]
+    else:
+        identity = os.urandom(32)
+    topo, qt = C.build_ingress_topology(cfg, identity)
+    topo.build()
+    topo.start()
+    print(f"workspace {cfg.name!r}: quic {qt.quic_addr} udp {qt.udp_addr}",
+          flush=True)
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    mon = Monitor(cfg.name)
+    prev = None
+    try:
+        while not stop:
+            topo.poll_failure()
+            cur = mon.snapshot()
+            print(mon.render(prev, cur, 1.0), flush=True)
+            prev = cur
+            if args.iterations:
+                args.iterations -= 1
+                if args.iterations <= 0:
+                    break
+            time.sleep(1.0)
+    finally:
+        topo.halt()
+        topo.close()
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    from firedancer_tpu.app.monitor import Monitor
+
+    Monitor(args.name).run(
+        interval_s=args.interval, iterations=args.iterations
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fdtctl")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("run", help="boot the ingress topology from config")
+    pr.add_argument("--config", default=None)
+    pr.add_argument("--keyfile", default=None)
+    pr.add_argument("--iterations", type=int, default=0,
+                    help="exit after N monitor prints (0 = run forever)")
+    pm = sub.add_parser("monitor", help="attach to a running topology")
+    pm.add_argument("--name", default="fdt")
+    pm.add_argument("--interval", type=float, default=1.0)
+    pm.add_argument("--iterations", type=int, default=None)
+    args = p.parse_args(argv)
+    return {"run": cmd_run, "monitor": cmd_monitor}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,100 @@
+"""Monitor: observe a running topology from OUTSIDE its process.
+
+Reference model: src/app/fdctl/monitor/monitor.c:233 — periodically
+snapshot every tile's cnc heartbeat/signal and metrics shared memory plus
+every link's fseq, render the diffs.  This build attaches to the named
+workspace via its published directory (tango.rings.Workspace.attach) and
+reads the same single-writer regions the tiles write lock-free.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from firedancer_tpu.disco.metrics import Metrics, MetricsSchema
+from firedancer_tpu.tango import rings as R
+
+_SIGNAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
+
+
+@dataclass
+class TileView:
+    name: str
+    metrics: Metrics
+    cnc: R.CNC
+
+
+class Monitor:
+    """Attach-and-read view of a named topology workspace."""
+
+    def __init__(self, wksp_name: str):
+        self.wksp, extra = R.Workspace.attach(wksp_name)
+        self.tiles: dict[str, TileView] = {}
+        for name, t in extra.get("tiles", {}).items():
+            schema = MetricsSchema(
+                counters=tuple(t["counters"]), hists=tuple(t["hists"])
+            )
+            # schema comes pre-flattened (with_base applied by the topo)
+            m = Metrics(self.wksp.view(t["metrics"]), schema)
+            self.tiles[name] = TileView(
+                name, m, R.CNC(self.wksp.view(t["cnc"]), join=True)
+            )
+        self.links = extra.get("links", {})
+
+    def snapshot(self) -> dict:
+        """One consistent-enough read of every tile's state."""
+        out = {}
+        for name, tv in self.tiles.items():
+            out[name] = {
+                "signal": _SIGNAMES.get(
+                    tv.cnc.signal_query(), str(tv.cnc.signal_query())
+                ),
+                "heartbeat": tv.cnc.heartbeat_query(),
+                "counters": {
+                    c: tv.metrics.counter(c)
+                    for c in tv.metrics.schema.counters
+                },
+            }
+        for lname, ls in self.links.items():
+            seqs = {}
+            for c in ls["consumers"]:
+                fs = R.FSeq(self.wksp.view(c["fseq"]), join=True)
+                seqs[c["tile"]] = fs.query()
+            out.setdefault("_links", {})[lname] = seqs
+        return out
+
+    def render(self, prev: dict | None, cur: dict, dt: float) -> str:
+        """Tile table with in/out rates (frags/s) since the last snapshot."""
+        lines = [
+            f"{'tile':>10} {'state':>5} {'in/s':>12} {'out/s':>12} "
+            f"{'in_frags':>12} {'out_frags':>12}"
+        ]
+        for name, row in cur.items():
+            if name == "_links":
+                continue
+            c = row["counters"]
+            if prev is not None and name in prev:
+                p = prev[name]["counters"]
+                rin = (c["in_frags"] - p["in_frags"]) / dt
+                rout = (c["out_frags"] - p["out_frags"]) / dt
+            else:
+                rin = rout = 0.0
+            lines.append(
+                f"{name:>10} {row['signal']:>5} {rin:12,.0f} {rout:12,.0f} "
+                f"{c['in_frags']:12,} {c['out_frags']:12,}"
+            )
+        return "\n".join(lines)
+
+    def run(self, interval_s: float = 1.0, iterations: int | None = None):
+        """Print live rates until interrupted (fdctl monitor behavior)."""
+        prev = None
+        i = 0
+        while iterations is None or i < iterations:
+            cur = self.snapshot()
+            print(self.render(prev, cur, interval_s))
+            print()
+            prev = cur
+            i += 1
+            if iterations is None or i < iterations:
+                time.sleep(interval_s)

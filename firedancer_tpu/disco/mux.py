@@ -26,6 +26,13 @@ from firedancer_tpu.tango import rings as R
 from .metrics import Metrics, MetricsSchema
 
 
+def now_ts() -> int:
+    """Frag timestamp: microseconds, truncated to the meta's u32 field
+    (wraps every ~71 min; latency deltas use modular arithmetic like the
+    reference's compressed tspub, fd_frag_meta_ts_comp)."""
+    return (time.monotonic_ns() // 1000) & 0xFFFFFFFF
+
+
 @dataclass
 class InLink:
     """This tile's consumer endpoint of one link."""
@@ -73,9 +80,13 @@ class OutLink:
         szs: np.ndarray | None = None,
         ctls: np.ndarray | None = None,
         tspub: int = 0,
+        tsorigs: np.ndarray | None = None,
     ) -> int:
         """Batch-publish len(sigs) frags; payload rows are scattered into
-        the dcache first when given.  Returns frags published."""
+        the dcache first when given.  Returns frags published.
+
+        tspub defaults to now; pass tsorigs = in-frags' tsorig to carry
+        origin timestamps through a relay tile (latency observability)."""
         n = len(sigs)
         if n == 0:
             return 0
@@ -83,8 +94,10 @@ class OutLink:
         if rows is not None:
             assert self.dcache is not None and szs is not None
             chunks = self.dcache.write_batch(rows, szs)
+        if tspub == 0:
+            tspub = now_ts()
         self.seq = self.mcache.publish_batch(
-            self.seq, sigs, chunks, szs, ctls, tspub
+            self.seq, sigs, chunks, szs, ctls, tspub, tsorigs
         )
         return n
 
@@ -126,11 +139,11 @@ class MuxCtx:
             return self.wksp.alloc(f"{self.name}_{name}", footprint)
         return np.zeros(footprint, dtype=np.uint8)
 
-    def publish(self, sigs, rows=None, szs=None, ctls=None) -> int:
+    def publish(self, sigs, rows=None, szs=None, ctls=None, tsorigs=None) -> int:
         """Publish to every out link (the common single-out case)."""
         n = 0
         for o in self.outs:
-            n = o.publish(sigs, rows, szs, ctls)
+            n = o.publish(sigs, rows, szs, ctls, tsorigs=tsorigs)
         if n:
             self.metrics.inc("out_frags", n)
             if szs is not None:

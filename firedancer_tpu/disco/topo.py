@@ -155,6 +155,34 @@ class Topology:
                 wksp=self.wksp,
             )
 
+    def export_manifest(self) -> None:
+        """Publish the workspace directory + a monitor manifest (tile
+        schemas, metrics/cnc alloc names, link fseq names) so a separate
+        process can attach and observe (app/monitor.py).  No-op for
+        anonymous (in-process) workspaces."""
+        if self.wksp is None or self.wksp.name is None:
+            return
+        tiles = {}
+        for name, ts in self.tiles.items():
+            schema = ts.tile.schema.with_base()
+            tiles[name] = {
+                "metrics": f"metrics_{name}",
+                "cnc": f"cnc_{name}",
+                "counters": list(schema.counters),
+                "hists": list(schema.hists),
+            }
+        links = {
+            ls.name: {
+                "depth": ls.depth,
+                "consumers": [
+                    {"tile": cons, "fseq": f"fs_{ls.name}_{cons}"}
+                    for cons, _rel in ls.consumers
+                ],
+            }
+            for ls in self.links.values()
+        }
+        self.wksp.publish_directory({"tiles": tiles, "links": links})
+
     # ---- run ------------------------------------------------------------
 
     def _tile_main(self, ts: TileSpec, loop_kw: dict) -> None:
@@ -186,6 +214,9 @@ class Topology:
                     self.halt()
                     raise TimeoutError(f"tile {name!r} stuck in BOOT")
                 time.sleep(1e-3)
+        # publish AFTER boot: tile on_boot workspace allocations (tcaches
+        # etc.) must appear in the directory the monitor attaches to
+        self.export_manifest()
 
     def poll_failure(self) -> None:
         """Fail-stop check: if any tile died, halt everything and re-raise."""

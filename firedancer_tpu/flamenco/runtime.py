@@ -1,0 +1,229 @@
+"""Transaction execution runtime — the bank tile's executor.
+
+Reference model: src/flamenco/runtime/fd_executor.c (dispatch txn ->
+program), runtime/program/fd_system_program.c (transfer / create_account /
+assign / allocate with the bincode u32-discriminant instruction encoding),
+and the BPF loader path into the VM (fd_vm_interp).  Accounts live in funk
+via flamenco.accounts; each executed batch runs inside a funk transaction
+so a failed block can be cancelled wholesale (the fork model the reference
+gets from funk too).
+
+Execution semantics implemented:
+  * fee collection: FEE_PER_SIGNATURE lamports per signature, debited
+    from the fee payer (first signer) BEFORE execution; txn rejected
+    outright if the payer cannot cover fees
+  * per-instruction dispatch by owner/program id: system program native
+    impl; programs owned by the BPF loader execute in the sBPF VM
+  * failed txns roll back their own writes but still pay fees (matching
+    the reference's fee-then-execute ordering)
+  * rent: create_account requires the rent-exempt minimum for the
+    requested space (simplified linear model; reference sysvar rent)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import (
+    Account, AccountMgr, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.funk.funk import Funk, ROOT_XID
+
+FEE_PER_SIGNATURE = 5000
+
+#: simplified rent-exempt minimum: lamports per byte-year * 2 years
+RENT_PER_BYTE = 3480 * 2
+RENT_BASE = 890_880
+
+#: consensus cap on account data size (10 MiB, MAX_PERMITTED_DATA_LENGTH)
+MAX_DATA_LEN = 10 * 1024 * 1024
+
+BPF_LOADER_ID = b"BPFLoader" + bytes(23)
+
+# system instruction discriminants (bincode u32le)
+_SYS_CREATE = 0
+_SYS_ASSIGN = 1
+_SYS_TRANSFER = 2
+_SYS_ALLOCATE = 8
+
+
+def rent_exempt_minimum(space: int) -> int:
+    return RENT_BASE + RENT_PER_BYTE * space
+
+
+@dataclass
+class TxnResult:
+    ok: bool
+    err: str = ""
+    fee: int = 0
+    logs: list = field(default_factory=list)
+    cu_used: int = 0
+
+
+class Executor:
+    """Executes parsed transactions against a funk fork."""
+
+    def __init__(self, funk: Funk, xid: bytes = ROOT_XID):
+        self.funk = funk
+        self.xid = xid
+        self.mgr = AccountMgr(funk, xid)
+
+    # ---- entry points ---------------------------------------------------
+
+    def execute_txn(self, payload: bytes, desc: T.TxnDesc | None = None) -> TxnResult:
+        desc = desc or T.parse(payload)
+        if desc is None:
+            return TxnResult(False, "parse")
+        keys = [
+            bytes(desc.acct_addr(payload, j))
+            for j in range(desc.acct_addr_cnt)
+        ]
+        fee = FEE_PER_SIGNATURE * desc.signature_cnt
+
+        payer = self.mgr.load(keys[0])
+        if payer is None or payer.lamports < fee:
+            return TxnResult(False, "insufficient fee payer", fee=0)
+        payer.lamports -= fee
+        self.mgr.store(keys[0], payer)
+
+        # execute instructions against a scratch overlay so a failed txn
+        # rolls back its writes but keeps the fee debit
+        overlay: dict[bytes, Account | None] = {}
+
+        def load(k: bytes) -> Account | None:
+            if k in overlay:
+                a = overlay[k]
+                return None if a is None else Account(**vars(a))
+            return self.mgr.load(k)
+
+        def store(k: bytes, a: Account) -> None:
+            overlay[k] = a
+
+        logs: list = []
+        for ins in desc.instr:
+            prog_key = keys[ins.program_id]
+            data = payload[ins.data_off : ins.data_off + ins.data_sz]
+            ins_keys = [
+                keys[payload[ins.acct_off + j]]
+                for j in range(ins.acct_cnt)
+            ]
+            err = self._dispatch(
+                prog_key, data, ins_keys, desc, keys, load, store, logs
+            )
+            if err:
+                return TxnResult(False, err, fee=fee, logs=logs)
+        for k, a in overlay.items():
+            if a is not None:
+                self.mgr.store(k, a)
+        return TxnResult(True, fee=fee, logs=logs)
+
+    # ---- dispatch -------------------------------------------------------
+
+    def _dispatch(self, prog_key, data, ins_keys, desc, keys, load, store,
+                  logs) -> str:
+        if prog_key == SYSTEM_PROGRAM_ID:
+            return self._system(data, ins_keys, desc, keys, load, store)
+        prog = load(prog_key)
+        if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
+            return self._bpf(prog, data, ins_keys, load, store, logs)
+        return "unknown program"
+
+    def _system(self, data, ins_keys, desc, keys, load, store) -> str:
+        if len(data) < 4:
+            return "bad system instruction"
+        disc = int.from_bytes(data[:4], "little")
+        if disc == _SYS_TRANSFER:
+            if len(ins_keys) < 2 or len(data) < 12:
+                return "bad transfer"
+            lamports = int.from_bytes(data[4:12], "little")
+            src_k, dst_k = ins_keys[0], ins_keys[1]
+            if not self._is_signer(src_k, desc, keys):
+                return "missing signature"
+            src = load(src_k)
+            if src is None or src.lamports < lamports:
+                return "insufficient funds"
+            if src_k == dst_k:
+                return ""  # self-transfer is a no-op (never mints)
+            dst = load(dst_k) or Account(0)
+            src.lamports -= lamports
+            dst.lamports += lamports
+            store(src_k, src)
+            store(dst_k, dst)
+            return ""
+        if disc == _SYS_CREATE:
+            if len(ins_keys) < 2 or len(data) < 52:
+                return "bad create_account"
+            lamports = int.from_bytes(data[4:12], "little")
+            space = int.from_bytes(data[12:20], "little")
+            if space > MAX_DATA_LEN:
+                return "data length exceeds maximum"
+            owner = data[20:52]
+            src_k, new_k = ins_keys[0], ins_keys[1]
+            if not self._is_signer(src_k, desc, keys) or not self._is_signer(
+                new_k, desc, keys
+            ):
+                return "missing signature"
+            if lamports < rent_exempt_minimum(space):
+                return "rent: not exempt"
+            src = load(src_k)
+            if src is None or src.lamports < lamports:
+                return "insufficient funds"
+            if load(new_k) is not None:
+                return "account exists"
+            src.lamports -= lamports
+            store(src_k, src)
+            store(new_k, Account(lamports, owner, False, 0, bytes(space)))
+            return ""
+        if disc == _SYS_ASSIGN:
+            if len(ins_keys) < 1 or len(data) < 36:
+                return "bad assign"
+            k = ins_keys[0]
+            if not self._is_signer(k, desc, keys):
+                return "missing signature"
+            a = load(k)
+            if a is None:
+                return "no account"
+            a.owner = data[4:36]
+            store(k, a)
+            return ""
+        if disc == _SYS_ALLOCATE:
+            if len(ins_keys) < 1 or len(data) < 12:
+                return "bad allocate"
+            space = int.from_bytes(data[4:12], "little")
+            if space > MAX_DATA_LEN:
+                return "data length exceeds maximum"
+            k = ins_keys[0]
+            if not self._is_signer(k, desc, keys):
+                return "missing signature"
+            a = load(k)
+            if a is None:
+                return "no account"
+            if a.lamports < rent_exempt_minimum(space):
+                return "rent: not exempt"
+            a.data = bytes(space)
+            store(k, a)
+            return ""
+        return "unsupported system instruction"
+
+    @staticmethod
+    def _is_signer(key: bytes, desc: T.TxnDesc, keys: list) -> bool:
+        return key in keys[: desc.signature_cnt]
+
+    def _bpf(self, prog: Account, data, ins_keys, load, store, logs) -> str:
+        from firedancer_tpu.ballet import sbpf
+        from firedancer_tpu.flamenco.vm import Vm, VmError
+
+        try:
+            program = sbpf.load(prog.data)
+        except sbpf.SbpfError as e:
+            return f"elf: {e}"
+        vm = Vm(program)
+        vm.input_mem = bytearray(data)  # instruction data as input region
+        try:
+            r0 = vm.run()
+        except VmError as e:
+            logs.extend(vm.logs)
+            return f"vm: {e}"
+        logs.extend(vm.logs)
+        return "" if r0 == 0 else f"program error {r0}"

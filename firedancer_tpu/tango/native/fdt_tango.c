@@ -152,13 +152,15 @@ uint64_t fdt_mcache_publish_batch( void * mcache, uint64_t seq0,
                                    uint32_t const * chunks,
                                    uint16_t const * szs,
                                    uint16_t const * ctls,
+                                   uint32_t const * tsorigs,
                                    uint32_t tspub, uint64_t n ) {
   for( uint64_t i = 0; i < n; i++ )
     fdt_mcache_publish( mcache, seq0 + i, sigs[ i ],
                         chunks ? chunks[ i ] : 0U,
                         szs ? szs[ i ] : (uint16_t)0,
                         ctls ? ctls[ i ] : (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
-                        tspub, tspub );
+                        tsorigs ? tsorigs[ i ] : tspub,
+                        tspub );
   return seq0 + n;
 }
 

@@ -105,6 +105,7 @@ uint64_t fdt_mcache_publish_batch( void * mcache, uint64_t seq0,
                                    uint32_t const * chunks,
                                    uint16_t const * szs,
                                    uint16_t const * ctls,
+                                   uint32_t const * tsorigs,
                                    uint32_t tspub, uint64_t n );
 
 /* ---- dcache: chunk-addressed payload region ---------------------------- */

@@ -47,7 +47,7 @@ def _load() -> ct.CDLL:
         "fdt_mcache_publish": (None, [vp, u64, u64, u32, u16, u16, u32, u32]),
         "fdt_mcache_poll": (i32, [vp, u64, vp, vp]),
         "fdt_mcache_drain": (u64, [vp, vp, u64, vp, vp]),
-        "fdt_mcache_publish_batch": (u64, [vp, u64, vp, vp, vp, vp, u32, u64]),
+        "fdt_mcache_publish_batch": (u64, [vp, u64, vp, vp, vp, vp, vp, u32, u64]),
         "fdt_dcache_scatter": (None, [vp, vp, u64, u64, vp, vp, u64, u64, vp]),
         "fdt_dcache_footprint": (u64, [u64, u64]),
         "fdt_dcache_chunk_cnt": (u64, [u64]),
@@ -143,6 +143,41 @@ class Workspace:
         off, fp = self._allocs[name]
         return self.buf[off : off + fp]
 
+    # -- cross-process attach (named workspaces) --------------------------
+
+    def _dir_path(self) -> str:
+        assert self.name is not None, "directory needs a named workspace"
+        return f"/dev/shm/fdt_wksp_{self.name}.dir"
+
+    def publish_directory(self, extra: dict | None = None) -> None:
+        """Persist the alloc table (+ arbitrary JSON metadata) so another
+        process can attach() and find objects by name.  The reference
+        equivalent is the wksp's own on-shmem alloc directory
+        (src/util/wksp treap headers); a JSON sidecar keeps this build's
+        bump allocator trivial."""
+        import json
+
+        doc = {
+            "size": self.size,
+            "allocs": {k: list(v) for k, v in self._allocs.items()},
+            "extra": extra or {},
+        }
+        with open(self._dir_path(), "w") as f:
+            json.dump(doc, f)
+
+    @classmethod
+    def attach(cls, name: str) -> tuple["Workspace", dict]:
+        """Map an existing named workspace read-write and load its
+        directory.  Returns (workspace, extra-metadata)."""
+        import json
+
+        with open(f"/dev/shm/fdt_wksp_{name}.dir") as f:
+            doc = json.load(f)
+        ws = cls(doc["size"], name=name)
+        ws._allocs = {k: tuple(v) for k, v in doc["allocs"].items()}
+        ws._off = ws.size  # attached views must not allocate over live data
+        return ws, doc["extra"]
+
     def close(self) -> None:
         if self._mm is not None:
             self.buf = None
@@ -159,10 +194,11 @@ class Workspace:
     def unlink(self) -> None:
         self.close()
         if self.name is not None:
-            try:
-                os.unlink(self._path)
-            except FileNotFoundError:
-                pass
+            for p in (self._path, self._dir_path()):
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -232,13 +268,22 @@ class MCache:
         szs: np.ndarray | None = None,
         ctls: np.ndarray | None = None,
         tspub: int = 0,
+        tsorigs: np.ndarray | None = None,
     ) -> int:
-        """Publish len(sigs) frags at consecutive seqs; returns the new seq."""
+        """Publish len(sigs) frags at consecutive seqs; returns the new seq.
+
+        tsorigs carries per-frag origin timestamps end to end (latency
+        observability); None stamps tsorig = tspub (this tile is the
+        origin)."""
         sigs = np.ascontiguousarray(sigs, dtype=np.uint64)
         # converted copies must stay referenced until the native call returns
         chunks = None if chunks is None else np.ascontiguousarray(chunks, np.uint32)
         szs = None if szs is None else np.ascontiguousarray(szs, np.uint16)
         ctls = None if ctls is None else np.ascontiguousarray(ctls, np.uint16)
+        tsorigs = (
+            None if tsorigs is None
+            else np.ascontiguousarray(tsorigs, np.uint32)
+        )
         return _lib.fdt_mcache_publish_batch(
             _ptr(self.mem),
             seq0,
@@ -246,6 +291,7 @@ class MCache:
             None if chunks is None else chunks.ctypes.data,
             None if szs is None else szs.ctypes.data,
             None if ctls is None else ctls.ctypes.data,
+            None if tsorigs is None else tsorigs.ctypes.data,
             tspub,
             len(sigs),
         )

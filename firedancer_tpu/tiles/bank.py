@@ -6,10 +6,12 @@ fd_ext_bank_load_and_execute_txns, fd_bank.c:100-104), flags itself free
 through the busy fseq, and forwards the executed microblock to the poh
 tile for mixin.
 
-This build has no Agave; execution is the native stub `execute_txns`
-(parse + fee accounting), the seam where the flamenco runtime plugs in.
-Completion travels as a frag on the bank→pack ring (sig = bank<<32 |
-handle); the executed microblock is forwarded on the bank→poh ring.
+Execution runs the flamenco runtime (flamenco/runtime.py: fee collection,
+system program, sBPF programs via the VM) against a funk account store
+when one is provided; without a funk the tile falls back to fee-only
+accounting (the round-1 stub, kept for plumbing-only tests).  Completion
+travels as a frag on the bank→pack ring (sig = bank<<32 | handle); the
+executed microblock is forwarded on the bank→poh ring.
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ from . import pack as packtile
 
 
 def execute_txns(txns: list[np.ndarray]) -> int:
-    """Stub executor: parse + fee totals.  Returns lamports collected."""
+    """Fee-only fallback executor.  Returns lamports collected."""
     fees = 0
     for t in txns:
         d = T.parse(bytes(t))
@@ -40,12 +42,25 @@ class BankTile(Tile):
     outs[1] = bank_poh executed microblocks."""
 
     schema = MetricsSchema(
-        counters=("executed_microblocks", "executed_txns", "fees_lamports"),
+        counters=(
+            "executed_microblocks",
+            "executed_txns",
+            "failed_txns",
+            "fees_lamports",
+        ),
     )
 
-    def __init__(self, bank_id: int, name: str | None = None):
+    def __init__(self, bank_id: int, name: str | None = None, *, funk=None):
         self.bank_id = bank_id
         self.name = name or f"bank{bank_id}"
+        self.funk = funk
+        self._executor = None
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        if self.funk is not None:
+            from firedancer_tpu.flamenco.runtime import Executor
+
+            self._executor = Executor(self.funk)
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
@@ -54,7 +69,15 @@ class BankTile(Tile):
             buf = rows[i, : frags["sz"][i]]
             handle, bank, txns = packtile.mb_decode(buf)
             assert bank == self.bank_id
-            fees = execute_txns(txns)
+            if self._executor is not None:
+                fees = 0
+                for t in txns:
+                    res = self._executor.execute_txn(bytes(t))
+                    fees += res.fee
+                    if not res.ok:
+                        ctx.metrics.inc("failed_txns")
+            else:
+                fees = execute_txns(txns)
             ctx.metrics.inc("executed_microblocks")
             ctx.metrics.inc("executed_txns", len(txns))
             ctx.metrics.inc("fees_lamports", fees)

@@ -43,4 +43,7 @@ class DedupTile(Tile):
             return
         il = ctx.ins[in_idx]
         rows = il.gather(frags[keep])
-        ctx.publish(frags["sig"][keep], rows, frags["sz"][keep])
+        ctx.publish(
+            frags["sig"][keep], rows, frags["sz"][keep],
+            tsorigs=frags["tsorig"][keep],
+        )

@@ -14,7 +14,7 @@ from firedancer_tpu.disco.mux import MuxCtx, Tile
 
 
 class SinkTile(Tile):
-    schema = MetricsSchema(counters=("sunk_frags",))
+    schema = MetricsSchema(counters=("sunk_frags",), hists=("latency_us",))
 
     def __init__(self, *, record: bool = False, name: str = "sink"):
         self.name = name
@@ -25,6 +25,13 @@ class SinkTile(Tile):
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         ctx.metrics.inc("sunk_frags", len(frags))
+        # end-to-end latency: origin tsorig (stamped at ingress, carried
+        # through every relay) to arrival here; u32 modular delta
+        from firedancer_tpu.disco.mux import now_ts
+
+        now = np.uint32(now_ts())
+        lat = (now - frags["tsorig"].astype(np.uint32)) & np.uint32(0xFFFFFFFF)
+        ctx.metrics.hist_sample_many("latency_us", lat.astype(np.int64))
         if self.record:
             rows = ctx.ins[in_idx].gather(frags)
             with self.lock:

@@ -38,12 +38,18 @@ class VerifyTile(Tile):
         max_lanes: int = 4096,
         pre_dedup: bool = True,
         pad_full: bool = False,
+        shard: tuple[int, int] | None = None,
         name: str = "verify",
     ):
         """pad_full: always pad sub-batches to max_lanes (one compiled
         shape; right for steady full-rate ingress).  False pads to
         power-of-two buckets (log2(max_lanes) compiled shapes; cheaper on
-        trickle traffic)."""
+        trickle traffic).
+
+        shard=(idx, cnt): horizontal scaling — this replica only processes
+        frags with seq % cnt == idx (reference: round-robin seq sharding
+        across verify tiles, fd_verify.c:46); the others are skipped
+        without gathering payloads."""
         assert max_lanes & (max_lanes - 1) == 0, (
             "max_lanes must be a power of two (pad buckets + warm compiles "
             "assume it)"
@@ -53,6 +59,7 @@ class VerifyTile(Tile):
         self.max_lanes = max_lanes
         self.pre_dedup = pre_dedup
         self.pad_full = pad_full
+        self.shard = shard
         self._tc: R.TCache | None = None
         self._fn = None
 
@@ -91,6 +98,11 @@ class VerifyTile(Tile):
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
+        if self.shard is not None:
+            idx, cnt = self.shard
+            frags = frags[frags["seq"] % cnt == idx]
+            if not len(frags):
+                return
         rows = il.gather(frags)
         szs = frags["sz"].astype(np.int64)
         keep = np.ones(len(rows), dtype=bool)
@@ -149,6 +161,8 @@ class VerifyTile(Tile):
             tags[txn_ok],
             rows[txn_ok],
             szs[txn_ok].astype(np.uint16),
+            # frags is unfiltered: apply the pre-dedup keep mask first
+            tsorigs=frags["tsorig"][keep][txn_ok],
         )
 
 

@@ -1,0 +1,195 @@
+"""Conformance hardening: wycheproof-style Ed25519 edge vectors (generated
+from first principles against the golden oracle — regenerated, not copied)
+and differential fuzz loops for verify_batch and the txn parser.
+
+Reference analogs: test_ed25519_wycheproof.c, test_ed25519_cctv.c,
+fuzz_ed25519_sigverify_diff.c, fuzz_txn_parse.c (behavior contracts only).
+"""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.ops.ed25519 import golden
+from firedancer_tpu.ops.ed25519 import verify as fver
+from firedancer_tpu.ops.ed25519.golden import L, P
+
+pytestmark = pytest.mark.slow
+
+
+def _torsion_encodings():
+    """All accepted encodings of small-order points (incl. non-canonical)."""
+    return golden.small_order_blocklist()
+
+
+def _edge_scalars():
+    c = L - (1 << 252)
+    return [
+        0, 1, 2, L - 1, L, L + 1, (1 << 252), (1 << 252) - 1, c,
+        (1 << 255) - 19, (1 << 255), (1 << 256) - 1, L // 2, 7,
+    ]
+
+
+def _vectors():
+    """(msg, sig, pub, note) adversarial cases; expected verdicts come
+    from the golden oracle at check time (never hardcoded)."""
+    rng = np.random.default_rng(99)
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = golden.public_from_secret(sk)
+    msg = b"wycheproof-style"
+    good = golden.sign(sk, msg)
+    vecs = [(msg, good, pk, "valid")]
+
+    # s edge values spliced into a valid signature (malleability: s >= L
+    # must be rejected even when the curve equation would hold)
+    for s in _edge_scalars():
+        sig = good[:32] + int(s % (1 << 256)).to_bytes(32, "little")
+        vecs.append((msg, sig, pk, f"s={s}"))
+    # canonical-malleable pair: s' = s + L (the classic malleation)
+    s_val = int.from_bytes(good[32:], "little")
+    if s_val + L < 1 << 256:
+        vecs.append(
+            (msg, good[:32] + (s_val + L).to_bytes(32, "little"), pk,
+             "s+L malleation")
+        )
+
+    # small-order / non-canonical R and A (CCTV-style edge points)
+    for enc in _torsion_encodings():
+        vecs.append((msg, enc + good[32:], pk, "small-order R"))
+        vecs.append((msg, good, enc, "small-order A"))
+    # non-canonical y >= p for R/A that are NOT small order
+    for j in range(20):
+        enc = int(P + j).to_bytes(32, "little")
+        vecs.append((msg, enc + good[32:], pk, f"noncanon R y=p+{j}"))
+        vecs.append((msg, good, enc, f"noncanon A y=p+{j}"))
+    # bit flips across every region of the signature and key
+    for bit in (0, 7, 255, 256, 300, 511):
+        b = bytearray(good)
+        b[bit // 8] ^= 1 << (bit % 8)
+        vecs.append((msg, bytes(b), pk, f"sig bit {bit}"))
+    for bit in (0, 100, 254, 255):
+        b = bytearray(pk)
+        b[bit // 8] ^= 1 << (bit % 8)
+        vecs.append((msg, good, bytes(b), f"pub bit {bit}"))
+    # wrong message / empty message / long message
+    vecs.append((b"", golden.sign(sk, b""), pk, "empty msg"))
+    vecs.append((msg + b"x", good, pk, "msg extended"))
+    long_msg = bytes(rng.integers(0, 256, 500, np.uint8))
+    vecs.append((long_msg, golden.sign(sk, long_msg), pk, "long msg"))
+    return vecs
+
+
+def test_wycheproof_style_vectors():
+    vecs = _vectors()
+    width = max(len(m) for m, _, _, _ in vecs)
+    B = len(vecs)
+    msgs = np.zeros((B, width), np.uint8)
+    lens = np.zeros(B, np.int32)
+    sigs = np.zeros((B, 64), np.uint8)
+    pubs = np.zeros((B, 32), np.uint8)
+    for i, (m, s, p, _) in enumerate(vecs):
+        msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(s, np.uint8)
+        pubs[i] = np.frombuffer(p, np.uint8)
+    got = np.asarray(fver.verify_batch(msgs, lens, sigs, pubs))
+    for i, (m, s, p, note) in enumerate(vecs):
+        want = golden.verify(m, s, p) == 0
+        assert bool(got[i]) == want, f"vector {i} ({note})"
+    # sanity: the set exercises both verdicts
+    assert got.any() and not got.all()
+
+
+def test_differential_fuzz_verify():
+    """Random single-byte mutations of valid signatures: batch kernel ==
+    golden oracle on every lane (fuzz_ed25519_sigverify_diff analog)."""
+    rng = np.random.default_rng(7)
+    n_keys, per_key = 4, 64
+    width = 64
+    cases = []
+    for _ in range(n_keys):
+        sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+        pk = golden.public_from_secret(sk)
+        for _ in range(per_key):
+            m = bytes(rng.integers(0, 256, int(rng.integers(0, width)),
+                                   np.uint8))
+            sig = bytearray(golden.sign(sk, m))
+            pub = bytearray(pk)
+            mode = rng.integers(0, 4)
+            if mode == 1:
+                sig[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+            elif mode == 2:
+                pub[rng.integers(0, 32)] ^= 1 << rng.integers(0, 8)
+            elif mode == 3:
+                sig = bytearray(rng.integers(0, 256, 64, np.uint8).tobytes())
+            cases.append((m, bytes(sig), bytes(pub)))
+    B = len(cases)
+    msgs = np.zeros((B, width), np.uint8)
+    lens = np.zeros(B, np.int32)
+    sigs = np.zeros((B, 64), np.uint8)
+    pubs = np.zeros((B, 32), np.uint8)
+    for i, (m, s, p) in enumerate(cases):
+        msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(s, np.uint8)
+        pubs[i] = np.frombuffer(p, np.uint8)
+    got = np.asarray(fver.verify_batch(msgs, lens, sigs, pubs))
+    for i, (m, s, p) in enumerate(cases):
+        assert bool(got[i]) == (golden.verify(m, s, p) == 0), f"lane {i}"
+
+
+def test_txn_parser_fuzz():
+    """Parser total on adversarial input: random bytes and mutated valid
+    txns never raise; valid txns keep parsing; results are deterministic
+    (fuzz_txn_parse analog)."""
+    rng = np.random.default_rng(17)
+    # a corpus of valid txns of varied shapes
+    valid = []
+    for _ in range(32):
+        n_sign = int(rng.integers(1, 4))
+        n_extra = int(rng.integers(0, 5))
+        addrs = [
+            rng.integers(0, 256, 32, np.uint8).tobytes()
+            for _ in range(n_sign + n_extra + 1)
+        ]
+        data = rng.integers(0, 256, int(rng.integers(0, 80)), np.uint8)
+        body = T.build(
+            [bytes(64)] * n_sign,
+            addrs,
+            rng.integers(0, 256, 32, np.uint8).tobytes(),
+            [(len(addrs) - 1, list(range(min(3, len(addrs) - 1))),
+              data.tobytes())],
+            readonly_unsigned_cnt=1,
+        )
+        assert T.parse(body) is not None
+        valid.append(body)
+
+    checked = 0
+    for _ in range(3000):
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            buf = rng.integers(0, 256, int(rng.integers(0, 200)),
+                               np.uint8).tobytes()
+        else:
+            base = bytearray(valid[rng.integers(0, len(valid))])
+            for _ in range(int(rng.integers(1, 6))):
+                op = rng.integers(0, 3)
+                if op == 0 and len(base):
+                    base[rng.integers(0, len(base))] ^= 1 << rng.integers(0, 8)
+                elif op == 1 and len(base) > 2:
+                    del base[rng.integers(0, len(base))]
+                else:
+                    base.insert(
+                        int(rng.integers(0, len(base) + 1)),
+                        int(rng.integers(0, 256)),
+                    )
+            buf = bytes(base)
+        d1 = T.parse(buf)  # must not raise
+        d2 = T.parse(buf)
+        assert (d1 is None) == (d2 is None)
+        if d1 is not None:
+            # offsets in bounds: descriptor is internally consistent
+            assert d1.signature_off + 64 * d1.signature_cnt <= len(buf)
+            assert d1.acct_addr_off + 32 * d1.acct_addr_cnt <= len(buf)
+            checked += 1
+    assert checked > 10  # some mutants survive parsing, exercising offsets

@@ -112,3 +112,113 @@ def test_leader_pipeline_end_to_end():
         assert n_entries > 0
     finally:
         topo.close()
+
+
+def test_leader_pipeline_executes_balances():
+    """Funk-backed banks: post-block balances reflect every transfer
+    (VERDICT round-1 item 4: 'leader pipeline test asserts post-block
+    balances')."""
+    from firedancer_tpu.ballet import txn as T
+    from firedancer_tpu.flamenco.accounts import (
+        Account, AccountMgr, SYSTEM_PROGRAM_ID,
+    )
+    from firedancer_tpu.flamenco.runtime import FEE_PER_SIGNATURE
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.ops.ed25519 import golden
+
+    rng = np.random.default_rng(41)
+    n_txns, n_banks = 12, 2
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    bh = rng.integers(0, 256, 32, np.uint8).tobytes()
+
+    payers, dsts, amounts = [], [], []
+    rows = np.zeros((n_txns, wire.LINK_MTU), np.uint8)
+    szs = np.zeros(n_txns, np.uint16)
+    for i in range(n_txns):
+        sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+        pk = golden.public_from_secret(sk)
+        dst = rng.integers(0, 256, 32, np.uint8).tobytes()
+        amt = int(rng.integers(1_000, 50_000))
+        mgr.store(pk, Account(1_000_000))
+        data = (2).to_bytes(4, "little") + amt.to_bytes(8, "little")
+        body = T.build(
+            [bytes(64)], [pk, dst, SYSTEM_PROGRAM_ID], bh,
+            [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+        )
+        desc = T.parse(body)
+        sig = golden.sign(sk, desc.message(body))
+        payload = body[:1] + sig + body[1 + 64 :]
+        full = wire.append_trailer(payload, desc)
+        rows[i, : len(full)] = np.frombuffer(full, np.uint8)
+        szs[i] = len(full)
+        payers.append(pk)
+        dsts.append(dst)
+        amounts.append(amt)
+
+    synth = SynthTile(rows, szs, total=n_txns)
+    dedup = DedupTile(depth=1 << 10)
+    pack = PackTile(n_banks, microblock_ns=1_000)
+    banks = [BankTile(i, funk=funk) for i in range(n_banks)]
+    poh = PohTile(tick_batch=16)
+    sink = SinkTile()
+
+    topo = Topology()
+    topo.link("synth_dedup", depth=256, mtu=wire.LINK_MTU)
+    topo.link("dedup_pack", depth=256, mtu=wire.LINK_MTU)
+    for i in range(n_banks):
+        topo.link(f"pack_bank{i}", depth=64, mtu=MB_MTU)
+        topo.link(f"bank{i}_pack", depth=64)
+        topo.link(f"bank{i}_poh", depth=64, mtu=MB_MTU)
+    topo.link("poh_entries", depth=1024, mtu=256)
+    topo.tile(synth, outs=["synth_dedup"])
+    topo.tile(dedup, ins=[("synth_dedup", True)], outs=["dedup_pack"])
+    topo.tile(
+        pack,
+        ins=[("dedup_pack", True)]
+        + [(f"bank{i}_pack", True) for i in range(n_banks)],
+        outs=[f"pack_bank{i}" for i in range(n_banks)],
+    )
+    for i in range(n_banks):
+        topo.tile(
+            banks[i],
+            ins=[(f"pack_bank{i}", True)],
+            outs=[f"bank{i}_pack", f"bank{i}_poh"],
+        )
+    topo.tile(
+        poh,
+        ins=[(f"bank{i}_poh", True) for i in range(n_banks)],
+        outs=["poh_entries"],
+    )
+    topo.tile(sink, ins=[("poh_entries", False)])
+    topo.build()
+    topo.start(batch_max=64)
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            done = sum(
+                topo.metrics(f"bank{i}").counter("executed_txns")
+                for i in range(n_banks)
+            )
+            if done >= n_txns:
+                break
+            time.sleep(0.02)
+        topo.halt()
+
+        failed = sum(
+            topo.metrics(f"bank{i}").counter("failed_txns")
+            for i in range(n_banks)
+        )
+        assert failed == 0
+        # post-block balances: every transfer landed exactly once
+        for pk, dst, amt in zip(payers, dsts, amounts):
+            assert mgr.lamports(pk) == 1_000_000 - FEE_PER_SIGNATURE - amt
+            assert mgr.lamports(dst) == amt
+        fees = sum(
+            topo.metrics(f"bank{i}").counter("fees_lamports")
+            for i in range(n_banks)
+        )
+        assert fees == n_txns * FEE_PER_SIGNATURE
+    finally:
+        topo.close()

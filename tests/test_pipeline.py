@@ -87,3 +87,36 @@ def test_ingress_pipeline_end_to_end():
             assert (row[: szs[i]] == rows[i, : szs[i]]).all()
     finally:
         topo.close()
+
+
+def test_verify_pre_dedup_with_duplicates():
+    """Back-to-back duplicate sigs with pre_dedup=True: the tile must drop
+    them via its 16-deep tcache and keep tsorig propagation consistent
+    (regression: the keep-filter/tsorig index mismatch crashed here)."""
+    pool_n, repeat = 6, 2
+    rows, szs, good = make_txn_pool(pool_n, seed=37)
+    synth = SynthTile(rows, szs, total=pool_n * repeat, repeat=repeat)
+    verify = VerifyTile(msg_width=256, max_lanes=32, pad_full=True,
+                        pre_dedup=True)
+    sink = SinkTile()
+    topo = Topology()
+    topo.link("synth_verify", depth=64, mtu=wire.LINK_MTU)
+    topo.link("verify_sink", depth=64, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_sink"])
+    topo.tile(sink, ins=[("verify_sink", True)])
+    topo.build()
+    topo.start(batch_max=pool_n * repeat)  # one batch: dups land together
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if topo.metrics("sink").counter("sunk_frags") >= pool_n:
+                break
+            time.sleep(0.02)
+        topo.halt()
+        mv = topo.metrics("verify")
+        assert mv.counter("dedup_drop_txns") == pool_n * (repeat - 1)
+        assert topo.metrics("sink").counter("sunk_frags") == pool_n
+    finally:
+        topo.close()

@@ -1,0 +1,202 @@
+"""flamenco runtime: fees, system program, rollback, rent, funk forks."""
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import (
+    Account, AccountMgr, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.flamenco.runtime import (
+    FEE_PER_SIGNATURE, Executor, rent_exempt_minimum,
+)
+from firedancer_tpu.funk.funk import Funk, ROOT_XID
+
+
+def _keys(rng, n):
+    return [rng.integers(0, 256, 32, np.uint8).tobytes() for _ in range(n)]
+
+
+def _transfer_txn(payer, dst, lamports, blockhash, extra_signer=None):
+    """System transfer payer->dst.  Accounts: [payer, dst, system]."""
+    data = (2).to_bytes(4, "little") + int(lamports).to_bytes(8, "little")
+    signers = [payer] + ([extra_signer] if extra_signer else [])
+    addrs = signers + [dst, SYSTEM_PROGRAM_ID]
+    return T.build(
+        [bytes(64)] * len(signers),
+        addrs,
+        blockhash,
+        [(len(addrs) - 1, [0, len(signers)], data)],
+        readonly_unsigned_cnt=1,
+    )
+
+
+def test_transfer_and_fees():
+    rng = np.random.default_rng(0)
+    payer, dst = _keys(rng, 2)
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(1_000_000))
+
+    ex = Executor(funk)
+    res = ex.execute_txn(_transfer_txn(payer, dst, 300_000, bh))
+    assert res.ok, res.err
+    assert res.fee == FEE_PER_SIGNATURE
+    assert mgr.lamports(payer) == 1_000_000 - FEE_PER_SIGNATURE - 300_000
+    assert mgr.lamports(dst) == 300_000
+
+
+def test_insufficient_funds_rolls_back_but_fee_sticks():
+    rng = np.random.default_rng(1)
+    payer, dst = _keys(rng, 2)
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(100_000))
+
+    ex = Executor(funk)
+    res = ex.execute_txn(_transfer_txn(payer, dst, 500_000, bh))
+    assert not res.ok and res.err == "insufficient funds"
+    # fee debited, transfer rolled back
+    assert mgr.lamports(payer) == 100_000 - FEE_PER_SIGNATURE
+    assert mgr.lamports(dst) == 0
+
+
+def test_fee_payer_cannot_cover_fee():
+    rng = np.random.default_rng(2)
+    payer, dst = _keys(rng, 2)
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    AccountMgr(funk).store(payer, Account(10))
+    ex = Executor(funk)
+    res = ex.execute_txn(_transfer_txn(payer, dst, 1, bh))
+    assert not res.ok and "fee payer" in res.err
+    assert AccountMgr(funk).lamports(payer) == 10  # nothing charged
+
+
+def test_transfer_requires_signature():
+    rng = np.random.default_rng(3)
+    payer, victim, dst = _keys(rng, 3)
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(1_000_000))
+    mgr.store(victim, Account(1_000_000))
+    # instruction tries to move funds from `victim`, who did NOT sign
+    data = (2).to_bytes(4, "little") + (100).to_bytes(8, "little")
+    body = T.build(
+        [bytes(64)],
+        [payer, victim, dst, SYSTEM_PROGRAM_ID],
+        bh,
+        [(3, [1, 2], data)],
+        readonly_unsigned_cnt=1,
+    )
+    res = Executor(funk).execute_txn(body)
+    assert not res.ok and res.err == "missing signature"
+    assert mgr.lamports(victim) == 1_000_000
+
+
+def test_create_account_rent():
+    rng = np.random.default_rng(4)
+    payer, new = _keys(rng, 2)
+    bh = _keys(rng, 1)[0]
+    owner = _keys(rng, 1)[0]
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(100_000_000))
+
+    space = 128
+    need = rent_exempt_minimum(space)
+    data = (
+        (0).to_bytes(4, "little")
+        + int(need).to_bytes(8, "little")
+        + int(space).to_bytes(8, "little")
+        + owner
+    )
+    body = T.build(
+        [bytes(64)] * 2,
+        [payer, new, SYSTEM_PROGRAM_ID],
+        bh,
+        [(2, [0, 1], data)],
+        readonly_unsigned_cnt=1,
+    )
+    res = Executor(funk).execute_txn(body)
+    assert res.ok, res.err
+    acct = mgr.load(new)
+    assert acct.lamports == need and acct.owner == owner
+    assert len(acct.data) == space
+
+    # under-funded create is rejected by rent
+    data_low = (
+        (0).to_bytes(4, "little")
+        + int(need - 1).to_bytes(8, "little")
+        + int(space).to_bytes(8, "little")
+        + owner
+    )
+    new2 = _keys(rng, 1)[0]
+    body2 = T.build(
+        [bytes(64)] * 2,
+        [payer, new2, SYSTEM_PROGRAM_ID],
+        bh,
+        [(2, [0, 1], data_low)],
+        readonly_unsigned_cnt=1,
+    )
+    res2 = Executor(funk).execute_txn(body2)
+    assert not res2.ok and res2.err == "rent: not exempt"
+
+
+def test_execution_on_funk_fork():
+    """Executing inside a prepared fork leaves root untouched until
+    publish (the reference's bank/funk fork model)."""
+    rng = np.random.default_rng(5)
+    payer, dst = _keys(rng, 2)
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    AccountMgr(funk).store(payer, Account(1_000_000))
+
+    xid = b"\x01" * 32
+    funk.txn_prepare(ROOT_XID, xid)
+    ex = Executor(funk, xid)
+    assert ex.execute_txn(_transfer_txn(payer, dst, 500, bh)).ok
+    # root unchanged; fork sees the transfer
+    assert AccountMgr(funk).lamports(dst) == 0
+    assert AccountMgr(funk, xid).lamports(dst) == 500
+    funk.txn_publish(xid)
+    assert AccountMgr(funk).lamports(dst) == 500
+
+
+def test_self_transfer_is_noop():
+    rng = np.random.default_rng(6)
+    payer = _keys(rng, 1)[0]
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(1_000_000))
+    res = Executor(funk).execute_txn(_transfer_txn(payer, payer, 400_000, bh))
+    assert res.ok, res.err
+    # only the fee moved; no lamports minted or destroyed
+    assert mgr.lamports(payer) == 1_000_000 - FEE_PER_SIGNATURE
+
+
+def test_allocate_capped_and_rent_checked():
+    from firedancer_tpu.flamenco.runtime import MAX_DATA_LEN
+
+    rng = np.random.default_rng(7)
+    payer = _keys(rng, 1)[0]
+    bh = _keys(rng, 1)[0]
+    funk = Funk()
+    AccountMgr(funk).store(payer, Account(10**12))
+
+    def allocate(space):
+        data = (8).to_bytes(4, "little") + int(space).to_bytes(8, "little")
+        body = T.build(
+            [bytes(64)], [payer, SYSTEM_PROGRAM_ID], bh,
+            [(1, [0], data)], readonly_unsigned_cnt=1,
+        )
+        return Executor(funk).execute_txn(body)
+
+    assert allocate(64).ok
+    res = allocate(MAX_DATA_LEN + 1)
+    assert not res.ok and "maximum" in res.err
+    res2 = allocate(2**40)  # must error, never OOM
+    assert not res2.ok

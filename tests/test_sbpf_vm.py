@@ -1,0 +1,205 @@
+"""sBPF ELF loader + VM interpreter: opcode semantics, memory map,
+syscalls, CU metering, and a BPF program executing through the runtime."""
+
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import sbpf
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+from firedancer_tpu.flamenco.runtime import BPF_LOADER_ID, Executor
+from firedancer_tpu.flamenco.vm import Vm, VmError
+from firedancer_tpu.funk.funk import Funk
+
+
+def ins(op, dst=0, src=0, off=0, imm=0):
+    return struct.pack("<BBhi", op, (src << 4) | dst, off, imm)
+
+
+def lddw(dst, val):
+    lo = val & 0xFFFFFFFF
+    hi = (val >> 32) & 0xFFFFFFFF
+    return (
+        struct.pack("<BBhI", 0x18, dst, 0, lo)
+        + struct.pack("<BBhI", 0, 0, 0, hi)
+    )
+
+
+def run_text(text, cu=10_000, input_mem=b""):
+    prog = sbpf.load(sbpf.build_elf(text))
+    vm = Vm(prog, cu_limit=cu)
+    vm.input_mem = bytearray(input_mem)
+    return vm, vm.run()
+
+
+EXIT = ins(0x95)
+
+
+def test_alu64_basics():
+    # r0 = (7 + 5) * 3 - 6 = 30; then r0 /= 4 -> 7; r0 |= 0x10 -> 23
+    text = (
+        ins(0xB7, dst=0, imm=7)       # mov64 r0, 7
+        + ins(0x07, dst=0, imm=5)     # add64 r0, 5
+        + ins(0x27, dst=0, imm=3)     # mul64 r0, 3
+        + ins(0x17, dst=0, imm=6)     # sub64 r0, 6
+        + ins(0x37, dst=0, imm=4)     # div64 r0, 4
+        + ins(0x47, dst=0, imm=0x10)  # or64
+        + EXIT
+    )
+    _, r0 = run_text(text)
+    assert r0 == 23
+
+
+def test_alu32_wraps_and_arsh():
+    text = (
+        ins(0xB4, dst=1, imm=-1)      # mov32 r1, -1 -> 0xffffffff
+        + ins(0x04, dst=1, imm=1)     # add32 r1, 1 -> 0 (wrap)
+        + ins(0xB7, dst=2, imm=-8)    # mov64 r2, -8
+        + ins(0xC7, dst=2, imm=1)     # arsh64 r2, 1 -> -4
+        + ins(0xBF, dst=0, src=2)     # mov64 r0, r2
+        + EXIT
+    )
+    _, r0 = run_text(text)
+    assert r0 == (-4) & ((1 << 64) - 1)
+
+
+def test_lddw_and_jumps():
+    # r0 = 1 if r1(=0x11223344_55667788) > 2^32 else 2
+    text = (
+        lddw(1, 0x1122334455667788)
+        + lddw(2, 1 << 32)
+        + ins(0x2D, dst=1, src=2, off=2)  # jgt r1, r2, +2
+        + ins(0xB7, dst=0, imm=2)
+        + EXIT
+        + ins(0xB7, dst=0, imm=1)
+        + EXIT
+    )
+    _, r0 = run_text(text)
+    assert r0 == 1
+
+
+def test_memory_stack_and_input():
+    # store 0xAB at stack[-8], load it back; read input byte 0 and add
+    text = (
+        ins(0xB7, dst=1, imm=0xAB)
+        + ins(0x6B, dst=10, src=1, off=-8)          # stxh [r10-8], r1
+        + ins(0x69, dst=0, src=10, off=-8)          # ldxh r0, [r10-8]
+        + lddw(3, sbpf.MM_INPUT)
+        + ins(0x71, dst=4, src=3, off=0)            # ldxb r4, [r3]
+        + ins(0x0F, dst=0, src=4)                   # add64 r0, r4
+        + EXIT
+    )
+    _, r0 = run_text(text, input_mem=b"\x10")
+    assert r0 == 0xAB + 0x10
+
+
+def test_program_memory_is_readonly():
+    text = (
+        lddw(1, sbpf.MM_PROGRAM)
+        + ins(0x72, dst=1, off=0, imm=1)  # stb [r1], 1
+        + EXIT
+    )
+    try:
+        run_text(text)
+        raise AssertionError("write to rodata must fault")
+    except VmError as e:
+        assert "read-only" in str(e)
+
+
+def test_div_by_zero_and_cu_exhaustion():
+    try:
+        run_text(ins(0xB7, dst=0, imm=1) + ins(0x37, dst=0, imm=0) + EXIT)
+        raise AssertionError()
+    except VmError as e:
+        assert "division" in str(e)
+    # infinite loop burns the budget
+    try:
+        run_text(ins(0x05, off=-1) + EXIT, cu=500)
+        raise AssertionError()
+    except VmError as e:
+        assert "compute budget" in str(e)
+
+
+def test_syscall_log_and_bpf_call():
+    # function at +4: r0 = r1 * 2; main calls it with r1 = 21
+    text = (
+        ins(0xB7, dst=1, imm=21)
+        + ins(0x85, imm=2)            # call +2 (relative, lands on func)
+        + EXIT
+        + ins(0xB7, dst=9, imm=99)    # padding (skipped)
+        + ins(0xBF, dst=0, src=1)     # func: r0 = r1
+        + ins(0x27, dst=0, imm=2)     # r0 *= 2
+        + EXIT
+    )
+    vm, r0 = run_text(text)
+    assert r0 == 42
+    # syscall: sol_log_ of 3 input bytes
+    text2 = (
+        lddw(1, sbpf.MM_INPUT)
+        + ins(0xB7, dst=2, imm=3)
+        + ins(0x85, imm=sbpf.syscall_hash(b"sol_log_"))
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    vm2, r0b = run_text(text2, input_mem=b"hey")
+    assert r0b == 0 and vm2.logs == [b"hey"]
+
+
+def test_bpf_program_through_runtime():
+    """Deploy a tiny ELF as an executable account; a txn invoking it runs
+    in the VM (exit 0 = success, nonzero = failure)."""
+    rng = np.random.default_rng(9)
+    payer = rng.integers(0, 256, 32, np.uint8).tobytes()
+    prog_key = rng.integers(0, 256, 32, np.uint8).tobytes()
+    bh = rng.integers(0, 256, 32, np.uint8).tobytes()
+
+    # program: r0 = first input byte (instruction data) - 7
+    text = (
+        lddw(3, sbpf.MM_INPUT)
+        + ins(0x71, dst=0, src=3, off=0)
+        + ins(0x17, dst=0, imm=7)
+        + EXIT
+    )
+    elf = sbpf.build_elf(text)
+
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(1_000_000))
+    mgr.store(
+        prog_key, Account(1, owner=BPF_LOADER_ID, executable=True, data=elf)
+    )
+
+    def invoke(data: bytes):
+        body = T.build(
+            [bytes(64)], [payer, prog_key], bh, [(1, [0], data)],
+            readonly_unsigned_cnt=1,
+        )
+        return Executor(funk).execute_txn(body)
+
+    assert invoke(bytes([7])).ok  # 7-7 == 0 -> success
+    res = invoke(bytes([9]))
+    assert not res.ok and "program error 2" in res.err
+
+
+def test_malformed_elf_never_escapes_as_crash():
+    """Any garbage program account must yield SbpfError (and a per-txn
+    'elf:' failure through the runtime), never IndexError/MemoryError."""
+    rng = np.random.default_rng(11)
+    good = sbpf.build_elf(EXIT)
+    cases = [b"", b"\x7fELF", bytes(64), good[:40]]
+    # truncations + mutations of a valid ELF
+    for _ in range(200):
+        b = bytearray(good)
+        for _ in range(int(rng.integers(1, 8))):
+            b[rng.integers(0, len(b))] ^= 1 << rng.integers(0, 8)
+        cases.append(bytes(b[: rng.integers(8, len(b) + 1)]))
+    # a section claiming a huge address must not allocate memory
+    big = bytearray(good)
+    cases.append(bytes(big))
+    for i, c in enumerate(cases):
+        try:
+            p = sbpf.load(c)
+            assert len(p.rodata) <= sbpf.MAX_IMAGE_SZ
+        except sbpf.SbpfError:
+            pass  # the only acceptable failure mode
