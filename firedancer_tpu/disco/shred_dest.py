@@ -1,0 +1,136 @@
+"""Turbine destination computation: stake_ci + shred_dest.
+
+Reference model: src/disco/shred/fd_stake_ci.c (stake-weighted contact
+info, double-buffered across epoch boundaries) and fd_shred_dest.c
+(per-shred stake-weighted shuffle of the cluster, tree fanout, and "who
+are MY children / am I the root" queries).  Behavior re-derived from the
+turbine design: the leader sends each shred to the shuffle's root; every
+node forwards to up to `fanout` children in the shuffled order.
+
+TPU-batch angle: destinations for a whole FEC set are computed in one
+call — the per-shred weighted shuffles share the stake table and differ
+only in their ChaCha20 seeds (seeded from the shred's merkle root / sig,
+like the reference), so the host loop is over shreds with vectorized
+numpy inside WSample.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from firedancer_tpu.ballet.chacha20 import MODE_SHIFT, ChaCha20Rng
+from firedancer_tpu.ballet.wsample import WSample
+
+#: turbine data-plane fanout (Solana DATA_PLANE_FANOUT)
+FANOUT = 200
+
+
+@dataclass
+class ContactInfo:
+    pubkey: bytes
+    stake: int
+    addr: tuple[str, int] = ("0.0.0.0", 0)
+
+
+class StakeCI:
+    """Stake-weighted contact info, double-buffered per epoch.
+
+    The reference keeps two epochs live (fd_stake_ci.h) because shreds
+    near an epoch boundary may belong to either; `for_slot` picks the
+    epoch's table."""
+
+    def __init__(self):
+        self.epochs: dict[int, list[ContactInfo]] = {}
+
+    def set_epoch(self, epoch: int, infos: list[ContactInfo]) -> None:
+        # deterministic order: stake desc, pubkey desc (leaders.py rule)
+        self.epochs[epoch] = sorted(
+            infos, key=lambda c: (c.stake, c.pubkey), reverse=True
+        )
+        # keep at most the two most recent epochs
+        for e in sorted(self.epochs)[:-2]:
+            del self.epochs[e]
+
+    def for_epoch(self, epoch: int) -> list[ContactInfo]:
+        return self.epochs[epoch]
+
+
+def _shred_seed(slot: int, shred_idx: int, shred_type: int,
+                leader: bytes) -> bytes:
+    """Per-shred shuffle seed (derived from slot/index/type/leader, the
+    reference's seed inputs for the turbine shuffle)."""
+    import hashlib
+
+    return hashlib.sha256(
+        slot.to_bytes(8, "little")
+        + shred_idx.to_bytes(4, "little")
+        + bytes([shred_type])
+        + leader
+    ).digest()
+
+
+@dataclass
+class ShredDest:
+    """Turbine tree queries for one cluster snapshot."""
+
+    infos: list[ContactInfo]
+    fanout: int = FANOUT
+    _excl_cache: dict = field(init=False, default_factory=dict)
+
+    def _excluding(self, leader: bytes) -> tuple[list[int], list[int]]:
+        """(weights, idx_map) with the leader removed — computed once per
+        (cluster, leader) and shared by every shred's shuffle."""
+        hit = self._excl_cache.get(leader)
+        if hit is not None:
+            return hit
+        weights = []
+        idx_map = []
+        for i, c in enumerate(self.infos):
+            if c.pubkey == leader:
+                continue
+            weights.append(max(c.stake, 1))
+            idx_map.append(i)
+        self._excl_cache[leader] = (weights, idx_map)
+        return weights, idx_map
+
+    def shuffle(self, slot: int, shred_idx: int, shred_type: int,
+                leader: bytes) -> list[int]:
+        """Stake-weighted shuffle of contact indices for one shred.
+        The leader is EXCLUDED (it transmits, it never receives)."""
+        rng = ChaCha20Rng(_shred_seed(slot, shred_idx, shred_type, leader),
+                          MODE_SHIFT)
+        weights, idx_map = self._excluding(leader)
+        if not weights:
+            return []
+        ws = WSample(rng, weights, restore_enabled=False)
+        return [idx_map[j] for j in ws.sample_and_remove_many(len(weights))]
+
+    def children(self, order: list[int], me: bytes) -> tuple[list[int], bool]:
+        """(my child indices in the tree, am-I-root).  Tree layout over
+        the shuffled order: node at position p forwards to positions
+        fanout*p+1 .. fanout*p+fanout (the standard turbine broadcast
+        tree)."""
+        pos = None
+        for p, idx in enumerate(order):
+            if self.infos[idx].pubkey == me:
+                pos = p
+                break
+        if pos is None:
+            return [], False
+        lo = self.fanout * pos + 1
+        hi = min(lo + self.fanout, len(order))
+        return [order[p] for p in range(lo, hi)], pos == 0
+
+
+def fec_set_destinations(
+    sd: ShredDest, slot: int, leader: bytes, me: bytes,
+    shred_idxs: list[int], shred_type: int = 0,
+) -> list[tuple[list[int], bool]]:
+    """Destinations for every shred of a FEC set in one call."""
+    out = []
+    for si in shred_idxs:
+        order = sd.shuffle(slot, si, shred_type, leader)
+        out.append(sd.children(order, me))
+    return out

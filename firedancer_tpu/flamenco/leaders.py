@@ -36,8 +36,12 @@ class EpochLeaders:
     pubkeys: list[bytes]  # deduped identity table
     sched: list[int]  # one pubkey index per rotation
 
+    def contains(self, slot: int) -> bool:
+        return self.slot0 <= slot < self.slot0 + self.slot_cnt
+
     def leader_for_slot(self, slot: int) -> bytes:
-        assert self.slot0 <= slot < self.slot0 + self.slot_cnt
+        if not self.contains(slot):
+            raise ValueError(f"slot {slot} outside epoch {self.epoch}")
         rot = (slot - self.slot0) // SLOTS_PER_ROTATION
         return self.pubkeys[self.sched[rot]]
 

@@ -1,17 +1,18 @@
-"""PoH tile: the proof-of-history clock, mixing executed microblocks into
-the hash chain.
+"""PoH tile: the proof-of-history clock, slot state machine, and mixins.
 
-Reference model: src/app/fdctl/run/tiles/fd_poh.c — the validator's one
-sequential component: iterate state = SHA-256(state) continuously (500ns
-per hashcnt on mainnet), and on each executed microblock from a bank,
-mix its hash into the chain (one mixin consumes one hashcnt), emitting
-entries downstream (to shred in the reference).
+Reference model: src/app/fdctl/run/tiles/fd_poh.c (design essay at
+:10-250) — the validator's one sequential component: iterate
+state = SHA-256(state) continuously, track the slot boundary every
+ticks_per_slot ticks, follow the leader schedule (become leader when our
+identity holds the slot, hand off when it passes), and mix executed
+microblocks into the chain ONLY while leader.
 
 TPU-native shape: ticks are batched — after_credit runs `tick_batch`
 appends as ONE device dispatch (lax.fori_loop of the fixed-32B SHA-256
 compression, ops/poh.append_n) instead of one hash per loop iteration.
 Entries out carry (prev_state, hashcnt, mixin, state) so a downstream
-verifier can batch-check them (ops/poh.verify_entries).
+verifier can batch-check them (ops/poh.verify_entries); slot boundaries
+emit a tick entry with the slot number in the sig field.
 """
 
 from __future__ import annotations
@@ -25,21 +26,62 @@ from firedancer_tpu.ops import sha256 as SHA
 
 ENTRY_SZ = 32 + 8 + 32 + 32  # prev_state | hashcnt u64 | mixin | state
 
+#: mainnet: 64 ticks per slot (the reference derives it from genesis)
+TICKS_PER_SLOT = 64
+
+#: slot-boundary entries publish tag = SLOT_BOUNDARY_TAG | slot, keeping
+#: them disjoint from mixin/tick entry tags (small hashcnt values)
+SLOT_BOUNDARY_TAG = 1 << 63
+
 
 class PohTile(Tile):
     """ins = bank_poh microblock rings; outs[0] = entries ring."""
 
     schema = MetricsSchema(
-        counters=("hashcnt", "mixins", "entries"),
+        counters=(
+            "hashcnt",
+            "mixins",
+            "entries",
+            "slots",
+            "leader_slots",
+            "dropped_mixins",
+        ),
     )
 
-    def __init__(self, *, tick_batch: int = 64, name: str = "poh"):
+    def __init__(
+        self,
+        *,
+        tick_batch: int = 64,
+        ticks_per_slot: int = TICKS_PER_SLOT,
+        leaders=None,
+        identity: bytes | None = None,
+        slot0: int = 0,
+        name: str = "poh",
+    ):
+        """leaders/identity: an EpochLeaders schedule (flamenco.leaders)
+        plus our pubkey drive the leader-slot state machine; with
+        leaders=None the tile is always leader (single-node tests)."""
         self.name = name
         self.tick_batch = tick_batch
+        self.ticks_per_slot = ticks_per_slot
+        self.leaders = leaders
+        self.identity = identity
+        self.slot = slot0
+        self.ticks_in_slot = 0
         self.state = np.zeros(32, dtype=np.uint8)
         self.hashcnt = 0
         self._append = None
         self._mixin = None
+
+    # ---- leader state ----------------------------------------------------
+
+    def is_leader(self, slot: int | None = None) -> bool:
+        if self.leaders is None:
+            return True
+        s = self.slot if slot is None else slot
+        if not self.leaders.contains(s):
+            return False  # outside the schedule's epoch window
+        return self.leaders.leader_for_slot(s) == self.identity
 
     def on_boot(self, ctx: MuxCtx) -> None:
         import functools
@@ -54,15 +96,18 @@ class PohTile(Tile):
         s = self.state[None, :]
         np.asarray(self._append(s))
         np.asarray(self._mixin(s, s))
+        if self.is_leader():
+            ctx.metrics.inc("leader_slots")
 
-    def _emit(self, ctx: MuxCtx, prev, hashcnt, mix, state) -> None:
+    def _emit(self, ctx: MuxCtx, prev, hashcnt, mix, state, tag=None) -> None:
         buf = np.zeros(ENTRY_SZ, dtype=np.uint8)
         buf[0:32] = prev
         buf[32:40].view("<u8")[0] = hashcnt
         buf[40:72] = mix
         buf[72:104] = state
         ctx.publish(
-            np.array([hashcnt or 1], dtype=np.uint64),
+            np.array([tag if tag is not None else (hashcnt or 1)],
+                     dtype=np.uint64),
             buf[None, :],
             np.array([ENTRY_SZ], dtype=np.uint16),
         )
@@ -71,7 +116,14 @@ class PohTile(Tile):
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
         rows = il.gather(frags)
+        leader = self.is_leader()  # constant within one callback
         for i in range(len(rows)):
+            if not leader:
+                # a bank handed us a microblock outside our leader slot:
+                # fail-safe drop (the reference cannot reach this state
+                # because pack only schedules while leader; we count it)
+                ctx.metrics.inc("dropped_mixins")
+                continue
             mb = rows[i, : frags["sz"][i]]
             # microblock hash = SHA-256 of its bytes (stand-in for the
             # entry merkle root the reference mixes in)
@@ -93,4 +145,20 @@ class PohTile(Tile):
         self.state = np.asarray(self._append(self.state[None, :]))[0]
         self.hashcnt += self.tick_batch
         ctx.metrics.inc("hashcnt", self.tick_batch)
-        self._emit(ctx, prev, self.tick_batch, np.zeros(32, np.uint8), self.state)
+        self._emit(ctx, prev, self.tick_batch, np.zeros(32, np.uint8),
+                   self.state)
+        # slot state machine: tick_batch counts as tick_batch ticks
+        self.ticks_in_slot += self.tick_batch
+        while self.ticks_in_slot >= self.ticks_per_slot:
+            self.ticks_in_slot -= self.ticks_per_slot
+            self.slot += 1
+            ctx.metrics.inc("slots")
+            if self.is_leader():
+                ctx.metrics.inc("leader_slots")
+            # slot-boundary entry: tag = high bit | slot number — a tag
+            # space disjoint from mixin (sig=1) and tick (sig=hashcnt)
+            # entries so downstream consumers can detect boundaries
+            self._emit(
+                ctx, self.state, 0, np.zeros(32, np.uint8), self.state,
+                tag=SLOT_BOUNDARY_TAG | self.slot,
+            )

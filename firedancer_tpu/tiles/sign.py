@@ -1,0 +1,85 @@
+"""Sign tile (keyguard): the only tile holding the identity private key.
+
+Reference model: src/disco/keyguard/ + src/app/fdctl/run/tiles/fd_sign.c —
+other tiles (quic/TLS certs, shred merkle roots, gossip) request
+signatures over dedicated request/response rings; the keyguard refuses
+payloads whose type cannot be unambiguously determined, so a compromised
+peer tile can never trick it into signing a transaction or a message of
+another protocol (fd_keyguard.h:26-50 payload-type matchers).
+
+One request ring per role (like the reference's per-peer rings): the role
+is a property of the ring, not of the frag, so a compromised peer cannot
+claim a different role than its ring grants.  Request frag payload = the
+raw bytes to sign; response = the 64-byte signature with the request's
+sig field echoed for correlation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+# roles (who may sign what; one role per in-ring, like the reference's
+# per-peer rings)
+ROLE_SHRED = 1  # 32-byte merkle roots
+ROLE_TLS_CV = 2  # TLS 1.3 CertificateVerify transcripts
+ROLE_GOSSIP = 3  # gossip CRDS payloads
+
+_CV_PREFIX = b" " * 64 + b"TLS 1.3, server CertificateVerify" + b"\0"
+
+
+def payload_allowed(role: int, payload: bytes) -> bool:
+    """Type matcher: refuse anything ambiguous (fd_keyguard behavior:
+    a payload that PARSES AS A TRANSACTION is never signed by any role —
+    the identity key must not be usable to forge txns)."""
+    if T.parse(payload) is not None or T.parse(payload[1:]) is not None:
+        return False
+    if role == ROLE_SHRED:
+        return len(payload) == 32
+    if role == ROLE_TLS_CV:
+        return payload.startswith(_CV_PREFIX) and len(payload) == len(
+            _CV_PREFIX
+        ) + 32
+    if role == ROLE_GOSSIP:
+        return 0 < len(payload) <= 1232
+    return False
+
+
+class SignTile(Tile):
+    """ins[i] = request ring for role roles[i]; outs[i] = its responses."""
+
+    name = "sign"
+    schema = MetricsSchema(counters=("signed", "refused"))
+
+    def __init__(self, identity_secret: bytes, roles: list[int]):
+        self.identity_secret = identity_secret
+        self.roles = roles
+        self.pubkey: bytes | None = None
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        from firedancer_tpu.ops.ed25519 import golden
+
+        self.pubkey = golden.public_from_secret(self.identity_secret)
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        from firedancer_tpu.ops.ed25519 import golden
+
+        role = self.roles[in_idx]
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            payload = rows[i, : frags["sz"][i]].tobytes()
+            if not payload_allowed(role, payload):
+                ctx.metrics.inc("refused")
+                continue
+            sig = golden.sign(self.identity_secret, payload)
+            out = np.frombuffer(sig, np.uint8)
+            ctx.outs[in_idx].publish(
+                frags["sig"][i : i + 1],
+                out[None, :],
+                np.array([64], np.uint16),
+            )
+            ctx.metrics.inc("signed")
