@@ -1,7 +1,10 @@
 """Headline benchmark: Ed25519 verifies/s on one TPU chip.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "pipeline_tps": N}
+where value is the raw kernel rate and pipeline_tps is the replayed-corpus
+end-to-end rate through real rings (replay -> verify(TPU) -> dedup -> sink).
 
 Baseline (BASELINE.md): 1,000,000 verifies/s = one AWS-F1 FPGA card
 (the reference's wiredancer offload) = ~33 Skylake cores of the reference's
@@ -62,13 +65,15 @@ def _bench_verify() -> dict:
     ok = np.asarray(fn(*sets[0]))  # warm compile + correctness gate
     assert ok.all(), "verify_batch rejected valid sigs"
 
-    best = float("inf")
-    for s in sets[1:]:
-        t0 = time.perf_counter()
-        out = fn(*s)
+    # steady-state throughput: dispatch both timed batches back-to-back
+    # (JAX dispatch is async), then sync both — the fixed per-execution
+    # tunnel overhead overlaps the next batch's compute, exactly how the
+    # async verify tile runs the kernel in production (tiles/verify.py)
+    t0 = time.perf_counter()
+    outs = [fn(*s) for s in sets[1:]]
+    for out in outs:
         np.asarray(out)  # the only reliable sync on this platform
-        best = min(best, time.perf_counter() - t0)
-    rate = batch / best
+    rate = batch * len(outs) / (time.perf_counter() - t0)
     return {
         "metric": "ed25519_verifies_per_s_1chip",
         "value": round(rate, 1),
@@ -121,8 +126,13 @@ def _bench_pipeline_tps() -> float:
     from firedancer_tpu.tiles.verify import VerifyTile
     from firedancer_tpu.waltz import pcap
 
-    # small signed pool (host-side oracle signing is slow), looped hard
-    pool_n, total = 256, 65536
+    # small signed pool (host-side oracle signing is slow), looped hard;
+    # pre_dedup is OFF in the verify tile so every replayed frag does real
+    # device work (1 sig each) — the dedup tile downstream still exercises
+    # its real drop path on the repeats.  Completion is gated on the DEDUP
+    # tile having consumed every verified txn (end-to-end through the
+    # pipeline, not just verify-tile ingestion).
+    pool_n, total = 256, 1 << 20
     rows, szs, _good = make_txn_pool(pool_n, seed=7)
     fd, path = tempfile.mkstemp(suffix=".pcap")
     os.close(fd)
@@ -133,7 +143,9 @@ def _bench_pipeline_tps() -> float:
     w.close()
 
     replay = ReplayTile(path, total=total)
-    verify = VerifyTile(msg_width=256, max_lanes=16384, pad_full=True)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=16384, pad_full=True, pre_dedup=False
+    )
     dedup = DedupTile(depth=1 << 20)
     sink = SinkTile()
     topo = Topology()
@@ -149,14 +161,14 @@ def _bench_pipeline_tps() -> float:
     try:
         t0 = time.perf_counter()
         deadline = t0 + 300.0
-        mv = topo.metrics("verify")
+        md = topo.metrics("dedup")
         while time.perf_counter() < deadline:
             topo.poll_failure()
-            if mv.counter("in_frags") >= total:
+            if md.counter("in_frags") >= total:
                 break
             time.sleep(0.05)
         dt = time.perf_counter() - t0
-        done = mv.counter("in_frags")
+        done = md.counter("in_frags")
         topo.halt()
         return done / dt
     finally:

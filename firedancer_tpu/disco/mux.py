@@ -169,6 +169,13 @@ class Tile:
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         """A batch of frags arrived on ins[in_idx]."""
 
+    def in_budget(self, ctx: MuxCtx) -> int | None:
+        """Max in-frags this tile can absorb this iteration (None =
+        unlimited).  Tiles with internal queues (async device dispatch)
+        return 0 when full so upstream backpressure propagates through
+        the rings instead of an unbounded host buffer."""
+        return None
+
     def after_credit(self, ctx: MuxCtx) -> None:
         """Called every iteration after frag processing while credits
         remain — where producer tiles generate work (reference:
@@ -224,12 +231,15 @@ def run_loop(
 
             out_seq0 = [o.seq for o in ctx.outs]
             got = 0
+            absorb = tile.in_budget(ctx)
             for i, il in enumerate(ctx.ins):
                 # credits are consumed across in-links: a tile republishes
                 # at most 1 out-frag per in-frag, so bounding the remaining
                 # drain budget by frags already taken this iteration keeps
                 # total publishes <= cr even with many in-links
                 budget = cr - got
+                if absorb is not None:
+                    budget = min(budget, absorb - got)
                 if budget <= 0:
                     break
                 frags, il.seq, ovr = il.mcache.drain(il.seq, budget)

@@ -61,9 +61,10 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-@functools.partial(jax.jit, static_argnames=("msg_len", "use_pallas"))
-def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
-    del msg_len  # captured statically via msgs.shape
+def _verify_from_digest(digest, sigs, pubs, use_pallas):
+    """Steps 1-3 and 5 shared by the message and digest entry points;
+    `digest` is SHA512(R || A || M) per lane (step 4, from either the
+    device SHA or the host's fdt_sha512_rpm)."""
     # 1. canonical s
     s_limbs = SC.from_bytes(sigs[:, 32:])
     ok = SC.is_canonical(s_limbs)
@@ -71,9 +72,6 @@ def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
     # 3. small order A/R by encoding blocklist
     ok = ok & ~_is_small_order_enc(pubs) & ~_is_small_order_enc(sigs[:, :32])
 
-    # 4. k = SHA512(R || A || M) mod L
-    cat = jnp.concatenate([sigs[:, :32], pubs, msgs], axis=1)
-    digest = _sha.sha512(cat, lens.astype(jnp.int32) + 64)
     k_limbs = SC.reduce512(digest)
     k_digits = SC.to_signed_digits(k_limbs)
     s_digits = SC.to_signed_digits(s_limbs)
@@ -99,6 +97,15 @@ def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
     return ok & PT.eq_external(acc, r_pt)
 
 
+@functools.partial(jax.jit, static_argnames=("msg_len", "use_pallas"))
+def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
+    del msg_len  # captured statically via msgs.shape
+    # 4. k = SHA512(R || A || M) mod L, on device
+    cat = jnp.concatenate([sigs[:, :32], pubs, msgs], axis=1)
+    digest = _sha.sha512(cat, lens.astype(jnp.int32) + 64)
+    return _verify_from_digest(digest, sigs, pubs, use_pallas)
+
+
 def verify_batch(msgs, lens, sigs, pubs):
     """Verify a batch of Ed25519 signatures.
 
@@ -112,3 +119,24 @@ def verify_batch(msgs, lens, sigs, pubs):
     return _verify_impl(
         msgs, lens, sigs, pubs, msgs.shape[1], use_pallas=_use_pallas()
     )
+
+
+@functools.partial(jax.jit, static_argnames=("use_pallas",))
+def _verify_digest_impl(digests, sigs, pubs, use_pallas=False):
+    # step 4's SHA512 was done on the host (fdt_sha512_rpm inside
+    # fdt_verify_expand); everything else is shared
+    return _verify_from_digest(digests, sigs, pubs, use_pallas)
+
+
+def verify_batch_digest(digests, sigs, pubs):
+    """Verify from precomputed k-digests = SHA512(R || A || M).
+
+    The host computes the digests during lane expansion so the device is
+    shipped 64 bytes per lane instead of the whole message — the right
+    trade whenever host→device bandwidth, not device compute, bounds the
+    pipeline (PROFILE.md).  digests: (B, 64); sigs: (B, 64);
+    pubs: (B, 32).  Returns (B,) bool."""
+    digests = jnp.asarray(digests, jnp.uint8)
+    sigs = jnp.asarray(sigs, jnp.uint8)
+    pubs = jnp.asarray(pubs, jnp.uint8)
+    return _verify_digest_impl(digests, sigs, pubs, use_pallas=_use_pallas())

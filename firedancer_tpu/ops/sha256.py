@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-from .sha512 import _primes
+from firedancer_tpu.utils.shaconst import _primes
 
 
 def _frac_root_bits(p: int, e: int) -> int:

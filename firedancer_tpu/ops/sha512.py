@@ -21,35 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _icbrt(n: int) -> int:
-    x = 1 << ((n.bit_length() + 2) // 3 + 1)
-    while True:
-        y = (2 * x + n // (x * x)) // 3
-        if y >= x:
-            return x
-        x = y
-
-
-def _primes(n: int):
-    ps, c = [], 2
-    while len(ps) < n:
-        if all(c % p for p in ps):
-            ps.append(c)
-        c += 1
-    return ps
-
-
-def _gen_constants():
-    import math
-
-    ps = _primes(80)
-    k = [_icbrt(p << 192) & ((1 << 64) - 1) for p in ps]
-    h = [math.isqrt(p << 128) & ((1 << 64) - 1) for p in ps[:8]]
-    return k, h
-
-
-_K64, _H64 = _gen_constants()
-assert _K64[0] == 0x428A2F98D728AE22 and _H64[0] == 0x6A09E667F3BCC908
+from firedancer_tpu.utils.shaconst import H64 as _H64
+from firedancer_tpu.utils.shaconst import K64 as _K64
 
 _K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
 _K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)

@@ -440,3 +440,102 @@ int fdt_tcache_query( void const * tcache, uint64_t tag ) {
       h->depth;
   return tc_map_query( map, h->map_cnt - 1UL, tag );
 }
+
+/* ==== verify lane expansion ============================================= */
+
+/* fdt_sha512.c (same shared library) */
+extern void fdt_sha512_rpm( uint8_t const * r, uint8_t const * a,
+                            uint8_t const * m, uint64_t mlen, uint8_t * out );
+
+/* One-pass gather + trailer parse + per-signature lane expansion for the
+   verify tile (tiles/verify.py).  For each frag (chunks[i], szs[i]):
+     - copy the full payload into rows_out[i] (zero-padded to width) so the
+       tile can republish it downstream without re-reading the dcache;
+     - parse the 16-byte wire trailer (tiles/wire.py format: u16 sig_off,
+       pub_off, msg_off, msg_len, txn_sz; u8 sig_cnt, ...);
+     - emit one verify lane per signature j in [0, sig_cnt):
+         msgs[lane]: payload[msg_off .. msg_off+msg_len) padded to msg_width
+         lens[lane]  = msg_len
+         sigs[lane]  = payload[sig_off + 64 j ..][0:64]
+         pubs[lane]  = payload[pub_off + 32 j ..][0:32]
+     - write per-txn sig_cnt[i] and tags[i] (first 8 bytes of the first
+       signature, little-endian, the dedup key — fd_dedup keys the tango
+       sig field the same way).
+   A malformed trailer (offsets past the payload) yields one lane of
+   zeroed sig/pub (which can never verify) instead of out-of-bounds reads.
+   Caller sizes lane outputs for the worst case (n * max sigs per txn).
+   Returns the lane count. */
+uint64_t fdt_verify_expand( void const * dcache_base,
+                            uint32_t const * chunks, uint16_t const * szs,
+                            uint64_t n, uint64_t width,
+                            uint8_t * rows_out, uint64_t msg_width,
+                            uint8_t * msgs, int32_t * lens,
+                            uint8_t * sigs, uint8_t * pubs,
+                            int32_t * txn_idx, int32_t * sig_cnt,
+                            uint64_t * tags, uint8_t * digests ) {
+  uint8_t const * base = (uint8_t const *)dcache_base;
+  uint64_t lane = 0UL;
+  for( uint64_t i = 0; i < n; i++ ) {
+    uint64_t sz = szs[ i ];
+    if( sz > width ) sz = width;
+    uint8_t const * p   = base + (uint64_t)chunks[ i ] * FDT_CHUNK_SZ;
+    uint8_t       * row = rows_out + i * width;
+    memcpy( row, p, sz );
+    memset( row + sz, 0, width - sz );
+
+    uint64_t ok = sz >= 16UL;
+    uint64_t tb = ok ? sz - 16UL : 0UL;
+    uint64_t sig_off = 0, pub_off = 0, msg_off = 0, msg_len = 0, cnt = 0;
+    if( ok ) {
+      sig_off = (uint64_t)p[ tb + 0 ] | ( (uint64_t)p[ tb + 1 ] << 8 );
+      pub_off = (uint64_t)p[ tb + 2 ] | ( (uint64_t)p[ tb + 3 ] << 8 );
+      msg_off = (uint64_t)p[ tb + 4 ] | ( (uint64_t)p[ tb + 5 ] << 8 );
+      msg_len = (uint64_t)p[ tb + 6 ] | ( (uint64_t)p[ tb + 7 ] << 8 );
+      cnt     = (uint64_t)p[ tb + 10 ];
+      /* msg_width only bounds the copy-out buffer; digest-only callers
+         (msgs == NULL) hash messages of any length */
+      if( msgs && msg_len > msg_width ) msg_len = 0, ok = 0;
+      if( msg_off + msg_len > tb ) msg_len = 0, ok = 0;
+      if( !cnt || sig_off + 64UL * cnt > tb || pub_off + 32UL * cnt > tb )
+        ok = 0;
+    }
+    if( !ok ) {
+      /* one poisoned lane: zero sig/pub never verifies */
+      if( msgs ) {
+        memset( msgs + lane * msg_width, 0, msg_width );
+        lens[ lane ] = 0;
+      }
+      memset( sigs + lane * 64UL, 0, 64UL );
+      memset( pubs + lane * 32UL, 0, 32UL );
+      if( digests ) memset( digests + lane * 64UL, 0, 64UL );
+      txn_idx[ lane ] = (int32_t)i;
+      sig_cnt[ i ] = 1;
+      tags[ i ] = 0UL;
+      lane++;
+      continue;
+    }
+    sig_cnt[ i ] = (int32_t)cnt;
+    uint64_t tag = 0UL;
+    for( int b = 7; b >= 0; b-- )
+      tag = ( tag << 8 ) | p[ sig_off + (uint64_t)b ];
+    tags[ i ] = tag;
+    for( uint64_t j = 0; j < cnt; j++ ) {
+      if( msgs ) {  /* NULL when the caller ships digests instead */
+        uint8_t * m = msgs + lane * msg_width;
+        memcpy( m, p + msg_off, msg_len );
+        memset( m + msg_len, 0, msg_width - msg_len );
+        lens[ lane ] = (int32_t)msg_len;
+      }
+      memcpy( sigs + lane * 64UL, p + sig_off + 64UL * j, 64UL );
+      memcpy( pubs + lane * 32UL, p + pub_off + 32UL * j, 32UL );
+      if( digests )
+        /* k-digest = SHA512(R || A || M): host-side so the device is
+           shipped 64 digest bytes instead of msg_width message bytes */
+        fdt_sha512_rpm( p + sig_off + 64UL * j, p + pub_off + 32UL * j,
+                        p + msg_off, msg_len, digests + lane * 64UL );
+      txn_idx[ lane ] = (int32_t)i;
+      lane++;
+    }
+  }
+  return lane;
+}

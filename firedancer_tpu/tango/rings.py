@@ -29,7 +29,10 @@ _HERE = Path(__file__).parent
 
 
 def _load() -> ct.CDLL:
-    so = cbuild.build("fdt_tango", [_HERE / "native" / "fdt_tango.c"])
+    so = cbuild.build(
+        "fdt_tango",
+        [_HERE / "native" / "fdt_tango.c", _HERE / "native" / "fdt_sha512.c"],
+    )
     lib = ct.CDLL(str(so))
     u64, u32, u16, i32, vp = (
         ct.c_uint64,
@@ -72,11 +75,24 @@ def _load() -> ct.CDLL:
         "fdt_tcache_dedup": (u64, [vp, vp, u64, vp]),
         "fdt_tcache_query": (i32, [vp, u64]),
         "fdt_tcache_reset": (None, [vp]),
+        "fdt_verify_expand": (
+            u64,
+            [vp, vp, vp, u64, u64, vp, u64, vp, vp, vp, vp, vp, vp, vp, vp],
+        ),
+        "fdt_sha512_init_consts": (None, [vp, vp]),
+        "fdt_sha512_rpm": (None, [vp, vp, vp, u64, vp]),
+        "fdt_sha512_batch": (None, [vp, vp, u64, u64, vp]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
         fn.restype = res
         fn.argtypes = args
+    # inject the derived SHA-512 constant tables (no constant block in C)
+    from firedancer_tpu.utils.shaconst import H64, K64
+
+    k = np.array(K64, dtype=np.uint64)
+    h = np.array(H64, dtype=np.uint64)
+    lib.fdt_sha512_init_consts(k.ctypes.data, h.ctypes.data)
     return lib
 
 

@@ -1,16 +1,30 @@
 """The TPU sig-verify bridge tile — this build's analog of the reference's
 verify tile (src/app/fdctl/run/tiles/fd_verify.c) and of the wiredancer
-FPGA offload (src/wiredancer/c/wd_f1.c): drain a batch of txn frags from
-the in ring, verify every signature on the device in one SPMD dispatch,
-and republish the txns that pass with the dedup tag in the sig field.
+FPGA offload (src/wiredancer/c/wd_f1.c).
+
+Round-3 redesign: ASYNCHRONOUS push-request / push-result dispatch, the
+defining wiredancer property (src/wiredancer/README.md "Pipeline Design":
+the ring never waits on the accelerator).  The mux loop stages host-side
+work (gather, trailer parse, lane expansion) and pushes prepared batches
+to a device worker thread; the worker keeps several batches in flight
+(dispatch N+1 while N computes — JAX dispatch is async, the only true
+sync on this platform is the device-to-host copy) and lands results on a
+lock-free deque; the mux loop publishes landed results downstream as
+credits allow.  Upstream backpressure propagates through `in_budget`:
+when the request queue is full the tile stops draining its in-ring and
+the ring's credit model takes over — exactly the reference's flow-control
+discipline, with the device behind the same tile/link boundary.
 
 Batch discipline: lane counts are padded up to power-of-two buckets so
 XLA compiles a handful of static shapes, then reuses them forever.  All
-per-frag work (trailer parse, lane expansion) is vectorized numpy; the
-Python loop body is O(1) per batch.
+per-frag work is vectorized numpy; the Python loop body is O(1) per batch.
 """
 
 from __future__ import annotations
+
+import collections
+import queue
+import threading
 
 import numpy as np
 
@@ -24,10 +38,70 @@ from . import wire
 #: pre-dedup catching back-to-back duplicates before they burn device time
 PRE_DEDUP_DEPTH = 16
 
+_STOP = object()
+
+
+class _DeviceWorker:
+    """Push-request/push-result engine (the wd_f1.c interface shape).
+
+    One dedicated thread owns all device interaction.  `depth` batches
+    ride in flight: the thread dispatches every queued request before it
+    blocks on the oldest result's D2H copy, so transfer and compute of
+    batch N+1 overlap the sync of batch N.
+    """
+
+    def __init__(self, fn, depth: int = 3):
+        self.fn = fn
+        self.depth = depth
+        self.reqq: queue.Queue = queue.Queue(maxsize=depth)
+        self.results: collections.deque = collections.deque()
+        self.error: BaseException | None = None
+        self.thread = threading.Thread(
+            target=self._main, name="verify-dev", daemon=True
+        )
+        self.thread.start()
+
+    def submit(self, meta, args) -> None:
+        self.reqq.put((meta, args))
+
+    def stop(self) -> None:
+        self.reqq.put(_STOP)
+        self.thread.join()
+
+    def _main(self) -> None:
+        pending: collections.deque = collections.deque()
+        stopped = False
+        try:
+            while not (stopped and not pending):
+                while not stopped and len(pending) < self.depth:
+                    try:
+                        item = self.reqq.get(
+                            block=not pending, timeout=0.02
+                        )
+                    except queue.Empty:
+                        break
+                    if item is _STOP:
+                        stopped = True
+                        break
+                    meta, args = item
+                    # async dispatch: returns a device future immediately
+                    pending.append((meta, self.fn(*args)))
+                if pending:
+                    meta, fut = pending.popleft()
+                    # D2H copy is the only reliable sync on this platform
+                    self.results.append((meta, np.asarray(fut)))
+        except BaseException as e:  # noqa: BLE001 — surfaced by the tile
+            self.error = e
+
 
 class VerifyTile(Tile):
     schema = MetricsSchema(
-        counters=("verify_fail_txns", "dedup_drop_txns", "verified_sigs"),
+        counters=(
+            "verify_fail_txns",
+            "dedup_drop_txns",
+            "verified_sigs",
+            "device_batches",
+        ),
         hists=("lane_batch",),
     )
 
@@ -39,6 +113,7 @@ class VerifyTile(Tile):
         pre_dedup: bool = True,
         pad_full: bool = False,
         shard: tuple[int, int] | None = None,
+        async_depth: int = 3,
         name: str = "verify",
     ):
         """pad_full: always pad sub-batches to max_lanes (one compiled
@@ -49,7 +124,10 @@ class VerifyTile(Tile):
         shard=(idx, cnt): horizontal scaling — this replica only processes
         frags with seq % cnt == idx (reference: round-robin seq sharding
         across verify tiles, fd_verify.c:46); the others are skipped
-        without gathering payloads."""
+        without gathering payloads.
+
+        async_depth: device batches in flight (the wiredancer request
+        pipe depth); 1 degenerates to synchronous dispatch."""
         assert max_lanes & (max_lanes - 1) == 0, (
             "max_lanes must be a power of two (pad buckets + warm compiles "
             "assume it)"
@@ -60,8 +138,16 @@ class VerifyTile(Tile):
         self.pre_dedup = pre_dedup
         self.pad_full = pad_full
         self.shard = shard
+        self.async_depth = max(async_depth, 1)
         self._tc: R.TCache | None = None
         self._fn = None
+        self._worker: _DeviceWorker | None = None
+        #: staged host-prepared lanes not yet submitted (list of dicts)
+        self._staged: collections.deque = collections.deque()
+        self._staged_lanes = 0
+        #: results processed into publish-ready arrays, awaiting credits
+        self._outq: collections.deque = collections.deque()
+        self._outq_txns = 0
 
     def wksp_footprint(self) -> int:
         if not self.pre_dedup:
@@ -75,7 +161,11 @@ class VerifyTile(Tile):
 
         from firedancer_tpu.ops.ed25519 import verify as fver
 
-        self._fn = jax.jit(fver.verify_batch)
+        # digest-input variant: host hashes SHA512(R||A||M) during lane
+        # expansion, so each lane ships 160 device bytes (digest+sig+pub)
+        # instead of msg_width+100 — the pipeline is host->device
+        # bandwidth bound, not compute bound (PROFILE.md)
+        self._fn = jax.jit(fver.verify_batch_digest)
         if self.pre_dedup:
             depth = PRE_DEDUP_DEPTH
             map_cnt = R.TCache.map_cnt_for(depth)
@@ -89,12 +179,16 @@ class VerifyTile(Tile):
             else [1 << i for i in range((self.max_lanes).bit_length())]
         )
         for lanes in buckets:
-            self._fn(
-                np.zeros((lanes, self.msg_width), dtype=np.uint8),
-                np.zeros(lanes, np.int32),
-                np.zeros((lanes, 64), np.uint8),
-                np.zeros((lanes, 32), np.uint8),
-            ).block_until_ready()
+            np.asarray(
+                self._fn(
+                    np.zeros((lanes, 64), dtype=np.uint8),
+                    np.zeros((lanes, 64), np.uint8),
+                    np.zeros((lanes, 32), np.uint8),
+                )
+            )
+        self._worker = _DeviceWorker(self._fn, self.async_depth)
+
+    # ---- ingress: host prep + staging -----------------------------------
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
@@ -103,67 +197,193 @@ class VerifyTile(Tile):
             frags = frags[frags["seq"] % cnt == idx]
             if not len(frags):
                 return
-        rows = il.gather(frags)
-        szs = frags["sz"].astype(np.int64)
-        keep = np.ones(len(rows), dtype=bool)
-
         if self._tc is not None:
             dup = self._tc.dedup(frags["sig"])
             if dup.any():
                 ctx.metrics.inc("dedup_drop_txns", int(dup.sum()))
-                keep &= ~dup
-        if not keep.any():
+                frags = frags[~dup]
+        if not len(frags):
             return
-        rows, szs = rows[keep], szs[keep]
+        # one GIL-released native call: dcache gather + trailer parse +
+        # per-sig lane expansion + k-digests + dedup tags; the device
+        # gets digests, so the message copy is skipped outright
+        b = wire.expand_native(il.dcache, frags, self.msg_width,
+                               with_digests=True, with_msgs=False)
+        lanes = len(b["sigs"])
+        b.pop("txn_idx")
+        b["tsorigs"] = frags["tsorig"].copy()
+        self._staged.append(b)
+        self._staged_lanes += lanes
+        while self._staged_lanes >= self.max_lanes:
+            self._submit_front(self.max_lanes)
 
-        tr = wire.parse_trailers(rows, szs)
-        msgs, lens, sigs, pubs, txn_idx = wire.expand_sig_lanes(
-            rows, tr, self.msg_width
+    def in_budget(self, ctx: MuxCtx) -> int | None:
+        # stop draining the ring when the device pipe is full or results
+        # are waiting on downstream credits — backpressure flows upstream
+        # through the ring's credit model, not an unbounded host buffer
+        w = self._worker
+        if w is not None and w.reqq.full():
+            return 0
+        if self._staged_lanes >= 2 * self.max_lanes:
+            return 0
+        if self._outq_txns >= 4 * self.max_lanes:
+            return 0
+        return None
+
+    # ---- device submit ---------------------------------------------------
+
+    def _submit_front(self, lanes_cap: int) -> None:
+        """Concatenate staged chunks into one device batch of <= lanes_cap
+        lanes (whole txns only) and push it to the worker."""
+        take, lanes = [], 0
+        while self._staged:
+            chunk = self._staged[0]
+            n = len(chunk["sigs"])
+            if lanes + n > lanes_cap:
+                # split the chunk on a txn boundary
+                cnt = chunk["sig_cnt"]
+                ends = np.cumsum(cnt)
+                k = int(np.searchsorted(ends, lanes_cap - lanes, "right"))
+                if k == 0:
+                    if lanes == 0:
+                        # a single txn with more lanes than the cap: take
+                        # it alone (the kernel pads to any pow2 bucket) —
+                        # never stall with zero progress
+                        k = 1
+                    else:
+                        break
+                head, tail = _split_chunk(chunk, k, int(ends[k - 1]))
+                take.append(head)
+                lanes += int(ends[k - 1])
+                if len(tail["sigs"]):
+                    self._staged[0] = tail
+                else:
+                    self._staged.popleft()
+                break
+            take.append(self._staged.popleft())
+            lanes += n
+        if not take:
+            return
+        self._staged_lanes -= lanes
+        if len(take) == 1:
+            b = take[0]
+        else:
+            b = {
+                k: np.concatenate([c[k] for c in take])
+                for k in take[0]
+            }
+        pad = (
+            self.max_lanes
+            if self.pad_full
+            else 1 << max(lanes - 1, 0).bit_length()
         )
-        lanes = len(lens)
-        ctx.metrics.hist_sample("lane_batch", lanes)
+        meta = dict(
+            rows=b["rows"], szs=b["szs"], tsorigs=b["tsorigs"],
+            sig_cnt=b["sig_cnt"], tags=b["tags"], lanes=lanes,
+        )
+        self._worker.submit(
+            meta,
+            (
+                _pad2(b["digests"], pad),
+                _pad2(b["sigs"], pad),
+                _pad2(b["pubs"], pad),
+            ),
+        )
 
-        ok = np.empty(lanes, dtype=bool)
-        for lo in range(0, lanes, self.max_lanes):
-            hi = min(lo + self.max_lanes, lanes)
-            n = hi - lo
-            if self.pad_full:
-                pad = self.max_lanes
-            else:
-                pad = 1 << max(n - 1, 0).bit_length()  # next pow2 >= n
-            sl = slice(lo, lo + pad)
-            out = self._fn(
-                _pad2(msgs[sl], pad),
-                _pad1(lens[sl], pad),
-                _pad2(sigs[sl], pad),
-                _pad2(pubs[sl], pad),
+    # ---- egress: results -> publish --------------------------------------
+
+    def _land_results(self, ctx: MuxCtx) -> None:
+        w = self._worker
+        if w.error is not None:
+            raise w.error
+        while w.results:
+            meta, ok = w.results.popleft()
+            lanes = meta["lanes"]
+            ok = ok[:lanes]
+            ctx.metrics.inc("verified_sigs", lanes)
+            ctx.metrics.inc("device_batches")
+            ctx.metrics.hist_sample("lane_batch", lanes)
+            cnt = meta["sig_cnt"]
+            starts = np.concatenate(([0], np.cumsum(cnt)[:-1]))
+            txn_ok = (
+                np.logical_and.reduceat(ok, starts)
+                if lanes
+                else np.zeros(0, bool)
             )
-            ok[lo:hi] = np.asarray(out)[:n]
-        ctx.metrics.inc("verified_sigs", lanes)
+            n_fail = int((~txn_ok).sum())
+            if n_fail:
+                ctx.metrics.inc("verify_fail_txns", n_fail)
+            if not txn_ok.any():
+                continue
+            # dedup tag: first 8 bytes of the first signature, LE u64
+            # (reference: fd_dedup keys the tango sig field, fd_dedup.c:125)
+            # — computed by fdt_verify_expand at staging time
+            self._outq.append(
+                dict(
+                    tags=meta["tags"][txn_ok],
+                    rows=meta["rows"][txn_ok],
+                    szs=meta["szs"][txn_ok].astype(np.uint16),
+                    tsorigs=meta["tsorigs"][txn_ok],
+                )
+            )
+            self._outq_txns += int(txn_ok.sum())
 
-        # a txn passes iff every one of its signatures verifies
-        cnt = tr["sig_cnt"].astype(np.int64)
-        starts = np.concatenate(([0], np.cumsum(cnt)[:-1]))
-        txn_ok = np.logical_and.reduceat(ok, starts) if lanes else np.zeros(0, bool)
-        n_fail = int((~txn_ok).sum())
-        if n_fail:
-            ctx.metrics.inc("verify_fail_txns", n_fail)
-        if not txn_ok.any():
-            return
+    def _publish_ready(self, ctx: MuxCtx) -> None:
+        while self._outq and ctx.credits > 0:
+            b = self._outq[0]
+            n = len(b["tags"])
+            if n <= ctx.credits:
+                self._outq.popleft()
+                ctx.publish(b["tags"], b["rows"], b["szs"], tsorigs=b["tsorigs"])
+                ctx.credits -= n
+                self._outq_txns -= n
+            else:
+                m = ctx.credits
+                ctx.publish(
+                    b["tags"][:m], b["rows"][:m], b["szs"][:m],
+                    tsorigs=b["tsorigs"][:m],
+                )
+                for k in ("tags", "rows", "szs", "tsorigs"):
+                    b[k] = b[k][m:]
+                ctx.credits = 0
+                self._outq_txns -= m
 
-        # dedup tag: first 8 bytes of the first signature, LE u64
-        # (reference: fd_dedup keys the tango sig field, fd_dedup.c:125)
-        first_sig = sigs[starts]
-        tags = first_sig[:, :8].astype(np.uint64) @ (
-            np.uint64(1) << (np.uint64(8) * np.arange(8, dtype=np.uint64))
-        )
-        ctx.publish(
-            tags[txn_ok],
-            rows[txn_ok],
-            szs[txn_ok].astype(np.uint16),
-            # frags is unfiltered: apply the pre-dedup keep mask first
-            tsorigs=frags["tsorig"][keep][txn_ok],
-        )
+    def after_credit(self, ctx: MuxCtx) -> None:
+        self._land_results(ctx)
+        self._publish_ready(ctx)
+        # keep the device fed: push a partial batch when the request pipe
+        # has room and nothing fuller is coming (trickle traffic)
+        if self._staged_lanes and not self._worker.reqq.full():
+            self._submit_front(self.max_lanes)
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        # drain everything: staged -> device -> results -> downstream.
+        # consumers are still running (topology halts upstream-first,
+        # disco/topo.py halt order), so credits keep freeing.
+        while self._staged_lanes:
+            self._submit_front(self.max_lanes)
+        self._worker.stop()
+        self._land_results(ctx)
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while self._outq and _t.monotonic() < deadline:
+            cr = min(o.cr_avail() for o in ctx.outs) if ctx.outs else 0
+            if cr <= 0:
+                _t.sleep(100e-6)
+                continue
+            ctx.credits = cr
+            self._publish_ready(ctx)
+
+
+def _split_chunk(chunk: dict, k_txns: int, k_lanes: int) -> tuple[dict, dict]:
+    """Split a staged chunk after k_txns txns / k_lanes lanes."""
+    head, tail = {}, {}
+    for key in ("rows", "szs", "tsorigs", "sig_cnt", "tags"):
+        head[key], tail[key] = chunk[key][:k_txns], chunk[key][k_txns:]
+    for key in ("digests", "sigs", "pubs"):
+        head[key], tail[key] = chunk[key][:k_lanes], chunk[key][k_lanes:]
+    return head, tail
 
 
 def _pad2(a: np.ndarray, n: int) -> np.ndarray:

@@ -21,10 +21,65 @@ from __future__ import annotations
 import numpy as np
 
 from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.tango import rings as R
 
 TRAILER_SZ = 16
 #: dcache MTU for pipeline links carrying txn+trailer payloads
 LINK_MTU = T.MTU + TRAILER_SZ
+
+
+def expand_native(
+    dcache: R.DCache,
+    frags: np.ndarray,
+    msg_width: int,
+    with_digests: bool = False,
+    with_msgs: bool = True,
+) -> dict:
+    """One native call: dcache gather + trailer parse + per-sig lane
+    expansion + dedup tags + (optionally) the SHA512(R||A||M) k-digests
+    (fdt_verify_expand) — the verify tile's whole host prep, GIL-released.
+
+    with_msgs=False skips the per-lane message copy entirely (the digest
+    path never ships messages to the device).
+
+    Returns dict(rows, szs, sig_cnt, tags, sigs, pubs, txn_idx
+    [, msgs, lens][, digests]) with lane arrays truncated to the lane
+    count."""
+    chunks = np.ascontiguousarray(frags["chunk"], np.uint32)
+    szs = np.ascontiguousarray(frags["sz"], np.uint16)
+    n = len(chunks)
+    width = dcache.mtu
+    # worst-case lanes/txn: the C bounds check admits sig_cnt only while
+    # 64*cnt fits inside the payload, i.e. cnt <= (width - TRAILER_SZ)/64
+    max_lanes = n * max((width - TRAILER_SZ) // 64, 1)
+    rows = np.empty((n, width), np.uint8)
+    msgs = np.empty((max_lanes, msg_width), np.uint8) if with_msgs else None
+    lens = np.empty(max_lanes, np.int32) if with_msgs else None
+    sigs = np.empty((max_lanes, 64), np.uint8)
+    pubs = np.empty((max_lanes, 32), np.uint8)
+    txn_idx = np.empty(max_lanes, np.int32)
+    sig_cnt = np.empty(n, np.int32)
+    tags = np.empty(n, np.uint64)
+    digests = np.empty((max_lanes, 64), np.uint8) if with_digests else None
+    lanes = R._lib.fdt_verify_expand(
+        R._ptr(dcache.mem), chunks.ctypes.data, szs.ctypes.data, n, width,
+        rows.ctypes.data, msg_width,
+        msgs.ctypes.data if msgs is not None else None,
+        lens.ctypes.data if lens is not None else None,
+        sigs.ctypes.data, pubs.ctypes.data, txn_idx.ctypes.data,
+        sig_cnt.ctypes.data, tags.ctypes.data,
+        digests.ctypes.data if digests is not None else None,
+    )
+    out = dict(
+        rows=rows, szs=szs, sig_cnt=sig_cnt.astype(np.int64), tags=tags,
+        sigs=sigs[:lanes], pubs=pubs[:lanes], txn_idx=txn_idx[:lanes],
+    )
+    if msgs is not None:
+        out["msgs"] = msgs[:lanes]
+        out["lens"] = lens[:lanes]
+    if digests is not None:
+        out["digests"] = digests[:lanes]
+    return out
 
 
 def append_trailer(payload: bytes, desc: T.TxnDesc) -> bytes:
