@@ -16,6 +16,8 @@ Tests cross-check against NIST CAVP-style vectors and the system
 
 from __future__ import annotations
 
+import hmac
+
 import numpy as np
 
 # ---------------------------------------------------------------------------
@@ -210,6 +212,9 @@ class AesGcm:
         if len(ct_tag) < 16:
             return None
         ct, tag = ct_tag[:-16], ct_tag[-16:]
-        if self._tag(iv, aad, ct) != tag:
+        # constant-time compare: the attacker controls ct+tag on the QUIC
+        # packet-protection path, so a short-circuit != would leak the
+        # matching prefix length
+        if not hmac.compare_digest(self._tag(iv, aad, ct), tag):
             return None
         return self._xor_stream(iv, ct)

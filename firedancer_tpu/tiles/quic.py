@@ -76,6 +76,16 @@ class QuicIngressTile(Tile):
         if self.udp_sock:
             self.udp_sock.close()
 
+    def during_housekeeping(self, ctx: MuxCtx) -> None:
+        # loss-recovery probe timers: retransmit when acks stall
+        out_pkts = []
+        for addr, conn in list(self.server.by_addr.items()):
+            conn.on_timer()
+            for d in conn.datagrams_out():
+                out_pkts.append((d, addr))
+        if out_pkts:
+            ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
+
     def _ingest_txn(self, ctx: MuxCtx, raw: bytes, counter: str) -> None:
         desc = T.parse(raw)
         if desc is None:
@@ -106,6 +116,10 @@ class QuicIngressTile(Tile):
                 for raw in conn.txns:
                     self._ingest_txn(ctx, raw, "rx_txns_quic")
                 conn.txns.clear()
+        # stateless Retry responses (server retry mode)
+        for pkt, addr in self.server.stateless_out:
+            out_pkts.append((pkt, addr))
+        self.server.stateless_out.clear()
         if out_pkts:
             ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
         if len(self.server.conns) > n_conns:

@@ -1,4 +1,4 @@
-"""Minimal QUIC v1 (RFC 9000/9001) for the Solana TPU ingress path.
+"""Minimal QUIC v1 (RFC 9000/9001/9002) for the Solana TPU ingress path.
 
 Reference: /root/reference/src/waltz/quic/fd_quic.c — connection lifecycle,
 Initial/Handshake/1-RTT packet protection, CRYPTO-stream handshake via the
@@ -7,18 +7,30 @@ transaction (FIN marks the end), which is exactly how the Solana TPU
 protocol uses QUIC.  Independent re-implementation of that scope from the
 RFCs; packet protection uses ballet.aes, the handshake uses waltz.tls.
 
-Scope notes (documented divergences, all irrelevant to the loopback/LAN
-ingress use): no version negotiation, no Retry/anti-amplification, no loss
-recovery/retransmission (lossless-link assumption; the reference's pkt_meta
-loss tracking has no analog yet), no key update, no connection migration.
+Loss recovery (reference analog: fd_quic_pkt_meta.c ack tracking + loss
+detection): every ack-eliciting packet's retransmittable frames are kept
+in a per-level sent map; ACK frames are parsed into ranges, newly-acked
+packets feed the RFC 9002 smoothed-RTT estimator, and packets are declared
+lost by packet threshold (3) or time threshold (9/8 RTT), their frames
+re-queued for a fresh packet number.  A PTO timer (`on_timer`, sans-IO:
+the owner polls it) probes with exponential backoff when acks stop.
+Receivers track true ACK ranges so reordered/lossy arrival is acked
+faithfully.  Server-side Retry + token validation and pre-validation
+anti-amplification (3x) implement RFC 9000 section 8.
+
+Remaining scope notes: no version negotiation, no key update, no
+connection migration.
 
 Sans-IO: Connection.datagrams_out() drains UDP payloads to send; feed
-received payloads via Connection.on_datagram().
+received payloads via Connection.on_datagram(); call on_timer(now)
+periodically (or at next_timeout()).
 """
 
 from __future__ import annotations
 
+import hmac as _hmac
 import os
+import time as _time
 
 from firedancer_tpu.ballet import aes as A
 from firedancer_tpu.waltz import tls
@@ -34,6 +46,16 @@ _LEVEL_BY_PT = {_PT_INITIAL: INITIAL, _PT_HANDSHAKE: HANDSHAKE}
 _PT_BY_LEVEL = {INITIAL: _PT_INITIAL, HANDSHAKE: _PT_HANDSHAKE}
 
 MAX_DATAGRAM = 1200
+
+#: RFC 9002 constants
+K_PACKET_THRESHOLD = 3
+K_TIME_THRESHOLD = 9 / 8
+K_GRANULARITY = 1e-3
+INITIAL_RTT = 0.1  # conservative for LAN/tests; RFC suggests 0.333
+
+#: RFC 9001 section 5.8 Retry integrity key/nonce for QUIC v1
+_RETRY_KEY = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
+_RETRY_NONCE = bytes.fromhex("461599d35d632bf2239825bb")
 
 
 # ---------------------------------------------------------------------------
@@ -192,10 +214,21 @@ class Connection:
         self.keys_tx: dict[int, Keys] = {}
         self.pn_tx = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
         self.largest_rx = {INITIAL: -1, HANDSHAKE: -1, APPLICATION: -1}
-        self.rx_pns: dict[int, list[int]] = {INITIAL: [], HANDSHAKE: [], APPLICATION: []}
+        #: received pn ranges per level: sorted merged [lo, hi] pairs —
+        #: the truth the ACK frames we send are generated from
+        self.rx_ranges: dict[int, list[list[int]]] = {
+            INITIAL: [], HANDSHAKE: [], APPLICATION: [],
+        }
+        self.ack_pending = {INITIAL: False, HANDSHAKE: False, APPLICATION: False}
         self.crypto_rx = {INITIAL: CryptoStream(), HANDSHAKE: CryptoStream(), APPLICATION: CryptoStream()}
         self.crypto_tx_off = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
         self.streams: dict[int, StreamBuf] = {}
+        #: completed stream ids (bounded): a retransmitted copy of an
+        #: already-delivered stream must not re-deliver its txn
+        import collections as _c
+
+        self._done_streams: set[int] = set()
+        self._done_order: _c.deque = _c.deque()
         self.txns: list[bytes] = []  # completed stream payloads (server)
         self.established = False
         self.closed = False
@@ -203,6 +236,25 @@ class Connection:
         self._pending_frames: dict[int, list[bytes]] = {INITIAL: [], HANDSHAKE: [], APPLICATION: []}
         self._next_uni_stream = 2  # client: uni stream ids 2, 6, 10, ...
         self.peer_identity = None
+        # ---- loss recovery state (fd_quic_pkt_meta analog) ----
+        #: per level: pn -> (time_sent, retransmittable frame tuple)
+        self.sent: dict[int, dict[int, tuple[float, tuple[bytes, ...]]]] = {
+            INITIAL: {}, HANDSHAKE: {}, APPLICATION: {},
+        }
+        self.largest_acked = {INITIAL: -1, HANDSHAKE: -1, APPLICATION: -1}
+        self.srtt: float | None = None
+        self.rttvar: float | None = None
+        self.pto_count = 0
+        self.lost_packets = 0
+        self.retx_frames = 0
+        #: client: retry token to carry in Initial packets
+        self.token = b""
+        #: server address validation (RFC 9000 section 8): until the peer
+        #: proves address ownership, send at most 3x bytes received
+        self.validated = not is_server
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+        self._amp_blocked: list[bytes] = []
 
     # -- key install ---------------------------------------------------------
 
@@ -225,10 +277,18 @@ class Connection:
                 else:
                     self.keys_rx[level] = Keys(s)
                     self.keys_tx[level] = Keys(c)
+                if level == HANDSHAKE and not self.is_server:
+                    # client discards the Initial space when it first
+                    # sends at the handshake level (RFC 9002 6.4); the
+                    # server keeps it until a Handshake packet ARRIVES
+                    # (a lost ServerHello must stay retransmittable)
+                    self.sent[INITIAL].clear()
 
     # -- receive path --------------------------------------------------------
 
     def on_datagram(self, data: bytes) -> None:
+        self.bytes_rx += len(data)
+        self._release_amp_blocked()
         off = 0
         while off < len(data) and not self.closed:
             first = data[off]
@@ -250,6 +310,18 @@ class Connection:
             # Handshake flight typically share one datagram)
             self._install_from_tls()
         self._drive()
+        # a packet in this datagram may have validated the path (token or
+        # handshake receipt): release anything the 3x budget was holding
+        self._release_amp_blocked()
+
+    def _amp_ok(self, extra: int) -> bool:
+        return self.validated or self.bytes_tx + extra <= 3 * self.bytes_rx
+
+    def _release_amp_blocked(self) -> None:
+        while self._amp_blocked and self._amp_ok(len(self._amp_blocked[0])):
+            d = self._amp_blocked.pop(0)
+            self.bytes_tx += len(d)
+            self._out.append(d)
 
     def _rx_long(self, data: bytes, off: int) -> int:
         pt = (data[off] >> 4) & 3
@@ -260,11 +332,15 @@ class Connection:
         scil = data[o]
         scid = data[o + 1 : o + 1 + scil]
         o += 1 + scil
+        if pt == _PT_RETRY:
+            if not self.is_server:
+                self._on_retry(data[off:], scid)
+            return len(data) - off  # retry consumes the datagram
         if pt == _PT_INITIAL:
             tok_len, o = vi_dec(data, o)
             o += tok_len
         elif pt not in _LEVEL_BY_PT:
-            return -1  # retry/0-rtt unsupported
+            return -1  # 0-rtt unsupported
         length, o = vi_dec(data, o)
         level = _LEVEL_BY_PT[pt]
         if level == INITIAL and INITIAL not in self.keys_rx:
@@ -305,11 +381,39 @@ class Connection:
         )
         if payload is None:
             return
+        if level == HANDSHAKE and self.is_server:
+            # a decryptable Handshake packet proves the peer owns the
+            # address (RFC 9000 8.1) and closes the Initial space (9002 6.4)
+            self.validated = True
+            self.sent[INITIAL].clear()
+        if level == APPLICATION:
+            self.sent[HANDSHAKE].clear()
         self.largest_rx[level] = max(self.largest_rx[level], pn)
+        self._range_add(level, pn)
         if self._on_frames(level, payload):
-            # only ack-eliciting packets are queued for acknowledgement
+            # only ack-eliciting packets trigger sending an ACK
             # (acking pure-ACK packets would ping-pong forever)
-            self.rx_pns[level].append(pn)
+            self.ack_pending[level] = True
+
+    def _range_add(self, level: int, pn: int) -> None:
+        """Insert pn into the level's merged [lo, hi] range list."""
+        rs = self.rx_ranges[level]
+        for r in rs:
+            if r[0] - 1 <= pn <= r[1] + 1:
+                r[0] = min(r[0], pn)
+                r[1] = max(r[1], pn)
+                break
+        else:
+            rs.append([pn, pn])
+        rs.sort()
+        # merge neighbors and cap the list (oldest ranges drop first)
+        merged = [rs[0]]
+        for r in rs[1:]:
+            if r[0] <= merged[-1][1] + 1:
+                merged[-1][1] = max(merged[-1][1], r[1])
+            else:
+                merged.append(r)
+        self.rx_ranges[level] = merged[-32:]
 
     def _on_frames(self, level: int, payload: bytes) -> bool:
         """Process frames; returns True if any frame was ack-eliciting."""
@@ -326,16 +430,23 @@ class Connection:
                 off += 1
             elif ft in (0x02, 0x03):  # ACK
                 off += 1
-                _, off = vi_dec(payload, off)  # largest
+                largest, off = vi_dec(payload, off)
                 _, off = vi_dec(payload, off)  # delay
                 cnt, off = vi_dec(payload, off)
-                _, off = vi_dec(payload, off)  # first range
+                first, off = vi_dec(payload, off)
+                hi = largest
+                ranges = [(hi - first, hi)]
+                lo = hi - first
                 for _ in range(cnt):
-                    _, off = vi_dec(payload, off)
-                    _, off = vi_dec(payload, off)
+                    gap, off = vi_dec(payload, off)
+                    rlen, off = vi_dec(payload, off)
+                    hi = lo - gap - 2
+                    lo = hi - rlen
+                    ranges.append((lo, hi))
                 if ft == 0x03:
                     for _ in range(3):
                         _, off = vi_dec(payload, off)
+                self._on_ack(level, ranges)
             elif ft == 0x06:  # CRYPTO
                 off += 1
                 coff, off = vi_dec(payload, off)
@@ -362,11 +473,17 @@ class Connection:
                     slen = n - off
                 data = payload[off : off + slen]
                 off += slen
+                if sid in self._done_streams:
+                    continue  # duplicate of a delivered stream
                 buf = self.streams.setdefault(sid, StreamBuf())
                 done = buf.insert(soff, data, fin)
                 if done is not None:
                     self.txns.append(done)
                     del self.streams[sid]
+                    self._done_streams.add(sid)
+                    self._done_order.append(sid)
+                    if len(self._done_order) > 4096:
+                        self._done_streams.discard(self._done_order.popleft())
             elif ft in (0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17):
                 off += 1  # flow-control / blocked frames: type + varints
                 nargs = {0x11: 2, 0x15: 2}.get(ft, 1)
@@ -388,6 +505,141 @@ class Connection:
                 self.closed = True  # unknown frame: fatal per RFC
                 return eliciting
         return eliciting
+
+    # -- loss recovery (RFC 9002; reference: fd_quic_pkt_meta.c) -------------
+
+    def _on_ack(self, level: int, ranges: list[tuple[int, int]]) -> None:
+        now = _time.monotonic()
+        if ranges[0][1] >= self.pn_tx[level]:
+            # ack for a packet number never sent: a bogus largest would
+            # poison largest_acked and storm-retransmit (RFC 9002 rule)
+            self.closed = True
+            return
+        sent = self.sent[level]
+        newly = []
+        for lo, hi in ranges:
+            for pn in list(sent):
+                if lo <= pn <= hi:
+                    newly.append(pn)
+        if not newly:
+            # still update largest_acked for loss threshold purposes
+            self.largest_acked[level] = max(
+                self.largest_acked[level], ranges[0][1]
+            )
+            self._detect_losses(level, now)
+            return
+        largest_newly = max(newly)
+        if largest_newly == ranges[0][1]:
+            # RTT sample from the largest acked when it is newly acked
+            sample = max(now - sent[largest_newly][0], K_GRANULARITY)
+            if self.srtt is None:
+                self.srtt = sample
+                self.rttvar = sample / 2
+            else:
+                self.rttvar = 0.75 * self.rttvar + 0.25 * abs(
+                    self.srtt - sample
+                )
+                self.srtt = 0.875 * self.srtt + 0.125 * sample
+        for pn in newly:
+            del sent[pn]
+        self.largest_acked[level] = max(self.largest_acked[level], ranges[0][1])
+        self.pto_count = 0
+        self._detect_losses(level, now)
+
+    def _detect_losses(self, level: int, now: float) -> None:
+        """Packet-threshold + time-threshold loss declaration; lost
+        packets' frames re-enter the pending queue for a new packet."""
+        sent = self.sent[level]
+        la = self.largest_acked[level]
+        if la < 0:
+            return
+        loss_delay = K_TIME_THRESHOLD * (self.srtt or INITIAL_RTT)
+        lost = [
+            pn
+            for pn, (t, _f) in sent.items()
+            if pn < la
+            and (la - pn >= K_PACKET_THRESHOLD or t <= now - loss_delay)
+        ]
+        for pn in lost:
+            _t, frames = sent.pop(pn)
+            self.lost_packets += 1
+            self.retx_frames += len(frames)
+            self._pending_frames[level].extend(frames)
+        if lost:
+            self._flush()
+
+    def _pto_interval(self) -> float:
+        base = (self.srtt or INITIAL_RTT) + max(
+            4 * (self.rttvar or INITIAL_RTT / 2), K_GRANULARITY
+        )
+        return base * (1 << min(self.pto_count, 6))
+
+    def on_timer(self, now: float | None = None) -> None:
+        """Probe-timeout check: when the oldest unacked packet has waited
+        a full PTO, its frames are retransmitted with exponential backoff.
+        Owners call this periodically (sans-IO)."""
+        if self.closed:
+            return
+        if self._amp_blocked:
+            # packets held by the 3x pre-validation budget were never on
+            # the wire; "retransmitting" them would only duplicate state
+            return
+        now = _time.monotonic() if now is None else now
+        pto = self._pto_interval()
+        fired = False
+        for level in (INITIAL, HANDSHAKE, APPLICATION):
+            sent = self.sent[level]
+            if not sent:
+                continue
+            oldest = min(sent, key=lambda p: sent[p][0])
+            t, frames = sent[oldest]
+            if now - t >= pto:
+                del sent[oldest]
+                self.retx_frames += len(frames)
+                self._pending_frames[level].extend(
+                    frames if frames else [b"\x01"]  # bare probe: PING
+                )
+                fired = True
+        if fired:
+            self.pto_count += 1
+            self._flush()
+
+    def next_timeout(self, now: float | None = None) -> float | None:
+        """Seconds until the earliest PTO expiry, or None when idle."""
+        now = _time.monotonic() if now is None else now
+        pto = self._pto_interval()
+        nxt = None
+        for level in (INITIAL, HANDSHAKE, APPLICATION):
+            for t, _f in self.sent[level].values():
+                d = t + pto - now
+                nxt = d if nxt is None else min(nxt, d)
+        return nxt
+
+    def _on_retry(self, pkt: bytes, retry_scid: bytes) -> None:
+        """Client side of Retry: verify the integrity tag, adopt the
+        server's new CID, stash the token, and replay the Initial flight
+        under re-derived keys (RFC 9001 section 5.8 / RFC 9000 17.2.5)."""
+        if self.token or HANDSHAKE in self.keys_rx:
+            return  # at most one retry, and only before the handshake
+        if len(pkt) < 16:
+            return
+        tag = pkt[-16:]
+        token = pkt[:-16][5 + 1 + len(self.scid) + 1 + len(retry_scid) :]
+        # integrity check: AEAD over pseudo-packet (odcid prefixed)
+        pseudo = bytes([len(self.dcid)]) + self.dcid + pkt[:-16]
+        want = A.AesGcm(_RETRY_KEY).encrypt(_RETRY_NONCE, b"", pseudo)
+        if not _hmac.compare_digest(want[-16:], tag):
+            return
+        self.token = token
+        # replay the Initial flight: unacked frames go back to pending
+        frames = []
+        for pn in sorted(self.sent[INITIAL]):
+            frames.extend(self.sent[INITIAL][pn][1])
+        self.sent[INITIAL].clear()
+        self.dcid = retry_scid
+        self._install_initial(retry_scid)
+        self._pending_frames[INITIAL] = frames + self._pending_frames[INITIAL]
+        self._flush()
 
     # -- send path -----------------------------------------------------------
 
@@ -414,32 +666,74 @@ class Connection:
             self.peer_identity = self.tls.peer_identity
             self._pending_frames[APPLICATION].append(b"\x1e")  # HANDSHAKE_DONE
             self.established = True
-        # ACK every level with new packets
+        # ACK every level with new ack-eliciting packets, with true ranges
         for level in (INITIAL, HANDSHAKE, APPLICATION):
-            if self.rx_pns[level] and level in self.keys_tx:
-                largest = self.largest_rx[level]
-                ack = b"\x02" + vi_enc(largest) + vi_enc(0) + vi_enc(0) + vi_enc(0)
-                self._pending_frames[level].append(ack)
-                self.rx_pns[level] = []
+            if self.ack_pending[level] and level in self.keys_tx:
+                ack = self._ack_frame(level)
+                if ack:
+                    self._pending_frames[level].append(ack)
+                self.ack_pending[level] = False
         self._flush()
 
+    def _ack_frame(self, level: int) -> bytes:
+        """Encode the level's received ranges as one ACK frame."""
+        rs = self.rx_ranges[level]
+        if not rs:
+            return b""
+        rs = rs[::-1]  # largest first
+        lo, hi = rs[0]
+        out = b"\x02" + vi_enc(hi) + vi_enc(0) + vi_enc(len(rs) - 1)
+        out += vi_enc(hi - lo)
+        prev_lo = lo
+        for nlo, nhi in rs[1:]:
+            out += vi_enc(prev_lo - nhi - 2) + vi_enc(nhi - nlo)
+            prev_lo = nlo
+        return out
+
     def _flush(self) -> None:
-        """Coalesce pending frames into protected packets/datagrams."""
+        """Coalesce pending frames into protected packets/datagrams.
+
+        Each ack-eliciting packet's retransmittable frames are recorded in
+        the sent map for loss recovery (pkt_meta registration)."""
+        now = _time.monotonic()
         datagram = b""
         for level in (INITIAL, HANDSHAKE, APPLICATION):
             frames = self._pending_frames[level]
             if not frames or level not in self.keys_tx:
                 continue
             self._pending_frames[level] = []
-            payload = b"".join(frames)
-            pkt = self._build_packet(level, payload)
-            if len(datagram) + len(pkt) > MAX_DATAGRAM:
-                if datagram:
-                    self._out.append(self._pad_if_initial(datagram))
-                datagram = b""
-            datagram += pkt
+            # split oversized frame runs across packets
+            while frames:
+                take, sz = [], 0
+                while frames and sz + len(frames[0]) <= MAX_DATAGRAM - 64:
+                    take.append(frames.pop(0))
+                    sz += len(take[-1])
+                if not take:  # single oversized frame: send alone
+                    take.append(frames.pop(0))
+                payload = b"".join(take)
+                retrans = tuple(
+                    f for f in take if f[0] not in (0x00, 0x02, 0x03)
+                )
+                pkt, pn = self._build_packet(level, payload)
+                if retrans:
+                    self.sent[level][pn] = (now, retrans)
+                if len(datagram) + len(pkt) > MAX_DATAGRAM:
+                    if datagram:
+                        self._emit_datagram(datagram)
+                    datagram = b""
+                datagram += pkt
         if datagram:
-            self._out.append(self._pad_if_initial(datagram))
+            self._emit_datagram(datagram)
+
+    def _emit_datagram(self, dgram: bytes) -> None:
+        dgram = self._pad_if_initial(dgram)
+        if not self._amp_ok(len(dgram)):
+            # pre-validation 3x budget exhausted: hold until more bytes
+            # arrive from the (unvalidated) peer
+            self._amp_blocked.append(dgram)
+            return
+        self.bytes_tx += len(dgram)
+        self._out.append(dgram)
 
     def _pad_if_initial(self, dgram: bytes) -> bytes:
         # datagrams containing Initial packets must be >= 1200 bytes
@@ -447,7 +741,7 @@ class Connection:
             return dgram + b"\0" * (MAX_DATAGRAM - len(dgram))
         return dgram
 
-    def _build_packet(self, level: int, payload: bytes) -> bytes:
+    def _build_packet(self, level: int, payload: bytes) -> tuple[bytes, int]:
         keys = self.keys_tx[level]
         pn = self.pn_tx[level]
         self.pn_tx[level] += 1
@@ -462,6 +756,7 @@ class Connection:
         else:
             first = 0xC0 | (_PT_BY_LEVEL[level] << 4) | (pn_len - 1)
             length = len(payload) + 16 + pn_len
+            token = self.token if not self.is_server else b""
             header = (
                 bytes([first])
                 + VERSION.to_bytes(4, "big")
@@ -469,7 +764,7 @@ class Connection:
                 + self.dcid
                 + bytes([len(self.scid)])
                 + self.scid
-                + (vi_enc(0) if level == INITIAL else b"")
+                + (vi_enc(len(token)) + token if level == INITIAL else b"")
                 + vi_enc(length)
                 + pn_bytes
             )
@@ -483,7 +778,7 @@ class Connection:
             pkt[0] ^= mask[0] & 0x1F
         for i in range(pn_len):
             pkt[pn_off + i] ^= mask[1 + i]
-        return bytes(pkt)
+        return bytes(pkt), pn
 
     def datagrams_out(self) -> list[bytes]:
         out, self._out = self._out, []
@@ -523,35 +818,157 @@ class QuicServer:
     """Multi-connection QUIC server endpoint (sans-IO; sockets live in the
     net tile)."""
 
-    def __init__(self, identity_secret: bytes):
+    #: cap on live connections — a new-source flood beyond this is refused
+    #: rather than allocating a TlsServer + x509 cert per datagram
+    MAX_CONNS = 4096
+
+    def __init__(
+        self,
+        identity_secret: bytes,
+        max_conns: int = MAX_CONNS,
+        retry: bool = False,
+    ):
+        """retry=True: stateless Retry with address-validating tokens —
+        no connection state (TLS engine, certs) is allocated until the
+        client echoes a valid token (RFC 9000 section 8.1.2)."""
+        from firedancer_tpu.tango.lru import Lru
+
         self.identity_secret = identity_secret
+        self.max_conns = max_conns
+        self.retry = retry
+        self.token_secret = os.urandom(32)
         self.conns: dict[bytes, Connection] = {}  # by our scid
         self.by_addr: dict = {}
+        #: recency over addrs: at capacity the least-recently-active
+        #: connection is evicted (reference: tango/lru under fd_quic)
+        self.lru = Lru(max_conns)
+        #: stateless packets to send: (datagram, addr) — Retry responses
+        self.stateless_out: list[tuple[bytes, object]] = []
+
+    def _reap(self, addr, conn) -> None:
+        self.conns.pop(conn.scid, None)
+        self.by_addr.pop(addr, None)
+        self.lru.remove(addr)
+
+    @staticmethod
+    def _addr_bytes(addr) -> bytes:
+        return repr(addr).encode()
+
+    def _retry_packet(self, client_scid: bytes, odcid: bytes, addr) -> bytes:
+        retry_scid = os.urandom(8)
+        mac = _hmac.new(
+            self.token_secret,
+            self._addr_bytes(addr) + odcid + retry_scid,
+            "sha256",
+        ).digest()[:16]
+        token = bytes([len(odcid)]) + odcid + retry_scid + mac
+        hdr = (
+            bytes([0xF0])
+            + VERSION.to_bytes(4, "big")
+            + bytes([len(client_scid)])
+            + client_scid
+            + bytes([len(retry_scid)])
+            + retry_scid
+            + token
+        )
+        pseudo = bytes([len(odcid)]) + odcid + hdr
+        tag = A.AesGcm(_RETRY_KEY).encrypt(_RETRY_NONCE, b"", pseudo)[-16:]
+        return hdr + tag
+
+    def _check_token(self, token: bytes, addr) -> tuple[bytes, bytes] | None:
+        """Valid token -> (odcid, retry_scid); else None."""
+        if len(token) < 1 + 8 + 16:
+            return None
+        ol = token[0]
+        if len(token) != 1 + ol + 8 + 16:
+            return None
+        odcid = token[1 : 1 + ol]
+        retry_scid = token[1 + ol : 1 + ol + 8]
+        mac = token[1 + ol + 8 :]
+        want = _hmac.new(
+            self.token_secret,
+            self._addr_bytes(addr) + odcid + retry_scid,
+            "sha256",
+        ).digest()[:16]
+        return (odcid, retry_scid) if _hmac.compare_digest(mac, want) else None
 
     def on_datagram(self, data: bytes, addr) -> Connection | None:
         conn = self.by_addr.get(addr)
+        if conn is not None and conn.closed:
+            self._reap(addr, conn)
+            conn = None
         if conn is None:
             if len(data) < 7 or not (data[0] & 0x80):
                 return None  # short header / runt for unknown conn
+            if ((data[0] >> 4) & 3) != _PT_INITIAL:
+                return None  # only an Initial may open a connection
             if 6 + data[5] + 1 > len(data):
                 return None  # malformed CID lengths
-            scid = os.urandom(8)
-            tp = (
-                vi_enc(0x00) + vi_enc(len(data[6 : 6 + data[5]]))
-                + data[6 : 6 + data[5]]  # original_destination_connection_id
-                + vi_enc(0x0F) + vi_enc(len(scid)) + scid
-                + _TP_DEFAULT
-            )
-            engine = tls.TlsServer(self.identity_secret, transport_params=tp)
-            # client's SCID becomes our DCID
+            if len(self.conns) >= self.max_conns:
+                # sweep closed conns, then evict the least-recently-active
+                for a, c in list(self.by_addr.items()):
+                    if c.closed:
+                        self._reap(a, c)
+                if len(self.conns) >= self.max_conns:
+                    # evict the LRU conn, preferring one that never
+                    # finished its handshake (a handshake flood must not
+                    # push out established peers)
+                    victim = None
+                    for a in self.lru.iter_lru():
+                        c = self.by_addr.get(a)
+                        if c is not None and not c.established:
+                            victim = a
+                            break
+                    victim = victim if victim is not None else self.lru.lru_key()
+                    if victim is None:
+                        return None
+                    self._reap(victim, self.by_addr[victim])
             dcil = data[5]
+            dcid = data[6 : 6 + dcil]
             o = 6 + dcil
             scil = data[o]
             client_scid = data[o + 1 : o + 1 + scil]
+            o += 1 + scil
+            validated = False
+            odcid = dcid
+            if self.retry:
+                try:
+                    tok_len, to = vi_dec(data, o)
+                    token = data[to : to + tok_len]
+                except (IndexError, ValueError):
+                    return None
+                if not token:
+                    self.stateless_out.append(
+                        (self._retry_packet(client_scid, dcid, addr), addr)
+                    )
+                    return None
+                hit = self._check_token(token, addr)
+                if hit is None:
+                    return None  # forged/stale token: drop silently
+                odcid, retry_scid = hit
+                if retry_scid != dcid:
+                    return None  # client must address us by the retry cid
+                validated = True
+            scid = dcid if (self.retry and validated) else os.urandom(8)
+            tp = (
+                vi_enc(0x00) + vi_enc(len(odcid)) + odcid
+                + vi_enc(0x0F) + vi_enc(len(scid)) + scid
+                + (
+                    vi_enc(0x10) + vi_enc(len(scid)) + scid
+                    if validated
+                    else b""
+                )  # retry_source_connection_id
+                + _TP_DEFAULT
+            )
+            engine = tls.TlsServer(self.identity_secret, transport_params=tp)
             conn = Connection(True, engine, scid, client_scid)
+            conn.validated = conn.validated or validated
             self.conns[scid] = conn
             self.by_addr[addr] = conn
+        self.lru.acquire(addr)
         conn.on_datagram(data)
+        if conn.closed:
+            self._reap(addr, conn)
         return conn
 
 

@@ -217,6 +217,10 @@ class TlsServer(_Engine):
         eph = os.urandom(32)
         my_pub = X.public_key(eph)
         shared = X.x25519(eph, peer_pub)
+        if shared == b"\x00" * 32:
+            # RFC 8446 7.4.2: abort on all-zero X25519 output (low-order
+            # peer share would force a predictable handshake key)
+            self._fail("bad key share")
 
         sh_exts = _ext(EXT_SUPPORTED_VERSIONS, (0x0304).to_bytes(2, "big"))
         sh_exts += _ext(
@@ -393,6 +397,9 @@ class TlsClient(_Engine):
         klen = int.from_bytes(ks[2:4], "big")
         server_pub = ks[4 : 4 + klen]
         shared = X.x25519(self._eph, server_pub)
+        if shared == b"\x00" * 32:
+            # RFC 8446 7.4.2 contributory-behavior check (see server side)
+            self._fail("bad key share")
         self.transcript += raw
 
         early = hkdf_extract(b"", b"\0" * _HASH_LEN)
