@@ -1,0 +1,193 @@
+"""Shred tile: PoH entries → entry batches → FEC sets → signed shreds.
+
+Reference model: src/app/fdctl/run/tiles/fd_shred.c (the 847-LoC tile
+whose header essay describes its flow control) — while leader, it turns
+the PoH tile's entry stream into entry batches, shreds each batch
+(disco/shredder), has the keyguard sign every FEC set's merkle root, and
+emits the signed shreds toward the network (turbine) and the store tile.
+
+Differences from the reference, by design:
+  * signing is ASYNCHRONOUS over the keyguard rings: a FEC set parks in
+    a pending map keyed by a request tag while its root is at the sign
+    tile; the shred tile keeps draining entries meanwhile (the reference
+    spins in fd_keyguard_client_sign).
+  * turbine destinations are computed per shred (disco/shred_dest
+    stake-weighted shuffle) and the chosen root is recorded in metrics;
+    the UDP egress rides the net tile when one is attached.
+
+Ring layout: ins[0] = poh entries; ins[1] (optional) = sign responses.
+outs[0] = shreds (one frag per shred, payload = raw wire bytes,
+sig = slot<<32 | code_bit<<31 | shred idx); outs[1] (optional) = sign
+requests (32-byte merkle roots, sig = request tag).
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from firedancer_tpu.ballet import shred as SH
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.disco.shredder import EntryBatchMeta, Shredder
+from firedancer_tpu.tiles.poh import SLOT_BOUNDARY_TAG
+
+
+def shred_tag(slot: int, idx: int, is_code: bool) -> int:
+    """Frag sig for a shred: slot<<32 | code_bit<<31 | idx."""
+    return (slot << 32) | (int(is_code) << 31) | idx
+
+
+class ShredTile(Tile):
+    schema = MetricsSchema(
+        counters=(
+            "batches",
+            "fec_sets",
+            "data_shreds",
+            "parity_shreds",
+            "sign_requests",
+            "sign_responses",
+            "turbine_dests",
+        ),
+    )
+
+    def __init__(
+        self,
+        *,
+        shred_version: int = 1,
+        signer=None,
+        shred_dest=None,
+        identity: bytes | None = None,
+        name: str = "shred",
+    ):
+        """signer(root)->sig for local signing; None uses the keyguard
+        rings (ins[1]/outs[1] must exist).  shred_dest: a
+        disco.shred_dest.ShredDest for turbine fanout queries; identity:
+        our pubkey (the turbine tree is leader-rooted, and while leader we
+        transmit to the shuffle root)."""
+        self.name = name
+        self.shred_version = shred_version
+        self.signer = signer
+        self.shred_dest = shred_dest
+        self.identity = identity
+        self._shredder = Shredder(shred_version, signer=lambda root: b"\0" * 64)
+        self._slot: int | None = None
+        self._batch = bytearray()
+        #: FEC sets waiting for their root signature: tag -> (slot, FecSet)
+        self._pending: dict[int, tuple[int, object]] = {}
+        self._next_tag = 1
+        #: signed shreds waiting for downstream credits
+        self._outq: collections.deque = collections.deque()
+
+    # ---- ingress ---------------------------------------------------------
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        if in_idx == 1:
+            self._on_sign_responses(ctx, frags)
+            return
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            tag = int(frags["sig"][i])
+            if tag & SLOT_BOUNDARY_TAG:
+                new_slot = tag & 0xFFFFFFFF
+                self._finish_slot(ctx, block_complete=True)
+                self._slot = new_slot
+                continue
+            if self._slot is None:
+                self._slot = 0
+            self._batch += rows[i, : frags["sz"][i]].tobytes()
+
+    def _finish_slot(self, ctx: MuxCtx, *, block_complete: bool) -> None:
+        if self._slot is None or not self._batch:
+            return
+        self._shredder.start_slot(self._slot)
+        meta = EntryBatchMeta(block_complete=block_complete)
+        sets = self._shredder.shred_batch(bytes(self._batch), meta)
+        self._batch.clear()
+        ctx.metrics.inc("batches")
+        for fec in sets:
+            ctx.metrics.inc("fec_sets")
+            ctx.metrics.inc("data_shreds", len(fec.data_shreds))
+            ctx.metrics.inc("parity_shreds", len(fec.parity_shreds))
+            if self.signer is not None:
+                self._release(ctx, self._slot, fec,
+                              self.signer(fec.merkle_root))
+            else:
+                tag = self._next_tag
+                self._next_tag += 1
+                self._pending[tag] = (self._slot, fec)
+                root = np.frombuffer(fec.merkle_root, np.uint8)
+                ctx.outs[1].publish(
+                    np.array([tag], np.uint64), root[None, :],
+                    np.array([len(root)], np.uint16),
+                )
+                ctx.metrics.inc("sign_requests")
+
+    # ---- keyguard responses ----------------------------------------------
+
+    def _on_sign_responses(self, ctx: MuxCtx, frags: np.ndarray) -> None:
+        il = ctx.ins[1]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            tag = int(frags["sig"][i])
+            entry = self._pending.pop(tag, None)
+            if entry is None:
+                continue
+            slot, fec = entry
+            sig = rows[i, :64].tobytes()
+            ctx.metrics.inc("sign_responses")
+            self._release(ctx, slot, fec, sig)
+
+    def _release(self, ctx: MuxCtx, slot: int, fec, sig: bytes) -> None:
+        """Patch the signature into every shred of the set and queue the
+        shreds for publication (the proof region never covers the
+        signature, so late patching is sound)."""
+        fec.signature = sig
+        for raw in fec.data_shreds + fec.parity_shreds:
+            patched = sig + raw[64:]
+            s = SH.parse(patched)
+            assert s is not None
+            self._outq.append((slot, s.idx, not s.is_data, patched))
+            if self.shred_dest is not None and self.identity is not None:
+                order = self.shred_dest.shuffle(
+                    slot, s.idx, 0 if s.is_data else 1, self.identity
+                )
+                if order:
+                    ctx.metrics.inc("turbine_dests")
+
+    # ---- egress ----------------------------------------------------------
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        while self._outq and ctx.credits > 0:
+            n = min(len(self._outq), ctx.credits)
+            items = [self._outq.popleft() for _ in range(n)]
+            w = max(len(it[3]) for it in items)
+            rows = np.zeros((n, w), np.uint8)
+            szs = np.zeros(n, np.uint16)
+            tags = np.zeros(n, np.uint64)
+            for i, (slot, idx, is_code, raw) in enumerate(items):
+                rows[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+                szs[i] = len(raw)
+                tags[i] = shred_tag(slot, idx, is_code)
+            ctx.outs[0].publish(tags, rows, szs)
+            ctx.credits -= n
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        # flush the final partial slot so short-lived test topologies
+        # don't lose the tail batch, then drain straggler sign responses
+        # and queued shreds while downstream credits free up
+        self._finish_slot(ctx, block_complete=False)
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while (self._outq or self._pending) and _t.monotonic() < deadline:
+            if len(ctx.ins) > 1 and self._pending:
+                il = ctx.ins[1]
+                frags, il.seq, _ = il.mcache.drain(il.seq, 256)
+                if len(frags):
+                    self._on_sign_responses(ctx, frags)
+            ctx.credits = ctx.outs[0].cr_avail()
+            self.after_credit(ctx)
+            _t.sleep(100e-6)

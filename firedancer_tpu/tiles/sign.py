@@ -38,7 +38,8 @@ def payload_allowed(role: int, payload: bytes) -> bool:
     if T.parse(payload) is not None or T.parse(payload[1:]) is not None:
         return False
     if role == ROLE_SHRED:
-        return len(payload) == 32
+        # merkle roots: 20-byte bmtree shred nodes or 32-byte wide nodes
+        return len(payload) in (20, 32)
     if role == ROLE_TLS_CV:
         return payload.startswith(_CV_PREFIX) and len(payload) == len(
             _CV_PREFIX

@@ -1,0 +1,170 @@
+"""Store tile: persist shreds and reassembled blocks.
+
+Reference model: src/app/fdctl/run/tiles/fd_store.c:149 — the reference
+hands completed shred sets to the Agave blockstore over FFI
+(fd_ext_blockstore_insert_shreds); this build persists NATIVELY: a
+Blockstore directory holds per-slot shred logs (length-prefixed raw wire
+bytes, append-only) and, once the slot's FEC sets all complete through a
+fec_resolver, the reassembled entry-batch payload as the block file.
+
+The store is also the read side for replay/repair: `shreds(slot)` and
+`block(slot)` recover everything written.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import shred as SH
+from firedancer_tpu.disco.fec_resolver import FecResolver
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+
+class Blockstore:
+    """Directory-backed shred + block persistence.
+
+    Layout: <dir>/slot_<n>.shreds — concatenated (u16 len | raw bytes)
+    records; <dir>/slot_<n>.block — the reassembled entry-batch payload,
+    written once the slot completes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._logs: dict[int, object] = {}
+
+    def append_shred(self, slot: int, raw: bytes) -> None:
+        f = self._logs.get(slot)
+        if f is None:
+            f = self._logs[slot] = open(
+                os.path.join(self.path, f"slot_{slot}.shreds"), "ab"
+            )
+        f.write(struct.pack("<H", len(raw)) + raw)
+
+    def write_block(self, slot: int, payload: bytes) -> None:
+        with open(os.path.join(self.path, f"slot_{slot}.block"), "wb") as f:
+            f.write(payload)
+
+    def shreds(self, slot: int) -> list[bytes]:
+        p = os.path.join(self.path, f"slot_{slot}.shreds")
+        if not os.path.exists(p):
+            return []
+        self.flush()
+        out = []
+        with open(p, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + 2 <= len(data):
+            (n,) = struct.unpack_from("<H", data, off)
+            off += 2
+            out.append(data[off : off + n])
+            off += n
+        return out
+
+    def block(self, slot: int) -> bytes | None:
+        p = os.path.join(self.path, f"slot_{slot}.block")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def slots(self) -> list[int]:
+        out = set()
+        for name in os.listdir(self.path):
+            if name.startswith("slot_"):
+                out.add(int(name.split("_")[1].split(".")[0]))
+        return sorted(out)
+
+    def flush(self) -> None:
+        for f in self._logs.values():
+            f.flush()
+
+    def close(self) -> None:
+        for f in self._logs.values():
+            f.close()
+        self._logs.clear()
+
+
+class StoreTile(Tile):
+    """ins[0] = shred ring (from the shred tile or net ingress)."""
+
+    schema = MetricsSchema(
+        counters=(
+            "stored_shreds",
+            "completed_sets",
+            "completed_slots",
+            "recovered_shreds",
+            "rejected_shreds",
+        ),
+    )
+
+    def __init__(self, path: str, *, verify_sig=None, name: str = "store"):
+        self.name = name
+        self.store = Blockstore(path)
+        self._resolver = FecResolver(verify_sig=verify_sig)
+        #: per-slot completed set payloads: slot -> {fec_set_idx: payload}
+        self._sets: dict[int, dict[int, bytes]] = {}
+        #: slots whose SLOT_COMPLETE set has landed: slot -> last set idx
+        self._complete_at: dict[int, int] = {}
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        for i in range(len(rows)):
+            raw = rows[i, : frags["sz"][i]].tobytes()
+            s = SH.parse(raw)
+            if s is None:
+                ctx.metrics.inc("rejected_shreds")
+                continue
+            self.store.append_shred(s.slot, raw)
+            ctx.metrics.inc("stored_shreds")
+            res = self._resolver.add_shred(raw)
+            rej = self._resolver.rejected
+            if rej:
+                ctx.metrics.inc("rejected_shreds", rej)
+                self._resolver.rejected = 0
+            if res is None:
+                continue
+            ctx.metrics.inc("completed_sets")
+            if res.recovered_cnt:
+                ctx.metrics.inc("recovered_shreds", res.recovered_cnt)
+            # record (payload, span): fec_set_idx is the set's first data
+            # shred index and the span is its data shred count, so slot
+            # completion is a contiguity walk over [idx, idx+span) ranges
+            self._sets.setdefault(res.slot, {})[res.fec_set_idx] = (
+                res.payload, len(res.data_shreds),
+            )
+            last = SH.parse(res.data_shreds[-1])
+            if last is not None and last.flags is not None and (
+                last.flags & SH.FLAG_SLOT_COMPLETE
+            ):
+                self._complete_at[res.slot] = res.fec_set_idx
+            self._try_finish_slot(ctx, res.slot)
+
+    def _try_finish_slot(self, ctx: MuxCtx, slot: int) -> None:
+        """A slot is done when its SLOT_COMPLETE set and every set below
+        it have completed: walk the contiguous set chain from index 0."""
+        end = self._complete_at.get(slot)
+        if end is None:
+            return
+        sets = self._sets.get(slot, {})
+        payload = bytearray()
+        cur = 0
+        while cur in sets:
+            chunk, span = sets[cur]
+            payload += chunk
+            if cur == end:
+                self.store.write_block(slot, bytes(payload))
+                self.store.flush()
+                ctx.metrics.inc("completed_slots")
+                del self._sets[slot]
+                del self._complete_at[slot]
+                return
+            cur += span
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        self.store.flush()
+        self.store.close()
