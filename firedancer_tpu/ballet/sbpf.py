@@ -32,6 +32,7 @@ class Program:
     text: bytes  # instruction stream (multiple of 8)
     entry_pc: int  # starting instruction index
     rodata: bytes  # full loadable image mapped at MM_PROGRAM
+    text_addr: int = 0  # image offset of text[0] (callx target translation)
     syscalls: dict[int, str] = field(default_factory=dict)
 
 
@@ -114,6 +115,7 @@ def _load(elf: bytes) -> Program:
         text=bytes(text),
         entry_pc=(e_entry - text_addr) // 8,
         rodata=bytes(img),
+        text_addr=text_addr,
     )
 
 

@@ -138,10 +138,13 @@ class TxnDesc:
         return payload[o : o + 32]
 
     def is_writable(self, j: int) -> bool:
-        """Writability of static account index j (ALT accounts excluded)."""
+        """Writability of combined account index j: signer section, static
+        unsigned section, then ALT lookups (writable section first)."""
         if j < self.signature_cnt:
             return j < self.signature_cnt - self.readonly_signed_cnt
-        return j < self.acct_addr_cnt - self.readonly_unsigned_cnt
+        if j < self.acct_addr_cnt:
+            return j < self.acct_addr_cnt - self.readonly_unsigned_cnt
+        return j < self.acct_addr_cnt + self.addr_table_adtl_writable_cnt
 
     def writable_idxs(self) -> List[int]:
         return [j for j in range(self.acct_addr_cnt) if self.is_writable(j)]

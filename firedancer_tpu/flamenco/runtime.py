@@ -24,13 +24,32 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import struct
+
 from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.ballet.base58 import decode_32
 from firedancer_tpu.flamenco.accounts import (
     Account, AccountMgr, SYSTEM_PROGRAM_ID,
 )
 from firedancer_tpu.funk.funk import Funk, ROOT_XID
 
 FEE_PER_SIGNATURE = 5000
+
+#: address-lookup-table native program
+#: (reference: runtime/program/fd_address_lookup_table_program.c)
+ALT_PROGRAM_ID = decode_32("AddressLookupTab1e1111111111111111111111111")
+
+#: ALT account layout: 56-byte header then packed 32-byte addresses
+_ALT_HDR = struct.Struct("<IQQBB32sH")
+ALT_HEADER_SZ = 56
+_ALT_DISC_TABLE = 1
+ALT_DEACT_NONE = (1 << 64) - 1
+
+# ALT instruction discriminants (bincode u32le)
+_ALT_CREATE = 0
+_ALT_FREEZE = 1
+_ALT_EXTEND = 2
+_ALT_DEACTIVATE = 3
 
 #: simplified rent-exempt minimum: lamports per byte-year * 2 years
 RENT_PER_BYTE = 3480 * 2
@@ -52,6 +71,19 @@ def rent_exempt_minimum(space: int) -> int:
     return RENT_BASE + RENT_PER_BYTE * space
 
 
+def alt_addresses(table_data: bytes) -> list[bytes] | None:
+    """Addresses held by an ALT account (None on malformation)."""
+    if len(table_data) < ALT_HEADER_SZ:
+        return None
+    disc = int.from_bytes(table_data[:4], "little")
+    if disc != _ALT_DISC_TABLE:
+        return None
+    body = table_data[ALT_HEADER_SZ:]
+    if len(body) % 32:
+        return None
+    return [body[i : i + 32] for i in range(0, len(body), 32)]
+
+
 @dataclass
 class TxnResult:
     ok: bool
@@ -68,6 +100,42 @@ class Executor:
         self.funk = funk
         self.xid = xid
         self.mgr = AccountMgr(funk, xid)
+        self.slot = 0  # bank slot (ALT create derivation, deactivation)
+
+    def begin_slot(self, slot: int, unix_timestamp: int = 0) -> None:
+        """Advance the bank slot: refresh the sysvar accounts
+        (reference: fd_sysvar_clock_update at slot start)."""
+        from firedancer_tpu.flamenco import sysvar
+
+        self.slot = slot
+        sysvar.install(self.mgr, slot, unix_timestamp=unix_timestamp)
+
+    # ---- address lookup tables ------------------------------------------
+
+    def _resolve_alts(self, payload: bytes, desc: T.TxnDesc):
+        """-> list of resolved keys (writables then readonlys), or an
+        error string.  Reference behavior: fd_runtime load of v0 message
+        addresses via the ALT program's on-chain tables."""
+        writable: list[bytes] = []
+        readonly: list[bytes] = []
+        for lut in desc.address_tables:
+            table_key = payload[lut.addr_off : lut.addr_off + 32]
+            acct = self.mgr.load(table_key)
+            if acct is None or acct.owner != ALT_PROGRAM_ID:
+                return "alt: table account missing"
+            addrs = alt_addresses(acct.data)
+            if addrs is None:
+                return "alt: malformed table"
+            for off, cnt, out in (
+                (lut.writable_off, lut.writable_cnt, writable),
+                (lut.readonly_off, lut.readonly_cnt, readonly),
+            ):
+                for j in range(cnt):
+                    idx = payload[off + j]
+                    if idx >= len(addrs):
+                        return "alt: index out of range"
+                    out.append(addrs[idx])
+        return writable + readonly
 
     # ---- entry points ---------------------------------------------------
 
@@ -79,6 +147,14 @@ class Executor:
             bytes(desc.acct_addr(payload, j))
             for j in range(desc.acct_addr_cnt)
         ]
+        if desc.addr_table_adtl_cnt > 0:
+            # v0: resolve address-table lookups against on-chain ALT
+            # accounts (message ordering: static keys, then all writable
+            # lookups, then all readonly lookups)
+            resolved = self._resolve_alts(payload, desc)
+            if isinstance(resolved, str):
+                return TxnResult(False, resolved)
+            keys += resolved
         fee = FEE_PER_SIGNATURE * desc.signature_cnt
 
         payer = self.mgr.load(keys[0])
@@ -104,12 +180,11 @@ class Executor:
         for ins in desc.instr:
             prog_key = keys[ins.program_id]
             data = payload[ins.data_off : ins.data_off + ins.data_sz]
-            ins_keys = [
-                keys[payload[ins.acct_off + j]]
-                for j in range(ins.acct_cnt)
-            ]
+            ins_idx = [payload[ins.acct_off + j] for j in range(ins.acct_cnt)]
+            ins_keys = [keys[j] for j in ins_idx]
             err = self._dispatch(
-                prog_key, data, ins_keys, desc, keys, load, store, logs
+                prog_key, data, ins_keys, desc, keys, load, store, logs,
+                ins_idx=ins_idx,
             )
             if err:
                 return TxnResult(False, err, fee=fee, logs=logs)
@@ -121,13 +196,101 @@ class Executor:
     # ---- dispatch -------------------------------------------------------
 
     def _dispatch(self, prog_key, data, ins_keys, desc, keys, load, store,
-                  logs) -> str:
+                  logs, ins_idx=None) -> str:
         if prog_key == SYSTEM_PROGRAM_ID:
             return self._system(data, ins_keys, desc, keys, load, store)
+        if prog_key == ALT_PROGRAM_ID:
+            return self._alt_program(data, ins_keys, desc, keys, load, store)
         prog = load(prog_key)
         if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
-            return self._bpf(prog, data, ins_keys, load, store, logs)
+            return self._bpf(
+                prog, data, ins_keys, desc, keys, load, store, logs,
+                ins_idx or [],
+            )
         return "unknown program"
+
+
+    def _alt_program(self, data, ins_keys, desc, keys, load, store) -> str:
+        """Address-lookup-table native program: create / freeze / extend /
+        deactivate (fd_address_lookup_table_program.c behavior, simplified:
+        no PDA derivation check — the table address is the account given)."""
+        if len(data) < 4:
+            return "alt: bad instruction"
+        disc = int.from_bytes(data[:4], "little")
+        if disc == _ALT_CREATE:
+            if len(ins_keys) < 2:
+                return "alt: bad create"
+            table_k, auth_k = ins_keys[0], ins_keys[1]
+            if not self._is_signer(auth_k, desc, keys):
+                return "alt: missing authority signature"
+            if load(table_k) is not None:
+                return "alt: account exists"
+            hdr = _ALT_HDR.pack(
+                _ALT_DISC_TABLE, ALT_DEACT_NONE, 0, 0, 1, auth_k, 0
+            )
+            # lamport conservation: the table starts unfunded; rent is the
+            # caller's business (system-transfer to it), never minted here
+            store(table_k, Account(0, ALT_PROGRAM_ID, False, 0, hdr))
+            return ""
+        # remaining instructions operate on an existing live table with
+        # the authority as the second account
+        if len(ins_keys) < 2:
+            return "alt: bad instruction accounts"
+        table_k, auth_k = ins_keys[0], ins_keys[1]
+        acct = load(table_k)
+        if acct is None or acct.owner != ALT_PROGRAM_ID:
+            return "alt: no table"
+        disc0, deact, last_slot, last_idx, has_auth, auth, _pad = (
+            _ALT_HDR.unpack_from(acct.data)
+        )
+        if disc0 != _ALT_DISC_TABLE:
+            return "alt: malformed table"
+        if not has_auth:
+            return "alt: frozen"
+        if auth != auth_k or not self._is_signer(auth_k, desc, keys):
+            return "alt: bad authority"
+        if disc == _ALT_FREEZE:
+            acct.data = (
+                _ALT_HDR.pack(
+                    _ALT_DISC_TABLE, deact, last_slot, last_idx, 0,
+                    bytes(32), 0,
+                )
+                + acct.data[ALT_HEADER_SZ:]
+            )
+            store(table_k, acct)
+            return ""
+        if disc == _ALT_EXTEND:
+            if deact != ALT_DEACT_NONE:
+                return "alt: deactivated"
+            if len(data) < 12:
+                return "alt: bad extend"
+            n = int.from_bytes(data[4:12], "little")
+            if len(data) < 12 + 32 * n:
+                return "alt: bad extend"
+            existing = (len(acct.data) - ALT_HEADER_SZ) // 32
+            if existing + n > 256:
+                return "alt: table full"
+            new_addrs = data[12 : 12 + 32 * n]
+            acct.data = (
+                _ALT_HDR.pack(
+                    _ALT_DISC_TABLE, deact, self.slot, existing, 1, auth, 0
+                )
+                + acct.data[ALT_HEADER_SZ:]
+                + new_addrs
+            )
+            store(table_k, acct)
+            return ""
+        if disc == _ALT_DEACTIVATE:
+            acct.data = (
+                _ALT_HDR.pack(
+                    _ALT_DISC_TABLE, self.slot, last_slot, last_idx, 1,
+                    auth, 0,
+                )
+                + acct.data[ALT_HEADER_SZ:]
+            )
+            store(table_k, acct)
+            return ""
+        return "alt: unsupported instruction"
 
     def _system(self, data, ins_keys, desc, keys, load, store) -> str:
         if len(data) < 4:
@@ -210,7 +373,19 @@ class Executor:
     def _is_signer(key: bytes, desc: T.TxnDesc, keys: list) -> bool:
         return key in keys[: desc.signature_cnt]
 
-    def _bpf(self, prog: Account, data, ins_keys, load, store, logs) -> str:
+    def _bpf(self, prog: Account, data, ins_keys, desc, keys, load, store,
+             logs, ins_idx) -> str:
+        """Execute an sBPF program with the instruction's accounts
+        serialized into the VM input region.
+
+        Input ABI (this build's serialization; the reference implements
+        Solana's own input layout in fd_vm_context):
+          u16 acct_cnt
+          per account: pubkey[32] | u8 flags (1=writable, 2=signer)
+                       | u64 lamports | owner[32] | u64 data_len | data
+          u64 ins_data_len | ins_data
+        Writable accounts' lamports + data (same length; no realloc) are
+        committed back after a successful run."""
         from firedancer_tpu.ballet import sbpf
         from firedancer_tpu.flamenco.vm import Vm, VmError
 
@@ -219,11 +394,45 @@ class Executor:
         except sbpf.SbpfError as e:
             return f"elf: {e}"
         vm = Vm(program)
-        vm.input_mem = bytearray(data)  # instruction data as input region
+
+        buf = bytearray()
+        buf += len(ins_keys).to_bytes(2, "little")
+        offsets = []  # (key, writable, lamports_off, data_off, data_len)
+        for j, k in zip(ins_idx, ins_keys):
+            a = load(k) or Account(0)
+            writable = desc.is_writable(j)
+            flags = (1 if writable else 0) | (
+                2 if self._is_signer(k, desc, keys) else 0
+            )
+            buf += k + bytes([flags])
+            lam_off = len(buf)
+            buf += a.lamports.to_bytes(8, "little")
+            buf += a.owner
+            buf += len(a.data).to_bytes(8, "little")
+            data_off = len(buf)
+            buf += a.data
+            offsets.append((k, writable, lam_off, data_off, len(a.data)))
+        buf += len(data).to_bytes(8, "little") + data
+        vm.input_mem = bytearray(buf)
+
         try:
             r0 = vm.run()
         except VmError as e:
             logs.extend(vm.logs)
             return f"vm: {e}"
         logs.extend(vm.logs)
-        return "" if r0 == 0 else f"program error {r0}"
+        if r0 != 0:
+            return f"program error {r0}"
+        # commit writable accounts back from the input region
+        seen = set()
+        for k, writable, lam_off, data_off, dlen in offsets:
+            if not writable or k in seen:
+                continue
+            seen.add(k)
+            a = load(k) or Account(0)
+            a.lamports = int.from_bytes(
+                vm.input_mem[lam_off : lam_off + 8], "little"
+            )
+            a.data = bytes(vm.input_mem[data_off : data_off + dlen])
+            store(k, a)
+        return ""

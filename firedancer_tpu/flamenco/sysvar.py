@@ -1,0 +1,132 @@
+"""Sysvars: cluster-state accounts programs read at well-known addresses.
+
+Reference model: src/flamenco/runtime/sysvar/ (fd_sysvar_clock.c,
+fd_sysvar_rent.c, fd_sysvar_epoch_schedule.c) — the runtime materializes
+cluster state (clock, rent parameters, epoch schedule) into accounts
+owned by the sysvar program so on-chain programs can read them like any
+other account.  Layouts are the bincode wire shapes of the corresponding
+Solana types (fixed-width little-endian fields).
+
+The bank installs/refreshes them per slot via `install(mgr, slot, ...)`.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from firedancer_tpu.ballet.base58 import decode_32
+from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+
+#: the sysvar owner program id ("Sysvar1111...")
+SYSVAR_OWNER_ID = decode_32("Sysvar1111111111111111111111111111111111111")
+CLOCK_ID = decode_32("SysvarC1ock11111111111111111111111111111111")
+RENT_ID = decode_32("SysvarRent111111111111111111111111111111111")
+EPOCH_SCHEDULE_ID = decode_32("SysvarEpochSchedu1e111111111111111111111111")
+
+
+@dataclass
+class Clock:
+    slot: int = 0
+    epoch_start_timestamp: int = 0
+    epoch: int = 0
+    leader_schedule_epoch: int = 0
+    unix_timestamp: int = 0
+
+    _S = struct.Struct("<QqQQq")
+
+    def encode(self) -> bytes:
+        return self._S.pack(
+            self.slot, self.epoch_start_timestamp, self.epoch,
+            self.leader_schedule_epoch, self.unix_timestamp,
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Clock":
+        return cls(*cls._S.unpack_from(raw))
+
+
+@dataclass
+class Rent:
+    lamports_per_byte_year: int = 3480
+    exemption_threshold: float = 2.0
+    burn_percent: int = 50
+
+    _S = struct.Struct("<QdB")
+
+    def encode(self) -> bytes:
+        return self._S.pack(
+            self.lamports_per_byte_year, self.exemption_threshold,
+            self.burn_percent,
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Rent":
+        return cls(*cls._S.unpack_from(raw))
+
+    def minimum_balance(self, data_len: int) -> int:
+        return int(
+            (128 + data_len)
+            * self.lamports_per_byte_year
+            * self.exemption_threshold
+        )
+
+
+@dataclass
+class EpochSchedule:
+    slots_per_epoch: int = 432_000
+    leader_schedule_slot_offset: int = 432_000
+    warmup: bool = False
+    first_normal_epoch: int = 0
+    first_normal_slot: int = 0
+
+    _S = struct.Struct("<QQBQQ")
+
+    def encode(self) -> bytes:
+        return self._S.pack(
+            self.slots_per_epoch, self.leader_schedule_slot_offset,
+            int(self.warmup), self.first_normal_epoch, self.first_normal_slot,
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "EpochSchedule":
+        s = cls(*cls._S.unpack_from(raw))
+        s.warmup = bool(s.warmup)
+        return s
+
+    def epoch_of(self, slot: int) -> int:
+        return slot // self.slots_per_epoch  # post-warmup schedule
+
+
+def install(
+    mgr: AccountMgr,
+    slot: int,
+    *,
+    unix_timestamp: int = 0,
+    rent: Rent | None = None,
+    schedule: EpochSchedule | None = None,
+) -> None:
+    """Materialize/refresh the sysvar accounts for `slot` (the bank calls
+    this at slot start; reference: fd_sysvar_clock_update)."""
+    rent = rent or Rent()
+    schedule = schedule or EpochSchedule()
+    epoch = schedule.epoch_of(slot)
+    clock = Clock(
+        slot=slot,
+        epoch=epoch,
+        leader_schedule_epoch=epoch + 1,
+        unix_timestamp=unix_timestamp,
+    )
+    for key, body in (
+        (CLOCK_ID, clock.encode()),
+        (RENT_ID, rent.encode()),
+        (EPOCH_SCHEDULE_ID, schedule.encode()),
+    ):
+        mgr.store(
+            key,
+            Account(
+                lamports=1_000_000_000,
+                owner=SYSVAR_OWNER_ID,
+                data=body,
+            ),
+        )

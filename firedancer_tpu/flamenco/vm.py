@@ -47,6 +47,10 @@ class Vm:
     heap_sz: int = 32 * 1024
     cu_limit: int = 200_000
     input_mem: bytearray = field(default_factory=bytearray)
+    #: per-instruction tracer (fd_vm_trace.c analog): records
+    #: (pc, opcode, regs snapshot) up to trace_limit entries
+    trace: bool = False
+    trace_limit: int = 65536
 
     def __post_init__(self):
         self.reg = [0] * 11
@@ -54,6 +58,7 @@ class Vm:
         self.heap = bytearray(self.heap_sz)
         self.cu = self.cu_limit
         self.logs: list[bytes] = []
+        self.trace_log: list[tuple[int, int, tuple[int, ...]]] = []
         self.call_depth = 0
         self._ret_stack: list[int] = []
         self.syscalls: dict[int, callable] = {}
@@ -98,29 +103,108 @@ class Vm:
         self.syscalls[syscall_hash(name)] = fn
 
     def _register_default_syscalls(self) -> None:
+        # per-syscall CU costs beyond the flat call cost (reference:
+        # fd_vm_syscalls.c cost model — hashes charge base + per-byte)
         def sol_log(vm, r1, r2, r3, r4, r5):
+            vm.consume(max(r2, 100))
             vm.logs.append(vm.mem_read_bytes(r1, r2))
             return 0
 
         def sol_log_64(vm, r1, r2, r3, r4, r5):
+            vm.consume(100)
             vm.logs.append(
                 b"%x %x %x %x %x" % (r1, r2, r3, r4, r5)
             )
             return 0
 
-        def sol_memcpy(vm, r1, r2, r3, r4, r5):
-            data = vm.mem_read_bytes(r2, r3)
-            for i, b in enumerate(data):
-                vm.mem_write(r1 + i, 1, b)
+        def sol_log_pubkey(vm, r1, r2, r3, r4, r5):
+            vm.consume(100)
+            from firedancer_tpu.ballet import base58
+
+            vm.logs.append(base58.encode_32(vm.mem_read_bytes(r1, 32)).encode())
             return 0
+
+        def sol_memcpy(vm, r1, r2, r3, r4, r5):
+            vm.consume(r3 // 250 + 1)
+            data = vm.mem_read_bytes(r2, r3)
+            vm.mem_write_bytes(r1, data)
+            return 0
+
+        def sol_memset(vm, r1, r2, r3, r4, r5):
+            vm.consume(r3 // 250 + 1)
+            vm.mem_write_bytes(r1, bytes([r2 & 0xFF]) * r3)
+            return 0
+
+        def sol_memcmp(vm, r1, r2, r3, r4, r5):
+            vm.consume(r3 // 250 + 1)
+            a = vm.mem_read_bytes(r1, r3)
+            b = vm.mem_read_bytes(r2, r3)
+            diff = 0
+            for x, y in zip(a, b):
+                if x != y:
+                    diff = (x - y) & 0xFFFFFFFF
+                    break
+            vm.mem_write(r4, 4, diff)
+            return 0
+
+        def _hash_syscall(hasher, base_cost, byte_cost):
+            def syscall(vm, r1, r2, r3, r4, r5):
+                # r1 = &[(addr, len)] slice vector, r2 = count,
+                # r3 = result address (32 bytes out)
+                vm.consume(base_cost)
+                h = hasher()
+                for i in range(r2):
+                    addr = vm.mem_read(r1 + 16 * i, 8)
+                    ln = vm.mem_read(r1 + 16 * i + 8, 8)
+                    vm.consume(ln * byte_cost // 100)
+                    h.update(vm.mem_read_bytes(addr, ln))
+                vm.mem_write_bytes(r3, h.digest())
+                return 0
+
+            return syscall
+
+        import hashlib
+
+        from firedancer_tpu.ops.keccak256 import digest_host
+
+        class _Keccak:
+            def __init__(self):
+                self._buf = b""
+
+            def update(self, b):
+                self._buf += b
+
+            def digest(self):
+                return digest_host(self._buf)
 
         def abort(vm, r1, r2, r3, r4, r5):
             raise VmError("abort() called")
 
         self.register_syscall(b"sol_log_", sol_log)
         self.register_syscall(b"sol_log_64_", sol_log_64)
+        self.register_syscall(b"sol_log_pubkey", sol_log_pubkey)
         self.register_syscall(b"sol_memcpy_", sol_memcpy)
+        self.register_syscall(b"sol_memset_", sol_memset)
+        self.register_syscall(b"sol_memcmp_", sol_memcmp)
+        self.register_syscall(
+            b"sol_sha256", _hash_syscall(hashlib.sha256, 85, 1)
+        )
+        self.register_syscall(
+            b"sol_keccak256", _hash_syscall(_Keccak, 85, 1)
+        )
         self.register_syscall(b"abort", abort)
+
+    def consume(self, cus: int) -> None:
+        """Charge compute units (syscall cost model)."""
+        self.cu -= int(cus)
+        if self.cu < 0:
+            raise VmError("compute budget exceeded")
+
+    def mem_write_bytes(self, addr: int, data: bytes) -> None:
+        buf, off, writable = self._region(addr, len(data))
+        if not writable:
+            raise VmError(f"write to read-only memory at {addr:#x}")
+        buf[off : off + len(data)] = data
 
     # ---- interpreter ----------------------------------------------------
 
@@ -144,6 +228,8 @@ class Vm:
             off = int.from_bytes(ins[2:4], "little", signed=True)
             imm = int.from_bytes(ins[4:8], "little", signed=True)
             cls = op & 7
+            if self.trace and len(self.trace_log) < self.trace_limit:
+                self.trace_log.append((pc, op, tuple(reg)))
             pc += 1
 
             if op == 0x18:  # lddw
@@ -210,8 +296,20 @@ class Vm:
                         reg[10] += STACK_FRAME_SZ
                         pc += imm  # relative target (signed imm)
                     continue
-                if op == 0x8D:  # callx
-                    raise VmError("callx unsupported")
+                if op == 0x8D:  # callx: indirect bpf-to-bpf via reg[imm]
+                    if not 0 <= imm < 11:
+                        raise VmError(f"callx bad register r{imm}")
+                    tgt = reg[imm]
+                    rel = tgt - MM_PROGRAM - self.prog.text_addr
+                    if rel < 0 or rel % 8 or rel // 8 >= n_ins:
+                        raise VmError(f"callx target oob {tgt:#x}")
+                    self.call_depth += 1
+                    if self.call_depth >= MAX_CALL_DEPTH:
+                        raise VmError("call depth exceeded")
+                    self._ret_stack.append(pc)
+                    reg[10] += STACK_FRAME_SZ
+                    pc = rel // 8
+                    continue
                 if op == 0x95:  # exit
                     if self._ret_stack:
                         pc = self._ret_stack.pop()
@@ -265,3 +363,65 @@ class Vm:
         self.reg[0] = (
             fn(self, *(self.reg[1:6])) or 0
         ) & U64
+
+
+# ---------------------------------------------------------------------------
+# disassembler + trace formatting (fd_vm_disasm.c / fd_vm_trace.c analogs)
+# ---------------------------------------------------------------------------
+
+_ALU_NAMES = {0x00: "add", 0x10: "sub", 0x20: "mul", 0x30: "div",
+              0x40: "or", 0x50: "and", 0x60: "lsh", 0x70: "rsh",
+              0x80: "neg", 0x90: "mod", 0xA0: "xor", 0xB0: "mov",
+              0xC0: "arsh"}
+_JMP_NAMES = {0x00: "ja", 0x10: "jeq", 0x20: "jgt", 0x30: "jge",
+              0x40: "jset", 0x50: "jne", 0x60: "jsgt", 0x70: "jsge",
+              0xA0: "jlt", 0xB0: "jle", 0xC0: "jslt", 0xD0: "jsle"}
+_SIZES = {0x10: "b", 0x08: "h", 0x00: "w", 0x18: "dw"}
+
+
+def disasm(ins: bytes) -> str:
+    """One 8-byte instruction -> assembly-ish text."""
+    op = ins[0]
+    dst = ins[1] & 0xF
+    src = ins[1] >> 4
+    off = int.from_bytes(ins[2:4], "little", signed=True)
+    imm = int.from_bytes(ins[4:8], "little", signed=True)
+    cls = op & 7
+    if op == 0x18:
+        return f"lddw r{dst}, {imm:#x}(lo)"
+    if op == 0x85:
+        return f"call {imm:#x}"
+    if op == 0x8D:
+        return f"callx r{imm & 0xF}"
+    if op == 0x95:
+        return "exit"
+    if cls in (0x07, 0x04):
+        name = _ALU_NAMES.get(op & 0xF0, f"alu{op:#x}")
+        w = "64" if cls == 0x07 else "32"
+        rhs = f"r{src}" if op & 0x08 else f"{imm}"
+        return f"{name}{w} r{dst}, {rhs}"
+    if cls in (0x05, 0x06):
+        name = _JMP_NAMES.get(op & 0xF0, f"jmp{op:#x}")
+        if name == "ja":
+            return f"ja {off:+d}"
+        rhs = f"r{src}" if op & 0x08 else f"{imm}"
+        return f"{name} r{dst}, {rhs}, {off:+d}"
+    if cls == 0x01:
+        return f"ldx{_SIZES[op & 0x18]} r{dst}, [r{src}{off:+d}]"
+    if cls == 0x02:
+        return f"st{_SIZES[op & 0x18]} [r{dst}{off:+d}], {imm}"
+    if cls == 0x03:
+        return f"stx{_SIZES[op & 0x18]} [r{dst}{off:+d}], r{src}"
+    return f".quad {int.from_bytes(ins, 'little'):#x}"
+
+
+def format_trace(vm: "Vm", limit: int | None = None) -> str:
+    """Rendered instruction trace of a traced run (fd_vm_trace output
+    shape: pc, disassembly, registers)."""
+    out = []
+    text = vm.prog.text
+    for pc, _op, regs in vm.trace_log[: limit or len(vm.trace_log)]:
+        ins = text[8 * pc : 8 * pc + 8]
+        rs = " ".join(f"r{i}={regs[i]:#x}" for i in range(11))
+        out.append(f"{pc:6d}: {disasm(ins):<28} {rs}")
+    return "\n".join(out)

@@ -170,3 +170,72 @@ def keccak256(msgs, lens):
     msgs = jnp.asarray(msgs, jnp.uint8)
     lens = jnp.asarray(lens, jnp.int32)
     return _keccak256_impl(msgs, lens, msgs.shape[1])
+
+
+# ---------------------------------------------------------------------------
+# host-side single-message digest (VM syscall path: arbitrary lengths,
+# no shape-specialized compile; plain python ints)
+# ---------------------------------------------------------------------------
+
+_ROTC = (1, 3, 6, 10, 15, 21, 28, 36, 45, 55, 2, 14,
+         27, 41, 56, 8, 25, 43, 62, 18, 39, 61, 20, 44)
+_PILN = (10, 7, 11, 17, 18, 3, 5, 16, 8, 21, 24, 4,
+         15, 23, 19, 13, 12, 2, 20, 14, 22, 9, 6, 1)
+_M64 = (1 << 64) - 1
+
+
+def _rc_host():
+    # round constants from the degree-8 LFSR (derived, not pasted)
+    out = []
+    r = 1
+    for _ in range(24):
+        rc = 0
+        for j in range(7):
+            if r & 1:
+                rc ^= 1 << ((1 << j) - 1)
+            r = ((r << 1) ^ (0x71 if r & 0x80 else 0)) & 0xFF
+        out.append(rc)
+    return out
+
+
+_RC_HOST = _rc_host()
+
+
+def _permute_host(st: list[int]) -> None:
+    for rc in _RC_HOST:
+        # theta
+        bc = [st[i] ^ st[i + 5] ^ st[i + 10] ^ st[i + 15] ^ st[i + 20]
+              for i in range(5)]
+        for i in range(5):
+            t = bc[(i + 4) % 5] ^ (
+                ((bc[(i + 1) % 5] << 1) | (bc[(i + 1) % 5] >> 63)) & _M64
+            )
+            for j in range(0, 25, 5):
+                st[i + j] ^= t
+        # rho + pi
+        t = st[1]
+        for i in range(24):
+            j = _PILN[i]
+            bc0 = st[j]
+            r = _ROTC[i]
+            st[j] = ((t << r) | (t >> (64 - r))) & _M64
+            t = bc0
+        # chi
+        for j in range(0, 25, 5):
+            row = st[j : j + 5]
+            for i in range(5):
+                st[j + i] = row[i] ^ ((~row[(i + 1) % 5]) & row[(i + 2) % 5])
+        st[0] ^= rc
+
+
+def digest_host(data: bytes) -> bytes:
+    """Keccak-256 of one message, host-side (VM syscall use)."""
+    rate = 136
+    st = [0] * 25
+    padded = data + b"\x01" + b"\x00" * ((-len(data) - 2) % rate) + b"\x80"
+    for off in range(0, len(padded), rate):
+        blk = padded[off : off + rate]
+        for i in range(rate // 8):
+            st[i] ^= int.from_bytes(blk[8 * i : 8 * i + 8], "little")
+        _permute_host(st)
+    return b"".join(st[i].to_bytes(8, "little") for i in range(4))

@@ -61,6 +61,9 @@ class BankTile(Tile):
             from firedancer_tpu.flamenco.runtime import Executor
 
             self._executor = Executor(self.funk)
+            # sysvar accounts (clock/rent/epoch schedule) materialize at
+            # slot start so programs can read them like any account
+            self._executor.begin_slot(0)
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
@@ -72,7 +75,13 @@ class BankTile(Tile):
             if self._executor is not None:
                 fees = 0
                 for t in txns:
-                    res = self._executor.execute_txn(bytes(t))
+                    # one malformed txn must not take the bank down: record
+                    # it as failed and keep executing the microblock
+                    try:
+                        res = self._executor.execute_txn(bytes(t))
+                    except Exception:
+                        ctx.metrics.inc("failed_txns")
+                        continue
                     fees += res.fee
                     if not res.ok:
                         ctx.metrics.inc("failed_txns")

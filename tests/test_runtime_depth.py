@@ -1,0 +1,305 @@
+"""Runtime depth: sysvars, the address-lookup-table native program with
+v0 resolution end-to-end, VM syscalls (sha256/keccak/memset/memcmp) with
+CU costs, account serialization into sBPF programs, and the instruction
+tracer.
+
+Reference analogs: src/flamenco/runtime/sysvar/, runtime/program/
+fd_address_lookup_table_program.c, vm/fd_vm_syscalls.c, vm/fd_vm_trace.c.
+"""
+
+import hashlib
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import sbpf
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco import sysvar
+from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+from firedancer_tpu.flamenco.runtime import (
+    ALT_PROGRAM_ID, BPF_LOADER_ID, Executor, alt_addresses,
+    rent_exempt_minimum,
+)
+from firedancer_tpu.flamenco.vm import Vm, VmError, disasm, format_trace
+from firedancer_tpu.funk.funk import Funk
+
+
+def ins(op, dst=0, src=0, off=0, imm=0):
+    return struct.pack("<BBhI", op, (src << 4) | dst, off, imm & 0xFFFFFFFF)
+
+
+def lddw(dst, val):
+    lo = val & 0xFFFFFFFF
+    hi = (val >> 32) & 0xFFFFFFFF
+    return (
+        struct.pack("<BBhI", 0x18, dst, 0, lo)
+        + struct.pack("<BBhI", 0, 0, 0, hi)
+    )
+
+
+EXIT = ins(0x95)
+
+
+def _funk():
+    return Funk()
+
+
+def _keys(rng, n):
+    return [rng.integers(0, 256, 32, np.uint8).tobytes() for _ in range(n)]
+
+
+def _sign_stub(n):
+    return [bytes([7]) * 64 for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# sysvars
+# ---------------------------------------------------------------------------
+
+
+def test_sysvar_install_and_decode():
+    funk = _funk()
+    ex = Executor(funk)
+    ex.begin_slot(1234, unix_timestamp=999)
+    clk = sysvar.Clock.decode(ex.mgr.load(sysvar.CLOCK_ID).data)
+    assert clk.slot == 1234 and clk.unix_timestamp == 999
+    rent = sysvar.Rent.decode(ex.mgr.load(sysvar.RENT_ID).data)
+    assert rent.minimum_balance(0) > 0
+    sched = sysvar.EpochSchedule.decode(
+        ex.mgr.load(sysvar.EPOCH_SCHEDULE_ID).data
+    )
+    assert sched.epoch_of(clk.slot) == clk.epoch == 1234 // 432_000
+    assert ex.mgr.load(sysvar.CLOCK_ID).owner == sysvar.SYSVAR_OWNER_ID
+
+
+# ---------------------------------------------------------------------------
+# ALT program + v0 resolution
+# ---------------------------------------------------------------------------
+
+
+def test_alt_create_extend_resolve_transfer():
+    rng = np.random.default_rng(5)
+    funk = _funk()
+    ex = Executor(funk)
+    payer, table, dest = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+
+    # create + extend via the native program (one txn each)
+    create = T.build(
+        _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+        [(2, [1, 0], struct.pack("<IQB", 0, 0, 0))],
+        readonly_unsigned_cnt=1,
+    )
+    r = ex.execute_txn(create)
+    assert r.ok, r.err
+    extend = T.build(
+        _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+        [(2, [1, 0], struct.pack("<IQ", 2, 1) + dest)],
+        readonly_unsigned_cnt=1,
+    )
+    r = ex.execute_txn(extend)
+    assert r.ok, r.err
+    addrs = alt_addresses(ex.mgr.load(table).data)
+    assert addrs == [dest]
+
+    # v0 txn: transfer to `dest` addressed THROUGH the lookup table
+    lamports = 123_456
+    body = struct.pack("<IQ", 2, lamports)  # system transfer
+    v0 = T.build(
+        _sign_stub(1), [payer, bytes(32)], bytes(32),
+        [(1, [0, 2], body)],  # acct 2 = first lookup address
+        readonly_unsigned_cnt=1,
+        version=T.V0,
+        address_tables=[(table, [0], [])],
+    )
+    desc = T.parse(v0)
+    assert desc is not None and desc.addr_table_adtl_cnt == 1
+    r = ex.execute_txn(v0)
+    assert r.ok, r.err
+    assert ex.mgr.load(dest).lamports == lamports
+
+    # freeze makes the table immutable
+    freeze = T.build(
+        _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+        [(2, [1, 0], struct.pack("<I", 1))],
+        readonly_unsigned_cnt=1,
+    )
+    assert ex.execute_txn(freeze).ok
+    r = ex.execute_txn(extend)
+    assert not r.ok and "frozen" in r.err
+
+
+def test_alt_missing_table_fails_cleanly():
+    rng = np.random.default_rng(6)
+    funk = _funk()
+    ex = Executor(funk)
+    payer, ghost = _keys(rng, 2)
+    ex.mgr.store(payer, Account(1_000_000_000))
+    v0 = T.build(
+        _sign_stub(1), [payer, bytes(32)], bytes(32),
+        [(1, [0, 2], struct.pack("<IQ", 2, 5))],
+        readonly_unsigned_cnt=1,
+        version=T.V0,
+        address_tables=[(ghost, [0], [])],
+    )
+    r = ex.execute_txn(v0)
+    assert not r.ok and r.err.startswith("alt:")
+
+
+# ---------------------------------------------------------------------------
+# VM syscalls + tracer
+# ---------------------------------------------------------------------------
+
+
+def test_sha256_syscall_and_cu_cost():
+    # input_mem: slice table at offset 0 (addr,len), message at 64
+    msg = b"firedancer-tpu"
+    input_mem = bytearray(128)
+    struct.pack_into("<QQ", input_mem, 0, sbpf.MM_INPUT + 64, len(msg))
+    input_mem[64 : 64 + len(msg)] = msg
+    text = (
+        lddw(1, sbpf.MM_INPUT)        # slice table
+        + ins(0xB7, dst=2, imm=1)     # one slice
+        + lddw(3, sbpf.MM_INPUT + 96) # result -> input[96..128)
+        + ins(0x85, imm=sbpf.syscall_hash(b"sol_sha256"))
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    prog = sbpf.load(sbpf.build_elf(text))
+    vm = Vm(prog)
+    vm.input_mem = input_mem
+    cu0 = vm.cu
+    assert vm.run() == 0
+    assert bytes(vm.input_mem[96:128]) == hashlib.sha256(msg).digest()
+    assert cu0 - vm.cu > 85  # base + per-byte + per-instruction
+
+    # keccak через the same slice ABI
+    text_k = (
+        lddw(1, sbpf.MM_INPUT)
+        + ins(0xB7, dst=2, imm=1)
+        + lddw(3, sbpf.MM_INPUT + 96)
+        + ins(0x85, imm=sbpf.syscall_hash(b"sol_keccak256"))
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    vm2 = Vm(sbpf.load(sbpf.build_elf(text_k)))
+    vm2.input_mem = bytearray(input_mem)
+    assert vm2.run() == 0
+    from firedancer_tpu.ops.keccak256 import digest_host
+
+    assert bytes(vm2.input_mem[96:128]) == digest_host(msg)
+
+
+def test_memset_memcmp_syscalls():
+    text = (
+        lddw(1, sbpf.MM_INPUT)
+        + ins(0xB7, dst=2, imm=0xAB)
+        + ins(0xB7, dst=3, imm=8)
+        + ins(0x85, imm=sbpf.syscall_hash(b"sol_memset_"))
+        + lddw(1, sbpf.MM_INPUT)          # a
+        + lddw(2, sbpf.MM_INPUT + 8)      # b
+        + ins(0xB7, dst=3, imm=8)
+        + lddw(4, sbpf.MM_INPUT + 16)     # result
+        + ins(0x85, imm=sbpf.syscall_hash(b"sol_memcmp_"))
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    vm = Vm(sbpf.load(sbpf.build_elf(text)))
+    vm.input_mem = bytearray(24)
+    vm.input_mem[8:16] = b"\xab" * 8
+    assert vm.run() == 0
+    assert bytes(vm.input_mem[:8]) == b"\xab" * 8
+    assert struct.unpack_from("<I", vm.input_mem, 16)[0] == 0
+
+
+def test_tracer_and_disasm():
+    text = (
+        ins(0xB7, dst=0, imm=7)
+        + ins(0x07, dst=0, imm=5)
+        + EXIT
+    )
+    vm = Vm(sbpf.load(sbpf.build_elf(text)), trace=True)
+    assert vm.run() == 12
+    assert len(vm.trace_log) == 3
+    rendered = format_trace(vm)
+    assert "mov64 r0, 7" in rendered and "add64 r0, 5" in rendered
+    assert "exit" in rendered
+    # regs snapshot BEFORE each instruction executes
+    assert vm.trace_log[1][2][0] == 7
+    assert disasm(ins(0x8D, imm=3)) == "callx r3"
+    assert disasm(lddw(2, 0x10)[:8]).startswith("lddw r2")
+
+
+def test_callx_and_bad_register():
+    # callx r1 -> function at pc 4 returning 9
+    target_pc = 5
+    text = (
+        lddw(1, sbpf.MM_PROGRAM + 8 * target_pc)
+        + ins(0x8D, imm=1)            # callx r1
+        + ins(0xBF, dst=0, src=6)     # r0 = r6 (after return)
+        + EXIT
+        # pc 5: callee
+        + ins(0xB7, dst=6, imm=9)
+        + EXIT
+    )
+    vm = Vm(sbpf.load(sbpf.build_elf(text)))
+    assert vm.run() == 9
+    vm2 = Vm(sbpf.load(sbpf.build_elf(ins(0x8D, imm=12) + EXIT)))
+    try:
+        vm2.run()
+        raise AssertionError("callx r12 must fault")
+    except VmError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# account serialization into sBPF programs (sysvar read end-to-end)
+# ---------------------------------------------------------------------------
+
+
+def test_bpf_program_reads_clock_sysvar():
+    """A deployed program reads the clock sysvar account (first
+    instruction account) out of the input ABI and writes lamports into a
+    writable account: depth = sysvars + serialization + write-back."""
+    rng = np.random.default_rng(9)
+    funk = _funk()
+    ex = Executor(funk)
+    ex.begin_slot(77)
+    payer, prog_key, scratch = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    ex.mgr.store(
+        scratch, Account(rent_exempt_minimum(8), bytes(32), False, 0, bytes(8))
+    )
+
+    # input ABI offsets with 2 accounts: [0]=clock (data 40B), [1]=scratch:
+    #   u16 cnt | acct0: 32+1+8+32+8+40 | acct1: 32+1 |lam 8| 32 |8| data 8
+    a0_data = 2 + 32 + 1 + 8 + 32 + 8
+    a1_lam = a0_data + 40 + 32 + 1
+    a1_data = a1_lam + 8 + 32 + 8
+    text = (
+        # r6 = clock.slot (first u64 of clock sysvar data)
+        lddw(1, sbpf.MM_INPUT + a0_data)
+        + ins(0x79, dst=6, src=1)       # ldxdw r6, [r1+0]
+        # write it into scratch's data
+        + lddw(2, sbpf.MM_INPUT + a1_data)
+        + ins(0x7B, dst=2, src=6)       # stxdw [r2+0], r6
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    elf = sbpf.build_elf(text)
+    ex.mgr.store(
+        prog_key, Account(1, BPF_LOADER_ID, True, 0, elf)
+    )
+    # account order: scratch sits before the readonly tail (readonly
+    # covers the LAST readonly_unsigned_cnt unsigned keys: clock + prog)
+    txn = T.build(
+        _sign_stub(1),
+        [payer, scratch, sysvar.CLOCK_ID, prog_key],
+        bytes(32),
+        [(3, [2, 1], b"")],
+        readonly_unsigned_cnt=2,
+    )
+    r = ex.execute_txn(txn)
+    assert r.ok, r.err
+    got = struct.unpack("<Q", ex.mgr.load(scratch).data)[0]
+    assert got == 77, got

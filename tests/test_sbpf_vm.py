@@ -154,9 +154,12 @@ def test_bpf_program_through_runtime():
     prog_key = rng.integers(0, 256, 32, np.uint8).tobytes()
     bh = rng.integers(0, 256, 32, np.uint8).tobytes()
 
-    # program: r0 = first input byte (instruction data) - 7
+    # program: r0 = first instruction-data byte - 7.  Input ABI
+    # (Executor._bpf): u16 acct_cnt | accounts | u64 data_len | data;
+    # one account (payer, empty data) = 32+1+8+32+8 = 81 bytes, so the
+    # instruction data starts at 2 + 81 + 8 = 91.
     text = (
-        lddw(3, sbpf.MM_INPUT)
+        lddw(3, sbpf.MM_INPUT + 91)
         + ins(0x71, dst=0, src=3, off=0)
         + ins(0x17, dst=0, imm=7)
         + EXIT
