@@ -261,6 +261,10 @@ class Topology:
     def metrics(self, tile_name: str) -> Metrics:
         return self._metrics[tile_name]
 
+    def metrics_registry(self) -> dict[str, Metrics]:
+        """Snapshot of every tile's Metrics (the metric tile's source)."""
+        return dict(self._metrics)
+
     def close(self) -> None:
         if self.wksp is not None:
             self.wksp.unlink()
