@@ -203,8 +203,9 @@ class AgingBloom:
     zeroed, so the filter always remembers between AGE_CAPACITY and
     2*AGE_CAPACITY of the most recent tags."""
 
-    def __init__(self, mesh: Mesh):
+    def __init__(self, mesh: Mesh, capacity: int = AGE_CAPACITY):
         self._sharding = NamedSharding(mesh, P("mp"))
+        self.capacity = capacity
         self.cur = jax.device_put(fresh_bloom(), self._sharding)
         self.prev = jax.device_put(fresh_bloom(), self._sharding)
         self.inserted = 0
@@ -218,7 +219,7 @@ class AgingBloom:
         capacity."""
         self.cur = new_cur
         self.inserted += int(np.asarray(metrics)[3])
-        if self.inserted >= AGE_CAPACITY:
+        if self.inserted >= self.capacity:
             self.prev = self.cur
             self.cur = jax.device_put(fresh_bloom(), self._sharding)
             self.inserted = 0
@@ -265,7 +266,7 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
     msgs[1], sigs[1] = msgs[0], sigs[0]
     tags2 = sigs[:, :8].copy().view(np.uint32).reshape(B, 2).astype(np.uint32)
 
-    bloom = AgingBloom(mesh)  # production filter size (BLOOM_BITS = 2^27)
+    bloom = AgingBloom(mesh)  # production filter size (BLOOM_BITS = 2^28)
 
     step = make_step(mesh)
     sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
@@ -304,3 +305,87 @@ def dryrun_step(mesh: Mesh, msgs: np.ndarray, lens: np.ndarray) -> None:
     )
     jax.block_until_ready(take)
     assert np.asarray(take).any()
+
+
+def dryrun_sustained(mesh: Mesh, steps: int = 6) -> None:
+    """Multi-step sustained run: drives AgingBloom across TWO rotation
+    boundaries (capacity = one batch), checks per-step metrics
+    consistency, exercises an uneven (padded) final dp batch, and
+    verifies the aging semantics end-to-end: tags are remembered for
+    one full epoch after rotation and forgotten after two.
+    """
+    from firedancer_tpu.ops.ed25519 import golden
+
+    dp = mesh.shape["dp"]
+    B, W = 8 * dp, 64
+    rng = np.random.default_rng(13)
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = golden.public_from_secret(sk)
+    pubs = np.tile(np.frombuffer(pk, np.uint8), (B, 1))
+
+    def batch(seed, n_real=B):
+        r = np.random.default_rng(seed)
+        msgs = r.integers(0, 256, size=(B, W), dtype=np.uint8)
+        lens = np.full(B, W, np.int32)
+        sigs = np.zeros((B, 64), np.uint8)
+        for i in range(n_real):
+            sigs[i] = np.frombuffer(
+                golden.sign(sk, msgs[i].tobytes()), np.uint8
+            )
+        # lanes past n_real model an uneven final dp batch: zero-padded
+        # (zero sig fails verify; metrics must count them as failed)
+        tags2 = sigs[:, :8].copy().view(np.uint32).reshape(B, 2)
+        return msgs, lens, sigs, pubs.copy(), tags2
+
+    step = make_step(mesh)
+    sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+
+    def put(b):
+        m, l, s, p, t = b
+        return (
+            jax.device_put(m, sh(P("dp", None))),
+            jax.device_put(l, sh(P("dp"))),
+            jax.device_put(s, sh(P("dp", None))),
+            jax.device_put(p, sh(P("dp", None))),
+            jax.device_put(t, sh(P("dp", None))),
+        )
+
+    bloom = AgingBloom(mesh, capacity=1)  # rotate after every batch
+    first = put(batch(100))
+    keep, cur, metrics = step(*first, *bloom.buffers())
+    m = np.asarray(metrics)
+    assert m[0] == B and m[1] == 0 and m[3] == B, m
+    assert np.asarray(keep).all()
+    bloom.update(cur, metrics)
+    assert bloom.rotations == 1
+
+    # epoch 1: fresh batch; epoch-0 tags must STILL be remembered (the
+    # membership consults current|previous across the rotation boundary)
+    keep_r, cur, metrics_r = step(*first, *bloom.buffers())
+    assert not np.asarray(keep_r).any(), "post-rotation recall failed"
+    bloom.update(cur, metrics_r)  # inserts 0 (all hits): no rotation
+    assert bloom.rotations == 1
+
+    for k in range(steps - 2):
+        b = put(batch(200 + k))
+        keep, cur, metrics = step(*b, *bloom.buffers())
+        m = np.asarray(metrics)
+        assert m[0] + m[1] == B, m  # every lane accounted each step
+        assert m[0] == B and m[3] == B
+        bloom.update(cur, metrics)
+    assert bloom.rotations >= 3
+
+    # two full epochs later the first batch's tags must be FORGOTTEN
+    keep_f, cur, metrics_f = step(*first, *bloom.buffers())
+    assert np.asarray(keep_f).all(), "aged-out tags must be admitted again"
+    bloom.update(cur, metrics_f)
+
+    # uneven final batch: only half the lanes carry real signed txns
+    half = B // 2
+    b = put(batch(999, n_real=half))
+    keep, cur, metrics = step(*b, *bloom.buffers())
+    m = np.asarray(metrics)
+    k = np.asarray(keep)
+    assert m[0] == half and m[1] == B - half, m
+    assert k[:half].all() and not k[half:].any()
+    print(f"dryrun_sustained ok: {steps} steps, rotations={bloom.rotations}")

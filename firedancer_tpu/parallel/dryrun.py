@@ -43,9 +43,15 @@ def run(n_devices: int) -> None:
     import importlib.util
 
     if importlib.util.find_spec("firedancer_tpu.models.pipeline") is not None:
+        import os
+
         from firedancer_tpu.models import pipeline
 
         pipeline.dryrun_step(mesh, msgs, lens)
+        if os.environ.get("FDT_DRYRUN_SUSTAINED", "1") != "0":
+            # multi-step sustained run: aging-bloom rotation boundaries,
+            # per-step metrics consistency, uneven final dp batch
+            pipeline.dryrun_sustained(mesh)
         print(f"dryrun_multichip ok: full pipeline on mesh dp={dp} mp={mp}")
         return
 
