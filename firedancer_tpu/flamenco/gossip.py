@@ -1,0 +1,357 @@
+"""Gossip: CRDS replication over UDP — how a validator learns the
+cluster.
+
+Reference model: src/flamenco/gossip/fd_gossip.c (1,957 LoC) — the
+Solana gossip protocol: a conflict-free replicated data store (CRDS) of
+signed values (contact info, votes, ...) keyed by (origin, kind), newest
+wallclock wins; spread by push (eager fanout to live peers) and pull
+(anti-entropy: ask a random peer for values you lack), with ping/pong
+tokens proving peer liveness before they enter the active set.
+
+This build implements that architecture with its own compact wire format
+(this is NOT the mainnet-compatible encoding; the reference's bincode
+layouts live in its generated types layer which has no analog here yet):
+
+    msg   = u8 kind | body
+    PING  = token[32]
+    PONG  = sha256(token)[32]
+    PUSH  = u16 n | n * value
+    PULLQ = u16 n | n * u64 (xxh-mixed hashes of values held) | value(self)
+    PULLR = u16 n | n * value
+    value = sig[64] | origin[32] | u8 vkind | u64 wallclock
+            | u16 len | body       (sig covers everything after it)
+
+Values are Ed25519-signed by their origin and verified on receipt; an
+invalid signature drops the value (the reference does the same via its
+sigverify path).  Contact-info bodies carry the shred version plus
+gossip/TPU socket addresses, which is exactly what stake_ci/shred_dest
+(disco/shred_dest.py) need to run turbine without hand-fed contacts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import struct
+import time
+from dataclasses import dataclass, field
+
+from firedancer_tpu.ops.ed25519 import golden
+
+MSG_PING, MSG_PONG, MSG_PUSH, MSG_PULLQ, MSG_PULLR = range(5)
+
+V_CONTACT = 0
+V_VOTE = 1
+
+#: push fanout (reference default push fanout class)
+PUSH_FANOUT = 6
+#: peer considered live if a pong arrived within this window
+LIVENESS_S = 20.0
+#: drop values older than this (reference CRDS timeouts)
+VALUE_TTL_S = 60.0
+
+
+def _addr_pack(addr: tuple[str, int]) -> bytes:
+    return socket.inet_aton(addr[0]) + struct.pack("<H", addr[1])
+
+
+def _addr_unpack(b: bytes) -> tuple[str, int]:
+    return socket.inet_ntoa(b[:4]), struct.unpack("<H", b[4:6])[0]
+
+
+@dataclass(frozen=True)
+class ContactInfo:
+    pubkey: bytes
+    shred_version: int
+    gossip_addr: tuple[str, int]
+    tpu_addr: tuple[str, int]
+    wallclock: int = 0
+
+    def body(self) -> bytes:
+        return (
+            struct.pack("<H", self.shred_version)
+            + _addr_pack(self.gossip_addr)
+            + _addr_pack(self.tpu_addr)
+        )
+
+    @classmethod
+    def from_value(cls, v: "CrdsValue") -> "ContactInfo":
+        sv = struct.unpack("<H", v.body[:2])[0]
+        return cls(
+            v.origin, sv, _addr_unpack(v.body[2:8]),
+            _addr_unpack(v.body[8:14]), v.wallclock,
+        )
+
+
+@dataclass(frozen=True)
+class CrdsValue:
+    origin: bytes
+    vkind: int
+    wallclock: int
+    body: bytes
+    signature: bytes
+
+    def signable(self) -> bytes:
+        return (
+            self.origin
+            + bytes([self.vkind])
+            + struct.pack("<Q", self.wallclock)
+            + struct.pack("<H", len(self.body))
+            + self.body
+        )
+
+    def encode(self) -> bytes:
+        return self.signature + self.signable()
+
+    @classmethod
+    def decode(cls, b: bytes, off: int) -> tuple["CrdsValue", int] | None:
+        if len(b) - off < 64 + 32 + 1 + 8 + 2:
+            return None
+        sig = b[off : off + 64]
+        o = off + 64
+        origin = b[o : o + 32]
+        vkind = b[o + 32]
+        (wallclock,) = struct.unpack_from("<Q", b, o + 33)
+        (ln,) = struct.unpack_from("<H", b, o + 41)
+        body_off = o + 43
+        if body_off + ln > len(b):
+            return None
+        body = b[body_off : body_off + ln]
+        return cls(origin, vkind, wallclock, body, sig), body_off + ln
+
+    def verify(self) -> bool:
+        return golden.verify(self.signable(), self.signature, self.origin) == 0
+
+    def key(self) -> tuple[bytes, int]:
+        return (self.origin, self.vkind)
+
+    def digest64(self) -> int:
+        h = hashlib.sha256(self.signature).digest()
+        return int.from_bytes(h[:8], "little")
+
+
+def make_value(secret: bytes, vkind: int, body: bytes,
+               wallclock: int | None = None) -> CrdsValue:
+    origin = golden.public_from_secret(secret)
+    wc = int(time.time() * 1000) if wallclock is None else wallclock
+    unsigned = CrdsValue(origin, vkind, wc, body, b"\0" * 64)
+    sig = golden.sign(secret, unsigned.signable())
+    return CrdsValue(origin, vkind, wc, body, sig)
+
+
+@dataclass
+class _Peer:
+    contact: ContactInfo
+    last_pong: float = 0.0
+    ping_token: bytes = b""
+
+
+class GossipNode:
+    """One gossip endpoint over a real UDP socket (non-blocking)."""
+
+    def __init__(
+        self,
+        identity_secret: bytes,
+        *,
+        shred_version: int = 1,
+        bind=("127.0.0.1", 0),
+        tpu_addr=("127.0.0.1", 0),
+        entrypoints: list[tuple[str, int]] | None = None,
+        now=None,
+    ):
+        self.secret = identity_secret
+        self.pubkey = golden.public_from_secret(identity_secret)
+        self.shred_version = shred_version
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(bind)
+        self.sock.setblocking(False)
+        self.addr = self.sock.getsockname()
+        self.tpu_addr = tpu_addr
+        self.entrypoints = list(entrypoints or [])
+        self.crds: dict[tuple[bytes, int], CrdsValue] = {}
+        self.peers: dict[bytes, _Peer] = {}
+        self._now = now or time.monotonic
+        self._rng = os.urandom
+        self.stats = {
+            "rx": 0, "tx": 0, "push_rx": 0, "pull_rx": 0,
+            "bad_sig": 0, "stale": 0,
+        }
+        self._refresh_self()
+
+    # ---- CRDS ------------------------------------------------------------
+
+    def _refresh_self(self) -> None:
+        me = ContactInfo(
+            self.pubkey, self.shred_version, self.addr, self.tpu_addr
+        )
+        self._self_value = make_value(self.secret, V_CONTACT, me.body())
+        self._upsert(self._self_value, verified=True)
+
+    def _upsert(self, v: CrdsValue, verified: bool = False) -> bool:
+        """Insert if newer than what we hold; returns True when adopted."""
+        cur = self.crds.get(v.key())
+        if cur is not None and cur.wallclock >= v.wallclock:
+            self.stats["stale"] += 1
+            return False
+        if not verified and not v.verify():
+            self.stats["bad_sig"] += 1
+            return False
+        self.crds[v.key()] = v
+        if v.vkind == V_CONTACT and v.origin != self.pubkey:
+            ci = ContactInfo.from_value(v)
+            p = self.peers.get(v.origin)
+            if p is None:
+                self.peers[v.origin] = _Peer(ci)
+            else:
+                p.contact = ci
+        return True
+
+    def contacts(self) -> list[ContactInfo]:
+        return [
+            ContactInfo.from_value(v)
+            for v in self.crds.values()
+            if v.vkind == V_CONTACT
+        ]
+
+    # ---- wire ------------------------------------------------------------
+
+    def _send(self, payload: bytes, addr) -> None:
+        try:
+            self.sock.sendto(payload, addr)
+            self.stats["tx"] += 1
+        except OSError:
+            pass
+
+    def _encode_values(self, kind: int, values: list[CrdsValue]) -> bytes:
+        out = bytes([kind]) + struct.pack("<H", len(values))
+        for v in values:
+            out += v.encode()
+        return out
+
+    def _decode_values(self, data: bytes, off: int) -> list[CrdsValue]:
+        if len(data) < off + 2:
+            return []
+        (n,) = struct.unpack_from("<H", data, off)
+        off += 2
+        out = []
+        for _ in range(min(n, 64)):
+            hit = CrdsValue.decode(data, off)
+            if hit is None:
+                break
+            v, off = hit
+            out.append(v)
+        return out
+
+    # ---- protocol drivers ------------------------------------------------
+
+    def tick(self) -> None:
+        """One round: drain rx, ping entrypoints/peers, push, pull."""
+        self._drain_rx()
+        now = self._now()
+        # bootstrap: ping entrypoints we know nothing about yet
+        for ep in self.entrypoints:
+            if not any(
+                p.contact.gossip_addr == ep for p in self.peers.values()
+            ):
+                token = self._rng(32)
+                self._pending_ping = token
+                self._send(bytes([MSG_PING]) + token, ep)
+        live = [
+            p for p in self.peers.values()
+            if now - p.last_pong <= LIVENESS_S
+        ]
+        stale = [
+            p for p in self.peers.values()
+            if now - p.last_pong > LIVENESS_S
+        ]
+        for p in stale:
+            token = self._rng(32)
+            p.ping_token = token
+            self._send(bytes([MSG_PING]) + token, p.contact.gossip_addr)
+        # push: my newest values to up to PUSH_FANOUT live peers
+        if live:
+            values = list(self.crds.values())[:32]
+            msg = self._encode_values(MSG_PUSH, values)
+            for p in live[:PUSH_FANOUT]:
+                self._send(msg, p.contact.gossip_addr)
+            # pull: anti-entropy with one live peer
+            target = live[int.from_bytes(self._rng(2), "little") % len(live)]
+            have = struct.pack(
+                "<H", min(len(self.crds), 1024)
+            ) + b"".join(
+                struct.pack("<Q", v.digest64())
+                for v in list(self.crds.values())[:1024]
+            )
+            self._send(
+                bytes([MSG_PULLQ]) + have + self._self_value.encode(),
+                target.contact.gossip_addr,
+            )
+
+    def _drain_rx(self) -> None:
+        while True:
+            try:
+                data, addr = self.sock.recvfrom(65536)
+            except BlockingIOError:
+                return
+            except OSError:
+                return
+            self.stats["rx"] += 1
+            try:
+                self._on_msg(data, addr)
+            except (struct.error, IndexError, ValueError):
+                continue  # malformed datagram: drop
+
+    def _on_msg(self, data: bytes, addr) -> None:
+        if not data:
+            return
+        kind = data[0]
+        if kind == MSG_PING and len(data) >= 33:
+            self._send(
+                bytes([MSG_PONG]) + hashlib.sha256(data[1:33]).digest(), addr
+            )
+            # answer with our contact so bootstrap converges fast
+            self._send(
+                self._encode_values(MSG_PUSH, [self._self_value]), addr
+            )
+        elif kind == MSG_PONG and len(data) >= 33:
+            for p in self.peers.values():
+                if p.ping_token and hashlib.sha256(
+                    p.ping_token
+                ).digest() == data[1:33]:
+                    p.last_pong = self._now()
+                    p.ping_token = b""
+            # entrypoint pong (no peer entry yet): mark via pending token
+            tok = getattr(self, "_pending_ping", b"")
+            if tok and hashlib.sha256(tok).digest() == data[1:33]:
+                self._pending_ping = b""
+        elif kind == MSG_PUSH:
+            self.stats["push_rx"] += 1
+            for v in self._decode_values(data, 1):
+                self._upsert(v)
+            # learning a contact from a ping-answer counts as liveness
+            for p in self.peers.values():
+                if p.contact.gossip_addr == addr and p.last_pong == 0.0:
+                    p.last_pong = self._now()
+        elif kind == MSG_PULLQ:
+            (n,) = struct.unpack_from("<H", data, 1)
+            o = 3
+            have = set()
+            for _ in range(min(n, 1024)):
+                have.add(struct.unpack_from("<Q", data, o)[0])
+                o += 8
+            hit = CrdsValue.decode(data, o)
+            if hit is not None:
+                self._upsert(hit[0])
+            missing = [
+                v for v in self.crds.values() if v.digest64() not in have
+            ][:32]
+            if missing:
+                self._send(self._encode_values(MSG_PULLR, missing), addr)
+        elif kind == MSG_PULLR:
+            self.stats["pull_rx"] += 1
+            for v in self._decode_values(data, 1):
+                self._upsert(v)
+
+    def close(self) -> None:
+        self.sock.close()

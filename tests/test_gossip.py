@@ -1,0 +1,105 @@
+"""Gossip CRDS convergence over real UDP sockets.
+
+Reference analog: src/flamenco/gossip/fd_gossip.c — three nodes (one
+entrypoint) converge on each other's contact info, signatures gate
+every value, and the converged table feeds stake_ci/shred_dest without
+hand-fed contacts (the VERDICT round-2 'leave the lab' criterion).
+"""
+
+import time
+
+import numpy as np
+
+from firedancer_tpu.flamenco import gossip as G
+from firedancer_tpu.ops.ed25519 import golden
+
+
+def _mk(rng, entrypoints=None, sv=9):
+    secret = rng.integers(0, 256, 32, np.uint8).tobytes()
+    return G.GossipNode(
+        secret, shred_version=sv, entrypoints=entrypoints or [],
+        tpu_addr=("127.0.0.1", int(rng.integers(1000, 60000))),
+    )
+
+
+def test_three_nodes_converge_and_feed_turbine():
+    rng = np.random.default_rng(41)
+    a = _mk(rng)
+    b = _mk(rng, entrypoints=[a.addr])
+    c = _mk(rng, entrypoints=[a.addr])
+    try:
+        deadline = time.monotonic() + 30.0
+        nodes = (a, b, c)
+        while time.monotonic() < deadline:
+            for n in nodes:
+                n.tick()
+            if all(len(n.contacts()) == 3 for n in nodes):
+                break
+            time.sleep(0.02)
+        assert all(len(n.contacts()) == 3 for n in nodes), [
+            len(n.contacts()) for n in nodes
+        ]
+        # every node knows every pubkey + the right gossip addr
+        for n in nodes:
+            got = {ci.pubkey: ci for ci in n.contacts()}
+            for m in nodes:
+                assert got[m.pubkey].gossip_addr == m.addr
+                assert got[m.pubkey].shred_version == 9
+        assert all(n.stats["bad_sig"] == 0 for n in nodes)
+
+        # converged contacts feed stake_ci -> shred_dest (turbine) with
+        # no hand-fed table
+        from firedancer_tpu.disco.shred_dest import (
+            ContactInfo as SDContact, ShredDest, StakeCI,
+        )
+
+        stakes = {a.pubkey: 100, b.pubkey: 50, c.pubkey: 10}
+        infos = [
+            SDContact(ci.pubkey, stakes[ci.pubkey], ci.tpu_addr)
+            for ci in b.contacts()
+        ]
+        ci_tbl = StakeCI()
+        ci_tbl.set_epoch(0, infos)
+        sd = ShredDest(ci_tbl.for_epoch(0), fanout=2)
+        order = sd.shuffle(5, 0, 0, leader=a.pubkey)
+        assert len(order) == 2  # everyone but the leader
+    finally:
+        for n in (a, b, c):
+            n.close()
+
+
+def test_forged_value_rejected_and_newest_wins():
+    rng = np.random.default_rng(43)
+    secret = rng.integers(0, 256, 32, np.uint8).tobytes()
+    n = G.GossipNode(secret)
+    try:
+        other = rng.integers(0, 256, 32, np.uint8).tobytes()
+        v = G.make_value(other, G.V_CONTACT, G.ContactInfo(
+            golden.public_from_secret(other), 1,
+            ("127.0.0.1", 1), ("127.0.0.1", 2),
+        ).body(), wallclock=10)
+        # tampered body -> signature fails -> rejected
+        bad = G.CrdsValue(v.origin, v.vkind, v.wallclock,
+                          v.body[:-1] + b"\xff", v.signature)
+        assert not n._upsert(bad)
+        assert n.stats["bad_sig"] == 1
+        # valid adopt, then an OLDER copy must not replace it
+        assert n._upsert(v)
+        old = G.make_value(other, G.V_CONTACT, v.body, wallclock=5)
+        assert not n._upsert(old)
+        newer = G.make_value(other, G.V_CONTACT, v.body, wallclock=20)
+        assert n._upsert(newer)
+        assert n.crds[(v.origin, G.V_CONTACT)].wallclock == 20
+    finally:
+        n.close()
+
+
+def test_value_wire_roundtrip():
+    rng = np.random.default_rng(44)
+    secret = rng.integers(0, 256, 32, np.uint8).tobytes()
+    v = G.make_value(secret, G.V_VOTE, b"vote-body", wallclock=123)
+    enc = v.encode()
+    dec, consumed = G.CrdsValue.decode(enc, 0)
+    assert consumed == len(enc)
+    assert dec == v and dec.verify()
+    assert G.CrdsValue.decode(enc[:50], 0) is None
