@@ -1,0 +1,110 @@
+"""zstd frame codec + snapshot create/restore/HTTP-download round trips.
+
+Reference analogs: src/ballet/zstd/, src/flamenco/snapshot/
+(fd_snapshot_create, fd_snapshot_restore, fd_snapshot_http).
+"""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import zstd as Z
+from firedancer_tpu.flamenco import snapshot as S
+from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+from firedancer_tpu.funk.funk import Funk
+
+
+def test_xxh64_public_vectors():
+    assert Z.xxh64(b"") == 0xEF46DB3751D8E999
+    assert Z.xxh64(b"a") == 0xD24EC4F1A98C6E5B
+    assert Z.xxh64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_zstd_roundtrip_and_interop():
+    rng = np.random.default_rng(0)
+    cases = [
+        b"", b"x", b"hello" * 1000,
+        rng.integers(0, 256, 300_000, np.uint8).tobytes(),
+        b"\0" * 500_000,
+    ]
+    for data in cases:
+        assert Z.decompress(Z.compress(data)) == data
+    # RLE blocks give real compression on zero-heavy data
+    assert len(Z.compress(b"\0" * 500_000)) < 100
+    # frames are VALID zstd: the reference implementation decodes them
+    zstandard = pytest.importorskip("zstandard")
+    data = cases[3]
+    assert zstandard.ZstdDecompressor().decompress(Z.compress(data)) == data
+    # external entropy-coded frames still decode (delegated) or fail loud
+    real = zstandard.ZstdCompressor(level=3).compress(data)
+    assert Z.decompress(real) == data
+
+
+def test_zstd_corruption_detected():
+    frame = bytearray(Z.compress(b"payload" * 100))
+    frame[-10] ^= 0xFF  # flip a content byte -> checksum mismatch
+    with pytest.raises(Z.ZstdError):
+        Z.decompress(bytes(frame))
+    with pytest.raises(Z.ZstdError):
+        Z.decompress(b"nope")
+
+
+def _populated_funk(n=200):
+    rng = np.random.default_rng(7)
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    keys = []
+    for _ in range(n):
+        k = rng.integers(0, 256, 32, np.uint8).tobytes()
+        mgr.store(
+            k,
+            Account(
+                int(rng.integers(1, 1 << 40)),
+                rng.integers(0, 256, 32, np.uint8).tobytes(),
+                data=rng.integers(0, 256, int(rng.integers(0, 512)),
+                                  np.uint8).tobytes(),
+            ),
+        )
+        keys.append(k)
+    return funk, keys
+
+
+def test_snapshot_roundtrip(tmp_path):
+    funk, keys = _populated_funk()
+    path = str(tmp_path / "snap.tar.zst")
+    h = S.create(funk, path, slot=42)
+    funk2, slot, h2 = S.restore(path)
+    assert slot == 42 and h == h2
+    assert funk2.root == funk.root
+    # restored accounts decode identically
+    m1, m2 = AccountMgr(funk), AccountMgr(funk2)
+    for k in keys[:10]:
+        assert m1.load(k).encode() == m2.load(k).encode()
+
+
+def test_snapshot_corruption_rejected(tmp_path):
+    funk, _ = _populated_funk(20)
+    path = str(tmp_path / "snap.tar.zst")
+    S.create(funk, path, slot=1)
+    raw = Z.decompress(open(path, "rb").read())
+    # tamper INSIDE an account record, then re-frame (checksum passes,
+    # manifest hash must catch it)
+    idx = raw.find(b"accounts/")
+    tampered = bytearray(raw)
+    tampered[idx + 2048] ^= 0x01
+    open(path, "wb").write(Z.compress(bytes(tampered)))
+    with pytest.raises((S.SnapshotError, Exception)):
+        S.restore(path)
+
+
+def test_snapshot_http_download(tmp_path):
+    funk, _ = _populated_funk(50)
+    src = str(tmp_path / "src.tar.zst")
+    dst = str(tmp_path / "dl.tar.zst")
+    h = S.create(funk, src, slot=9)
+    srv = S.serve(src)
+    try:
+        S.download(srv.addr, dst)
+    finally:
+        srv.close()
+    funk2, slot, h2 = S.restore(dst)
+    assert slot == 9 and h2 == h and funk2.root == funk.root
