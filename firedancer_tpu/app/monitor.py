@@ -42,26 +42,64 @@ class Monitor:
             )
         self.links = extra.get("links", {})
 
+    #: heartbeat older than this is flagged as stale (reference monitor
+    #: renders heartbeat diffs; a stuck tile stops beating long before
+    #: the fail-stop supervisor sees it die)
+    STALE_HEARTBEAT_NS = 2_000_000_000
+
     def snapshot(self) -> dict:
         """One consistent-enough read of every tile's state."""
+        import time as _t
+
+        now = _t.monotonic_ns()
         out = {}
         for name, tv in self.tiles.items():
+            hb = tv.cnc.heartbeat_query()
             out[name] = {
                 "signal": _SIGNAMES.get(
                     tv.cnc.signal_query(), str(tv.cnc.signal_query())
                 ),
-                "heartbeat": tv.cnc.heartbeat_query(),
+                "heartbeat": hb,
+                "stale": bool(hb) and now - hb > self.STALE_HEARTBEAT_NS,
                 "counters": {
                     c: tv.metrics.counter(c)
                     for c in tv.metrics.schema.counters
                 },
             }
         for lname, ls in self.links.items():
+            prod_seq = None
+            if "mcache" in ls:
+                mc = R.MCache(
+                    self.wksp.view(ls["mcache"]), ls["depth"], join=True
+                )
+                prod_seq = mc.seq_query()
             seqs = {}
             for c in ls["consumers"]:
                 fs = R.FSeq(self.wksp.view(c["fseq"]), join=True)
-                seqs[c["tile"]] = fs.query()
-            out.setdefault("_links", {})[lname] = seqs
+                cseq = fs.query()
+                seqs[c["tile"]] = {
+                    "seq": cseq,
+                    # consumer lag behind the producer cursor, in frags
+                    "lag": None
+                    if prod_seq is None
+                    else max(prod_seq - cseq, 0),
+                }
+            out.setdefault("_links", {})[lname] = {
+                "produced": prod_seq,
+                "consumers": seqs,
+            }
+        return out
+
+    def alarms(self, snap: dict) -> list[str]:
+        """Stale heartbeats + failed tiles, rendered as alarm lines."""
+        out = []
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            if row["signal"] == "FAIL":
+                out.append(f"ALARM {name}: FAIL signal")
+            elif row.get("stale"):
+                out.append(f"ALARM {name}: heartbeat stale")
         return out
 
     def render(self, prev: dict | None, cur: dict, dt: float) -> str:
@@ -80,10 +118,18 @@ class Monitor:
                 rout = (c["out_frags"] - p["out_frags"]) / dt
             else:
                 rin = rout = 0.0
+            flag = " STALE" if row.get("stale") else ""
             lines.append(
                 f"{name:>10} {row['signal']:>5} {rin:12,.0f} {rout:12,.0f} "
-                f"{c['in_frags']:12,} {c['out_frags']:12,}"
+                f"{c['in_frags']:12,} {c['out_frags']:12,}{flag}"
             )
+        for lname, ls in cur.get("_links", {}).items():
+            for tile, s in ls["consumers"].items():
+                if s["lag"]:
+                    lines.append(
+                        f"{'':>10} link {lname} -> {tile}: lag {s['lag']:,}"
+                    )
+        lines.extend(self.alarms(cur))
         return "\n".join(lines)
 
     def run(self, interval_s: float = 1.0, iterations: int | None = None):

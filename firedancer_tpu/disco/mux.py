@@ -191,24 +191,35 @@ def run_loop(
     ctx: MuxCtx,
     *,
     batch_max: int = 4096,
-    housekeep_every: int = 64,
+    lazy_ns: int | None = None,
     idle_sleep_s: float = 50e-6,
     idle_before_sleep: int = 32,
 ) -> None:
     """Drive one tile until its cnc receives HALT (or on_boot/callbacks
     raise).  Mirrors the fd_mux_tile phase structure: housekeeping →
-    credit receive → frag drain → callbacks → idle backoff."""
+    credit receive → frag drain → callbacks → idle backoff.
+
+    Housekeeping cadence is time-based via tango.tempo: the interval
+    derives from the smallest ring depth (lazy_default) and each firing
+    re-arms at a jittered point (async_reload) so tiles decorrelate."""
+    from firedancer_tpu.tango import tempo
+
     m = ctx.metrics
     cnc = ctx.cnc
     tile.on_boot(ctx)
     cnc.signal(R.CNC_RUN)
-    it = 0
+    if lazy_ns is None:
+        depths = [il.mcache.depth for il in ctx.ins] + [
+            o.depth for o in ctx.outs
+        ]
+        lazy_ns = tempo.lazy_default(min(depths) if depths else batch_max)
+    next_hk = 0  # fire immediately on the first iteration
     idle = 0
     try:
         while True:
-            it += 1
-            if (it - 1) % housekeep_every == 0:
-                now = time.monotonic_ns()
+            now = time.monotonic_ns()
+            if now >= next_hk:
+                next_hk = now + tempo.async_reload(lazy_ns)
                 cnc.heartbeat(now)
                 for il in ctx.ins:
                     il.fseq.update(il.seq)

@@ -174,6 +174,7 @@ class Topology:
         links = {
             ls.name: {
                 "depth": ls.depth,
+                "mcache": f"mc_{ls.name}",
                 "consumers": [
                     {"tile": cons, "fseq": f"fs_{ls.name}_{cons}"}
                     for cons, _rel in ls.consumers
@@ -186,9 +187,15 @@ class Topology:
     # ---- run ------------------------------------------------------------
 
     def _tile_main(self, ts: TileSpec, loop_kw: dict) -> None:
+        from firedancer_tpu.utils import log
+
+        log.set_tile(ts.ctx.name)
+        log.info("tile booting")
         try:
             run_loop(ts.tile, ts.ctx, **loop_kw)
+            log.info("tile halted")
         except BaseException as e:  # noqa: BLE001 — fail-stop supervision
+            log.err("tile failed: %r", e)
             ts.error = e
 
     def start(self, boot_timeout_s: float = 600.0, **loop_kw) -> None:

@@ -126,3 +126,61 @@ void fdt_sha512_batch( uint8_t const * msgs, int32_t const * lens,
         o[ 8 * a2 + j ] = (uint8_t)( st[ a2 ] >> ( 56 - 8 * j ) );
   }
 }
+
+/* ==== XXH64 (zstd content checksums; spec-derived prime constants) ==== */
+
+static const uint64_t XP1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t XP2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t XP3 = 0x165667B19E3779F9ULL;
+static const uint64_t XP4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t XP5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t xrotl( uint64_t x, int r ) {
+  return ( x << r ) | ( x >> ( 64 - r ) );
+}
+
+static inline uint64_t xread64( uint8_t const * p ) {
+  uint64_t v;
+  memcpy( &v, p, 8 );
+  return v;  /* little-endian hosts only (matches the rest of the build) */
+}
+
+uint64_t fdt_xxh64( uint8_t const * p, uint64_t n, uint64_t seed ) {
+  uint8_t const * end = p + n;
+  uint64_t h;
+  if( n >= 32 ) {
+    uint64_t v1 = seed + XP1 + XP2, v2 = seed + XP2, v3 = seed,
+             v4 = seed - XP1;
+    uint8_t const * limit = end - 32;
+    do {
+      v1 = xrotl( v1 + xread64( p ) * XP2, 31 ) * XP1; p += 8;
+      v2 = xrotl( v2 + xread64( p ) * XP2, 31 ) * XP1; p += 8;
+      v3 = xrotl( v3 + xread64( p ) * XP2, 31 ) * XP1; p += 8;
+      v4 = xrotl( v4 + xread64( p ) * XP2, 31 ) * XP1; p += 8;
+    } while( p <= limit );
+    h = xrotl( v1, 1 ) + xrotl( v2, 7 ) + xrotl( v3, 12 ) + xrotl( v4, 18 );
+    v1 = xrotl( v1 * XP2, 31 ) * XP1; h = ( h ^ v1 ) * XP1 + XP4;
+    v2 = xrotl( v2 * XP2, 31 ) * XP1; h = ( h ^ v2 ) * XP1 + XP4;
+    v3 = xrotl( v3 * XP2, 31 ) * XP1; h = ( h ^ v3 ) * XP1 + XP4;
+    v4 = xrotl( v4 * XP2, 31 ) * XP1; h = ( h ^ v4 ) * XP1 + XP4;
+  } else {
+    h = seed + XP5;
+  }
+  h += n;
+  while( p + 8 <= end ) {
+    h = xrotl( h ^ ( xrotl( xread64( p ) * XP2, 31 ) * XP1 ), 27 ) * XP1 + XP4;
+    p += 8;
+  }
+  if( p + 4 <= end ) {
+    uint32_t v;
+    memcpy( &v, p, 4 );
+    h = xrotl( h ^ ( (uint64_t)v * XP1 ), 23 ) * XP2 + XP3;
+    p += 4;
+  }
+  while( p < end ) {
+    h = xrotl( h ^ ( (uint64_t)*p * XP5 ), 11 ) * XP1;
+    p++;
+  }
+  h ^= h >> 33; h *= XP2; h ^= h >> 29; h *= XP3; h ^= h >> 32;
+  return h;
+}

@@ -1,0 +1,135 @@
+"""Pod: a hierarchical key-value property bag serialized flat in shared
+memory.
+
+Reference model: src/util/pod/ — config and topology property bags live
+in one contiguous shmem region ("pod") so any process mapping the
+workspace can query `a.b.c` paths without an allocator or a parser
+dependency.  Layout here is an append-only record stream inside a
+caller-provided u8 buffer:
+
+    header: b"POD1" | u32 used
+    record: u16 keylen | key (utf-8, dot-separated path)
+            | u8 type | u32 vallen | value
+    types:  0 = u64 (little-endian 8 bytes), 1 = utf-8 string,
+            2 = raw bytes, 3 = subpod (nested record stream)
+
+Later records shadow earlier ones with the same key (the query scans
+from the end), which gives O(1) update-by-append like the reference's
+pod semantics for config layering.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+T_U64, T_STR, T_BYTES, T_SUBPOD = range(4)
+
+_MAGIC = b"POD1"
+_HDR = 8
+
+
+class Pod:
+    """View over a (shared) u8 buffer holding one pod."""
+
+    def __init__(self, buf: np.ndarray, *, new: bool = False):
+        self.buf = buf
+        if new or bytes(buf[:4]) != _MAGIC:
+            buf[:4] = np.frombuffer(_MAGIC, np.uint8)
+            self._set_used(0)
+
+    def _used(self) -> int:
+        return int(self.buf[4:8].view("<u4")[0])
+
+    def _set_used(self, n: int) -> None:
+        self.buf[4:8].view("<u4")[0] = n
+
+    # -- write -------------------------------------------------------------
+
+    def _append(self, key: str, typ: int, val: bytes) -> None:
+        kb = key.encode()
+        rec = struct.pack("<H", len(kb)) + kb + bytes([typ])
+        rec += struct.pack("<I", len(val)) + val
+        used = self._used()
+        end = _HDR + used + len(rec)
+        if end > len(self.buf):
+            raise MemoryError("pod full")
+        self.buf[_HDR + used : end] = np.frombuffer(rec, np.uint8)
+        self._set_used(used + len(rec))
+
+    def insert_u64(self, key: str, v: int) -> None:
+        self._append(key, T_U64, struct.pack("<Q", v))
+
+    def insert_str(self, key: str, v: str) -> None:
+        self._append(key, T_STR, v.encode())
+
+    def insert_bytes(self, key: str, v: bytes) -> None:
+        self._append(key, T_BYTES, v)
+
+    def insert_subpod(self, key: str, sub: "Pod") -> None:
+        self._append(
+            key, T_SUBPOD, bytes(sub.buf[: _HDR + sub._used()])
+        )
+
+    # -- read --------------------------------------------------------------
+
+    def _records(self):
+        raw = bytes(self.buf[_HDR : _HDR + self._used()])
+        off = 0
+        while off < len(raw):
+            (klen,) = struct.unpack_from("<H", raw, off)
+            off += 2
+            key = raw[off : off + klen].decode()
+            off += klen
+            typ = raw[off]
+            off += 1
+            (vlen,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            val = raw[off : off + vlen]
+            off += vlen
+            yield key, typ, val
+
+    def query(self, path: str):
+        """-> (type, raw value) or None.  Dotted paths descend subpods
+        when no flat key matches."""
+        hit = None
+        for key, typ, val in self._records():
+            if key == path:
+                hit = (typ, val)  # last record wins (layering)
+        if hit is not None:
+            return hit
+        # descend: longest subpod prefix
+        parts = path.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            sub = None
+            for key, typ, val in self._records():
+                if key == prefix and typ == T_SUBPOD:
+                    sub = val
+            if sub is not None:
+                buf = np.frombuffer(bytearray(sub), np.uint8)
+                return Pod(buf).query(".".join(parts[cut:]))
+        return None
+
+    def query_u64(self, path: str, default: int | None = None) -> int | None:
+        hit = self.query(path)
+        if hit is None or hit[0] != T_U64:
+            return default
+        return struct.unpack("<Q", hit[1])[0]
+
+    def query_str(self, path: str, default: str | None = None) -> str | None:
+        hit = self.query(path)
+        if hit is None or hit[0] != T_STR:
+            return default
+        return hit[1].decode()
+
+    def query_bytes(self, path: str) -> bytes | None:
+        hit = self.query(path)
+        return hit[1] if hit is not None and hit[0] == T_BYTES else None
+
+    def keys(self) -> list[str]:
+        seen = {}
+        for key, typ, _ in self._records():
+            seen[key] = typ
+        return sorted(seen)

@@ -82,6 +82,7 @@ def _load() -> ct.CDLL:
         "fdt_sha512_init_consts": (None, [vp, vp]),
         "fdt_sha512_rpm": (None, [vp, vp, vp, u64, vp]),
         "fdt_sha512_batch": (None, [vp, vp, u64, u64, vp]),
+        "fdt_xxh64": (u64, [vp, u64, u64]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -135,6 +136,7 @@ class Workspace:
         self.size = int(size)
         self.name = name
         self._allocs: dict[str, tuple[int, int]] = {}
+        self._free: list[tuple[int, int]] = []
         self._off = 64
         if name is None:
             self._mm = None
@@ -148,6 +150,23 @@ class Workspace:
             self._path = path
 
     def alloc(self, name: str, footprint: int, align: int = 128) -> np.ndarray:
+        # first fit from the free list (freed regions are reusable, the
+        # reference's treap free/used discipline in miniature), else bump
+        free = self._free
+        if free:
+            for i, (foff, fsz) in enumerate(free):
+                off = (foff + align - 1) & ~(align - 1)
+                if off + footprint <= foff + fsz:
+                    head = off - foff
+                    tail = (foff + fsz) - (off + footprint)
+                    rep = []
+                    if head:
+                        rep.append((foff, head))
+                    if tail:
+                        rep.append((off + footprint, tail))
+                    free[i : i + 1] = rep
+                    self._allocs[name] = (off, footprint)
+                    return self.buf[off : off + footprint]
         off = (self._off + align - 1) & ~(align - 1)
         if off + footprint > self.size:
             raise MemoryError(f"workspace full allocating {name!r}")
@@ -155,9 +174,67 @@ class Workspace:
         self._allocs[name] = (off, footprint)
         return self.buf[off : off + footprint]
 
+    def free(self, name: str) -> None:
+        """Return an allocation to the free list (coalescing neighbors).
+        The caller owns the hazard of outstanding views (single-writer
+        discipline, like fd_wksp_free)."""
+        off, fp = self._allocs.pop(name)
+        free = self._free
+        free.append((off, fp))
+        free.sort()
+        merged = [free[0]]
+        for o, s in free[1:]:
+            lo, ls = merged[-1]
+            if lo + ls == o:
+                merged[-1] = (lo, ls + s)
+            else:
+                merged.append((o, s))
+        self._free = merged
+
     def view(self, name: str) -> np.ndarray:
         off, fp = self._allocs[name]
         return self.buf[off : off + fp]
+
+    # -- checkpoint / restore (fd_wksp_checkpt/restore analog) ------------
+
+    _CKPT_MAGIC = b"FDTWKSP1"
+
+    def checkpt(self, path: str) -> None:
+        """Serialize the whole workspace (alloc table + live bytes) to a
+        file; any shared-memory state (rings, tcaches, metrics) can be
+        snapshotted and resumed (src/util/wksp/fd_wksp.h:966-1012)."""
+        import json
+
+        meta = json.dumps(
+            {
+                "size": self.size,
+                "off": self._off,
+                "allocs": {k: list(v) for k, v in self._allocs.items()},
+                "free": [list(v) for v in self._free],
+            }
+        ).encode()
+        with open(path, "wb") as f:
+            f.write(self._CKPT_MAGIC)
+            f.write(len(meta).to_bytes(4, "little"))
+            f.write(meta)
+            f.write(self.buf[: self._off].tobytes())
+
+    @classmethod
+    def restore_file(cls, path: str, name: str | None = None) -> "Workspace":
+        import json
+
+        with open(path, "rb") as f:
+            if f.read(8) != cls._CKPT_MAGIC:
+                raise ValueError("bad wksp checkpoint magic")
+            n = int.from_bytes(f.read(4), "little")
+            meta = json.loads(f.read(n))
+            body = f.read(meta["off"])
+        ws = cls(meta["size"], name=name)
+        ws.buf[: len(body)] = np.frombuffer(body, np.uint8)
+        ws._off = meta["off"]
+        ws._allocs = {k: tuple(v) for k, v in meta["allocs"].items()}
+        ws._free = [tuple(v) for v in meta.get("free", [])]
+        return ws
 
     # -- cross-process attach (named workspaces) --------------------------
 

@@ -1,0 +1,89 @@
+"""Best-effort process sandbox for tile processes.
+
+Reference model: src/util/sandbox/fd_sandbox.h:14-60 — before entering
+the run loop a tile drops everything it can: close stray file
+descriptors, scrub the environment, zero rlimits it does not need,
+forbid privilege re-escalation, and (in the reference) install a
+seccomp-BPF syscall allowlist.  This Python host applies every measure
+the interpreter can survive: fd close, env clear, RLIMIT zeroing,
+umask, PR_SET_NO_NEW_PRIVS via prctl, and setuid/setgid when running as
+root with a target uid.  A seccomp filter needs a native helper and is
+not installed here (documented gap, not a silent one).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import resource
+
+PR_SET_NO_NEW_PRIVS = 38
+
+
+def _close_fds(keep: set[int]) -> int:
+    closed = 0
+    try:
+        fds = [int(x) for x in os.listdir("/proc/self/fd")]
+    except OSError:
+        fds = list(range(3, 1024))
+    for fd in fds:
+        if fd in keep:
+            continue
+        try:
+            os.close(fd)
+            closed += 1
+        except OSError:
+            pass
+    return closed
+
+
+def sandbox(
+    *,
+    keep_fds: tuple[int, ...] = (0, 1, 2),
+    keep_env: tuple[str, ...] = (),
+    max_open_files: int | None = None,
+    no_fork: bool = True,
+    uid: int | None = None,
+    gid: int | None = None,
+) -> dict:
+    """Apply the drop set; returns a report of what was applied.
+
+    Call AFTER every needed fd (sockets, logs, shared memory) is open
+    and listed in keep_fds — exactly the reference's ordering contract
+    (privileged_init opens, fd_sandbox drops, unprivileged_init runs)."""
+    report: dict = {}
+    report["closed_fds"] = _close_fds(set(keep_fds))
+    # environment scrub
+    kept = {k: v for k, v in os.environ.items() if k in keep_env}
+    os.environ.clear()
+    os.environ.update(kept)
+    report["env_kept"] = sorted(kept)
+    os.umask(0o077)
+    # rlimits: no new files beyond what we hold, no core dumps, no forks
+    if max_open_files is not None:
+        resource.setrlimit(
+            resource.RLIMIT_NOFILE, (max_open_files, max_open_files)
+        )
+        report["rlimit_nofile"] = max_open_files
+    resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+    if no_fork:
+        try:
+            resource.setrlimit(resource.RLIMIT_NPROC, (0, 0))
+            report["rlimit_nproc"] = 0
+        except (ValueError, OSError):
+            report["rlimit_nproc"] = "unavailable"
+    # privilege drop (only meaningful as root)
+    if gid is not None and hasattr(os, "setresgid"):
+        os.setresgid(gid, gid, gid)
+        report["gid"] = gid
+    if uid is not None and hasattr(os, "setresuid"):
+        os.setresuid(uid, uid, uid)
+        report["uid"] = uid
+    # no_new_privs: execve can never regain privileges
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        if libc.prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) == 0:
+            report["no_new_privs"] = True
+    except OSError:
+        report["no_new_privs"] = False
+    return report

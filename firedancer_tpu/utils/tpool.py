@@ -1,0 +1,84 @@
+"""Fork-join thread pool with recursive-bisection dispatch.
+
+Reference model: src/util/tpool/fd_tpool.h (design essay) — a pool of
+worker tiles where a caller partitions an index range by recursive
+halving: the caller keeps one half, hands the other to an idle worker,
+and recurses, so dispatch cost is O(log workers) on the critical path
+and the work lands in cache-friendly contiguous spans.  This build's
+workers are threads; the bisection discipline (and the exec/wait API
+shape) carries over, and numpy/native callees release the GIL so the
+joins genuinely overlap.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class TPool:
+    """exec_all(task, lo, hi): run task(lo', hi') over [lo, hi) split
+    across the pool by recursive bisection; wait() joins everything."""
+
+    def __init__(self, workers: int = 4):
+        assert workers >= 1
+        self.workers = workers
+        self._q: queue.Queue = queue.Queue()
+        self._threads = [
+            threading.Thread(target=self._main, daemon=True, name=f"tpool{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _main(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args, done = item
+            try:
+                fn(*args)
+            except BaseException as e:  # noqa: BLE001 — joined in wait()
+                done.errors.append(e)
+            finally:
+                done.sem.release()
+
+    class _Join:
+        def __init__(self):
+            self.sem = threading.Semaphore(0)
+            self.count = 0
+            self.errors: list[BaseException] = []
+
+        def wait(self) -> None:
+            for _ in range(self.count):
+                self.sem.acquire()
+            if self.errors:
+                raise self.errors[0]
+
+    def exec_all(self, task, lo: int, hi: int, max_split: int | None = None):
+        """Recursive-bisection dispatch of task(lo, hi) spans; returns a
+        join handle (.wait())."""
+        join = self._Join()
+        splits = max_split or self.workers
+
+        def bisect(lo: int, hi: int, ways: int) -> None:
+            if ways <= 1 or hi - lo <= 1:
+                join.count += 1
+                self._q.put((task, (lo, hi), join))
+                return
+            mid = lo + (hi - lo) // 2
+            bisect(lo, mid, ways // 2)
+            bisect(mid, hi, ways - ways // 2)
+
+        bisect(lo, hi, splits)
+        return join
+
+    def run_all(self, task, lo: int, hi: int) -> None:
+        self.exec_all(task, lo, hi).wait()
+
+    def close(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5.0)

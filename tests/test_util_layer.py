@@ -1,0 +1,173 @@
+"""util/tango substrate pieces: tempo, pod, wksp free/checkpt, tpool,
+sandbox, lru, logging.
+
+Reference analogs: src/tango/tempo/, src/util/pod/, src/util/wksp
+(checkpt/restore + free), src/util/tpool/, src/util/sandbox/,
+src/tango/lru/, src/util/log/.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from firedancer_tpu.tango import rings as R
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.tango.lru import Lru
+from firedancer_tpu.tango.pod import Pod
+from firedancer_tpu.utils.tpool import TPool
+
+
+def test_tempo_calibration_and_lazy():
+    r = tempo.tick_per_ns(0.002)
+    assert 0.5 < r < 2.0  # tick source is the ns clock
+    lazy = tempo.lazy_default(1 << 15)
+    assert 100_000 <= lazy <= 100_000_000
+    xs = {tempo.async_reload(lazy) for _ in range(64)}
+    assert all(lazy // 2 <= x <= 3 * lazy // 2 + 1 for x in xs)
+    assert len(xs) > 8  # actually jittered
+
+
+def test_pod_layered_queries():
+    buf = np.zeros(4096, np.uint8)
+    pod = Pod(buf, new=True)
+    pod.insert_u64("tiles.verify.max_lanes", 16384)
+    pod.insert_str("name", "fdt")
+    pod.insert_bytes("identity", b"\x01" * 32)
+    sub = Pod(np.zeros(512, np.uint8), new=True)
+    sub.insert_u64("depth", 4096)
+    pod.insert_subpod("links.quic_verify", sub)
+    assert pod.query_u64("tiles.verify.max_lanes") == 16384
+    assert pod.query_str("name") == "fdt"
+    assert pod.query_bytes("identity") == b"\x01" * 32
+    assert pod.query_u64("links.quic_verify.depth") == 4096
+    assert pod.query_u64("missing", default=7) == 7
+    # layering: later insert shadows earlier
+    pod.insert_u64("tiles.verify.max_lanes", 4096)
+    assert pod.query_u64("tiles.verify.max_lanes") == 4096
+    # pod survives a round trip through raw shared bytes
+    pod2 = Pod(buf)
+    assert pod2.query_u64("links.quic_verify.depth") == 4096
+    assert "name" in pod2.keys()
+
+
+def test_wksp_free_reuse_and_checkpt(tmp_path):
+    ws = R.Workspace(1 << 16)
+    a = ws.alloc("a", 1024)
+    a[:] = 7
+    b = ws.alloc("b", 2048)
+    b[:] = 9
+    off_b = ws._allocs["b"][0]
+    ws.free("b")
+    c = ws.alloc("c", 1000)  # fits in b's freed hole
+    assert ws._allocs["c"][0] >= off_b
+    assert ws._allocs["c"][0] + 1000 <= off_b + 2048
+    ws.free("c")
+    ws.free("a")
+    # coalescing: a+b+c adjacent ranges merge
+    assert len(ws._free) == 1
+
+    d = ws.alloc("d", 64)
+    d[:] = np.arange(64, dtype=np.uint8)
+    p = str(tmp_path / "w.ckpt")
+    ws.checkpt(p)
+    ws2 = R.Workspace.restore_file(p)
+    assert np.array_equal(ws2.view("d"), d)
+    assert ws2._allocs == ws._allocs
+
+
+def test_tpool_bisection_fork_join():
+    pool = TPool(workers=4)
+    try:
+        out = np.zeros(10_000, np.int64)
+
+        def task(lo, hi):
+            out[lo:hi] = np.arange(lo, hi)
+
+        pool.run_all(task, 0, len(out))
+        assert np.array_equal(out, np.arange(len(out)))
+
+        # errors propagate at join
+        def boom(lo, hi):
+            raise RuntimeError("boom")
+
+        try:
+            pool.run_all(boom, 0, 4)
+            raise AssertionError("expected join error")
+        except RuntimeError:
+            pass
+    finally:
+        pool.close()
+
+
+def test_sandbox_subprocess():
+    """Apply the sandbox in a child: env cleared, rlimits set, fork
+    forbidden."""
+    code = r"""
+import json, os, resource, sys
+sys.path.insert(0, %r)
+from firedancer_tpu.utils.sandbox import sandbox
+os.environ["SECRET"] = "x"
+# as root, NPROC=0 only binds after the uid drop (root is exempt from
+# process-count limits) — exactly the reference's drop ordering
+drop = {"uid": 65534, "gid": 65534} if os.geteuid() == 0 else {}
+rep = sandbox(keep_env=("PATH",), max_open_files=16, **drop)
+out = {
+    "env": dict(os.environ),
+    "nofile": resource.getrlimit(resource.RLIMIT_NOFILE)[0],
+    "rep_keys": sorted(rep),
+}
+try:
+    os.fork()
+    out["fork"] = "allowed"
+except OSError:
+    out["fork"] = "blocked"
+print(json.dumps(out))
+""" % (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    import json
+
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "SECRET" not in out["env"]
+    assert out["nofile"] == 16
+    assert out["fork"] == "blocked"
+
+
+def test_lru_eviction_order():
+    lru = Lru(3)
+    for k in "abc":
+        lru.acquire(k)
+    lru.touch("a")  # order now (LRU -> MRU): b, c, a
+    assert list(lru.iter_lru()) == ["b", "c", "a"]
+    _slot, evicted = lru.acquire("d")
+    assert evicted == "b"
+    assert lru.remove("c") and not lru.remove("zz")
+    assert len(lru) == 2
+
+
+def test_log_levels_and_dedup(tmp_path, capsys):
+    from firedancer_tpu.utils import log
+
+    p = str(tmp_path / "fdt.log")
+    log.init(path=p, stderr_level="ERR", file_level="DEBUG")
+    with log.scope("verify"):
+        log.notice("hello %d", 1)
+        log.notice("hello %d", 1)  # duplicate: suppressed
+        log.notice("hello %d", 2)
+        log.err("boom")
+    log.init()  # close the file stream
+    text = open(p).read()
+    assert text.count("hello 1") == 1
+    assert "repeated 1 times" in text
+    assert "hello 2" in text and "boom" in text
+    assert " verify " in text  # tile attribution
+    err = capsys.readouterr().err
+    assert "boom" in err and "hello 2" not in err  # stderr filtered at ERR
