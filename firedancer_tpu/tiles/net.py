@@ -1,0 +1,126 @@
+"""Net tile: the socket edge, decoupled from protocol tiles.
+
+Reference model: src/app/fdctl/run/tiles/fd_net.c — the only tile
+touching the NIC (AF_XDP there, UDP sockets here): rx datagrams route by
+destination port onto per-protocol rings, and protocol tiles send by
+publishing to the net tile's tx ring.  Datagram frags carry the peer
+address as a 6-byte prefix (ip4 + port), so protocol tiles stay sans-IO.
+
+Ring layout: outs[0] = rx ring (to the quic tile, QUIC port + legacy
+port datagrams alike; the ctl field distinguishes: CTL_QUIC/CTL_LEGACY);
+ins[0] = tx ring (addr-prefixed datagrams to put on the wire).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+
+from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.waltz.udpsock import UdpSock
+
+ADDR_SZ = 6
+#: ctl tags for rx frags (which port the datagram arrived on)
+CTL_QUIC = 8
+CTL_LEGACY = 16
+
+#: dcache MTU for net rings: addr prefix + a full UDP payload
+NET_MTU = ADDR_SZ + 1500
+
+
+def addr_pack(addr: tuple[str, int]) -> bytes:
+    return socket.inet_aton(addr[0]) + struct.pack("<H", addr[1])
+
+
+def addr_unpack(b: bytes) -> tuple[str, int]:
+    return socket.inet_ntoa(bytes(b[:4])), struct.unpack("<H", bytes(b[4:6]))[0]
+
+
+class NetTile(Tile):
+    """Owns the sockets; routes rx by port, drains the tx ring."""
+
+    name = "net"
+    schema = MetricsSchema(
+        counters=("rx_dgrams", "tx_dgrams", "rx_bytes", "tx_bytes",
+                  "oversize_drops"),
+    )
+
+    def __init__(
+        self,
+        *,
+        quic_addr=("127.0.0.1", 0),
+        udp_addr=("127.0.0.1", 0),
+        burst: int = 256,
+    ):
+        self._quic_addr_req = quic_addr
+        self._udp_addr_req = udp_addr
+        self.burst = burst
+        self.quic_sock: UdpSock | None = None
+        self.udp_sock: UdpSock | None = None
+
+    @property
+    def quic_addr(self):
+        return self.quic_sock.addr
+
+    @property
+    def udp_addr(self):
+        return self.udp_sock.addr
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        self.quic_sock = UdpSock(self._quic_addr_req)
+        self.udp_sock = UdpSock(self._udp_addr_req)
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        for s in (self.quic_sock, self.udp_sock):
+            if s is not None:
+                s.close()
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        """tx ring: addr-prefixed datagrams out the QUIC socket."""
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        pkts = []
+        for i in range(len(rows)):
+            row = rows[i, : frags["sz"][i]]
+            addr = addr_unpack(row[:ADDR_SZ])
+            pkts.append((row[ADDR_SZ:].tobytes(), addr))
+        n = self.quic_sock.send_burst(pkts)
+        ctx.metrics.inc("tx_dgrams", n)
+        ctx.metrics.inc("tx_bytes", int(frags["sz"].sum()) - ADDR_SZ * len(rows))
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        budget = ctx.credits
+        if budget <= 0:
+            return
+        rows_l, szs_l, ctls_l = [], [], []
+        for sock, ctl in ((self.quic_sock, CTL_QUIC), (self.udp_sock, CTL_LEGACY)):
+            # the budget is shared across both sockets: the combined
+            # publish must stay within the iteration's credits
+            take = min(self.burst, budget - len(rows_l))
+            if take <= 0:
+                break
+            for data, addr in sock.recv_burst(take):
+                if len(data) > NET_MTU - ADDR_SZ:
+                    ctx.metrics.inc("oversize_drops")
+                    continue
+                payload = addr_pack(addr) + data
+                row = np.zeros(NET_MTU, np.uint8)
+                row[: len(payload)] = np.frombuffer(payload, np.uint8)
+                rows_l.append(row)
+                szs_l.append(len(payload))
+                ctls_l.append(ctl | 3)  # SOM|EOM
+        if not rows_l:
+            return
+        n = len(rows_l)
+        ctx.metrics.inc("rx_dgrams", n)
+        ctx.metrics.inc("rx_bytes", int(sum(szs_l)) - ADDR_SZ * n)
+        ctx.publish(
+            np.arange(n, dtype=np.uint64),
+            np.stack(rows_l),
+            np.asarray(szs_l, np.uint16),
+            ctls=np.asarray(ctls_l, np.uint16),
+        )
+        ctx.credits -= n

@@ -46,15 +46,23 @@ class QuicIngressTile(Tile):
         quic_addr=("127.0.0.1", 0),
         udp_addr=("127.0.0.1", 0),
         burst: int = 256,
+        via_net: bool = False,
     ):
+        """via_net=True: sans-IO mode behind a NetTile — ins[0] carries
+        addr-prefixed datagram frags, outs[-1] is the tx ring back to the
+        net tile (reference topology: net -> quic -> net)."""
         self.identity_secret = identity_secret
         self._quic_addr_req = quic_addr
         self._udp_addr_req = udp_addr
         self.burst = burst
+        self.via_net = via_net
         self.quic_sock: UdpSock | None = None
         self.udp_sock: UdpSock | None = None
         self.server: Q.QuicServer | None = None
         self._backlog: list[bytes] = []  # parsed txn+trailer payloads
+        import collections
+
+        self._tx_backlog: collections.deque = collections.deque()
 
     # bound addresses, available after on_boot (ports may be ephemeral)
     @property
@@ -66,8 +74,9 @@ class QuicIngressTile(Tile):
         return self.udp_sock.addr
 
     def on_boot(self, ctx: MuxCtx) -> None:
-        self.quic_sock = UdpSock(self._quic_addr_req)
-        self.udp_sock = UdpSock(self._udp_addr_req)
+        if not self.via_net:
+            self.quic_sock = UdpSock(self._quic_addr_req)
+            self.udp_sock = UdpSock(self._udp_addr_req)
         self.server = Q.QuicServer(self.identity_secret)
 
     def on_halt(self, ctx: MuxCtx) -> None:
@@ -76,6 +85,39 @@ class QuicIngressTile(Tile):
         if self.udp_sock:
             self.udp_sock.close()
 
+    def _tx(self, ctx: MuxCtx, out_pkts: list[tuple[bytes, tuple]]) -> None:
+        """Send datagrams: straight out the socket, or queue them for the
+        tx ring toward the net tile (one rx datagram can produce several
+        tx datagrams, so ring publishes are credit-gated in _flush_tx)."""
+        if not out_pkts:
+            return
+        if not self.via_net:
+            ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
+            return
+        self._tx_backlog.extend(out_pkts)
+        self._flush_tx(ctx)
+
+    def _flush_tx(self, ctx: MuxCtx) -> None:
+        """Publish queued tx datagrams within the net ring's own credit
+        headroom (independent of the txn ring's budget)."""
+        if not self._tx_backlog:
+            return
+        from firedancer_tpu.tiles.net import NET_MTU, addr_pack
+
+        out = ctx.outs[-1]
+        n = min(len(self._tx_backlog), out.cr_avail())
+        if n <= 0:
+            return
+        rows = np.zeros((n, NET_MTU), np.uint8)
+        szs = np.zeros(n, np.uint16)
+        for i in range(n):
+            d, addr = self._tx_backlog.popleft()
+            payload = addr_pack(addr) + d
+            rows[i, : len(payload)] = np.frombuffer(payload, np.uint8)
+            szs[i] = len(payload)
+        out.publish(np.arange(n, dtype=np.uint64), rows, szs)
+        ctx.metrics.inc("tx_dgrams", n)
+
     def during_housekeeping(self, ctx: MuxCtx) -> None:
         # loss-recovery probe timers: retransmit when acks stall
         out_pkts = []
@@ -83,8 +125,38 @@ class QuicIngressTile(Tile):
             conn.on_timer()
             for d in conn.datagrams_out():
                 out_pkts.append((d, addr))
-        if out_pkts:
-            ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
+        self._tx(ctx, out_pkts)
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
+        """via_net mode: datagram frags from the net tile."""
+        from firedancer_tpu.tiles.net import ADDR_SZ, CTL_LEGACY, addr_unpack
+
+        il = ctx.ins[in_idx]
+        rows = il.gather(frags)
+        out_pkts = []
+        n_conns = len(self.server.conns)
+        for i in range(len(rows)):
+            row = rows[i, : frags["sz"][i]]
+            addr = addr_unpack(row[:ADDR_SZ])
+            data = row[ADDR_SZ:].tobytes()
+            ctx.metrics.inc("rx_dgrams")
+            if frags["ctl"][i] & CTL_LEGACY:
+                self._ingest_txn(ctx, data, "rx_txns_udp")
+                continue
+            conn = self.server.on_datagram(data, addr)
+            if conn is not None:
+                for d in conn.datagrams_out():
+                    out_pkts.append((d, addr))
+                if conn.txns:
+                    for raw in conn.txns:
+                        self._ingest_txn(ctx, raw, "rx_txns_quic")
+                    conn.txns.clear()
+        for pkt, addr in self.server.stateless_out:
+            out_pkts.append((pkt, addr))
+        self.server.stateless_out.clear()
+        if len(self.server.conns) > n_conns:
+            ctx.metrics.inc("conns_opened", len(self.server.conns) - n_conns)
+        self._tx(ctx, out_pkts)
 
     def _ingest_txn(self, ctx: MuxCtx, raw: bytes, counter: str) -> None:
         desc = T.parse(raw)
@@ -96,36 +168,39 @@ class QuicIngressTile(Tile):
 
     def after_credit(self, ctx: MuxCtx) -> None:
         n_conns = len(self.server.conns)
-        # legacy UDP: one datagram = one txn (fd_quic.c legacy path)
-        for data, _addr in self.udp_sock.recv_burst(self.burst):
-            ctx.metrics.inc("rx_dgrams")
-            self._ingest_txn(ctx, data, "rx_txns_udp")
+        if not self.via_net:
+            # legacy UDP: one datagram = one txn (fd_quic.c legacy path)
+            for data, _addr in self.udp_sock.recv_burst(self.burst):
+                ctx.metrics.inc("rx_dgrams")
+                self._ingest_txn(ctx, data, "rx_txns_udp")
 
-        # QUIC datagrams
-        out_pkts = []
-        touched = []
-        for data, addr in self.quic_sock.recv_burst(self.burst):
-            ctx.metrics.inc("rx_dgrams")
-            conn = self.server.on_datagram(data, addr)
-            if conn is not None:
-                touched.append((conn, addr))
-        for conn, addr in touched:
-            for d in conn.datagrams_out():
-                out_pkts.append((d, addr))
-            if conn.txns:
-                for raw in conn.txns:
-                    self._ingest_txn(ctx, raw, "rx_txns_quic")
-                conn.txns.clear()
-        # stateless Retry responses (server retry mode)
-        for pkt, addr in self.server.stateless_out:
-            out_pkts.append((pkt, addr))
-        self.server.stateless_out.clear()
-        if out_pkts:
-            ctx.metrics.inc("tx_dgrams", self.quic_sock.send_burst(out_pkts))
+            # QUIC datagrams
+            out_pkts = []
+            touched = []
+            for data, addr in self.quic_sock.recv_burst(self.burst):
+                ctx.metrics.inc("rx_dgrams")
+                conn = self.server.on_datagram(data, addr)
+                if conn is not None:
+                    touched.append((conn, addr))
+            for conn, addr in touched:
+                for d in conn.datagrams_out():
+                    out_pkts.append((d, addr))
+                if conn.txns:
+                    for raw in conn.txns:
+                        self._ingest_txn(ctx, raw, "rx_txns_quic")
+                    conn.txns.clear()
+            # stateless Retry responses (server retry mode)
+            for pkt, addr in self.server.stateless_out:
+                out_pkts.append((pkt, addr))
+            self.server.stateless_out.clear()
+            self._tx(ctx, out_pkts)
         if len(self.server.conns) > n_conns:
             ctx.metrics.inc("conns_opened", len(self.server.conns) - n_conns)
 
-        # publish backlog within credit budget
+        if self.via_net:
+            self._flush_tx(ctx)  # drain tx held back by net-ring credits
+        # publish backlog within credit budget (txn ring = outs[0] only;
+        # in via_net mode outs[-1] is the net tx ring)
         if not self._backlog or ctx.credits <= 0:
             return
         take = self._backlog[: ctx.credits]
@@ -141,4 +216,5 @@ class QuicIngressTile(Tile):
         tags = sig0.astype(np.uint64) @ (
             np.uint64(1) << (np.uint64(8) * np.arange(8, dtype=np.uint64))
         )
-        ctx.publish(tags, rows, szs)
+        ctx.outs[0].publish(tags, rows, szs)
+        ctx.metrics.inc("out_frags", n)

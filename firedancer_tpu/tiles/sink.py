@@ -21,6 +21,7 @@ class SinkTile(Tile):
         self.record = record
         self.sigs: list[np.ndarray] = []
         self.payloads: list[np.ndarray] = []
+        self.sizes: list[np.ndarray] = []
         self.lock = threading.Lock()
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
@@ -37,6 +38,7 @@ class SinkTile(Tile):
             with self.lock:
                 self.sigs.append(frags["sig"].copy())
                 self.payloads.append(rows)
+                self.sizes.append(frags["sz"].copy())
 
     def all_sigs(self) -> np.ndarray:
         with self.lock:

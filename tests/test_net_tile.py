@@ -1,0 +1,92 @@
+"""Net tile: sockets factored out of the quic tile (fd_net.c analog).
+
+Topology under test: net -> quic(via_net) -> sink, with the quic tile's
+responses riding the quic->net tx ring — a real client completes its
+handshake and delivers txns without the quic tile ever touching a
+socket.
+"""
+
+import socket
+import time
+
+import numpy as np
+
+from firedancer_tpu.disco import Topology
+from firedancer_tpu.tiles import wire
+from firedancer_tpu.tiles.net import NET_MTU, NetTile, addr_pack, addr_unpack
+from firedancer_tpu.tiles.quic import QuicIngressTile
+from firedancer_tpu.tiles.sink import SinkTile
+from firedancer_tpu.tiles.synth import make_txn_pool
+from firedancer_tpu.waltz import quic as Q
+
+
+def test_addr_codec():
+    for addr in (("127.0.0.1", 9000), ("10.1.2.3", 65535), ("0.0.0.0", 0)):
+        assert addr_unpack(np.frombuffer(addr_pack(addr), np.uint8)) == addr
+
+
+def test_net_quic_pipeline_real_sockets():
+    rng = np.random.default_rng(17)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    net = NetTile()
+    quic = QuicIngressTile(identity, via_net=True)
+    sink = SinkTile(record=True)
+    topo = Topology()
+    topo.link("net_quic", depth=1024, mtu=NET_MTU)
+    topo.link("quic_net", depth=1024, mtu=NET_MTU)
+    topo.link("quic_sink", depth=1024, mtu=wire.LINK_MTU)
+    topo.tile(net, ins=[("quic_net", True)], outs=["net_quic"])
+    topo.tile(
+        quic, ins=[("net_quic", True)], outs=["quic_sink", "quic_net"]
+    )
+    topo.tile(sink, ins=[("quic_sink", True)])
+    topo.build()
+    topo.start(batch_max=256)
+    try:
+        rows, szs, _good = make_txn_pool(4, seed=3)
+        tr = wire.parse_trailers(rows, szs.astype(np.int64))
+        txns = [rows[i, : tr["txn_sz"][i]].tobytes() for i in range(4)]
+
+        client = Q.QuicClient()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(0.2)
+        server_addr = net.quic_addr
+
+        def pump(deadline_s=10.0, want=None):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                topo.poll_failure()
+                for d in client.conn.datagrams_out():
+                    sock.sendto(d, server_addr)
+                try:
+                    data, _ = sock.recvfrom(65536)
+                    client.conn.on_datagram(data)
+                except socket.timeout:
+                    client.conn.on_timer()
+                if want is not None and want():
+                    return True
+            return want is None
+
+        assert pump(want=lambda: client.conn.established)
+        for t in txns:
+            client.conn.send_txn(t)
+        assert pump(
+            want=lambda: topo.metrics("sink").counter("in_frags") >= 4
+        )
+        topo.halt()
+        # the sink received the txns with trailers, bit-exact payloads
+        with sink.lock:
+            got = set()
+            for rows_b, szs_b in zip(sink.payloads, sink.sizes):
+                for r, sz in zip(rows_b, szs_b):
+                    d = wire.parse_trailers(
+                        r[None, :], np.asarray([sz], np.int64)
+                    )
+                    got.add(r[: d["txn_sz"][0]].tobytes())
+        assert got == set(txns)
+        assert topo.metrics("net").counter("rx_dgrams") > 0
+        assert topo.metrics("net").counter("tx_dgrams") > 0
+        assert topo.metrics("quic").counter("rx_txns_quic") == 4
+    finally:
+        sock.close()
+        topo.close()
