@@ -33,6 +33,18 @@ def _rotl(x: int, r: int) -> int:
 
 
 def xxh64(data: bytes, seed: int = 0) -> int:
+    """XXH64; dispatches to the native helper (tango/native/fdt_sha512.c)
+    — the pure-python ladder below is the spec reference and fallback."""
+    try:
+        from firedancer_tpu.tango import rings as R
+
+        return int(R._lib.fdt_xxh64(bytes(data), len(data), seed))
+    except ImportError:
+        pass
+    return _xxh64_py(data, seed)
+
+
+def _xxh64_py(data: bytes, seed: int = 0) -> int:
     n = len(data)
     i = 0
     if n >= 32:
@@ -162,7 +174,9 @@ def decompress(frame: bytes) -> bytes:
             # entropy-coded block (FSE/Huffman): not decoded natively yet
             # — delegate the whole frame to the zstandard module when the
             # environment provides one, else fail loudly (never
-            # mis-decode)
+            # mis-decode).  decompressobj handles frames without a
+            # content-size field (streaming producers); foreign errors
+            # are wrapped into this module's type.
             try:
                 import zstandard  # noqa: PLC0415
             except ImportError:
@@ -171,7 +185,12 @@ def decompress(frame: bytes) -> bytes:
                     "store-mode frames only and no zstandard module is "
                     "available"
                 ) from None
-            return zstandard.ZstdDecompressor().decompress(frame)
+            try:
+                return zstandard.ZstdDecompressor().decompressobj().decompress(
+                    frame
+                )
+            except zstandard.ZstdError as e:
+                raise ZstdError(f"delegated decode failed: {e}") from None
         else:
             raise ZstdError("reserved block type")
         if last:

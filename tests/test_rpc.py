@@ -1,0 +1,69 @@
+"""JSON-RPC tile: the bencho-observer surface over a live topology.
+
+Reference analog: fddev's bencho tile watching landed TPS via RPC, and
+src/ballet/json feeding that client path.
+"""
+
+import time
+
+import numpy as np
+
+from firedancer_tpu.disco import Topology
+from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.ops.ed25519 import golden
+from firedancer_tpu.tiles.rpc import RpcTile, rpc_call
+from firedancer_tpu.tiles.sink import SinkTile
+from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+from firedancer_tpu.ballet import base58
+
+
+def test_rpc_methods_over_live_topology():
+    rng = np.random.default_rng(3)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    funk = Funk()
+    rich = rng.integers(0, 256, 32, np.uint8).tobytes()
+    AccountMgr(funk).store(rich, Account(123_456_789))
+
+    rows, szs, _ = make_txn_pool(32, seed=5)
+    synth = SynthTile(rows, szs, total=256)
+    sink = SinkTile()
+    topo = Topology()
+    rpc = RpcTile(
+        txn_count=lambda: topo.metrics("sink").counter("in_frags"),
+        slot=lambda: 42,
+        funk=funk,
+        identity=golden.public_from_secret(identity),
+    )
+    topo.link("synth_sink", depth=1024, mtu=1248)
+    topo.tile(synth, outs=["synth_sink"])
+    topo.tile(sink, ins=[("synth_sink", True)])
+    topo.tile(rpc)
+    topo.build()
+    topo.start(batch_max=128)
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if topo.metrics("sink").counter("in_frags") >= 256:
+                break
+            time.sleep(0.01)
+
+        # bencho shape: poll the txn count through RPC
+        r = rpc_call(rpc.addr, "getTransactionCount")
+        assert r["result"] >= 256
+        assert rpc_call(rpc.addr, "getSlot")["result"] == 42
+        assert rpc_call(rpc.addr, "getHealth")["result"] == "ok"
+        assert "solana-core" in rpc_call(rpc.addr, "getVersion")["result"]
+        ident = rpc_call(rpc.addr, "getIdentity")["result"]["identity"]
+        assert base58.decode_32(ident) == golden.public_from_secret(identity)
+        bal = rpc_call(
+            rpc.addr, "getBalance", [base58.encode_32(rich)]
+        )["result"]
+        assert bal["value"] == 123_456_789
+        # errors: unknown method and malformed input stay in-band
+        assert "error" in rpc_call(rpc.addr, "noSuchMethod")
+        assert rpc_call(rpc.addr, "getBalance", ["!!!"])["error"]
+        topo.halt()
+    finally:
+        topo.close()
