@@ -44,6 +44,11 @@ class Config:
     verify_msg_width: int = 1232
     dedup_depth: int = 4_194_302
     link_depth: int = 1024
+    bank_count: int = 2
+    ticks_per_slot: int = 64
+    shred_version: int = 1
+    metrics_port: int = 0
+    rpc_port: int = 0
     raw: dict = field(default_factory=dict)
 
 
@@ -62,8 +67,126 @@ def parse(text: str) -> Config:
         verify_msg_width=v.get("msg_width", 1232),
         dedup_depth=d.get("signature_cache_size", 4_194_302),
         link_depth=doc.get("links", {}).get("depth", 1024),
+        bank_count=t.get("bank", {}).get("count", 2),
+        ticks_per_slot=t.get("poh", {}).get("ticks_per_slot", 64),
+        shred_version=t.get("shred", {}).get("version", 1),
+        metrics_port=t.get("metric", {}).get("port", 0),
+        rpc_port=t.get("rpc", {}).get("port", 0),
         raw=doc,
     )
+
+
+def build_validator_topology(cfg: Config, identity_secret: bytes,
+                             blockstore_path: str, funk=None):
+    """The FULL single-host validator shape (reference wiring,
+    config.c:624-760 + tile registry main.c:20-47):
+
+        net -> quic -> verify xN -> dedup -> pack -> bank xB -> poh
+            -> shred (keyguard sign rings) -> store
+        + metric (Prometheus) + rpc (observer surface)
+
+    Returns (topo, handles dict)."""
+    from firedancer_tpu.ops.ed25519 import golden
+    from firedancer_tpu.tiles.bank import BankTile
+    from firedancer_tpu.tiles.metric import MetricTile
+    from firedancer_tpu.tiles.net import NET_MTU, NetTile
+    from firedancer_tpu.tiles.pack import PackTile
+    from firedancer_tpu.tiles.poh import ENTRY_SZ, PohTile
+    from firedancer_tpu.tiles.rpc import RpcTile
+    from firedancer_tpu.tiles.shred import ShredTile
+    from firedancer_tpu.tiles.sign import ROLE_SHRED, SignTile
+    from firedancer_tpu.tiles.store import StoreTile
+    from firedancer_tpu.ballet import shred as SH
+
+    mb_mtu = 40_000
+    depth = cfg.link_depth
+    n = cfg.verify_count
+    n_banks = cfg.bank_count
+    topo = Topology(name=cfg.name)
+
+    net = NetTile(
+        quic_addr=("0.0.0.0", cfg.quic_port),
+        udp_addr=("0.0.0.0", cfg.udp_port),
+    )
+    qt = QuicIngressTile(identity_secret, via_net=True)
+    topo.link("net_quic", depth=depth, mtu=NET_MTU)
+    topo.link("quic_net", depth=depth, mtu=NET_MTU)
+    topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
+    topo.tile(net, ins=[("quic_net", True)], outs=["net_quic"])
+    topo.tile(qt, ins=[("net_quic", True)], outs=["quic_verify", "quic_net"])
+    for i in range(n):
+        topo.link(f"verify{i}_dedup", depth=depth, mtu=wire.LINK_MTU)
+        topo.tile(
+            VerifyTile(
+                msg_width=cfg.verify_msg_width,
+                max_lanes=cfg.verify_max_lanes,
+                shard=(i, n) if n > 1 else None,
+                name=f"verify{i}",
+            ),
+            ins=[("quic_verify", True)],
+            outs=[f"verify{i}_dedup"],
+        )
+    topo.link("dedup_pack", depth=depth, mtu=wire.LINK_MTU)
+    topo.tile(
+        DedupTile(depth=cfg.dedup_depth),
+        ins=[(f"verify{i}_dedup", True) for i in range(n)],
+        outs=["dedup_pack"],
+    )
+    for i in range(n_banks):
+        topo.link(f"pack_bank{i}", depth=64, mtu=mb_mtu)
+        topo.link(f"bank{i}_pack", depth=64)
+        topo.link(f"bank{i}_poh", depth=64, mtu=mb_mtu)
+    topo.tile(
+        PackTile(n_banks),
+        ins=[("dedup_pack", True)]
+        + [(f"bank{i}_pack", True) for i in range(n_banks)],
+        outs=[f"pack_bank{i}" for i in range(n_banks)],
+    )
+    for i in range(n_banks):
+        topo.tile(
+            BankTile(i, funk=funk),
+            ins=[(f"pack_bank{i}", True)],
+            outs=[f"bank{i}_pack", f"bank{i}_poh"],
+        )
+    topo.link("poh_shred", depth=4096, mtu=ENTRY_SZ)
+    topo.tile(
+        PohTile(ticks_per_slot=cfg.ticks_per_slot),
+        ins=[(f"bank{i}_poh", True) for i in range(n_banks)],
+        outs=["poh_shred"],
+    )
+    topo.link("shred_store", depth=4096, mtu=SH.MAX_SZ)
+    topo.link("shred_sign", depth=256, mtu=32)
+    topo.link("sign_shred", depth=256, mtu=64)
+    topo.tile(
+        ShredTile(shred_version=cfg.shred_version),
+        ins=[("poh_shred", True), ("sign_shred", True)],
+        outs=["shred_store", "shred_sign"],
+    )
+    topo.tile(
+        SignTile(identity_secret, roles=[ROLE_SHRED]),
+        ins=[("shred_sign", True)],
+        outs=["sign_shred"],
+    )
+    store = StoreTile(blockstore_path)
+    topo.tile(store, ins=[("shred_store", True)])
+    metric = MetricTile(
+        registry=topo.metrics_registry, addr=("0.0.0.0", cfg.metrics_port)
+    )
+    topo.tile(metric)
+    rpc = RpcTile(
+        txn_count=lambda: sum(
+            topo.metrics(f"bank{i}").counter("executed_txns")
+            for i in range(n_banks)
+        ),
+        slot=lambda: topo.metrics("poh").counter("slots"),
+        funk=funk,
+        identity=golden.public_from_secret(identity_secret),
+        addr=("0.0.0.0", cfg.rpc_port),
+    )
+    topo.tile(rpc)
+    return topo, {
+        "net": net, "quic": qt, "store": store, "metric": metric, "rpc": rpc,
+    }
 
 
 def build_ingress_topology(

@@ -21,17 +21,35 @@ def cmd_run(args) -> int:
     from firedancer_tpu.app import config as C
     from firedancer_tpu.app.monitor import Monitor
 
+    from firedancer_tpu.utils import log
+
     text = open(args.config).read() if args.config else ""
     cfg = C.parse(text)
+    log.init(path=args.log_path, stderr_level="NOTICE")
     if args.keyfile:
         identity = open(args.keyfile, "rb").read()[:32]
     else:
         identity = os.urandom(32)
-    topo, qt = C.build_ingress_topology(cfg, identity)
-    topo.build()
-    topo.start()
-    print(f"workspace {cfg.name!r}: quic {qt.quic_addr} udp {qt.udp_addr}",
-          flush=True)
+    if args.full:
+        topo, handles = C.build_validator_topology(
+            cfg, identity, args.blockstore or f"/tmp/fdt_{cfg.name}_store"
+        )
+        qt = handles["net"]
+        topo.build()
+        topo.start()
+        log.notice(
+            "workspace %r: quic %s udp %s metrics %s rpc %s",
+            cfg.name, qt.quic_addr, qt.udp_addr,
+            handles["metric"].addr, handles["rpc"].addr,
+        )
+    else:
+        topo, qt = C.build_ingress_topology(cfg, identity)
+        topo.build()
+        topo.start()
+        log.notice(
+            "workspace %r: quic %s udp %s",
+            cfg.name, qt.quic_addr, qt.udp_addr,
+        )
 
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -70,6 +88,10 @@ def main(argv=None) -> int:
     pr = sub.add_parser("run", help="boot the ingress topology from config")
     pr.add_argument("--config", default=None)
     pr.add_argument("--keyfile", default=None)
+    pr.add_argument("--full", action="store_true",
+                    help="full validator topology (net..store+metric+rpc)")
+    pr.add_argument("--blockstore", default=None)
+    pr.add_argument("--log-path", default=None)
     pr.add_argument("--iterations", type=int, default=0,
                     help="exit after N monitor prints (0 = run forever)")
     pm = sub.add_parser("monitor", help="attach to a running topology")
