@@ -45,6 +45,7 @@ class Config:
     dedup_depth: int = 4_194_302
     link_depth: int = 1024
     bank_count: int = 2
+    pack_device_select: bool = False
     ticks_per_slot: int = 64
     shred_version: int = 1
     metrics_port: int = 0
@@ -68,6 +69,7 @@ def parse(text: str) -> Config:
         dedup_depth=d.get("signature_cache_size", 4_194_302),
         link_depth=doc.get("links", {}).get("depth", 1024),
         bank_count=t.get("bank", {}).get("count", 2),
+        pack_device_select=t.get("pack", {}).get("device_select", False),
         ticks_per_slot=t.get("poh", {}).get("ticks_per_slot", 64),
         shred_version=t.get("shred", {}).get("version", 1),
         metrics_port=t.get("metric", {}).get("port", 0),
@@ -137,7 +139,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
         topo.link(f"bank{i}_pack", depth=64)
         topo.link(f"bank{i}_poh", depth=64, mtu=mb_mtu)
     topo.tile(
-        PackTile(n_banks),
+        PackTile(n_banks, use_device_select=cfg.pack_device_select),
         ins=[("dedup_pack", True)]
         + [(f"bank{i}_pack", True) for i in range(n_banks)],
         outs=[f"pack_bank{i}" for i in range(n_banks)],

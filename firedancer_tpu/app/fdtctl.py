@@ -73,6 +73,18 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_configure(args) -> int:
+    from firedancer_tpu.app import configure as CF
+
+    stages = tuple(args.stages.split(",")) if args.stages else CF.STAGES
+    results = CF.run(args.mode, stages, keyfile=args.keyfile)
+    bad = 0
+    for r in results:
+        print(f"[{'ok' if r.ok else '!!'}] {r.name:8s} {r.detail}")
+        bad += not r.ok
+    return 1 if bad else 0
+
+
 def cmd_monitor(args) -> int:
     from firedancer_tpu.app.monitor import Monitor
 
@@ -98,8 +110,16 @@ def main(argv=None) -> int:
     pm.add_argument("--name", default="fdt")
     pm.add_argument("--interval", type=float, default=1.0)
     pm.add_argument("--iterations", type=int, default=None)
+    pc = sub.add_parser("configure", help="system setup stages (check/init)")
+    pc.add_argument("mode", nargs="?", default="check",
+                    choices=("check", "init"))
+    pc.add_argument("--stages", default=None,
+                    help="comma-separated subset (default: all)")
+    pc.add_argument("--keyfile", default=None)
     args = p.parse_args(argv)
-    return {"run": cmd_run, "monitor": cmd_monitor}[args.cmd](args)
+    return {
+        "run": cmd_run, "monitor": cmd_monitor, "configure": cmd_configure,
+    }[args.cmd](args)
 
 
 if __name__ == "__main__":

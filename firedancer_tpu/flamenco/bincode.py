@@ -1,0 +1,226 @@
+"""Bincode codec combinators + Solana on-chain type schemas.
+
+Reference model: src/flamenco/types/ — 26K LoC of GENERATED bincode
+serializers (fd_types.json -> fd_types.h/.c).  The TPU-native substrate
+replaces code generation with declarative schemas interpreted by a small
+combinator set: a schema IS the Python data structure, and encode/decode
+walk it.  The wire format is bincode's fixed-width little-endian
+convention (the one Solana uses for account/state types): integers
+little-endian, bool = 1 byte, Option = u8 tag + payload, Vec = u64 count
++ elements, enum = u32 discriminant + variant payload.
+
+Schemas below cover the state types the runtime touches (clock, rent,
+epoch schedule, stake/vote essentials); new types are one declaration
+each, not generated code.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# combinators: a schema is (kind, ...) tuples or primitive name strings
+# ---------------------------------------------------------------------------
+
+_PRIM = {
+    "u8": ("<B", 1), "u16": ("<H", 2), "u32": ("<I", 4), "u64": ("<Q", 8),
+    "i8": ("<b", 1), "i16": ("<h", 2), "i32": ("<i", 4), "i64": ("<q", 8),
+    "f64": ("<d", 8),
+}
+
+
+def opt(inner) -> tuple:
+    return ("option", inner)
+
+
+def vec(inner) -> tuple:
+    return ("vec", inner)
+
+
+def arr(inner, n: int) -> tuple:
+    return ("array", inner, n)
+
+
+def struct_of(*fields: tuple[str, Any]) -> tuple:
+    return ("struct", fields)
+
+
+def enum_of(*variants: tuple[str, Any]) -> tuple:
+    """variants: (name, schema-or-None) in discriminant order (u32)."""
+    return ("enum", variants)
+
+
+PUBKEY = ("bytes", 32)
+SIGNATURE = ("bytes", 64)
+
+
+def encode(schema, val) -> bytes:
+    if isinstance(schema, str):
+        fmt, _ = _PRIM[schema]
+        return struct.pack(fmt, val)
+    kind = schema[0]
+    if kind == "bool":
+        return bytes([1 if val else 0])
+    if kind == "bytes":
+        assert len(val) == schema[1], (len(val), schema[1])
+        return bytes(val)
+    if kind == "option":
+        if val is None:
+            return b"\x00"
+        return b"\x01" + encode(schema[1], val)
+    if kind == "vec":
+        out = struct.pack("<Q", len(val))
+        for v in val:
+            out += encode(schema[1], v)
+        return out
+    if kind == "array":
+        assert len(val) == schema[2]
+        return b"".join(encode(schema[1], v) for v in val)
+    if kind == "struct":
+        return b"".join(encode(s, val[name]) for name, s in schema[1])
+    if kind == "enum":
+        name, payload = val
+        for i, (vname, vschema) in enumerate(schema[1]):
+            if vname == name:
+                out = struct.pack("<I", i)
+                if vschema is not None:
+                    out += encode(vschema, payload)
+                return out
+        raise ValueError(f"unknown variant {name!r}")
+    raise ValueError(f"bad schema {schema!r}")
+
+
+def decode(schema, buf: bytes, off: int = 0) -> tuple[Any, int]:
+    if isinstance(schema, str):
+        fmt, n = _PRIM[schema]
+        return struct.unpack_from(fmt, buf, off)[0], off + n
+    kind = schema[0]
+    if kind == "bool":
+        if buf[off] > 1:
+            raise ValueError("bad bool")
+        return bool(buf[off]), off + 1
+    if kind == "bytes":
+        n = schema[1]
+        if off + n > len(buf):
+            raise ValueError("short bytes")
+        return buf[off : off + n], off + n
+    if kind == "option":
+        tag = buf[off]
+        if tag > 1:
+            raise ValueError("bad option tag")
+        if tag == 0:
+            return None, off + 1
+        return decode(schema[1], buf, off + 1)
+    if kind == "vec":
+        (n,) = struct.unpack_from("<Q", buf, off)
+        if n > 1 << 24:
+            raise ValueError("vec too long")
+        off += 8
+        out = []
+        for _ in range(n):
+            v, off = decode(schema[1], buf, off)
+            out.append(v)
+        return out, off
+    if kind == "array":
+        out = []
+        for _ in range(schema[2]):
+            v, off = decode(schema[1], buf, off)
+            out.append(v)
+        return out, off
+    if kind == "struct":
+        out = {}
+        for name, s in schema[1]:
+            out[name], off = decode(s, buf, off)
+        return out, off
+    if kind == "enum":
+        (disc,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        if disc >= len(schema[1]):
+            raise ValueError(f"bad discriminant {disc}")
+        vname, vschema = schema[1][disc]
+        if vschema is None:
+            return (vname, None), off
+        v, off = decode(vschema, buf, off)
+        return (vname, v), off
+    raise ValueError(f"bad schema {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# Solana state-type schemas (fd_types analogs, declared not generated)
+# ---------------------------------------------------------------------------
+
+CLOCK = struct_of(
+    ("slot", "u64"),
+    ("epoch_start_timestamp", "i64"),
+    ("epoch", "u64"),
+    ("leader_schedule_epoch", "u64"),
+    ("unix_timestamp", "i64"),
+)
+
+RENT = struct_of(
+    ("lamports_per_byte_year", "u64"),
+    ("exemption_threshold", "f64"),
+    ("burn_percent", "u8"),
+)
+
+EPOCH_SCHEDULE = struct_of(
+    ("slots_per_epoch", "u64"),
+    ("leader_schedule_slot_offset", "u64"),
+    ("warmup", ("bool",)),
+    ("first_normal_epoch", "u64"),
+    ("first_normal_slot", "u64"),
+)
+
+STAKE_HISTORY_ENTRY = struct_of(
+    ("effective", "u64"), ("activating", "u64"), ("deactivating", "u64"),
+)
+
+STAKE_HISTORY = vec(struct_of(
+    ("epoch", "u64"), ("entry", STAKE_HISTORY_ENTRY),
+))
+
+DELEGATION = struct_of(
+    ("voter_pubkey", PUBKEY),
+    ("stake", "u64"),
+    ("activation_epoch", "u64"),
+    ("deactivation_epoch", "u64"),
+    ("warmup_cooldown_rate", "f64"),
+)
+
+STAKE = struct_of(
+    ("delegation", DELEGATION), ("credits_observed", "u64"),
+)
+
+LOCKUP = struct_of(
+    ("unix_timestamp", "i64"), ("epoch", "u64"), ("custodian", PUBKEY),
+)
+
+AUTHORIZED = struct_of(("staker", PUBKEY), ("withdrawer", PUBKEY))
+
+STAKE_META = struct_of(
+    ("rent_exempt_reserve", "u64"),
+    ("authorized", AUTHORIZED),
+    ("lockup", LOCKUP),
+)
+
+#: StakeStateV2: the account state of the stake program
+STAKE_STATE = enum_of(
+    ("uninitialized", None),
+    ("initialized", STAKE_META),
+    ("stake", struct_of(
+        ("meta", STAKE_META), ("stake", STAKE), ("flags", "u8"),
+    )),
+    ("rewards_pool", None),
+)
+
+VOTE_LOCKOUT = struct_of(("slot", "u64"), ("confirmation_count", "u32"))
+
+#: the vote-state essentials gossip/consensus tooling reads
+VOTE_STATE_CORE = struct_of(
+    ("node_pubkey", PUBKEY),
+    ("authorized_withdrawer", PUBKEY),
+    ("commission", "u8"),
+    ("votes", vec(VOTE_LOCKOUT)),
+    ("root_slot", opt("u64")),
+)

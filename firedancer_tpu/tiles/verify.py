@@ -171,21 +171,16 @@ class VerifyTile(Tile):
             map_cnt = R.TCache.map_cnt_for(depth)
             fp = R.TCache.footprint(depth, map_cnt)
             self._tc = R.TCache(ctx.alloc("tcache", fp), depth, map_cnt)
-        # warm the compile caches for every lane bucket so steady state
-        # never hits a compile stall (first compile is slow on TPU)
-        buckets = (
-            [self.max_lanes]
-            if self.pad_full
-            else [1 << i for i in range((self.max_lanes).bit_length())]
-        )
-        for lanes in buckets:
-            np.asarray(
-                self._fn(
-                    np.zeros((lanes, 64), dtype=np.uint8),
-                    np.zeros((lanes, 64), np.uint8),
-                    np.zeros((lanes, 32), np.uint8),
-                )
+        # warm the full-batch shape so the steady state never compiles;
+        # smaller pow2 buckets (trickle traffic) compile on first use —
+        # warming every bucket cost minutes of boot on CPU hosts
+        np.asarray(
+            self._fn(
+                np.zeros((self.max_lanes, 64), dtype=np.uint8),
+                np.zeros((self.max_lanes, 64), np.uint8),
+                np.zeros((self.max_lanes, 32), np.uint8),
             )
+        )
         self._worker = _DeviceWorker(self._fn, self.async_depth)
 
     # ---- ingress: host prep + staging -----------------------------------

@@ -1,0 +1,96 @@
+"""Bincode combinators + Solana state-type schemas (flamenco.types
+analog).  Round-trips, known layouts, malformation rejection."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.flamenco import bincode as B
+from firedancer_tpu.flamenco import sysvar
+
+
+def test_clock_layout_matches_sysvar_codec():
+    # the declarative schema and the sysvar struct codec must agree byte
+    # for byte (single source of truth check)
+    c = sysvar.Clock(slot=7, epoch_start_timestamp=-3, epoch=1,
+                     leader_schedule_epoch=2, unix_timestamp=99)
+    via_schema = B.encode(B.CLOCK, {
+        "slot": 7, "epoch_start_timestamp": -3, "epoch": 1,
+        "leader_schedule_epoch": 2, "unix_timestamp": 99,
+    })
+    assert via_schema == c.encode()
+    dec, end = B.decode(B.CLOCK, via_schema)
+    assert end == len(via_schema) and dec["unix_timestamp"] == 99
+
+
+def test_rent_epoch_schedule_roundtrip():
+    for schema, val in (
+        (B.RENT, {"lamports_per_byte_year": 3480,
+                  "exemption_threshold": 2.0, "burn_percent": 50}),
+        (B.EPOCH_SCHEDULE, {"slots_per_epoch": 432000,
+                            "leader_schedule_slot_offset": 432000,
+                            "warmup": False, "first_normal_epoch": 0,
+                            "first_normal_slot": 0}),
+    ):
+        enc = B.encode(schema, val)
+        dec, end = B.decode(schema, enc)
+        assert end == len(enc) and dec == val
+
+
+def test_stake_state_enum_roundtrip():
+    rng = np.random.default_rng(0)
+    pk = lambda: rng.integers(0, 256, 32, np.uint8).tobytes()  # noqa: E731
+    meta = {
+        "rent_exempt_reserve": 12345,
+        "authorized": {"staker": pk(), "withdrawer": pk()},
+        "lockup": {"unix_timestamp": 0, "epoch": 0, "custodian": pk()},
+    }
+    state = ("stake", {
+        "meta": meta,
+        "stake": {
+            "delegation": {
+                "voter_pubkey": pk(), "stake": 999,
+                "activation_epoch": 1, "deactivation_epoch": 2**64 - 1,
+                "warmup_cooldown_rate": 0.25,
+            },
+            "credits_observed": 17,
+        },
+        "flags": 0,
+    })
+    enc = B.encode(B.STAKE_STATE, state)
+    # enum discriminant is a little-endian u32: "stake" is variant 2
+    assert enc[:4] == b"\x02\x00\x00\x00"
+    dec, end = B.decode(B.STAKE_STATE, enc)
+    assert end == len(enc) and dec == state
+    # unit variants carry no payload
+    enc_u = B.encode(B.STAKE_STATE, ("uninitialized", None))
+    assert enc_u == b"\x00\x00\x00\x00"
+
+
+def test_vote_state_and_collections():
+    votes = [{"slot": s, "confirmation_count": 31 - i}
+             for i, s in enumerate(range(100, 110))]
+    val = {
+        "node_pubkey": bytes(32), "authorized_withdrawer": bytes(32),
+        "commission": 5, "votes": votes, "root_slot": 42,
+    }
+    enc = B.encode(B.VOTE_STATE_CORE, val)
+    dec, _ = B.decode(B.VOTE_STATE_CORE, enc)
+    assert dec == val
+    val["root_slot"] = None
+    enc2 = B.encode(B.VOTE_STATE_CORE, val)
+    assert len(enc2) == len(enc) - 8
+    assert B.decode(B.VOTE_STATE_CORE, enc2)[0]["root_slot"] is None
+
+
+def test_malformed_rejected():
+    with pytest.raises(ValueError):
+        B.decode(B.STAKE_STATE, b"\xff\x00\x00\x00")  # bad discriminant
+    with pytest.raises(ValueError):
+        B.decode(("option", "u64"), b"\x05")  # bad option tag
+    with pytest.raises(ValueError):
+        B.decode(("bool",), b"\x07")
+    with pytest.raises(ValueError):
+        # absurd vec length must not allocate
+        B.decode(B.VOTE_STATE_CORE[1][3][1], b"\xff" * 8 + b"")
+    with pytest.raises((ValueError, IndexError, Exception)):
+        B.decode(B.CLOCK, b"\x01\x02")  # truncated

@@ -1,0 +1,25 @@
+"""fdtctl configure stages (reference: src/app/fdctl/configure/)."""
+
+import os
+
+from firedancer_tpu.app import configure as CF
+
+
+def test_check_then_init_keys(tmp_path):
+    key = str(tmp_path / "id.key")
+    rs = {r.name: r for r in CF.run("check", ("shm", "keys"), keyfile=key)}
+    assert rs["shm"].ok  # this host has /dev/shm
+    assert not rs["keys"].ok  # not generated yet in check mode
+    rs = {r.name: r for r in CF.run("init", ("keys",), keyfile=key)}
+    assert rs["keys"].ok and os.path.exists(key)
+    assert len(open(key, "rb").read()) == 32
+    assert (os.stat(key).st_mode & 0o777) == 0o600
+    # idempotent
+    rs2 = {r.name: r for r in CF.run("init", ("keys",), keyfile=key)}
+    assert rs2["keys"].ok
+
+
+def test_cache_and_ulimit_stages():
+    rs = {r.name: r for r in CF.run("check", ("ulimit", "cache"))}
+    assert "nofile" in rs["ulimit"].detail
+    assert "cache" in rs

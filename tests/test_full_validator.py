@@ -58,7 +58,9 @@ def test_single_transfer_lands(tmp_path):
         cfg, identity, str(tmp_path / "bs"), funk=funk
     )
     topo.build()
-    topo.start(batch_max=256)
+    # single-core host: a dozen tiles compile their kernels during boot
+    # (cached after the first run — see conftest's compilation cache)
+    topo.start(batch_max=256, boot_timeout_s=1200.0)
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.settimeout(0.2)
     try:

@@ -38,7 +38,9 @@ def test_leader_pipeline_end_to_end():
     rows, szs, _ = make_txn_pool(pool_n, seed=29)
     synth = SynthTile(rows, szs, total=pool_n)
     dedup = DedupTile(depth=1 << 12)
-    pack = PackTile(n_banks, microblock_ns=1_000)
+    # device_select ON: the conflict prefilter (ops/pack_select) runs in
+    # the live topology, not just the multichip dryrun
+    pack = PackTile(n_banks, microblock_ns=1_000, use_device_select=True)
     banks = [BankTile(i) for i in range(n_banks)]
     poh = PohTile(tick_batch=16)
     sink = SinkTile(record=True)
