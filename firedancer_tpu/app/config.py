@@ -123,6 +123,11 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
                 msg_width=cfg.verify_msg_width,
                 max_lanes=cfg.verify_max_lanes,
                 shard=(i, n) if n > 1 else None,
+                # one compiled shape: every sub-batch pads to max_lanes,
+                # so the boot-time warm covers steady state AND trickle
+                # (bucket shapes would each pay a multi-minute cold
+                # compile on CPU hosts)
+                pad_full=True,
                 name=f"verify{i}",
             ),
             ins=[("quic_verify", True)],

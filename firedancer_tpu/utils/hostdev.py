@@ -38,3 +38,26 @@ def ensure_cpu_devices(n: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass  # backend already initialized; caller's assert will catch it
+    enable_compilation_cache()
+
+
+def enable_compilation_cache(
+    path: str | None = None, min_secs: float = 1.0
+) -> None:
+    """Persistent XLA compilation cache (works via jax.config, NOT the
+    env vars, on this jax build).  On this single-core host a cold
+    verify-kernel compile costs minutes; cache hits make topology boots
+    and suite re-runs near-instant."""
+    import jax
+
+    path = path or os.environ.get(
+        "FDT_JAX_CACHE", os.path.expanduser("~/.cache/jax_comp")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older jax or read-only home: caching is best-effort

@@ -11,12 +11,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# persistent XLA compilation cache: this host has ONE cpu core, and a cold
-# compile of the verify kernel costs ~100s — cache hits make topology
-# boots (and re-runs of the suite) near-instant
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax_comp")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-
 from firedancer_tpu.utils.hostdev import ensure_cpu_devices  # noqa: E402
 
+# ensure_cpu_devices also enables the persistent XLA compilation cache:
+# this host has ONE cpu core and a cold verify-kernel compile costs
+# minutes — cache hits make topology boots and suite re-runs fast
 ensure_cpu_devices(8)
