@@ -171,6 +171,8 @@ class GossipNode:
         self.entrypoints = list(entrypoints or [])
         self.crds: dict[tuple[bytes, int], CrdsValue] = {}
         self.peers: dict[bytes, _Peer] = {}
+        #: outstanding bootstrap ping tokens, one per entrypoint addr
+        self._pending_pings: dict[tuple[str, int], bytes] = {}
         self._now = now or time.monotonic
         self._rng = os.urandom
         self.stats = {
@@ -249,14 +251,19 @@ class GossipNode:
         """One round: drain rx, ping entrypoints/peers, push, pull."""
         self._drain_rx()
         now = self._now()
-        # bootstrap: ping entrypoints we know nothing about yet
+        # bootstrap: ping entrypoints we know nothing about yet (one
+        # outstanding token per entrypoint so concurrent bootstraps work)
         for ep in self.entrypoints:
-            if not any(
+            if any(
                 p.contact.gossip_addr == ep for p in self.peers.values()
             ):
+                self._pending_pings.pop(ep, None)
+                continue
+            token = self._pending_pings.get(ep)
+            if token is None:
                 token = self._rng(32)
-                self._pending_ping = token
-                self._send(bytes([MSG_PING]) + token, ep)
+                self._pending_pings[ep] = token
+            self._send(bytes([MSG_PING]) + token, ep)
         live = [
             p for p in self.peers.values()
             if now - p.last_pong <= LIVENESS_S
@@ -321,10 +328,12 @@ class GossipNode:
                 ).digest() == data[1:33]:
                     p.last_pong = self._now()
                     p.ping_token = b""
-            # entrypoint pong (no peer entry yet): mark via pending token
-            tok = getattr(self, "_pending_ping", b"")
-            if tok and hashlib.sha256(tok).digest() == data[1:33]:
-                self._pending_ping = b""
+            # entrypoint pong (no peer entry yet): match against every
+            # outstanding entrypoint token
+            for ep, tok in list(self._pending_pings.items()):
+                if hashlib.sha256(tok).digest() == data[1:33]:
+                    del self._pending_pings[ep]
+                    break
         elif kind == MSG_PUSH:
             self.stats["push_rx"] += 1
             for v in self._decode_values(data, 1):

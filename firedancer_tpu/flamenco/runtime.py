@@ -44,6 +44,9 @@ _ALT_HDR = struct.Struct("<IQQBB32sH")
 ALT_HEADER_SZ = 56
 _ALT_DISC_TABLE = 1
 ALT_DEACT_NONE = (1 << 64) - 1
+#: slots a deactivating table keeps serving lookups (reference: table is
+#: usable until its deactivation slot ages out of slot-hashes, ~512 slots)
+ALT_DEACT_COOLDOWN = 513
 
 # ALT instruction discriminants (bincode u32le)
 _ALT_CREATE = 0
@@ -123,6 +126,10 @@ class Executor:
             acct = self.mgr.load(table_key)
             if acct is None or acct.owner != ALT_PROGRAM_ID:
                 return "alt: table account missing"
+            if len(acct.data) >= ALT_HEADER_SZ:
+                deact = int.from_bytes(acct.data[4:12], "little")
+                if deact != ALT_DEACT_NONE and self.slot >= deact + ALT_DEACT_COOLDOWN:
+                    return "alt: table deactivated"
             addrs = alt_addresses(acct.data)
             if addrs is None:
                 return "alt: malformed table"
@@ -250,6 +257,8 @@ class Executor:
         if auth != auth_k or not self._is_signer(auth_k, desc, keys):
             return "alt: bad authority"
         if disc == _ALT_FREEZE:
+            if deact != ALT_DEACT_NONE:
+                return "alt: deactivated tables cannot be frozen"
             acct.data = (
                 _ALT_HDR.pack(
                     _ALT_DISC_TABLE, deact, last_slot, last_idx, 0,
@@ -265,6 +274,8 @@ class Executor:
             if len(data) < 12:
                 return "alt: bad extend"
             n = int.from_bytes(data[4:12], "little")
+            if n == 0:
+                return "alt: empty extend"
             if len(data) < 12 + 32 * n:
                 return "alt: bad extend"
             existing = (len(acct.data) - ALT_HEADER_SZ) // 32
@@ -281,6 +292,8 @@ class Executor:
             store(table_k, acct)
             return ""
         if disc == _ALT_DEACTIVATE:
+            if deact != ALT_DEACT_NONE:
+                return "alt: already deactivated"
             acct.data = (
                 _ALT_HDR.pack(
                     _ALT_DISC_TABLE, self.slot, last_slot, last_idx, 1,
@@ -423,16 +436,30 @@ class Executor:
         logs.extend(vm.logs)
         if r0 != 0:
             return f"program error {r0}"
-        # commit writable accounts back from the input region
-        seen = set()
+        # Lamport conservation (ref fd_instr_info sum check): the sum of
+        # lamports across the instruction's unique accounts must not change.
+        pre_sum = 0
+        post = {}  # key -> (lamports, data) committed values
         for k, writable, lam_off, data_off, dlen in offsets:
-            if not writable or k in seen:
+            if k not in post:
+                pre_sum += (load(k) or Account(0)).lamports
+            elif post[k][1] is not None:
+                continue  # first writable occurrence wins
+            if writable:
+                post[k] = (
+                    int.from_bytes(vm.input_mem[lam_off : lam_off + 8], "little"),
+                    bytes(vm.input_mem[data_off : data_off + dlen]),
+                )
+            elif k not in post:
+                a = load(k) or Account(0)
+                post[k] = (a.lamports, None)
+        if sum(lam for lam, _ in post.values()) != pre_sum:
+            return "instruction changed total lamports"
+        for k, (lam, new_data) in post.items():
+            if new_data is None:
                 continue
-            seen.add(k)
             a = load(k) or Account(0)
-            a.lamports = int.from_bytes(
-                vm.input_mem[lam_off : lam_off + 8], "little"
-            )
-            a.data = bytes(vm.input_mem[data_off : data_off + dlen])
+            a.lamports = lam
+            a.data = new_data
             store(k, a)
         return ""

@@ -232,7 +232,12 @@ def digest_host(data: bytes) -> bytes:
     """Keccak-256 of one message, host-side (VM syscall use)."""
     rate = 136
     st = [0] * 25
-    padded = data + b"\x01" + b"\x00" * ((-len(data) - 2) % rate) + b"\x80"
+    # pad10*1: when only one pad byte fits, 0x01 and 0x80 merge into 0x81
+    q = rate - len(data) % rate
+    if q == 1:
+        padded = data + b"\x81"
+    else:
+        padded = data + b"\x01" + b"\x00" * (q - 2) + b"\x80"
     for off in range(0, len(padded), rate):
         blk = padded[off : off + rate]
         for i in range(rate // 8):

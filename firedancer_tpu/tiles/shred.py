@@ -79,6 +79,9 @@ class ShredTile(Tile):
         self._next_tag = 1
         #: signed shreds waiting for downstream credits
         self._outq: collections.deque = collections.deque()
+        #: sign requests waiting for keyguard-ring credits (a slot boundary
+        #: can shred into more FEC sets than one frag's worth of credits)
+        self._signq: collections.deque = collections.deque()
 
     # ---- ingress ---------------------------------------------------------
 
@@ -118,12 +121,7 @@ class ShredTile(Tile):
                 tag = self._next_tag
                 self._next_tag += 1
                 self._pending[tag] = (self._slot, fec)
-                root = np.frombuffer(fec.merkle_root, np.uint8)
-                ctx.outs[1].publish(
-                    np.array([tag], np.uint64), root[None, :],
-                    np.array([len(root)], np.uint16),
-                )
-                ctx.metrics.inc("sign_requests")
+                self._signq.append((tag, fec.merkle_root))
 
     # ---- keyguard responses ----------------------------------------------
 
@@ -159,7 +157,28 @@ class ShredTile(Tile):
 
     # ---- egress ----------------------------------------------------------
 
+    def _drain_signq(self, ctx: MuxCtx) -> None:
+        if not self._signq:
+            return
+        if len(ctx.outs) < 2:
+            raise RuntimeError(
+                "shred tile: keyguard signing requires outs[1] (sign ring)"
+            )
+        n = min(len(self._signq), ctx.outs[1].cr_avail())
+        if n <= 0:
+            return
+        items = [self._signq.popleft() for _ in range(n)]
+        tags = np.array([t for t, _ in items], np.uint64)
+        rows = np.stack(
+            [np.frombuffer(r, np.uint8) for _, r in items]
+        )
+        ctx.outs[1].publish(
+            tags, rows, np.full(n, rows.shape[1], np.uint16)
+        )
+        ctx.metrics.inc("sign_requests", n)
+
     def after_credit(self, ctx: MuxCtx) -> None:
+        self._drain_signq(ctx)
         while self._outq and ctx.credits > 0:
             n = min(len(self._outq), ctx.credits)
             items = [self._outq.popleft() for _ in range(n)]
@@ -182,7 +201,7 @@ class ShredTile(Tile):
         import time as _t
 
         deadline = _t.monotonic() + 10.0
-        while (self._outq or self._pending) and _t.monotonic() < deadline:
+        while (self._outq or self._pending or self._signq) and _t.monotonic() < deadline:
             if len(ctx.ins) > 1 and self._pending:
                 il = ctx.ins[1]
                 frags, il.seq, _ = il.mcache.drain(il.seq, 256)

@@ -85,6 +85,22 @@ def test_oracle_known_vectors():
     )
 
 
+def test_digest_host_vs_device_pad_boundary():
+    """Host digest (VM syscall path) vs device kernel across the pad10*1
+    merge boundary (len%136==135 needs the single 0x81 byte)."""
+    rng = np.random.default_rng(7)
+    lens = np.arange(130, 141, dtype=np.int32)
+    W = 160
+    msgs = np.zeros((len(lens), W), np.uint8)
+    for i, n in enumerate(lens):
+        msgs[i, :n] = rng.integers(0, 256, n, np.uint8)
+    got = np.asarray(K.keccak256(msgs, lens))
+    for i, n in enumerate(lens):
+        m = bytes(msgs[i, :n])
+        assert K.digest_host(m) == _oracle(m), f"host len {n}"
+        assert bytes(got[i]) == _oracle(m), f"device len {n}"
+
+
 def test_keccak256_batch_vs_oracle():
     rng = np.random.default_rng(5)
     W = 300  # multi-block coverage (rate 136): 0..2 extra blocks

@@ -146,6 +146,50 @@ def test_alt_missing_table_fails_cleanly():
     assert not r.ok and r.err.startswith("alt:")
 
 
+def test_alt_deactivated_table_stops_resolving():
+    rng = np.random.default_rng(11)
+    funk = _funk()
+    ex = Executor(funk)
+    payer, table, dest = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    for body in (
+        struct.pack("<IQB", 0, 0, 0),            # create
+        struct.pack("<IQ", 2, 1) + dest,          # extend
+    ):
+        r = ex.execute_txn(T.build(
+            _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+            [(2, [1, 0], body)], readonly_unsigned_cnt=1,
+        ))
+        assert r.ok, r.err
+    # n == 0 extend is rejected, not a struct.error
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+        [(2, [1, 0], struct.pack("<IQ", 2, 0))], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "empty extend" in r.err
+
+    ex.begin_slot(100)
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+        [(2, [1, 0], struct.pack("<I", 3))], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err  # deactivate at slot 100
+
+    v0 = T.build(
+        _sign_stub(1), [payer, bytes(32)], bytes(32),
+        [(1, [0, 2], struct.pack("<IQ", 2, 5))],
+        readonly_unsigned_cnt=1, version=T.V0,
+        address_tables=[(table, [0], [])],
+    )
+    # within the cooldown the table still serves lookups
+    ex.begin_slot(101)
+    assert ex.execute_txn(v0).ok
+    # after the cooldown it must not
+    ex.begin_slot(100 + 513)
+    r = ex.execute_txn(v0)
+    assert not r.ok and "deactivated" in r.err
+
+
 # ---------------------------------------------------------------------------
 # VM syscalls + tracer
 # ---------------------------------------------------------------------------
@@ -255,6 +299,53 @@ def test_callx_and_bad_register():
 # ---------------------------------------------------------------------------
 # account serialization into sBPF programs (sysvar read end-to-end)
 # ---------------------------------------------------------------------------
+
+
+def test_keccak_host_pad_merge_boundary():
+    """len % 136 == 135 forces the single-byte 0x81 pad (ADVICE r3).
+    Vectors precomputed with the independent scalar oracle in
+    tests/test_keccak256.py."""
+    from firedancer_tpu.ops.keccak256 import digest_host
+
+    vectors = {
+        134: "0a12e593c8f425a193451ce30336122b28303434b5ed8ef1fed0da6970d0c158",
+        135: "316ef5fac392334013c099d269106bf60e177aa75b6b3e0ccefc0cd19ef6adb2",
+        136: "fe7b19f0a766c96fdae42d45fa0de3423bfe68a710492afee13853eb6004d9c4",
+        271: "d09889bdca963a60c62a0e3baa13d4e51c791bc1cdbab166c94484da2b39450a",
+    }
+    for n, want in vectors.items():
+        assert digest_host(bytes([7]) * n).hex() == want, f"len {n}"
+
+
+def test_bpf_lamport_conservation_enforced():
+    """A program that rewrites a writable account's lamports upward must
+    fail the txn (reference: instruction-level lamport sum check)."""
+    rng = np.random.default_rng(13)
+    funk = _funk()
+    ex = Executor(funk)
+    payer, prog_key, victim = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    ex.mgr.store(victim, Account(500, bytes(32), False, 0, b""))
+    # input ABI with 1 account: u16 cnt | pubkey 32 | flags 1 | lamports 8
+    lam_off = 2 + 32 + 1
+    text = (
+        lddw(1, sbpf.MM_INPUT + lam_off)
+        + ins(0x79, dst=2, src=1)        # r2 = lamports
+        + ins(0x07, dst=2, imm=1000)     # mint 1000
+        + ins(0x7B, dst=1, src=2)        # store back
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    ex.mgr.store(
+        prog_key, Account(1, BPF_LOADER_ID, True, 0, sbpf.build_elf(text))
+    )
+    txn = T.build(
+        _sign_stub(1), [payer, victim, prog_key], bytes(32),
+        [(2, [1], b"")], readonly_unsigned_cnt=1,
+    )
+    r = ex.execute_txn(txn)
+    assert not r.ok and "lamports" in r.err
+    assert ex.mgr.load(victim).lamports == 500  # nothing committed
 
 
 def test_bpf_program_reads_clock_sysvar():
