@@ -96,6 +96,81 @@ class TxnResult:
     cu_used: int = 0
 
 
+#: reference: max instruction stack height 5 (top-level is height 1), so
+#: CPI may nest 4 deep (fd_vm_syscall_cpi max invoke depth behavior)
+MAX_INVOKE_STACK = 5
+#: per-txn compute budget shared across every instruction + CPI callee
+TXN_CU_BUDGET = 1_400_000
+#: CPI flat cost (reference: vm syscall cost model for sol_invoke_*)
+CPI_BASE_CU = 1_000
+#: PDA seed constraints (reference: fd_pubkey_create_program_address)
+MAX_SEEDS = 16
+MAX_SEED_LEN = 32
+
+
+@dataclass(frozen=True)
+class InstrCtx:
+    """Per-instruction execution context: the privilege sets the reference
+    carries in fd_instr_info (signer/writable flags per account), the
+    invoke stack for re-entrancy rules, and the shared CU meter.
+
+    `signers` includes txn signers and, under CPI, caller-granted signer
+    privileges + PDA signers; `writables` is the writable-privilege set
+    granted by the caller (top level: the txn message header flags).
+    `stack` holds the program ids of active invocations, outermost first.
+    `meter` is a 1-element mutable list: remaining CUs for the whole txn.
+    """
+
+    signers: frozenset
+    writables: frozenset
+    stack: tuple = ()
+    meter: list = field(default_factory=lambda: [TXN_CU_BUDGET])
+
+    @property
+    def depth(self) -> int:
+        return len(self.stack)
+
+    def child(self, signers, writables) -> "InstrCtx":
+        """Privilege-restricted context for a CPI callee.  The invoke
+        stack is NOT pushed here — _dispatch pushes the callee program id
+        when it runs the instruction."""
+        return InstrCtx(
+            frozenset(signers), frozenset(writables), self.stack, self.meter
+        )
+
+
+def create_program_address(seeds, program_id: bytes):
+    """PDA derivation: sha256(seeds.. || program_id || marker), rejected
+    when the digest decodes to a curve point (reference:
+    fd_pubkey_create_program_address)."""
+    import hashlib
+
+    if len(seeds) > MAX_SEEDS:
+        return None
+    h = hashlib.sha256()
+    for s in seeds:
+        if len(s) > MAX_SEED_LEN:
+            return None
+        h.update(s)
+    h.update(program_id)
+    h.update(b"ProgramDerivedAddress")
+    out = h.digest()
+    from firedancer_tpu.ops.ed25519 import golden
+
+    if golden.point_decompress(out) is not None:
+        return None  # on-curve: not a valid PDA
+    return out
+
+
+def find_program_address(seeds, program_id: bytes):
+    """(address, bump) with the canonical descending bump search."""
+    for bump in range(255, -1, -1):
+        pda = create_program_address(list(seeds) + [bytes([bump])], program_id)
+        if pda is not None:
+            return pda, bump
+    return None
+
+
 class Executor:
     """Executes parsed transactions against a funk fork."""
 
@@ -184,40 +259,56 @@ class Executor:
             overlay[k] = a
 
         logs: list = []
+        meter = [TXN_CU_BUDGET]
+        txn_signers = frozenset(keys[: desc.signature_cnt])
         for ins in desc.instr:
             prog_key = keys[ins.program_id]
             data = payload[ins.data_off : ins.data_off + ins.data_sz]
             ins_idx = [payload[ins.acct_off + j] for j in range(ins.acct_cnt)]
             ins_keys = [keys[j] for j in ins_idx]
-            err = self._dispatch(
-                prog_key, data, ins_keys, desc, keys, load, store, logs,
-                ins_idx=ins_idx,
+            ctx = InstrCtx(
+                frozenset(k for k in ins_keys if k in txn_signers),
+                frozenset(
+                    k for j, k in zip(ins_idx, ins_keys)
+                    if desc.is_writable(j)
+                ),
+                meter=meter,
             )
+            err = self._dispatch(prog_key, data, ins_keys, ctx, load, store, logs)
             if err:
-                return TxnResult(False, err, fee=fee, logs=logs)
+                return TxnResult(
+                    False, err, fee=fee, logs=logs,
+                    cu_used=TXN_CU_BUDGET - meter[0],
+                )
         for k, a in overlay.items():
             if a is not None:
                 self.mgr.store(k, a)
-        return TxnResult(True, fee=fee, logs=logs)
+        return TxnResult(
+            True, fee=fee, logs=logs, cu_used=TXN_CU_BUDGET - meter[0]
+        )
 
     # ---- dispatch -------------------------------------------------------
 
-    def _dispatch(self, prog_key, data, ins_keys, desc, keys, load, store,
-                  logs, ins_idx=None) -> str:
+    def _dispatch(self, prog_key, data, ins_keys, ctx: InstrCtx, load, store,
+                  logs) -> str:
+        if ctx.depth >= MAX_INVOKE_STACK:
+            return "max invoke stack depth"
+        ctx = InstrCtx(
+            ctx.signers, ctx.writables, ctx.stack + (prog_key,), ctx.meter
+        )
         if prog_key == SYSTEM_PROGRAM_ID:
-            return self._system(data, ins_keys, desc, keys, load, store)
+            return self._system(data, ins_keys, ctx, load, store)
         if prog_key == ALT_PROGRAM_ID:
-            return self._alt_program(data, ins_keys, desc, keys, load, store)
+            return self._alt_program(data, ins_keys, ctx, load, store)
         prog = load(prog_key)
         if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
             return self._bpf(
-                prog, data, ins_keys, desc, keys, load, store, logs,
-                ins_idx or [],
+                prog, prog_key, data, ins_keys, ctx, load, store, logs
             )
         return "unknown program"
 
 
-    def _alt_program(self, data, ins_keys, desc, keys, load, store) -> str:
+    def _alt_program(self, data, ins_keys, ctx: InstrCtx, load, store) -> str:
         """Address-lookup-table native program: create / freeze / extend /
         deactivate (fd_address_lookup_table_program.c behavior, simplified:
         no PDA derivation check — the table address is the account given)."""
@@ -228,8 +319,10 @@ class Executor:
             if len(ins_keys) < 2:
                 return "alt: bad create"
             table_k, auth_k = ins_keys[0], ins_keys[1]
-            if not self._is_signer(auth_k, desc, keys):
+            if auth_k not in ctx.signers:
                 return "alt: missing authority signature"
+            if table_k not in ctx.writables:
+                return "alt: table not writable"
             if load(table_k) is not None:
                 return "alt: account exists"
             hdr = _ALT_HDR.pack(
@@ -244,6 +337,8 @@ class Executor:
         if len(ins_keys) < 2:
             return "alt: bad instruction accounts"
         table_k, auth_k = ins_keys[0], ins_keys[1]
+        if table_k not in ctx.writables:
+            return "alt: table not writable"
         acct = load(table_k)
         if acct is None or acct.owner != ALT_PROGRAM_ID:
             return "alt: no table"
@@ -254,7 +349,7 @@ class Executor:
             return "alt: malformed table"
         if not has_auth:
             return "alt: frozen"
-        if auth != auth_k or not self._is_signer(auth_k, desc, keys):
+        if auth != auth_k or auth_k not in ctx.signers:
             return "alt: bad authority"
         if disc == _ALT_FREEZE:
             if deact != ALT_DEACT_NONE:
@@ -305,7 +400,7 @@ class Executor:
             return ""
         return "alt: unsupported instruction"
 
-    def _system(self, data, ins_keys, desc, keys, load, store) -> str:
+    def _system(self, data, ins_keys, ctx: InstrCtx, load, store) -> str:
         if len(data) < 4:
             return "bad system instruction"
         disc = int.from_bytes(data[:4], "little")
@@ -314,8 +409,10 @@ class Executor:
                 return "bad transfer"
             lamports = int.from_bytes(data[4:12], "little")
             src_k, dst_k = ins_keys[0], ins_keys[1]
-            if not self._is_signer(src_k, desc, keys):
+            if src_k not in ctx.signers:
                 return "missing signature"
+            if src_k not in ctx.writables or dst_k not in ctx.writables:
+                return "account not writable"
             src = load(src_k)
             if src is None or src.lamports < lamports:
                 return "insufficient funds"
@@ -336,10 +433,10 @@ class Executor:
                 return "data length exceeds maximum"
             owner = data[20:52]
             src_k, new_k = ins_keys[0], ins_keys[1]
-            if not self._is_signer(src_k, desc, keys) or not self._is_signer(
-                new_k, desc, keys
-            ):
+            if src_k not in ctx.signers or new_k not in ctx.signers:
                 return "missing signature"
+            if src_k not in ctx.writables or new_k not in ctx.writables:
+                return "account not writable"
             if lamports < rent_exempt_minimum(space):
                 return "rent: not exempt"
             src = load(src_k)
@@ -355,8 +452,10 @@ class Executor:
             if len(ins_keys) < 1 or len(data) < 36:
                 return "bad assign"
             k = ins_keys[0]
-            if not self._is_signer(k, desc, keys):
+            if k not in ctx.signers:
                 return "missing signature"
+            if k not in ctx.writables:
+                return "account not writable"
             a = load(k)
             if a is None:
                 return "no account"
@@ -370,8 +469,10 @@ class Executor:
             if space > MAX_DATA_LEN:
                 return "data length exceeds maximum"
             k = ins_keys[0]
-            if not self._is_signer(k, desc, keys):
+            if k not in ctx.signers:
                 return "missing signature"
+            if k not in ctx.writables:
+                return "account not writable"
             a = load(k)
             if a is None:
                 return "no account"
@@ -382,12 +483,8 @@ class Executor:
             return ""
         return "unsupported system instruction"
 
-    @staticmethod
-    def _is_signer(key: bytes, desc: T.TxnDesc, keys: list) -> bool:
-        return key in keys[: desc.signature_cnt]
-
-    def _bpf(self, prog: Account, data, ins_keys, desc, keys, load, store,
-             logs, ins_idx) -> str:
+    def _bpf(self, prog: Account, prog_key: bytes, data, ins_keys,
+             ctx: InstrCtx, load, store, logs) -> str:
         """Execute an sBPF program with the instruction's accounts
         serialized into the VM input region.
 
@@ -398,7 +495,11 @@ class Executor:
                        | u64 lamports | owner[32] | u64 data_len | data
           u64 ins_data_len | ins_data
         Writable accounts' lamports + data (same length; no realloc) are
-        committed back after a successful run."""
+        committed back after a successful run.
+
+        CPI: sol_invoke_signed_c re-enters _dispatch with caller-granted
+        privileges + PDA signers (reference: fd_vm_syscalls.c
+        fd_vm_syscall_cpi_c); see _register_cpi for the marshalling."""
         from firedancer_tpu.ballet import sbpf
         from firedancer_tpu.flamenco.vm import Vm, VmError
 
@@ -406,17 +507,15 @@ class Executor:
             program = sbpf.load(prog.data)
         except sbpf.SbpfError as e:
             return f"elf: {e}"
-        vm = Vm(program)
+        vm = Vm(program, cu_limit=ctx.meter[0])
 
         buf = bytearray()
         buf += len(ins_keys).to_bytes(2, "little")
         offsets = []  # (key, writable, lamports_off, data_off, data_len)
-        for j, k in zip(ins_idx, ins_keys):
+        for k in ins_keys:
             a = load(k) or Account(0)
-            writable = desc.is_writable(j)
-            flags = (1 if writable else 0) | (
-                2 if self._is_signer(k, desc, keys) else 0
-            )
+            writable = k in ctx.writables
+            flags = (1 if writable else 0) | (2 if k in ctx.signers else 0)
             buf += k + bytes([flags])
             lam_off = len(buf)
             buf += a.lamports.to_bytes(8, "little")
@@ -428,22 +527,34 @@ class Executor:
         buf += len(data).to_bytes(8, "little") + data
         vm.input_mem = bytearray(buf)
 
+        # lamport conservation baseline BEFORE execution: CPI commits into
+        # the overlay mid-run, so the post-run overlay is not the baseline
+        pre_sum = 0
+        seen = set()
+        for k, *_ in offsets:
+            if k not in seen:
+                seen.add(k)
+                pre_sum += (load(k) or Account(0)).lamports
+
+        self._register_cpi(
+            vm, prog_key, ins_keys, offsets, ctx, load, store, logs
+        )
+
         try:
             r0 = vm.run()
         except VmError as e:
             logs.extend(vm.logs)
+            ctx.meter[0] = max(vm.cu, 0)
             return f"vm: {e}"
         logs.extend(vm.logs)
+        ctx.meter[0] = max(vm.cu, 0)
         if r0 != 0:
             return f"program error {r0}"
         # Lamport conservation (ref fd_instr_info sum check): the sum of
         # lamports across the instruction's unique accounts must not change.
-        pre_sum = 0
         post = {}  # key -> (lamports, data) committed values
         for k, writable, lam_off, data_off, dlen in offsets:
-            if k not in post:
-                pre_sum += (load(k) or Account(0)).lamports
-            elif post[k][1] is not None:
+            if k in post and post[k][1] is not None:
                 continue  # first writable occurrence wins
             if writable:
                 post[k] = (
@@ -463,3 +574,177 @@ class Executor:
             a.data = new_data
             store(k, a)
         return ""
+
+    # ---- cross-program invocation ---------------------------------------
+
+    def _register_cpi(self, vm, prog_key: bytes, ins_keys, offsets,
+                      ctx: InstrCtx, load, store, logs) -> None:
+        """Install the CPI + PDA syscalls on a VM instance.
+
+        Marshalling follows the reference's C ABI (fd_vm_syscall_cpi_c):
+          SolInstruction  { program_id *u64, accounts *u64, accounts_len,
+                            data *u64, data_len }          (40 B)
+          SolAccountMeta  { pubkey *u64, is_writable u8, is_signer u8 }
+                                                           (16 B stride)
+          SolSignerSeedsC { addr *u64, len u64 } of SolSignerSeedC pairs
+        Account state flows through the runtime's own serialization table
+        (`offsets`), which is this build's analog of the reference's
+        account-info translation + copy-back."""
+        from firedancer_tpu.flamenco.vm import VmError
+
+        def _sync_down():
+            """Caller's input-region writes -> overlay (callee must see
+            the caller's in-flight state)."""
+            done = set()
+            for k, writable, lam_off, data_off, dlen in offsets:
+                if not writable or k in done:
+                    continue
+                done.add(k)
+                a = load(k) or Account(0)
+                a.lamports = int.from_bytes(
+                    vm.input_mem[lam_off : lam_off + 8], "little"
+                )
+                if len(a.data) == dlen:
+                    a.data = bytes(vm.input_mem[data_off : data_off + dlen])
+                store(k, a)
+
+        def _sync_up():
+            """Overlay -> caller's input region after the callee ran."""
+            for k, writable, lam_off, data_off, dlen in offsets:
+                if not writable:
+                    continue
+                a = load(k) or Account(0)
+                if len(a.data) != dlen:
+                    raise VmError("cpi: account resized (realloc unsupported)")
+                vm.input_mem[lam_off : lam_off + 8] = a.lamports.to_bytes(
+                    8, "little"
+                )
+                vm.input_mem[data_off : data_off + dlen] = a.data
+
+        def _seed_array(addr, count):
+            """Read a SolSignerSeedC[count] array -> list of seed bytes,
+            or None on constraint violation."""
+            if count > MAX_SEEDS:
+                return None
+            seeds = []
+            for j in range(count):
+                sa = vm.mem_read(addr + 16 * j, 8)
+                sl = vm.mem_read(addr + 16 * j + 8, 8)
+                if sl > MAX_SEED_LEN:
+                    return None
+                seeds.append(vm.mem_read_bytes(sa, sl))
+            return seeds
+
+        def _read_seeds(r4, r5):
+            if r5 > MAX_SEEDS:
+                raise VmError("cpi: too many signer seed sets")
+            pdas = []
+            for i in range(r5):
+                seeds_addr = vm.mem_read(r4 + 16 * i, 8)
+                n = vm.mem_read(r4 + 16 * i + 8, 8)
+                seeds = _seed_array(seeds_addr, n)
+                if seeds is None:
+                    raise VmError("cpi: bad signer seeds")
+                pda = create_program_address(seeds, prog_key)
+                if pda is None:
+                    raise VmError("cpi: invalid seeds (no PDA)")
+                pdas.append(pda)
+            return pdas
+
+        caller_keys = set(ins_keys)
+
+        def sol_invoke_signed_c(vm_, r1, r2, r3, r4, r5):
+            vm.consume(CPI_BASE_CU)
+            target = vm.mem_read_bytes(vm.mem_read(r1, 8), 32)
+            metas_addr = vm.mem_read(r1 + 8, 8)
+            metas_len = vm.mem_read(r1 + 16, 8)
+            data_addr = vm.mem_read(r1 + 24, 8)
+            data_len = vm.mem_read(r1 + 32, 8)
+            if metas_len > 64:
+                raise VmError("cpi: too many account metas")
+            if data_len > 10 * 1024:
+                raise VmError("cpi: instruction data too large")
+            inner_data = vm.mem_read_bytes(data_addr, data_len)
+
+            # the callee program account must be provided by the caller's
+            # instruction context (reference: callee must appear in the
+            # caller's account infos)
+            if target not in caller_keys:
+                raise VmError("cpi: program not in caller context")
+            # re-entrancy: a program already on the stack may only be
+            # re-entered by direct self-recursion, i.e. when it IS the
+            # currently executing program (reference rule)
+            if target != ctx.stack[-1] and target in ctx.stack:
+                raise VmError("cpi: reentrancy violation")
+
+            pdas = set(_read_seeds(r4, r5))
+            inner_keys, inner_signers, inner_writables = [], set(), set()
+            for i in range(metas_len):
+                base = metas_addr + 16 * i
+                k = vm.mem_read_bytes(vm.mem_read(base, 8), 32)
+                w = vm.mem_read(base + 8, 1)
+                s = vm.mem_read(base + 9, 1)
+                if k not in caller_keys:
+                    raise VmError("cpi: account not in caller context")
+                inner_keys.append(k)
+                if w:
+                    if k not in ctx.writables:
+                        raise VmError("cpi: writable privilege escalation")
+                    inner_writables.add(k)
+                if s:
+                    if k not in ctx.signers and k not in pdas:
+                        raise VmError("cpi: signer privilege escalation")
+                    inner_signers.add(k)
+
+            _sync_down()
+            ctx.meter[0] = max(vm.cu, 0)
+            err = self._dispatch(
+                target, inner_data, inner_keys,
+                ctx.child(inner_signers, inner_writables),
+                load, store, logs,
+            )
+            vm.cu = ctx.meter[0]
+            if err:
+                raise VmError(f"cpi: {err}")
+            _sync_up()
+            return 0
+
+        def sol_create_program_address(vm_, r1, r2, r3, r4, r5):
+            # r1 = seeds (SolSignerSeedC array), r2 = count,
+            # r3 = program id addr, r4 = result addr
+            vm.consume(1500)
+            seeds = _seed_array(r1, r2)
+            if seeds is None:
+                return 1
+            pid = vm.mem_read_bytes(r3, 32)
+            pda = create_program_address(seeds, pid)
+            if pda is None:
+                return 1
+            vm.mem_write_bytes(r4, pda)
+            return 0
+
+        def sol_try_find_program_address(vm_, r1, r2, r3, r4, r5):
+            # as above + r5 = bump seed out address.  CUs are charged per
+            # derivation attempt (reference: create_program_address units
+            # per bump iteration), which also bounds the host-side work.
+            seeds = _seed_array(r1, r2)
+            if seeds is None:
+                vm.consume(1500)
+                return 1
+            pid = vm.mem_read_bytes(r3, 32)
+            for bump in range(255, -1, -1):
+                vm.consume(1500)
+                pda = create_program_address(seeds + [bytes([bump])], pid)
+                if pda is not None:
+                    vm.mem_write_bytes(r4, pda)
+                    vm.mem_write(r5, 1, bump)
+                    return 0
+            return 1
+
+        vm.register_syscall(b"sol_invoke_signed_c", sol_invoke_signed_c)
+        vm.register_syscall(
+            b"sol_create_program_address", sol_create_program_address
+        )
+        vm.register_syscall(
+            b"sol_try_find_program_address", sol_try_find_program_address
+        )

@@ -86,6 +86,8 @@ class Vm:
         return int.from_bytes(buf[off : off + sz], "little")
 
     def mem_read_bytes(self, addr: int, sz: int) -> bytes:
+        if sz == 0:
+            return b""
         buf, off, _ = self._region(addr, sz)
         return bytes(buf[off : off + sz])
 
