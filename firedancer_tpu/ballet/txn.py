@@ -112,6 +112,9 @@ class TxnDesc:
     instr_cnt: int
     instr: Tuple[Instr, ...] = ()
     address_tables: Tuple[AddrLut, ...] = ()
+    #: serialized size of the parsed region (== len(payload) unless
+    #: allow_trailing was set)
+    sz: int = 0
 
     # -- account-category helpers (fd_txn_acct_iter equivalents) ----------
 
@@ -153,11 +156,13 @@ class TxnDesc:
         return [j for j in range(self.acct_addr_cnt) if not self.is_writable(j)]
 
 
-def parse(payload: bytes, allow_zero_signatures: bool = False) -> Optional[TxnDesc]:
+def parse(payload: bytes, allow_zero_signatures: bool = False,
+          allow_trailing: bool = False) -> Optional[TxnDesc]:
     """Parse + validate one serialized txn.  Returns None on any violation.
 
     Trailing bytes after the parsed region are rejected (the strict mode the
-    ingress tiles use).
+    ingress tiles use) unless allow_trailing is set (embedded-txn decode,
+    e.g. the gossip vote CRDS datum); desc.sz is the consumed size.
     """
     n = len(payload)
     if n > MTU:
@@ -318,7 +323,7 @@ def parse(payload: bytes, allow_zero_signatures: bool = False) -> Optional[TxnDe
             adtl_writable += writable_cnt
             adtl += writable_cnt + readonly_cnt
 
-    if i != n:
+    if not allow_trailing and i != n:
         return None
     if acct_addr_cnt + adtl > ACCT_ADDR_MAX:
         return None
@@ -343,6 +348,7 @@ def parse(payload: bytes, allow_zero_signatures: bool = False) -> Optional[TxnDe
         instr_cnt=instr_cnt,
         instr=tuple(instrs),
         address_tables=tuple(luts),
+        sz=i,
     )
 
 

@@ -38,6 +38,41 @@ def vec(inner) -> tuple:
     return ("vec", inner)
 
 
+def shortvec(inner) -> tuple:
+    """Solana short_vec: LEB128 u16 length + elements (the "compact"
+    modifier in fd_types.json)."""
+    return ("shortvec", inner)
+
+
+def varint(prim: str) -> tuple:
+    """serde_varint integer: 7-bit LEB128 groups, low first."""
+    return ("varint", prim)
+
+
+def _varint_encode(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _varint_decode(buf: bytes, off: int, max_bytes: int) -> tuple[int, int]:
+    v = 0
+    for i in range(max_bytes):
+        if off + i >= len(buf):
+            raise ValueError("short varint")
+        b = buf[off + i]
+        v |= (b & 0x7F) << (7 * i)
+        if not b & 0x80:
+            return v, off + i + 1
+    raise ValueError("varint too long")
+
+
 def arr(inner, n: int) -> tuple:
     return ("array", inner, n)
 
@@ -74,6 +109,17 @@ def encode(schema, val) -> bytes:
         for v in val:
             out += encode(schema[1], v)
         return out
+    if kind == "shortvec":
+        out = _varint_encode(len(val))
+        for v in val:
+            out += encode(schema[1], v)
+        return out
+    if kind == "varint":
+        return _varint_encode(val)
+    if kind == "txnbytes":
+        # embedded transaction: raw serialized bytes, no length prefix
+        # (fd_types "flamenco_txn"; the decoder parses it in place)
+        return bytes(val)
     if kind == "array":
         assert len(val) == schema[2]
         return b"".join(encode(schema[1], v) for v in val)
@@ -122,6 +168,28 @@ def decode(schema, buf: bytes, off: int = 0) -> tuple[Any, int]:
             v, off = decode(schema[1], buf, off)
             out.append(v)
         return out, off
+    if kind == "shortvec":
+        n, off = _varint_decode(buf, off, 3)
+        if n > 0xFFFF:
+            raise ValueError("shortvec too long")
+        out = []
+        for _ in range(n):
+            v, off = decode(schema[1], buf, off)
+            out.append(v)
+        return out, off
+    if kind == "varint":
+        limit = {"u16": 3, "u32": 5, "u64": 10}[schema[1]]
+        v, off = _varint_decode(buf, off, limit)
+        return v, off
+    if kind == "txnbytes":
+        from firedancer_tpu.ballet import txn as _T
+
+        # window the parse to one MTU: the embedded txn is at most MTU
+        # bytes, while the enclosing datagram may be far larger
+        desc = _T.parse(bytes(buf[off : off + _T.MTU]), allow_trailing=True)
+        if desc is None:
+            raise ValueError("bad embedded txn")
+        return bytes(buf[off : off + desc.sz]), off + desc.sz
     if kind == "array":
         out = []
         for _ in range(schema[2]):

@@ -5,27 +5,17 @@ Reference model: src/flamenco/gossip/fd_gossip.c (1,957 LoC) — the
 Solana gossip protocol: a conflict-free replicated data store (CRDS) of
 signed values (contact info, votes, ...) keyed by (origin, kind), newest
 wallclock wins; spread by push (eager fanout to live peers) and pull
-(anti-entropy: ask a random peer for values you lack), with ping/pong
-tokens proving peer liveness before they enter the active set.
+(anti-entropy: bloom-filtered requests answered with missing values),
+with ping/pong tokens proving peer liveness, and prune messages cutting
+redundant push routes.
 
-This build implements that architecture with its own compact wire format
-(this is NOT the mainnet-compatible encoding; the reference's bincode
-layouts live in its generated types layer which has no analog here yet):
-
-    msg   = u8 kind | body
-    PING  = token[32]
-    PONG  = sha256(token)[32]
-    PUSH  = u16 n | n * value
-    PULLQ = u16 n | n * u64 (xxh-mixed hashes of values held) | value(self)
-    PULLR = u16 n | n * value
-    value = sig[64] | origin[32] | u8 vkind | u64 wallclock
-            | u16 len | body       (sig covers everything after it)
-
-Values are Ed25519-signed by their origin and verified on receipt; an
-invalid signature drops the value (the reference does the same via its
-sigverify path).  Contact-info bodies carry the shred version plus
-gossip/TPU socket addresses, which is exactly what stake_ci/shred_dest
-(disco/shred_dest.py) need to run turbine without hand-fed contacts.
+Round 4: the wire format IS the mainnet bincode layout
+(flamenco/gossip_types.py declares the schemas from the reference's
+fd_types.json): gossip_msg = u32-tagged enum {pull_req, pull_resp,
+push_msg, prune_msg, ping, pong}; values are CrdsValue {signature,
+crds_data}; pull filters are CrdsFilter blooms whose bit positions use
+the reference's FNV-mix (fd_gossip.c fd_gossip_bloom_pos).  Signatures
+cover bincode(crds_data) and are verified on receipt.
 """
 
 from __future__ import annotations
@@ -37,12 +27,9 @@ import struct
 import time
 from dataclasses import dataclass, field
 
+from firedancer_tpu.flamenco import gossip_types as GT
+from firedancer_tpu.flamenco.bincode import encode
 from firedancer_tpu.ops.ed25519 import golden
-
-MSG_PING, MSG_PONG, MSG_PUSH, MSG_PULLQ, MSG_PULLR = range(5)
-
-V_CONTACT = 0
-V_VOTE = 1
 
 #: push fanout (reference default push fanout class)
 PUSH_FANOUT = 6
@@ -50,94 +37,69 @@ PUSH_FANOUT = 6
 LIVENESS_S = 20.0
 #: drop values older than this (reference CRDS timeouts)
 VALUE_TTL_S = 60.0
+#: bloom geometry for outgoing pull requests (reference sizes its filter
+#: to the packet budget; these are scaled-down equivalents)
+BLOOM_BITS = 4096
+BLOOM_KEYS = 3
+#: stale duplicate pushes from one relayer before we prune it for the
+#: duplicated origins (reference prune behavior)
+PRUNE_DUP_THRESHOLD = 3
+#: prune routes expire after this long (reference: prunes time out)
+PRUNE_TTL_S = 500.0
 
 
-def _addr_pack(addr: tuple[str, int]) -> bytes:
-    return socket.inet_aton(addr[0]) + struct.pack("<H", addr[1])
-
-
-def _addr_unpack(b: bytes) -> tuple[str, int]:
-    return socket.inet_ntoa(b[:4]), struct.unpack("<H", b[4:6])[0]
+def bloom_pos(value_hash: bytes, key: int, nbits: int) -> int:
+    """The reference's hash->bit-position FNV mix (fd_gossip.c
+    fd_gossip_bloom_pos): key ^= byte; key *= FNV prime; pos = key %
+    nbits."""
+    for i in range(32):
+        key ^= value_hash[i]
+        key = (key * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return key % nbits
 
 
 @dataclass(frozen=True)
 class ContactInfo:
+    """App-facing view of a contact_info_v1 CRDS datum."""
+
     pubkey: bytes
     shred_version: int
     gossip_addr: tuple[str, int]
     tpu_addr: tuple[str, int]
     wallclock: int = 0
 
-    def body(self) -> bytes:
-        return (
-            struct.pack("<H", self.shred_version)
-            + _addr_pack(self.gossip_addr)
-            + _addr_pack(self.tpu_addr)
-        )
+    def to_data(self) -> tuple:
+        payload = {
+            "id": self.pubkey,
+            "gossip": GT.sock(*self.gossip_addr),
+            "tvu": dict(GT.UNSPEC_SOCKET),
+            "tvu_fwd": dict(GT.UNSPEC_SOCKET),
+            "repair": dict(GT.UNSPEC_SOCKET),
+            "tpu": GT.sock(*self.tpu_addr),
+            "tpu_fwd": dict(GT.UNSPEC_SOCKET),
+            "tpu_vote": dict(GT.UNSPEC_SOCKET),
+            "rpc": dict(GT.UNSPEC_SOCKET),
+            "rpc_pubsub": dict(GT.UNSPEC_SOCKET),
+            "serve_repair": dict(GT.UNSPEC_SOCKET),
+            "wallclock": self.wallclock,
+            "shred_version": self.shred_version,
+        }
+        return ("contact_info_v1", payload)
 
     @classmethod
-    def from_value(cls, v: "CrdsValue") -> "ContactInfo":
-        sv = struct.unpack("<H", v.body[:2])[0]
-        return cls(
-            v.origin, sv, _addr_unpack(v.body[2:8]),
-            _addr_unpack(v.body[8:14]), v.wallclock,
-        )
-
-
-@dataclass(frozen=True)
-class CrdsValue:
-    origin: bytes
-    vkind: int
-    wallclock: int
-    body: bytes
-    signature: bytes
-
-    def signable(self) -> bytes:
-        return (
-            self.origin
-            + bytes([self.vkind])
-            + struct.pack("<Q", self.wallclock)
-            + struct.pack("<H", len(self.body))
-            + self.body
-        )
-
-    def encode(self) -> bytes:
-        return self.signature + self.signable()
-
-    @classmethod
-    def decode(cls, b: bytes, off: int) -> tuple["CrdsValue", int] | None:
-        if len(b) - off < 64 + 32 + 1 + 8 + 2:
+    def from_data(cls, data: tuple) -> "ContactInfo | None":
+        name, p = data
+        if name != "contact_info_v1":
             return None
-        sig = b[off : off + 64]
-        o = off + 64
-        origin = b[o : o + 32]
-        vkind = b[o + 32]
-        (wallclock,) = struct.unpack_from("<Q", b, o + 33)
-        (ln,) = struct.unpack_from("<H", b, o + 41)
-        body_off = o + 43
-        if body_off + ln > len(b):
+        g = GT.sock_to_tuple(p["gossip"])
+        t = GT.sock_to_tuple(p["tpu"])
+        if g is None or t is None:
             return None
-        body = b[body_off : body_off + ln]
-        return cls(origin, vkind, wallclock, body, sig), body_off + ln
-
-    def verify(self) -> bool:
-        return golden.verify(self.signable(), self.signature, self.origin) == 0
-
-    def key(self) -> tuple[bytes, int]:
-        return (self.origin, self.vkind)
-
-    def digest64(self) -> int:
-        h = hashlib.sha256(self.signature).digest()
-        return int.from_bytes(h[:8], "little")
+        return cls(p["id"], p["shred_version"], g, t, p["wallclock"])
 
 
-def make_value(secret: bytes, vkind: int, body: bytes,
-               wallclock: int | None = None) -> CrdsValue:
-    origin = golden.public_from_secret(secret)
-    wc = int(time.time() * 1000) if wallclock is None else wallclock
-    unsigned = CrdsValue(origin, vkind, wc, body, b"\0" * 64)
-    sig = golden.sign(secret, unsigned.signable())
-    return CrdsValue(origin, vkind, wc, body, sig)
+def make_contact_value(secret: bytes, ci: ContactInfo) -> dict:
+    return GT.sign_crds(secret, ci.to_data())
 
 
 @dataclass
@@ -145,6 +107,13 @@ class _Peer:
     contact: ContactInfo
     last_pong: float = 0.0
     ping_token: bytes = b""
+    #: origins this peer asked us not to push to it (prune protocol):
+    #: origin -> monotonic expiry time
+    pruned: dict = field(default_factory=dict)
+    #: per-origin stale-duplicate counts feeding our outgoing prunes
+    dup_counts: dict = field(default_factory=dict)
+    #: push cursor: values with adopt-seq > this still need pushing
+    push_seq: int = 0
 
 
 class GossipNode:
@@ -169,7 +138,13 @@ class GossipNode:
         self.addr = self.sock.getsockname()
         self.tpu_addr = tpu_addr
         self.entrypoints = list(entrypoints or [])
-        self.crds: dict[tuple[bytes, int], CrdsValue] = {}
+        #: CRDS table: crds_label -> {"signature", "data"}
+        self.crds: dict[tuple, dict] = {}
+        #: cached sha256(bincode(value)) per label (pull-filter identity)
+        self._hashes: dict[tuple, bytes] = {}
+        #: monotonically increasing adopt sequence per label (push-once)
+        self._adopt_seq: dict[tuple, int] = {}
+        self._seq = 0
         self.peers: dict[bytes, _Peer] = {}
         #: outstanding bootstrap ping tokens, one per entrypoint addr
         self._pending_pings: dict[tuple[str, int], bytes] = {}
@@ -177,7 +152,7 @@ class GossipNode:
         self._rng = os.urandom
         self.stats = {
             "rx": 0, "tx": 0, "push_rx": 0, "pull_rx": 0,
-            "bad_sig": 0, "stale": 0,
+            "bad_sig": 0, "stale": 0, "prune_rx": 0, "prune_tx": 0,
         }
         self._refresh_self()
 
@@ -185,70 +160,125 @@ class GossipNode:
 
     def _refresh_self(self) -> None:
         me = ContactInfo(
-            self.pubkey, self.shred_version, self.addr, self.tpu_addr
+            self.pubkey, self.shred_version, self.addr, self.tpu_addr,
+            wallclock=int(time.time() * 1000),
         )
-        self._self_value = make_value(self.secret, V_CONTACT, me.body())
+        self._self_value = make_contact_value(self.secret, me)
         self._upsert(self._self_value, verified=True)
 
-    def _upsert(self, v: CrdsValue, verified: bool = False) -> bool:
+    def _upsert(self, v: dict, verified: bool = False,
+                relayer: bytes | None = None) -> bool:
         """Insert if newer than what we hold; returns True when adopted."""
-        cur = self.crds.get(v.key())
-        if cur is not None and cur.wallclock >= v.wallclock:
+        label = GT.crds_label(v["data"])
+        cur = self.crds.get(label)
+        if cur is not None and (
+            GT.crds_wallclock(cur["data"]) >= GT.crds_wallclock(v["data"])
+        ):
             self.stats["stale"] += 1
+            if relayer is not None and GT.verify_crds(v):
+                p = self.peers.get(relayer)
+                if p is not None:
+                    origin = GT.crds_origin(v["data"])
+                    p.dup_counts[origin] = p.dup_counts.get(origin, 0) + 1
             return False
-        if not verified and not v.verify():
+        if not verified and not GT.verify_crds(v):
             self.stats["bad_sig"] += 1
             return False
-        self.crds[v.key()] = v
-        if v.vkind == V_CONTACT and v.origin != self.pubkey:
-            ci = ContactInfo.from_value(v)
-            p = self.peers.get(v.origin)
+        self.crds[label] = v
+        self._hashes[label] = GT.value_hash(v)
+        self._seq += 1
+        self._adopt_seq[label] = self._seq
+        origin = GT.crds_origin(v["data"])
+        ci = ContactInfo.from_data(v["data"])
+        if ci is not None and origin != self.pubkey:
+            p = self.peers.get(origin)
             if p is None:
-                self.peers[v.origin] = _Peer(ci)
+                self.peers[origin] = _Peer(ci)
             else:
                 p.contact = ci
         return True
 
     def contacts(self) -> list[ContactInfo]:
-        return [
-            ContactInfo.from_value(v)
-            for v in self.crds.values()
-            if v.vkind == V_CONTACT
-        ]
+        out = []
+        for v in self.crds.values():
+            ci = ContactInfo.from_data(v["data"])
+            if ci is not None:
+                out.append(ci)
+        return out
 
     # ---- wire ------------------------------------------------------------
 
-    def _send(self, payload: bytes, addr) -> None:
+    def _send(self, msg, addr) -> None:
         try:
-            self.sock.sendto(payload, addr)
+            self.sock.sendto(GT.encode_msg(msg), addr)
             self.stats["tx"] += 1
         except OSError:
             pass
 
-    def _encode_values(self, kind: int, values: list[CrdsValue]) -> bytes:
-        out = bytes([kind]) + struct.pack("<H", len(values))
-        for v in values:
-            out += v.encode()
-        return out
+    def _make_ping(self, token: bytes) -> tuple:
+        return ("ping", {
+            "from": self.pubkey,
+            "token": token,
+            "signature": golden.sign(self.secret, token),
+        })
 
-    def _decode_values(self, data: bytes, off: int) -> list[CrdsValue]:
-        if len(data) < off + 2:
-            return []
-        (n,) = struct.unpack_from("<H", data, off)
-        off += 2
+    def _make_pull_filter(self) -> dict:
+        """CrdsFilter bloom over every value hash we hold (single-shard:
+        mask_bits 0 means every hash falls in this filter's partition)."""
+        keys = [
+            int.from_bytes(self._rng(8), "little") for _ in range(BLOOM_KEYS)
+        ]
+        words = [0] * (BLOOM_BITS // 64)
+        nset = 0
+        for h in self._hashes.values():
+            for k in keys:
+                pos = bloom_pos(h, k, BLOOM_BITS)
+                w, b = divmod(pos, 64)
+                if not words[w] >> b & 1:
+                    nset += 1
+                words[w] |= 1 << b
+        return {
+            "filter": {
+                "keys": keys,
+                "bits": {"bits": {"vec": words}, "len": BLOOM_BITS},
+                "num_bits_set": nset,
+            },
+            "mask": (1 << 64) - 1,
+            "mask_bits": 0,
+        }
+
+    def _filter_misses(self, flt: dict) -> list[dict]:
+        """Values we hold that the requester's bloom does NOT contain
+        (reference: fd_gossip.c pull-request handling)."""
+        keys = flt["filter"]["keys"]
+        bv = flt["filter"]["bits"]
+        words = bv["bits"]["vec"] if bv["bits"] else []
+        nbits = bv["len"] or 1
+        mask = flt["mask"]
+        mask_bits = flt["mask_bits"]
         out = []
-        for _ in range(min(n, 64)):
-            hit = CrdsValue.decode(data, off)
-            if hit is None:
-                break
-            v, off = hit
-            out.append(v)
+        for label, v in self.crds.items():
+            h = self._hashes[label]
+            if mask_bits:
+                m = (1 << 64) - 1 >> mask_bits
+                if (int.from_bytes(h[:8], "little") | m) != mask:
+                    continue  # not this filter's hash-space shard
+            hit = True
+            for k in keys:
+                pos = bloom_pos(h, k, nbits)
+                w, b = divmod(pos, 64)
+                if w >= len(words) or not words[w] >> b & 1:
+                    hit = False
+                    break
+            if not hit or not keys:
+                out.append(v)
         return out
 
     # ---- protocol drivers ------------------------------------------------
 
     def tick(self) -> None:
-        """One round: drain rx, ping entrypoints/peers, push, pull."""
+        """One round: drain rx, ping entrypoints/peers, push, pull,
+        prune redundant relayers."""
         self._drain_rx()
         now = self._now()
         # bootstrap: ping entrypoints we know nothing about yet (one
@@ -263,7 +293,7 @@ class GossipNode:
             if token is None:
                 token = self._rng(32)
                 self._pending_pings[ep] = token
-            self._send(bytes([MSG_PING]) + token, ep)
+            self._send(self._make_ping(token), ep)
         live = [
             p for p in self.peers.values()
             if now - p.last_pong <= LIVENESS_S
@@ -275,25 +305,61 @@ class GossipNode:
         for p in stale:
             token = self._rng(32)
             p.ping_token = token
-            self._send(bytes([MSG_PING]) + token, p.contact.gossip_addr)
-        # push: my newest values to up to PUSH_FANOUT live peers
+            self._send(self._make_ping(token), p.contact.gossip_addr)
         if live:
-            values = list(self.crds.values())[:32]
-            msg = self._encode_values(MSG_PUSH, values)
+            # push: values adopted since each peer's cursor (push-once,
+            # like the reference's push queue), honoring prune routes
+            # (expired prunes reopen)
             for p in live[:PUSH_FANOUT]:
-                self._send(msg, p.contact.gossip_addr)
+                for origin, exp in list(p.pruned.items()):
+                    if now >= exp:
+                        del p.pruned[origin]
+                send = [
+                    self.crds[label]
+                    for label, seq in self._adopt_seq.items()
+                    if seq > p.push_seq
+                    and GT.crds_origin(self.crds[label]["data"])
+                    not in p.pruned
+                ][:32]
+                p.push_seq = self._seq
+                if send:
+                    self._send(("push_msg", {
+                        "pubkey": self.pubkey, "crds": send,
+                    }), p.contact.gossip_addr)
             # pull: anti-entropy with one live peer
             target = live[int.from_bytes(self._rng(2), "little") % len(live)]
-            have = struct.pack(
-                "<H", min(len(self.crds), 1024)
-            ) + b"".join(
-                struct.pack("<Q", v.digest64())
-                for v in list(self.crds.values())[:1024]
-            )
-            self._send(
-                bytes([MSG_PULLQ]) + have + self._self_value.encode(),
-                target.contact.gossip_addr,
-            )
+            self._send(("pull_req", {
+                "filter": self._make_pull_filter(),
+                "value": self._self_value,
+            }), target.contact.gossip_addr)
+            # prune relayers that keep pushing duplicates
+            self._send_prunes()
+
+    def _send_prunes(self) -> None:
+        for origin, p in self.peers.items():
+            dups = [
+                o for o, c in p.dup_counts.items()
+                if c >= PRUNE_DUP_THRESHOLD
+            ]
+            if not dups or origin in (None, self.pubkey):
+                continue
+            wallclock = int(time.time() * 1000)
+            sign_payload = encode(GT.PRUNE_SIGN_DATA, {
+                "pubkey": self.pubkey, "prunes": dups,
+                "destination": origin, "wallclock": wallclock,
+            })
+            self._send(("prune_msg", {
+                "pubkey": self.pubkey,
+                "data": {
+                    "pubkey": self.pubkey,
+                    "prunes": dups,
+                    "signature": golden.sign(self.secret, sign_payload),
+                    "destination": origin,
+                    "wallclock": wallclock,
+                },
+            }), p.contact.gossip_addr)
+            self.stats["prune_tx"] += 1
+            p.dup_counts.clear()
 
     def _drain_rx(self) -> None:
         while True:
@@ -305,62 +371,73 @@ class GossipNode:
                 return
             self.stats["rx"] += 1
             try:
-                self._on_msg(data, addr)
-            except (struct.error, IndexError, ValueError):
+                self._on_msg(GT.decode_msg(data), addr)
+            except (struct.error, IndexError, ValueError, KeyError):
                 continue  # malformed datagram: drop
 
-    def _on_msg(self, data: bytes, addr) -> None:
-        if not data:
-            return
-        kind = data[0]
-        if kind == MSG_PING and len(data) >= 33:
-            self._send(
-                bytes([MSG_PONG]) + hashlib.sha256(data[1:33]).digest(), addr
-            )
+    def _on_msg(self, msg, addr) -> None:
+        kind, body = msg
+        if kind == "ping":
+            pong_token = hashlib.sha256(body["token"]).digest()
+            self._send(("pong", {
+                "from": self.pubkey,
+                "token": pong_token,
+                "signature": golden.sign(self.secret, pong_token),
+            }), addr)
             # answer with our contact so bootstrap converges fast
-            self._send(
-                self._encode_values(MSG_PUSH, [self._self_value]), addr
-            )
-        elif kind == MSG_PONG and len(data) >= 33:
+            self._send(("push_msg", {
+                "pubkey": self.pubkey, "crds": [self._self_value],
+            }), addr)
+        elif kind == "pong":
+            got = body["token"]
             for p in self.peers.values():
                 if p.ping_token and hashlib.sha256(
                     p.ping_token
-                ).digest() == data[1:33]:
+                ).digest() == got:
                     p.last_pong = self._now()
                     p.ping_token = b""
             # entrypoint pong (no peer entry yet): match against every
             # outstanding entrypoint token
             for ep, tok in list(self._pending_pings.items()):
-                if hashlib.sha256(tok).digest() == data[1:33]:
+                if hashlib.sha256(tok).digest() == got:
                     del self._pending_pings[ep]
                     break
-        elif kind == MSG_PUSH:
+        elif kind == "push_msg":
             self.stats["push_rx"] += 1
-            for v in self._decode_values(data, 1):
-                self._upsert(v)
+            for v in body["crds"][:64]:
+                self._upsert(v, relayer=body["pubkey"])
             # learning a contact from a ping-answer counts as liveness
             for p in self.peers.values():
                 if p.contact.gossip_addr == addr and p.last_pong == 0.0:
                     p.last_pong = self._now()
-        elif kind == MSG_PULLQ:
-            (n,) = struct.unpack_from("<H", data, 1)
-            o = 3
-            have = set()
-            for _ in range(min(n, 1024)):
-                have.add(struct.unpack_from("<Q", data, o)[0])
-                o += 8
-            hit = CrdsValue.decode(data, o)
-            if hit is not None:
-                self._upsert(hit[0])
-            missing = [
-                v for v in self.crds.values() if v.digest64() not in have
-            ][:32]
+        elif kind == "pull_req":
+            self._upsert(body["value"])
+            missing = self._filter_misses(body["filter"])[:32]
             if missing:
-                self._send(self._encode_values(MSG_PULLR, missing), addr)
-        elif kind == MSG_PULLR:
+                self._send(("pull_resp", {
+                    "pubkey": self.pubkey, "crds": missing,
+                }), addr)
+        elif kind == "pull_resp":
             self.stats["pull_rx"] += 1
-            for v in self._decode_values(data, 1):
+            for v in body["crds"][:64]:
                 self._upsert(v)
+        elif kind == "prune_msg":
+            self.stats["prune_rx"] += 1
+            d = body["data"]
+            if d["destination"] != self.pubkey:
+                return
+            sign_payload = encode(GT.PRUNE_SIGN_DATA, {
+                "pubkey": d["pubkey"], "prunes": d["prunes"],
+                "destination": d["destination"], "wallclock": d["wallclock"],
+            })
+            if golden.verify(sign_payload, d["signature"], d["pubkey"]) != 0:
+                self.stats["bad_sig"] += 1
+                return
+            p = self.peers.get(d["pubkey"])
+            if p is not None:
+                exp = self._now() + PRUNE_TTL_S
+                for o in d["prunes"]:
+                    p.pruned[o] = exp
 
     def close(self) -> None:
         self.sock.close()

@@ -1,0 +1,319 @@
+"""Mainnet gossip wire types: CrdsValue / CrdsData / gossip messages.
+
+Declarative bincode schemas for the Solana gossip protocol's UDP payloads,
+matching the reference's generated types (layout source:
+/root/reference/src/flamenco/types/fd_types.json `gossip_*`/`crds_*`
+entries, encode/decode paths /root/reference/src/flamenco/gossip/
+fd_gossip.c).  Wire convention: bincode fixint LE; enums u32-tagged;
+Vec = u64 count; `compact` vectors = LEB128 short_vec; `varint` fields =
+serde_varint — all provided by flamenco.bincode.
+
+The CRDS signable payload is the bincode encoding of the CrdsData alone;
+the signature covers exactly those bytes (fd_gossip.c
+fd_gossip_sign_crds_value behavior).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct as _struct
+
+from firedancer_tpu.flamenco.bincode import (
+    PUBKEY, SIGNATURE, decode, encode, enum_of, opt, shortvec, struct_of,
+    varint, vec,
+)
+
+# ---------------------------------------------------------------------------
+# address / socket primitives
+# ---------------------------------------------------------------------------
+
+IP_ADDR = enum_of(
+    ("ip4", ("bytes", 4)),
+    ("ip6", ("bytes", 16)),
+)
+
+SOCKET_ADDR = struct_of(("addr", IP_ADDR), ("port", "u16"))
+
+#: placeholder unspecified socket (0.0.0.0:0)
+UNSPEC_SOCKET = {"addr": ("ip4", bytes(4)), "port": 0}
+
+
+def sock(ip: str, port: int) -> dict:
+    return {"addr": ("ip4", bytes(int(x) for x in ip.split("."))), "port": port}
+
+
+def sock_to_tuple(s: dict):
+    kind, raw = s["addr"]
+    if kind != "ip4":
+        return None
+    return (".".join(str(b) for b in raw), s["port"])
+
+
+# ---------------------------------------------------------------------------
+# CRDS data variants (fd_types.json order — discriminants are consensus!)
+# ---------------------------------------------------------------------------
+
+CONTACT_INFO_V1 = struct_of(
+    ("id", PUBKEY),
+    ("gossip", SOCKET_ADDR),
+    ("tvu", SOCKET_ADDR),
+    ("tvu_fwd", SOCKET_ADDR),
+    ("repair", SOCKET_ADDR),
+    ("tpu", SOCKET_ADDR),
+    ("tpu_fwd", SOCKET_ADDR),
+    ("tpu_vote", SOCKET_ADDR),
+    ("rpc", SOCKET_ADDR),
+    ("rpc_pubsub", SOCKET_ADDR),
+    ("serve_repair", SOCKET_ADDR),
+    ("wallclock", "u64"),
+    ("shred_version", "u16"),
+)
+
+#: flamenco_txn in the reference is a raw embedded txn; the vote's txn
+#: travels as its serialized bytes (u64-counted in the reference's vector
+#: framing of the raw payload is NOT used — the txn is parsed in place;
+#: we carry the raw bytes and parse with ballet.txn)
+VOTE = struct_of(
+    ("index", "u8"),
+    ("from", PUBKEY),
+    ("txn", ("txnbytes",)),
+    ("wallclock", "u64"),
+)
+
+LOWEST_SLOT = struct_of(
+    ("u8", "u8"),
+    ("from", PUBKEY),
+    ("root", "u64"),
+    ("lowest", "u64"),
+    ("slots", vec("u64")),
+    ("i_dont_know", "u64"),
+    ("wallclock", "u64"),
+)
+
+SLOT_HASH = struct_of(("slot", "u64"), ("hash", ("bytes", 32)))
+
+SLOT_HASHES = struct_of(
+    ("from", PUBKEY),
+    ("hashes", vec(SLOT_HASH)),
+    ("wallclock", "u64"),
+)
+
+BITVEC_U8 = struct_of(
+    ("bits", opt(struct_of(("vec", vec("u8"))))),
+    ("len", "u64"),
+)
+
+BITVEC_U64 = struct_of(
+    ("bits", opt(struct_of(("vec", vec("u64"))))),
+    ("len", "u64"),
+)
+
+SLOTS = struct_of(
+    ("first_slot", "u64"), ("num", "u64"), ("slots", BITVEC_U8),
+)
+
+FLATE2_SLOTS = struct_of(
+    ("first_slot", "u64"), ("num", "u64"), ("compressed", vec("u8")),
+)
+
+SLOTS_ENUM = enum_of(("flate2", FLATE2_SLOTS), ("uncompressed", SLOTS))
+
+EPOCH_SLOTS = struct_of(
+    ("u8", "u8"),
+    ("from", PUBKEY),
+    ("slots", vec(SLOTS_ENUM)),
+    ("wallclock", "u64"),
+)
+
+VERSION_V1 = struct_of(
+    ("from", PUBKEY),
+    ("wallclock", "u64"),
+    ("major", "u16"), ("minor", "u16"), ("patch", "u16"),
+    ("commit", opt("u32")),
+)
+
+VERSION_V2 = struct_of(
+    ("from", PUBKEY),
+    ("wallclock", "u64"),
+    ("major", "u16"), ("minor", "u16"), ("patch", "u16"),
+    ("commit", opt("u32")),
+    ("feature_set", "u32"),
+)
+
+VERSION_V3 = struct_of(
+    ("major", varint("u16")), ("minor", varint("u16")),
+    ("patch", varint("u16")),
+    ("commit", "u32"), ("feature_set", "u32"),
+    ("client", varint("u16")),
+)
+
+NODE_INSTANCE = struct_of(
+    ("from", PUBKEY),
+    ("wallclock", "u64"),
+    ("timestamp", "u64"),
+    ("token", "u64"),
+)
+
+DUPLICATE_SHRED = struct_of(
+    ("version", "u16"),
+    ("from", PUBKEY),
+    ("wallclock", "u64"),
+    ("slot", "u64"),
+    ("shred_index", "u32"),
+    ("shred_variant", "u8"),
+    ("chunk_cnt", "u8"),
+    ("chunk_idx", "u8"),
+    ("chunk", vec("u8")),
+)
+
+INC_SNAPSHOT_HASHES = struct_of(
+    ("from", PUBKEY),
+    ("base_hash", SLOT_HASH),
+    ("hashes", vec(SLOT_HASH)),
+    ("wallclock", "u64"),
+)
+
+SOCKET_ENTRY = struct_of(
+    ("key", "u8"), ("index", "u8"), ("offset", varint("u16")),
+)
+
+CONTACT_INFO_V2 = struct_of(
+    ("from", PUBKEY),
+    ("wallclock", varint("u64")),
+    ("outset", "u64"),
+    ("shred_version", "u16"),
+    ("version", VERSION_V3),
+    ("addrs", shortvec(IP_ADDR)),
+    ("sockets", shortvec(SOCKET_ENTRY)),
+    ("extensions", shortvec("u32")),
+)
+
+CRDS_DATA = enum_of(
+    ("contact_info_v1", CONTACT_INFO_V1),
+    ("vote", VOTE),
+    ("lowest_slot", LOWEST_SLOT),
+    ("snapshot_hashes", SLOT_HASHES),
+    ("accounts_hashes", SLOT_HASHES),
+    ("epoch_slots", EPOCH_SLOTS),
+    ("version_v1", VERSION_V1),
+    ("version_v2", VERSION_V2),
+    ("node_instance", NODE_INSTANCE),
+    ("duplicate_shred", DUPLICATE_SHRED),
+    ("incremental_snapshot_hashes", INC_SNAPSHOT_HASHES),
+    ("contact_info_v2", CONTACT_INFO_V2),
+)
+
+CRDS_VALUE = struct_of(("signature", SIGNATURE), ("data", CRDS_DATA))
+
+# ---------------------------------------------------------------------------
+# gossip protocol messages
+# ---------------------------------------------------------------------------
+
+CRDS_BLOOM = struct_of(
+    ("keys", vec("u64")),
+    ("bits", BITVEC_U64),
+    ("num_bits_set", "u64"),
+)
+
+CRDS_FILTER = struct_of(
+    ("filter", CRDS_BLOOM),
+    ("mask", "u64"),
+    ("mask_bits", "u32"),
+)
+
+PING = struct_of(
+    ("from", PUBKEY), ("token", ("bytes", 32)), ("signature", SIGNATURE),
+)
+
+PRUNE_DATA = struct_of(
+    ("pubkey", PUBKEY),
+    ("prunes", vec(PUBKEY)),
+    ("signature", SIGNATURE),
+    ("destination", PUBKEY),
+    ("wallclock", "u64"),
+)
+
+PRUNE_SIGN_DATA = struct_of(
+    ("pubkey", PUBKEY),
+    ("prunes", vec(PUBKEY)),
+    ("destination", PUBKEY),
+    ("wallclock", "u64"),
+)
+
+GOSSIP_MSG = enum_of(
+    ("pull_req", struct_of(("filter", CRDS_FILTER), ("value", CRDS_VALUE))),
+    ("pull_resp", struct_of(("pubkey", PUBKEY), ("crds", vec(CRDS_VALUE)))),
+    ("push_msg", struct_of(("pubkey", PUBKEY), ("crds", vec(CRDS_VALUE)))),
+    ("prune_msg", struct_of(("pubkey", PUBKEY), ("data", PRUNE_DATA))),
+    ("ping", PING),
+    ("pong", PING),
+)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def encode_msg(msg) -> bytes:
+    return encode(GOSSIP_MSG, msg)
+
+
+def decode_msg(buf: bytes):
+    v, off = decode(GOSSIP_MSG, buf, 0)
+    if off != len(buf):
+        raise ValueError("trailing bytes")
+    return v
+
+
+def crds_signable(data) -> bytes:
+    """The byte range a CrdsValue signature covers: bincode(data)."""
+    return encode(CRDS_DATA, data)
+
+
+def sign_crds(secret: bytes, data) -> dict:
+    from firedancer_tpu.ops.ed25519 import golden
+
+    sig = golden.sign(secret, crds_signable(data))
+    return {"signature": sig, "data": data}
+
+
+def verify_crds(value: dict) -> bool:
+    from firedancer_tpu.ops.ed25519 import golden
+
+    origin = crds_origin(value["data"])
+    if origin is None:
+        return False
+    return golden.verify(
+        crds_signable(value["data"]), value["signature"], origin
+    ) == 0
+
+
+def crds_origin(data):
+    """The origin pubkey of a CRDS datum (the key the signature is
+    checked against and the CRDS table is keyed by)."""
+    name, payload = data
+    if name == "contact_info_v1":
+        return payload["id"]
+    return payload.get("from")
+
+
+def crds_label(data) -> tuple:
+    """CRDS table key: (variant, origin [, index/slot discriminator])."""
+    name, payload = data
+    origin = crds_origin(data)
+    if name == "vote":
+        return (name, origin, payload["index"])
+    if name == "duplicate_shred":
+        return (name, origin, payload["slot"])
+    return (name, origin)
+
+
+def crds_wallclock(data) -> int:
+    name, payload = data
+    return int(payload.get("wallclock", 0))
+
+
+def value_hash(value: dict) -> bytes:
+    """sha256 of the full encoded CrdsValue (pull-filter identity)."""
+    return hashlib.sha256(encode(CRDS_VALUE, value)).digest()
