@@ -81,24 +81,33 @@ def _verify_core_kernel(c_ref, k_ref, s_ref, ay_ref, ry_ref, ok_ref):
         neg_a_table = PT.build_neg_table9(a_pt)
         b_table = F.c("B_TABLE9")
 
-        # the double_scalar_mul loop, with the per-iteration digit rows
-        # read straight from the VMEM refs (values cannot be dynamically
-        # sliced under Mosaic; refs can)
-        def body(j, acc):
-            idx = 63 - j
-            kd = jnp.squeeze(k_ref[pl.ds(idx, 1), :], axis=0)
-            sd = jnp.squeeze(s_ref[pl.ds(idx, 1), :], axis=0)
-            acc = PT.double(acc, with_t=False)
-            acc = PT.double(acc, with_t=False)
-            acc = PT.double(acc, with_t=False)
-            acc = PT.double(acc, with_t=True)
-            acc = PT.add_niels(acc, PT.lookup9(neg_a_table, kd), with_t=True)
-            acc = PT.add_niels_affine(
-                acc, PT.lookup9_affine(b_table, sd), with_t=False
-            )
+        # the double_scalar_mul loop, 8-way unrolled: one aligned (8, B)
+        # digit-chunk read per outer step, then 8 statically-sliced body
+        # copies.  Measured round 4 (scripts/exp_dsm_variants.py): the
+        # per-iteration loop boundary costs ~5.5 ns/iter/lane (spill +
+        # scheduling barrier); unrolling 8x removes 7/8 of it (1.12x),
+        # and 16x/32x measure the same — 8x keeps Mosaic compile ~74 s.
+        # The dynamic digit reads themselves are free (noread == base).
+        def outer(c, acc):
+            base = pl.multiple_of(56 - 8 * c, 8)  # chunks from the top
+            k8 = k_ref[pl.ds(base, 8), :]
+            s8 = s_ref[pl.ds(base, 8), :]
+            for r in range(7, -1, -1):
+                kd = jnp.squeeze(k8[r:r + 1, :], axis=0)
+                sd = jnp.squeeze(s8[r:r + 1, :], axis=0)
+                acc = PT.double(acc, with_t=False)
+                acc = PT.double(acc, with_t=False)
+                acc = PT.double(acc, with_t=False)
+                acc = PT.double(acc, with_t=True)
+                acc = PT.add_niels(
+                    acc, PT.lookup9(neg_a_table, kd), with_t=True
+                )
+                acc = PT.add_niels_affine(
+                    acc, PT.lookup9_affine(b_table, sd), with_t=False
+                )
             return acc
 
-        acc = jax.lax.fori_loop(0, 64, body, PT.identity(TILE))
+        acc = jax.lax.fori_loop(0, 8, outer, PT.identity(TILE))
         ok = ok & PT.eq_external(acc, r_pt)
         ok_ref[0, :] = ok.astype(jnp.int32)
 
