@@ -203,6 +203,8 @@ class Vm:
             raise VmError("compute budget exceeded")
 
     def mem_write_bytes(self, addr: int, data: bytes) -> None:
+        if not data:
+            return
         buf, off, writable = self._region(addr, len(data))
         if not writable:
             raise VmError(f"write to read-only memory at {addr:#x}")
