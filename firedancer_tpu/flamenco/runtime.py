@@ -175,18 +175,30 @@ class Executor:
     """Executes parsed transactions against a funk fork."""
 
     def __init__(self, funk: Funk, xid: bytes = ROOT_XID):
+        from firedancer_tpu.flamenco.features import Features
+
         self.funk = funk
         self.xid = xid
         self.mgr = AccountMgr(funk, xid)
         self.slot = 0  # bank slot (ALT create derivation, deactivation)
+        #: runtime behavior switches (reference: fd_features_t); dev
+        #: default is all-enabled, overridden by on-chain feature
+        #: accounts at each slot boundary
+        self.features = Features.all_enabled()
 
     def begin_slot(self, slot: int, unix_timestamp: int = 0) -> None:
         """Advance the bank slot: refresh the sysvar accounts
         (reference: fd_sysvar_clock_update at slot start)."""
         from firedancer_tpu.flamenco import sysvar
+        from firedancer_tpu.flamenco.features import Features
 
         self.slot = slot
         sysvar.install(self.mgr, slot, unix_timestamp=unix_timestamp)
+        # refresh the feature table from the account database
+        # (reference: fd_features derive from feature accounts)
+        self.features = Features.from_accounts(
+            self.mgr.load, default=self.features
+        )
 
     # ---- address lookup tables ------------------------------------------
 
@@ -225,6 +237,10 @@ class Executor:
         desc = desc or T.parse(payload)
         if desc is None:
             return TxnResult(False, "parse")
+        if desc.transaction_version != T.VLEGACY and not self.features.active(
+            "versioned_tx_message_enabled", self.slot
+        ):
+            return TxnResult(False, "versioned transactions not enabled")
         keys = [
             bytes(desc.acct_addr(payload, j))
             for j in range(desc.acct_addr_cnt)
@@ -414,6 +430,10 @@ class Executor:
             if src_k not in ctx.writables or dst_k not in ctx.writables:
                 return "account not writable"
             src = load(src_k)
+            if src is None and lamports == 0 and not self.features.active(
+                "system_transfer_zero_check", self.slot
+            ):
+                return ""  # pre-feature: 0-lamport from missing src is ok
             if src is None or src.lamports < lamports:
                 return "insufficient funds"
             if src_k == dst_k:

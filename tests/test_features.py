@@ -1,0 +1,110 @@
+"""Feature-gate registry: on-chain accounts flip runtime behavior.
+
+Reference analog: src/flamenco/features/ — activation-slot table derived
+from feature accounts; gated behaviors switch end-to-end.
+"""
+
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import Account, SYSTEM_PROGRAM_ID
+from firedancer_tpu.flamenco.features import (
+    DISABLED, FEATURE_IDS, FEATURE_OWNER_ID, Features,
+    decode_feature_account, encode_feature_account,
+)
+from firedancer_tpu.flamenco.runtime import ALT_PROGRAM_ID, Executor
+from firedancer_tpu.funk.funk import Funk
+
+
+def _keys(rng, n):
+    return [rng.integers(0, 256, 32, np.uint8).tobytes() for _ in range(n)]
+
+
+def _sign_stub(n):
+    return [bytes([7]) * 64 for _ in range(n)]
+
+
+def test_feature_account_codec():
+    assert decode_feature_account(encode_feature_account(None)) is None
+    assert decode_feature_account(encode_feature_account(123)) == 123
+    assert decode_feature_account(b"") is None
+    f = Features.all_enabled()
+    assert f.active("versioned_tx_message_enabled", 0)
+    f2 = Features.all_disabled()
+    assert not f2.active("versioned_tx_message_enabled", 10**9)
+
+
+def test_versioned_tx_gate_flips_via_feature_account():
+    """A v0 txn is rejected while the feature account is pending and
+    accepted once it records an activation slot <= the bank slot."""
+    rng = np.random.default_rng(31)
+    funk = Funk()
+    ex = Executor(funk)
+    payer, table, dest = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+
+    # a live lookup table holding `dest`
+    for body in (
+        struct.pack("<IQB", 0, 0, 0),
+        struct.pack("<IQ", 2, 1) + dest,
+    ):
+        r = ex.execute_txn(T.build(
+            _sign_stub(2), [payer, table, ALT_PROGRAM_ID], bytes(32),
+            [(2, [1, 0], body)], readonly_unsigned_cnt=1,
+        ))
+        assert r.ok, r.err
+
+    v0 = T.build(
+        _sign_stub(1), [payer, SYSTEM_PROGRAM_ID], bytes(32),
+        [(1, [0, 2], struct.pack("<IQ", 2, 77))],
+        readonly_unsigned_cnt=1, version=T.V0,
+        address_tables=[(table, [0], [])],
+    )
+
+    # install a PENDING feature account -> gate closes at next slot
+    fk = FEATURE_IDS["versioned_tx_message_enabled"]
+    ex.mgr.store(
+        fk, Account(1, FEATURE_OWNER_ID, False, 0,
+                    encode_feature_account(None))
+    )
+    ex.begin_slot(10)
+    r = ex.execute_txn(v0)
+    assert not r.ok and "versioned" in r.err
+
+    # record activation at slot 12: still closed at 11, open at 12
+    ex.mgr.store(
+        fk, Account(1, FEATURE_OWNER_ID, False, 0,
+                    encode_feature_account(12))
+    )
+    ex.begin_slot(11)
+    assert not ex.execute_txn(v0).ok
+    ex.begin_slot(12)
+    r = ex.execute_txn(v0)
+    assert r.ok, r.err
+    assert ex.mgr.load(dest).lamports == 77
+
+
+def test_zero_transfer_gate():
+    rng = np.random.default_rng(32)
+    funk = Funk()
+    ex = Executor(funk)
+    payer, ghost, dest = _keys(rng, 3)
+    ex.mgr.store(payer, Account(1_000_000_000))
+
+    def zero_transfer():
+        # src = ghost (nonexistent), 0 lamports, signed by ghost
+        return ex.execute_txn(T.build(
+            _sign_stub(2), [payer, ghost, dest, SYSTEM_PROGRAM_ID],
+            bytes(32), [(3, [1, 2], struct.pack("<IQ", 2, 0))],
+            readonly_unsigned_cnt=1,
+        ))
+
+    # all-enabled default: zero-check active -> rejected
+    r = zero_transfer()
+    assert not r.ok and "insufficient funds" in r.err
+
+    ex.features.slots["system_transfer_zero_check"] = DISABLED
+    r = zero_transfer()
+    assert r.ok, r.err
