@@ -136,7 +136,21 @@ class HttpServer:
                 status, body, ctype = self.handler(req)
             except Exception:
                 status, body, ctype = 500, b"internal error\n", "text/plain"
-            conn.sendall(build_response(status, body, ctype))
+            if isinstance(body, (bytes, bytearray)):
+                conn.sendall(build_response(status, bytes(body), ctype))
+            else:
+                # streamed body (iterator of byte chunks): close-framed
+                # response, O(chunk) memory on both ends
+                reason = {200: "OK"}.get(status, "OK")
+                conn.sendall(
+                    (
+                        f"HTTP/1.1 {status} {reason}\r\n"
+                        f"Content-Type: {ctype}\r\n"
+                        f"Connection: close\r\n\r\n"
+                    ).encode("latin1")
+                )
+                for chunk in body:
+                    conn.sendall(chunk)
         except OSError:
             pass
         finally:
@@ -165,3 +179,43 @@ def get(addr: tuple[str, int], path: str, timeout: float = 5.0) -> tuple[int, by
             data += chunk
     status, _h, body = parse_response(data)
     return status, body
+
+
+def get_stream(addr: tuple[str, int], path: str, sink,
+               timeout: float = 30.0) -> tuple[int, int]:
+    """Streaming GET: body chunks go to sink(bytes) as they arrive —
+    O(chunk) client memory (the snapshot download path; reference:
+    fd_snapshot_http.c's incremental read state machine).  Returns
+    (status, body_bytes)."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: {addr[0]}\r\n"
+            f"Connection: close\r\n\r\n".encode()
+        )
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ValueError("connection closed before headers")
+            buf += chunk
+        head, rest = buf.split(b"\r\n\r\n", 1)
+        lines = head.decode("latin1").split("\r\n")
+        status = int(lines[0].split(" ")[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, v = ln.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+        want = int(headers["content-length"]) if "content-length" in headers else None
+        n = 0
+        if rest:
+            sink(rest)
+            n += len(rest)
+        while want is None or n < want:
+            chunk = s.recv(262144)
+            if not chunk:
+                break
+            sink(chunk)
+            n += len(chunk)
+        if want is not None and n != want:
+            raise ValueError("short body")
+        return status, n

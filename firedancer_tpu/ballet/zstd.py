@@ -98,6 +98,66 @@ def _xxh64_py(data: bytes, seed: int = 0) -> int:
     return h
 
 
+class Xxh64Stream:
+    """Incremental XXH64 (spec streaming form): O(1) state — four lane
+    accumulators over 32-byte stripes plus a <32-byte tail buffer."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.v = [
+            (seed + _P1 + _P2) & _M64, (seed + _P2) & _M64,
+            seed, (seed - _P1) & _M64,
+        ]
+        self.tail = bytearray()
+        self.total = 0
+
+    def update(self, data: bytes) -> "Xxh64Stream":
+        self.total += len(data)
+        buf = self.tail + data
+        n = (len(buf) // 32) * 32
+        v1, v2, v3, v4 = self.v
+        for i in range(0, n, 32):
+            lanes = struct.unpack_from("<QQQQ", buf, i)
+            v1 = (_rotl((v1 + lanes[0] * _P2) & _M64, 31) * _P1) & _M64
+            v2 = (_rotl((v2 + lanes[1] * _P2) & _M64, 31) * _P1) & _M64
+            v3 = (_rotl((v3 + lanes[2] * _P2) & _M64, 31) * _P1) & _M64
+            v4 = (_rotl((v4 + lanes[3] * _P2) & _M64, 31) * _P1) & _M64
+        self.v = [v1, v2, v3, v4]
+        self.tail = bytearray(buf[n:])
+        return self
+
+    def digest(self) -> int:
+        if self.total < 32:
+            return _xxh64_py(bytes(self.tail), self.seed)
+        v1, v2, v3, v4 = self.v
+        h = (
+            _rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)
+        ) & _M64
+        for v in (v1, v2, v3, v4):
+            v = (_rotl((v * _P2) & _M64, 31) * _P1) & _M64
+            h = ((h ^ v) * _P1 + _P4) & _M64
+        h = (h + self.total) & _M64
+        data, i, n = bytes(self.tail), 0, len(self.tail)
+        while i + 8 <= n:
+            (lane,) = struct.unpack_from("<Q", data, i)
+            k = _rotl((lane * _P2) & _M64, 31) * _P1 & _M64
+            h = ((_rotl(h ^ k, 27) * _P1) + _P4) & _M64
+            i += 8
+        if i + 4 <= n:
+            (lane,) = struct.unpack_from("<I", data, i)
+            h = ((_rotl(h ^ (lane * _P1 & _M64), 23) * _P2) + _P3) & _M64
+            i += 4
+        while i < n:
+            h = (_rotl(h ^ (data[i] * _P5 & _M64), 11) * _P1) & _M64
+            i += 1
+        h ^= h >> 33
+        h = (h * _P2) & _M64
+        h ^= h >> 29
+        h = (h * _P3) & _M64
+        h ^= h >> 32
+        return h
+
+
 _MAX_BLOCK = (1 << 17)  # 128 KiB
 
 
@@ -130,6 +190,140 @@ def compress(data: bytes) -> bytes:
 
 class ZstdError(ValueError):
     pass
+
+
+class StreamCompressor:
+    """Incremental zstd frame writer (store-mode blocks), O(block) memory.
+
+    The frame header omits the content size (streaming producers don't
+    know it) and the checksum (computing xxh64 would need the whole
+    stream; snapshot integrity is carried by the accounts-hash manifest
+    gate instead).  Usage: out += write(chunk)...; out += finish().
+    """
+
+    def __init__(self):
+        #: window descriptor: exponent 7 -> window log 17 (= _MAX_BLOCK)
+        self._header = struct.pack("<I", _MAGIC) + bytes([0b00_0_0_0_0_00, 7 << 3])
+        self._buf = bytearray()
+        self._done = False
+
+    def _block(self, blk: bytes, last: int) -> bytes:
+        if len(blk) > 1 and blk.count(blk[0]) == len(blk):
+            hdr = last | (1 << 1) | (len(blk) << 3)  # RLE
+            return struct.pack("<I", hdr)[:3] + blk[:1]
+        return struct.pack("<I", last | (len(blk) << 3))[:3] + blk
+
+    def write(self, data: bytes) -> bytes:
+        assert not self._done
+        out = bytearray()
+        if self._header:
+            out += self._header
+            self._header = b""
+        self._buf += data
+        while len(self._buf) > _MAX_BLOCK:
+            out += self._block(bytes(self._buf[:_MAX_BLOCK]), 0)
+            del self._buf[:_MAX_BLOCK]
+        return bytes(out)
+
+    def finish(self) -> bytes:
+        assert not self._done
+        self._done = True
+        out = bytearray(self._header)
+        out += self._block(bytes(self._buf), 1)
+        self._buf.clear()
+        return bytes(out)
+
+
+class StreamDecompressor:
+    """Incremental zstd frame reader for store-mode frames, O(block)
+    memory: feed() compressed bytes, collect returned plaintext.  Sets
+    .eof after the last block (+ checksum when the frame carries one)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._state = "header"
+        self._checksum = False
+        self._fcs = None
+        self._out_len = 0
+        self._hash_parts: Xxh64Stream | None = None
+        self.eof = False
+
+    def feed(self, data: bytes) -> bytes:
+        self._buf += data
+        out = bytearray()
+        while True:
+            if self._state == "header":
+                if len(self._buf) < 6:
+                    break
+                if struct.unpack_from("<I", self._buf, 0)[0] != _MAGIC:
+                    raise ZstdError("bad magic")
+                fhd = self._buf[4]
+                off = 5
+                single = (fhd >> 5) & 1
+                self._checksum = bool((fhd >> 2) & 1)
+                did_sz = (0, 1, 2, 4)[fhd & 3]
+                fcs_flag = fhd >> 6
+                if not single:
+                    off += 1
+                off += did_sz
+                fcs_sz = {0: (1 if single else 0), 1: 2, 2: 4, 3: 8}[fcs_flag]
+                if len(self._buf) < off + fcs_sz:
+                    break
+                if fcs_sz:
+                    self._fcs = int.from_bytes(
+                        self._buf[off : off + fcs_sz], "little"
+                    )
+                    if fcs_flag == 1:
+                        self._fcs += 256
+                    off += fcs_sz
+                if self._checksum:
+                    self._hash_parts = Xxh64Stream()
+                del self._buf[:off]
+                self._state = "block"
+            elif self._state == "block":
+                if len(self._buf) < 3:
+                    break
+                hdr = int.from_bytes(self._buf[:3], "little")
+                last, btype, bsize = hdr & 1, (hdr >> 1) & 3, hdr >> 3
+                if btype == 0:
+                    need = 3 + bsize
+                    if len(self._buf) < need:
+                        break
+                    blk = bytes(self._buf[3:need])
+                elif btype == 1:
+                    need = 4
+                    if len(self._buf) < need:
+                        break
+                    blk = self._buf[3:4] * bsize
+                elif btype == 2:
+                    raise ZstdError(
+                        "entropy-coded block: streaming decoder handles "
+                        "store-mode frames only"
+                    )
+                else:
+                    raise ZstdError("reserved block type")
+                del self._buf[:need]
+                out += blk
+                self._out_len += len(blk)
+                if self._hash_parts is not None:
+                    self._hash_parts.update(bytes(blk))
+                if last:
+                    self._state = "checksum" if self._checksum else "done"
+            elif self._state == "checksum":
+                if len(self._buf) < 4:
+                    break
+                (want,) = struct.unpack_from("<I", self._buf, 0)
+                got = self._hash_parts.digest() & 0xFFFFFFFF
+                if got != want:
+                    raise ZstdError("content checksum mismatch")
+                del self._buf[:4]
+                self._state = "done"
+            else:  # done
+                if self._fcs is not None and self._fcs != self._out_len:
+                    raise ZstdError("content size mismatch")
+                self.eof = True
+                break
+        return bytes(out)
 
 
 def decompress(frame: bytes) -> bytes:

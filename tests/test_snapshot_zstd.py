@@ -108,3 +108,92 @@ def test_snapshot_http_download(tmp_path):
         srv.close()
     funk2, slot, h2 = S.restore(dst)
     assert slot == 9 and h2 == h and funk2.root == funk.root
+
+
+def test_streaming_zstd_classes():
+    """StreamCompressor/StreamDecompressor interop with the one-shot
+    codec, across block boundaries, plus incremental xxh64 parity."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 500_000, np.uint8).tobytes()
+    c = Z.StreamCompressor()
+    frame = b"".join(
+        [c.write(data[i:i + 70_000]) for i in range(0, len(data), 70_000)]
+    ) + c.finish()
+    # one-shot decoder reads the streamed frame
+    assert Z.decompress(frame) == data
+    # streaming decoder reads a one-shot frame (with checksum)
+    frame2 = Z.compress(data)
+    d = Z.StreamDecompressor()
+    out = b""
+    for i in range(0, len(frame2), 9_999):
+        out += d.feed(frame2[i:i + 9_999])
+    assert out == data and d.eof
+    # incremental xxh64 == one-shot
+    h = Z.Xxh64Stream()
+    for i in range(0, len(data), 37):
+        h.update(data[i:i + 37])
+    assert h.digest() == Z._xxh64_py(data)
+    assert Z.Xxh64Stream().update(b"xxhash").digest() == Z._xxh64_py(b"xxhash")
+
+
+def test_snapshot_restore_bounded_memory(tmp_path):
+    """Restore peak heap must be O(account store), NOT O(archive +
+    decompressed copy): the streaming pipeline never holds the whole
+    file (reference: fd_snapshot_http.c streaming restore)."""
+    import os
+    import tracemalloc
+
+    import numpy as np
+
+    from firedancer_tpu.flamenco import snapshot as S
+    from firedancer_tpu.funk.funk import Funk
+
+    rng = np.random.default_rng(9)
+    funk = Funk()
+    data_total = 0
+    for i in range(48):
+        v = rng.integers(0, 256, 262_144, np.uint8).tobytes()  # 256 KiB
+        funk.root[rng.integers(0, 256, 32, np.uint8).tobytes()] = v
+        data_total += len(v)
+    path = str(tmp_path / "snap.tar.zst")
+    S.create(funk, path, slot=5)
+    assert os.path.getsize(path) > 10_000_000  # incompressible corpus
+
+    tracemalloc.start()
+    funk2, slot, _h = S.restore(path)
+    _current, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert slot == 5 and len(funk2.root) == len(funk.root)
+    # peak = account store (data_total) + O(block) working set; the old
+    # whole-file path needed >= archive + decompressed copy (~3x data)
+    assert peak < data_total + 8 * 1024 * 1024, peak
+
+
+def test_accounts_hash_tpool_invariance():
+    """The fork-join accounts hash is identical with and without a pool
+    (tpool's production consumer; reference: tpool-parallel accounts
+    hashing)."""
+    import numpy as np
+
+    from firedancer_tpu.flamenco.snapshot import accounts_hash
+    from firedancer_tpu.utils.tpool import TPool
+
+    rng = np.random.default_rng(13)
+    records = {
+        rng.integers(0, 256, 32, np.uint8).tobytes():
+            rng.integers(0, 256, int(n), np.uint8).tobytes()
+        for n in rng.integers(1, 4096, 300)
+    }
+    serial = accounts_hash(records)
+    pool = TPool(4)
+    try:
+        assert accounts_hash(records, tpool=pool) == serial
+    finally:
+        pool.close()
+    pool2 = TPool(7)
+    try:
+        assert accounts_hash(records, tpool=pool2) == serial
+    finally:
+        pool2.close()
