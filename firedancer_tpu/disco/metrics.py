@@ -44,7 +44,9 @@ class MetricsSchema:
         "housekeep_iters",
         "loop_iters",
     )
-    BASE_HISTS = ("batch_sz", "loop_ns")
+    #: loop phase durations are sampled every 16th iteration (reference:
+    #: fd_mux.c histograms every loop phase via tickcount)
+    BASE_HISTS = ("batch_sz", "loop_ns", "hk_ns", "frag_ns", "credit_ns")
 
     def with_base(self) -> "MetricsSchema":
         return MetricsSchema(

@@ -215,9 +215,16 @@ def run_loop(
         lazy_ns = tempo.lazy_default(min(depths) if depths else batch_max)
     next_hk = 0  # fire immediately on the first iteration
     idle = 0
+    iters = 0
     try:
         while True:
             now = time.monotonic_ns()
+            # phase durations are histogram-sampled every 16th iteration
+            # (the reference histograms every phase, fd_mux.c:435-444; a
+            # 1/16 sample keeps the Python-side cost negligible while
+            # preserving the distribution)
+            sample = (iters & 0xF) == 0
+            iters += 1
             if now >= next_hk:
                 next_hk = now + tempo.async_reload(lazy_ns)
                 cnc.heartbeat(now)
@@ -227,6 +234,8 @@ def run_loop(
                 if cnc.signal_query() == R.CNC_HALT:
                     break
                 tile.during_housekeeping(ctx)
+                if sample:
+                    m.hist_sample("hk_ns", time.monotonic_ns() - now)
             m.inc("loop_iters")
 
             cr = batch_max
@@ -242,6 +251,7 @@ def run_loop(
 
             out_seq0 = [o.seq for o in ctx.outs]
             got = 0
+            t_frag0 = time.monotonic_ns() if sample else 0
             absorb = tile.in_budget(ctx)
             for i, il in enumerate(ctx.ins):
                 # credits are consumed across in-links: a tile republishes
@@ -264,7 +274,17 @@ def run_loop(
                     m.hist_sample("batch_sz", len(frags))
                     tile.on_frags(ctx, i, frags)
             ctx.credits = cr - got
-            tile.after_credit(ctx)
+            if sample:
+                t_credit0 = time.monotonic_ns()
+                if got:
+                    m.hist_sample("frag_ns", t_credit0 - t_frag0)
+                tile.after_credit(ctx)
+                m.hist_sample(
+                    "credit_ns", time.monotonic_ns() - t_credit0
+                )
+                m.hist_sample("loop_ns", time.monotonic_ns() - now)
+            else:
+                tile.after_credit(ctx)
 
             produced = any(o.seq != s0 for o, s0 in zip(ctx.outs, out_seq0))
             if got == 0 and not produced:
