@@ -18,8 +18,11 @@ Receivers track true ACK ranges so reordered/lossy arrival is acked
 faithfully.  Server-side Retry + token validation and pre-validation
 anti-amplification (3x) implement RFC 9000 section 8.
 
-Remaining scope notes: no version negotiation, no key update, no
-connection migration.
+Round 4 added RFC 9000 section 6 version negotiation (stateless VN
+packets from the server, client abort on incompatible VN) and RFC 9001
+section 6 key update (phase bit, per-generation secrets via "quic ku",
+constant header-protection keys, previous-generation receive window).
+Remaining scope note: no connection migration.
 
 Sans-IO: Connection.datagrams_out() drains UDP payloads to send; feed
 received payloads via Connection.on_datagram(); call on_timer(now)
@@ -85,15 +88,27 @@ def vi_dec(buf: bytes, off: int) -> tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-class Keys:
-    """AEAD + header-protection keys for one direction at one level."""
+def ku_secret(secret: bytes) -> bytes:
+    """Next-generation traffic secret (RFC 9001 section 6 key update)."""
+    return tls.hkdf_expand_label(secret, "quic ku", b"", 32)
 
-    def __init__(self, secret: bytes):
+
+class Keys:
+    """AEAD + header-protection keys for one direction at one level.
+
+    Key update note: the header-protection key is NOT updated across key
+    phases (RFC 9001 6.1) — updated generations reuse the old hp."""
+
+    def __init__(self, secret: bytes, hp=None):
+        self.secret = secret
         self.aead = A.AesGcm(
             tls.hkdf_expand_label(secret, "quic key", b"", 16)
         )
         self.iv = tls.hkdf_expand_label(secret, "quic iv", b"", 12)
-        self.hp = A.key_expand(tls.hkdf_expand_label(secret, "quic hp", b"", 16))
+        # key update passes the previous generation's hp (never updated)
+        self.hp = hp if hp is not None else A.key_expand(
+            tls.hkdf_expand_label(secret, "quic hp", b"", 16)
+        )
 
     def nonce(self, pn: int) -> bytes:
         n = int.from_bytes(self.iv, "big") ^ pn
@@ -255,6 +270,18 @@ class Connection:
         self.bytes_rx = 0
         self.bytes_tx = 0
         self._amp_blocked: list[bytes] = []
+        # ---- key update state (RFC 9001 section 6) ----
+        #: current key phase bit for 1-RTT packets (both directions flip
+        #: together)
+        self.key_phase = 0
+        self.key_updates = 0
+        self._app_rx_secret: bytes | None = None
+        self._app_tx_secret: bytes | None = None
+        #: previous-generation rx keys (reordered pre-update packets)
+        self._rx_prev: Keys | None = None
+        #: cached next-generation rx trial keys (one derivation per
+        #: generation, not per phase-mismatched packet)
+        self._rx_next: Keys | None = None
 
     # -- key install ---------------------------------------------------------
 
@@ -277,6 +304,9 @@ class Connection:
                 else:
                     self.keys_rx[level] = Keys(s)
                     self.keys_tx[level] = Keys(c)
+                if level == APPLICATION:
+                    self._app_rx_secret = self.keys_rx[level].secret
+                    self._app_tx_secret = self.keys_tx[level].secret
                 if level == HANDSHAKE and not self.is_server:
                     # client discards the Initial space when it first
                     # sends at the handshake level (RFC 9002 6.4); the
@@ -324,6 +354,26 @@ class Connection:
             self._out.append(d)
 
     def _rx_long(self, data: bytes, off: int) -> int:
+        version = int.from_bytes(data[off + 1 : off + 5], "big")
+        if version == 0:
+            # Version Negotiation (RFC 9000 section 6): only meaningful
+            # to a client that has not yet processed any server packet
+            if self.is_server or any(
+                v >= 0 for v in self.largest_rx.values()
+            ):
+                return len(data) - off
+            o = off + 5
+            o += 1 + data[o]            # dcid
+            o += 1 + data[o]            # scid
+            offered = {
+                int.from_bytes(data[i : i + 4], "big")
+                for i in range(o, len(data) - 3, 4)
+            }
+            if VERSION not in offered:
+                self.closed = True      # no compatible version
+            return len(data) - off
+        if version != VERSION:
+            return -1                   # unknown version: drop
         pt = (data[off] >> 4) & 3
         o = off + 5
         dcil = data[o]
@@ -376,9 +426,28 @@ class Connection:
         truncated = int.from_bytes(buf[pn_off : pn_off + pn_len], "big")
         pn = _pn_decode(truncated, pn_len, self.largest_rx[level])
         header = bytes(buf[: pn_off + pn_len])
-        payload = keys.aead.decrypt(
-            keys.nonce(pn), bytes(buf[pn_off + pn_len :]), header
-        )
+        body = bytes(buf[pn_off + pn_len :])
+        if level == APPLICATION and self._app_rx_secret is not None:
+            phase = (buf[0] >> 2) & 1
+            if phase != self.key_phase:
+                # peer-initiated key update (try next generation), or a
+                # reordered packet from before OUR update (previous keys)
+                if self._rx_next is None:
+                    self._rx_next = Keys(
+                        ku_secret(self._app_rx_secret), hp=keys.hp
+                    )
+                trial = self._rx_next
+                payload = trial.aead.decrypt(trial.nonce(pn), body, header)
+                if payload is not None:
+                    self._advance_generation(rx_keys=trial)
+                elif self._rx_prev is not None:
+                    payload = self._rx_prev.aead.decrypt(
+                        self._rx_prev.nonce(pn), body, header
+                    )
+            else:
+                payload = keys.aead.decrypt(keys.nonce(pn), body, header)
+        else:
+            payload = keys.aead.decrypt(keys.nonce(pn), body, header)
         if payload is None:
             return
         if level == HANDSHAKE and self.is_server:
@@ -394,6 +463,30 @@ class Connection:
             # only ack-eliciting packets trigger sending an ACK
             # (acking pure-ACK packets would ping-pong forever)
             self.ack_pending[level] = True
+
+    def _advance_generation(self, rx_keys: "Keys | None" = None) -> None:
+        """Step both directions to the next key generation and flip the
+        phase bit (used by initiate_key_update and on peer-initiated
+        updates)."""
+        self._rx_prev = self.keys_rx[APPLICATION]
+        self._app_rx_secret = ku_secret(self._app_rx_secret)
+        self._app_tx_secret = ku_secret(self._app_tx_secret)
+        self.keys_rx[APPLICATION] = rx_keys or Keys(
+            self._app_rx_secret, hp=self._rx_prev.hp
+        )
+        self.keys_tx[APPLICATION] = Keys(
+            self._app_tx_secret, hp=self.keys_tx[APPLICATION].hp
+        )
+        self._rx_next = None
+        self.key_phase ^= 1
+        self.key_updates += 1
+
+    def initiate_key_update(self) -> None:
+        """Start sending 1-RTT packets under the next key generation
+        (RFC 9001 6.1); the peer follows when it sees the flipped phase
+        bit."""
+        assert self.established and self._app_tx_secret is not None
+        self._advance_generation()
 
     def _range_add(self, level: int, pn: int) -> None:
         """Insert pn into the level's merged [lo, hi] range list."""
@@ -751,7 +844,7 @@ class Connection:
         if len(payload) + 16 < 20 - pn_len:
             payload = payload + b"\0" * (20 - pn_len - 16 - len(payload))
         if level == APPLICATION:
-            first = 0x40 | (pn_len - 1)
+            first = 0x40 | (self.key_phase << 2) | (pn_len - 1)
             header = bytes([first]) + self.dcid + pn_bytes
         else:
             first = 0xC0 | (_PT_BY_LEVEL[level] << 4) | (pn_len - 1)
@@ -854,6 +947,23 @@ class QuicServer:
     def _addr_bytes(addr) -> bytes:
         return repr(addr).encode()
 
+    @staticmethod
+    def _vn_packet(data: bytes) -> bytes:
+        """Stateless Version Negotiation: echo the client's CIDs swapped,
+        version field 0, then our supported version list."""
+        dcil = data[5]
+        dcid = data[6 : 6 + dcil]
+        o = 6 + dcil
+        scil = data[o]
+        scid = data[o + 1 : o + 1 + scil]
+        return (
+            bytes([0x80 | (os.urandom(1)[0] & 0x7F)])
+            + (0).to_bytes(4, "big")
+            + bytes([len(scid)]) + scid
+            + bytes([len(dcid)]) + dcid
+            + VERSION.to_bytes(4, "big")
+        )
+
     def _retry_packet(self, client_scid: bytes, odcid: bytes, addr) -> bytes:
         retry_scid = os.urandom(8)
         mac = _hmac.new(
@@ -900,6 +1010,15 @@ class QuicServer:
         if conn is None:
             if len(data) < 7 or not (data[0] & 0x80):
                 return None  # short header / runt for unknown conn
+            version = int.from_bytes(data[1:5], "big")
+            if version != VERSION:
+                # RFC 9000 section 6: answer an unknown version with a
+                # stateless Version Negotiation packet (and never VN a VN)
+                if version != 0 and len(data) >= 1200:
+                    self.stateless_out.append(
+                        (self._vn_packet(data), addr)
+                    )
+                return None
             if ((data[0] >> 4) & 3) != _PT_INITIAL:
                 return None  # only an Initial may open a connection
             if 6 + data[5] + 1 > len(data):

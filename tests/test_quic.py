@@ -96,3 +96,99 @@ def test_varint_roundtrip():
         enc = quic.vi_enc(v)
         got, off = quic.vi_dec(enc, 0)
         assert got == v and off == len(enc)
+
+
+def test_key_update_both_directions():
+    """RFC 9001 section 6: client initiates a key update; the server
+    follows on the flipped phase bit; traffic keeps flowing; a second
+    update also works (chained generations)."""
+    rng = np.random.default_rng(24)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    sconn = _pump(client.conn, server)
+    assert client.conn.established
+
+    t1 = rng.integers(0, 256, 100, np.uint8).tobytes()
+    client.conn.send_txn(t1)
+    _pump(client.conn, server)
+    assert sconn.txns == [t1]
+
+    client.conn.initiate_key_update()
+    assert client.conn.key_phase == 1
+    t2 = rng.integers(0, 256, 200, np.uint8).tobytes()
+    client.conn.send_txn(t2)
+    _pump(client.conn, server)
+    assert sconn.txns == [t1, t2]
+    assert sconn.key_phase == 1 and sconn.key_updates == 1
+
+    # server->client direction also moved to the new generation: the
+    # acks the server sent under phase 1 were accepted (no retransmit
+    # storm), and a second update chains
+    client.conn.initiate_key_update()
+    t3 = rng.integers(0, 256, 50, np.uint8).tobytes()
+    client.conn.send_txn(t3)
+    _pump(client.conn, server)
+    assert sconn.txns == [t1, t2, t3]
+    assert sconn.key_updates == 2 and sconn.key_phase == 0
+
+
+def test_version_negotiation():
+    rng = np.random.default_rng(25)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    # a long-header Initial-sized datagram with version 2 draws a
+    # stateless VN packet echoing the client CIDs
+    scid, dcid = b"AABBCCDD", b"11223344"
+    probe = bytearray()
+    probe += bytes([0xC0])
+    probe += (2).to_bytes(4, "big")
+    probe += bytes([len(dcid)]) + dcid
+    probe += bytes([len(scid)]) + scid
+    probe += bytes(1200 - len(probe))
+    assert server.on_datagram(bytes(probe), ("1.2.3.4", 5)) is None
+    assert len(server.stateless_out) == 1
+    vn, _addr = server.stateless_out[0]
+    assert int.from_bytes(vn[1:5], "big") == 0
+    # CIDs echoed swapped
+    assert vn[6 : 6 + len(scid)] == scid
+    # supported list holds exactly v1
+    assert vn[-4:] == (1).to_bytes(4, "big")
+    # runt/garbage with unknown version draws NO VN (anti-amplification)
+    server.stateless_out.clear()
+    assert server.on_datagram(bytes(probe[:600]), ("1.2.3.4", 5)) is None
+    assert not server.stateless_out
+    # a VN is never answered with a VN
+    assert server.on_datagram(bytes(vn) + bytes(1200), ("1.2.3.4", 5)) is None
+    assert not server.stateless_out
+
+    # client receiving a VN without its version aborts; one LISTING our
+    # version (spurious) is ignored
+    client = quic.QuicClient()
+    client.conn.datagrams_out()
+    bad_vn = vn[:-4] + (7).to_bytes(4, "big")
+    client.conn.on_datagram(bytes(bad_vn))
+    assert client.conn.closed
+    client2 = quic.QuicClient()
+    client2.conn.datagrams_out()
+    client2.conn.on_datagram(bytes(vn))
+    assert not client2.conn.closed
+
+
+def test_adversarial_garbage_storm():
+    """Random garbage datagrams (long+short header shapes) must neither
+    crash the server nor disturb an established connection."""
+    rng = np.random.default_rng(26)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    sconn = _pump(client.conn, server)
+    for i in range(200):
+        n = int(rng.integers(1, 1400))
+        junk = rng.integers(0, 256, n, np.uint8).tobytes()
+        server.on_datagram(junk, ("6.6.6.6", int(rng.integers(1, 65535))))
+    # established path still works
+    t = rng.integers(0, 256, 64, np.uint8).tobytes()
+    client.conn.send_txn(t)
+    _pump(client.conn, server)
+    assert t in sconn.txns
