@@ -177,6 +177,79 @@ def _bench_pipeline_tps() -> float:
         os.unlink(path)
 
 
+def _bench_landed_tps() -> float:
+    """Landed TPS through the FULL validator: a benchg/benchs load
+    (distinct device-signed transfers blasted at the legacy UDP txn
+    port) through net -> quic -> verify(TPU) -> dedup -> pack -> bank
+    (funk execution) -> poh -> shred -> store, gated on RPC
+    getTransactionCount (reference: src/app/fddev/bench.c:62-90)."""
+    import tempfile
+
+    from firedancer_tpu.app import config as C
+    from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.bench import UdpBlaster, make_transfer_pool
+    from firedancer_tpu.tiles.rpc import rpc_call
+
+    import os
+
+    pool_n = int(os.environ.get("FDT_BENCH_POOL", str(1 << 17)))
+    rows, payers = make_transfer_pool(pool_n, n_signers=8, seed=11)
+
+    rng = np.random.default_rng(3)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    for p in payers:
+        mgr.store(p, Account(1 << 60))
+
+    cfg = C.parse(
+        'name = "fdtbench"\n'
+        "[tiles.verify]\ncount = 1\nmax_lanes = 16384\nmsg_width = 256\n"
+        "[tiles.bank]\ncount = 4\n"
+        "[tiles.poh]\nticks_per_slot = 1024\n"
+        "[links]\ndepth = 32768\n"
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        topo, handles = C.build_validator_topology(
+            cfg, identity, tmp + "/bs", funk=funk
+        )
+        topo.build()
+        topo.start(batch_max=16384, boot_timeout_s=1200.0)
+        blaster = None
+        try:
+            rpc_addr = handles["rpc"].addr
+            udp_addr = ("127.0.0.1", handles["net"].udp_addr[1])
+            base = rpc_call(rpc_addr, "getTransactionCount")["result"]
+            blaster = UdpBlaster(rows, udp_addr).start()
+            t0 = time.perf_counter()
+            deadline = t0 + 240.0
+            t_first = t_last = None
+            first_cnt = last_cnt = base
+            while time.perf_counter() < deadline:
+                topo.poll_failure()
+                cnt = rpc_call(rpc_addr, "getTransactionCount")["result"]
+                now = time.perf_counter()
+                if cnt > last_cnt:
+                    if t_first is None:
+                        t_first, first_cnt = now, last_cnt
+                    t_last, last_cnt = now, cnt
+                elif (
+                    blaster.done and t_last is not None
+                    and now - t_last > 3.0
+                ):
+                    break  # drained: no progress for 3 s after send end
+                time.sleep(0.25)
+            if t_first is None or t_last is None or t_last <= t_first:
+                return 0.0
+            return (last_cnt - first_cnt) / (t_last - t_first)
+        finally:
+            if blaster is not None:
+                blaster.stop()
+            topo.halt()
+            topo.close()
+
+
 def main() -> None:
     from firedancer_tpu.utils.hostdev import enable_compilation_cache
 
@@ -188,9 +261,16 @@ def main() -> None:
         # failure must surface loudly rather than fall back.
         result = _bench_sha512_fallback()
     try:
-        result["pipeline_tps"] = round(_bench_pipeline_tps(), 1)
+        # verify-path rate (replay -> verify(TPU) -> dedup over rings)
+        result["verify_path_tps"] = round(_bench_pipeline_tps(), 1)
     except Exception:
         pass  # the headline metric line must never break
+    try:
+        # full-validator landed rate (net->quic->verify->...->bank, RPC-
+        # observed) — the number the reference's `fddev bench` reports
+        result["pipeline_tps"] = round(_bench_landed_tps(), 1)
+    except Exception:
+        pass
     print(json.dumps(result))
 
 

@@ -175,11 +175,18 @@ class GossipNode:
             GT.crds_wallclock(cur["data"]) >= GT.crds_wallclock(v["data"])
         ):
             self.stats["stale"] += 1
-            if relayer is not None and GT.verify_crds(v):
-                p = self.peers.get(relayer)
-                if p is not None:
-                    origin = GT.crds_origin(v["data"])
-                    p.dup_counts[origin] = p.dup_counts.get(origin, 0) + 1
+            if relayer is not None:
+                # byte-identical to the stored (already verified) value ->
+                # known-good duplicate; different bytes must re-verify
+                # before they can feed prune counters (forgeries must not
+                # sever honest push routes)
+                if GT.value_hash(v) == self._hashes.get(label) or (
+                    GT.verify_crds(v)
+                ):
+                    p = self.peers.get(relayer)
+                    if p is not None:
+                        origin = GT.crds_origin(v["data"])
+                        p.dup_counts[origin] = p.dup_counts.get(origin, 0) + 1
             return False
         if not verified and not GT.verify_crds(v):
             self.stats["bad_sig"] += 1
@@ -314,14 +321,21 @@ class GossipNode:
                 for origin, exp in list(p.pruned.items()):
                     if now >= exp:
                         del p.pruned[origin]
-                send = [
-                    self.crds[label]
+                pending = sorted(
+                    (seq, label)
                     for label, seq in self._adopt_seq.items()
                     if seq > p.push_seq
-                    and GT.crds_origin(self.crds[label]["data"])
-                    not in p.pruned
-                ][:32]
-                p.push_seq = self._seq
+                )
+                send = []
+                for seq, label in pending:
+                    origin = GT.crds_origin(self.crds[label]["data"])
+                    if origin not in p.pruned:
+                        send.append(self.crds[label])
+                        if len(send) >= 32:
+                            p.push_seq = seq
+                            break
+                else:
+                    p.push_seq = self._seq
                 if send:
                     self._send(("push_msg", {
                         "pubkey": self.pubkey, "crds": send,
