@@ -221,7 +221,12 @@ def _bench_landed_tps() -> float:
             rpc_addr = handles["rpc"].addr
             udp_addr = ("127.0.0.1", handles["net"].udp_addr[1])
             base = rpc_call(rpc_addr, "getTransactionCount")["result"]
-            blaster = UdpBlaster(rows, udp_addr).start()
+            # mild pacing stretches the pool across the measurement
+            # window instead of overflowing pack's buffer immediately
+            # (rejected txns are lost to the landed count)
+            blaster = UdpBlaster(
+                rows, udp_addr, burst=128, pace_s=0.002
+            ).start()
             t0 = time.perf_counter()
             deadline = t0 + 240.0
             t_first = t_last = None

@@ -33,7 +33,25 @@ def _prefixes(hash_sz: int) -> tuple[bytes, bytes]:
     return LEAF_PREFIX_SHORT, NODE_PREFIX_SHORT
 
 
+#: below this many messages a layer hashes on the HOST — a handful of
+#: sha256 calls never amortizes a device dispatch (see ops/reedsol
+#: HOST_MAX_BYTES for the same reasoning on the shred path)
+HOST_MAX_MSGS = int(
+    __import__("os").environ.get("FDT_BMTREE_HOST_MAX", "512")
+)
+
+
 def _sha_batch(msgs: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    if len(msgs) <= HOST_MAX_MSGS:
+        import hashlib
+
+        out = np.zeros((len(msgs), 32), np.uint8)
+        for i in range(len(msgs)):
+            out[i] = np.frombuffer(
+                hashlib.sha256(msgs[i, : lens[i]].tobytes()).digest(),
+                np.uint8,
+            )
+        return out
     return np.asarray(S.sha256(msgs, lens))
 
 

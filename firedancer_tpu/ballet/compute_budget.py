@@ -128,10 +128,29 @@ class BudgetState:
 
 
 # built-in program costs (block_cost_limits.rs values mirrored by
-# fd_pack_cost.h MAP_PERFECT_0..11); keyed by raw program id.  Programs not
-# in this table are BPF: they cost their CU limit.
+# fd_pack_cost.h MAP_PERFECT_0..11, consensus constants); keyed by raw
+# program id.  Programs not in this table are BPF: they cost their CU
+# limit.  Without this table every native-program txn would fall through
+# to the 200K default CU and a block would cap at ~240 txns.
+def _pid(b58: str) -> bytes:
+    from firedancer_tpu.ballet.base58 import decode_32
+
+    return decode_32(b58)
+
+
 BUILTIN_COSTS: dict[bytes, int] = {
     COMPUTE_BUDGET_PROGRAM_ID: 150,
+    _pid("Stake11111111111111111111111111111111111111"): 750,
+    _pid("Config1111111111111111111111111111111111111"): 450,
+    _pid("Vote111111111111111111111111111111111111111"): 2100,
+    bytes(32): 150,  # system program
+    _pid("AddressLookupTab1e1111111111111111111111111"): 750,
+    _pid("BPFLoaderUpgradeab1e11111111111111111111111"): 2370,
+    _pid("BPFLoader1111111111111111111111111111111111"): 1140,
+    _pid("BPFLoader2111111111111111111111111111111111"): 570,
+    _pid("LoaderV411111111111111111111111111111111111"): 2000,
+    _pid("KeccakSecp256k11111111111111111111111111111"): 720,
+    _pid("Ed25519SigVerify111111111111111111111111111"): 720,
 }
 
 

@@ -176,6 +176,15 @@ class Tile:
         the rings instead of an unbounded host buffer."""
         return None
 
+    #: a manual-credit tile gates each publish on that ring's own
+    #: cr_avail() instead of the loop's min-over-all-outs gate.  Needed
+    #: when two tiles form a request/response ring CYCLE (shred <->
+    #: keyguard): the global gate would stop the tile entirely when one
+    #: out ring fills, so it could never drain the response ring that
+    #: unblocks the peer — a deadlock.  Manual tiles must bound their
+    #: internal queues via in_budget.
+    manual_credits = False
+
     def after_credit(self, ctx: MuxCtx) -> None:
         """Called every iteration after frag processing while credits
         remain — where producer tiles generate work (reference:
@@ -238,22 +247,34 @@ def run_loop(
                     m.hist_sample("hk_ns", time.monotonic_ns() - now)
             m.inc("loop_iters")
 
-            cr = batch_max
-            for o in ctx.outs:
-                cr = min(cr, o.cr_avail())
-            if ctx.outs and cr == 0:
-                m.inc("backpressure_iters")
-                idle += 1
-                if idle >= idle_before_sleep:
-                    time.sleep(idle_sleep_s)
-                continue
+            if tile.manual_credits:
+                cr = batch_max
+            else:
+                cr = batch_max
+                for o in ctx.outs:
+                    cr = min(cr, o.cr_avail())
+                if ctx.outs and cr == 0:
+                    m.inc("backpressure_iters")
+                    idle += 1
+                    if idle >= idle_before_sleep:
+                        time.sleep(idle_sleep_s)
+                    continue
             ctx.credits = cr
 
             out_seq0 = [o.seq for o in ctx.outs]
             got = 0
             t_frag0 = time.monotonic_ns() if sample else 0
             absorb = tile.in_budget(ctx)
-            for i, il in enumerate(ctx.ins):
+            # rotate the drain order so a saturated in-link cannot starve
+            # the others of the shared credit budget (e.g. pack's txn
+            # firehose starving its bank-completion rings would idle
+            # every bank)
+            n_ins = len(ctx.ins)
+            order = range(n_ins) if n_ins <= 1 else [
+                (iters + j) % n_ins for j in range(n_ins)
+            ]
+            for i in order:
+                il = ctx.ins[i]
                 # credits are consumed across in-links: a tile republishes
                 # at most 1 out-frag per in-frag, so bounding the remaining
                 # drain budget by frags already taken this iteration keeps

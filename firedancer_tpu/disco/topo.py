@@ -195,7 +195,9 @@ class Topology:
             run_loop(ts.tile, ts.ctx, **loop_kw)
             log.info("tile halted")
         except BaseException as e:  # noqa: BLE001 — fail-stop supervision
-            log.err("tile failed: %r", e)
+            import traceback
+
+            log.err("tile failed: %r\n%s", e, traceback.format_exc())
             ts.error = e
 
     def start(self, boot_timeout_s: float = 600.0, **loop_kw) -> None:

@@ -38,6 +38,10 @@ FEE_PER_SIGNATURE = 5000
 #: address-lookup-table native program
 #: (reference: runtime/program/fd_address_lookup_table_program.c)
 ALT_PROGRAM_ID = decode_32("AddressLookupTab1e1111111111111111111111111")
+#: config native program (reference: fd_config_program.c)
+CONFIG_PROGRAM_ID = decode_32("Config1111111111111111111111111111111111111")
+#: ed25519 signature-verification precompile (fd_ed25519_program.c)
+ED25519_PROGRAM_ID = decode_32("Ed25519SigVerify111111111111111111111111111")
 
 #: ALT account layout: 56-byte header then packed 32-byte addresses
 _ALT_HDR = struct.Struct("<IQQBB32sH")
@@ -125,6 +129,10 @@ class InstrCtx:
     writables: frozenset
     stack: tuple = ()
     meter: list = field(default_factory=lambda: [TXN_CU_BUDGET])
+    #: (payload, desc) of the enclosing transaction — precompiles read
+    #: sibling instructions' data through it (fd_ed25519_program.c
+    #: _get_instr_data)
+    txn: tuple | None = None
 
     @property
     def depth(self) -> int:
@@ -135,7 +143,8 @@ class InstrCtx:
         stack is NOT pushed here — _dispatch pushes the callee program id
         when it runs the instruction."""
         return InstrCtx(
-            frozenset(signers), frozenset(writables), self.stack, self.meter
+            frozenset(signers), frozenset(writables), self.stack,
+            self.meter, self.txn,
         )
 
 
@@ -289,6 +298,7 @@ class Executor:
                     if desc.is_writable(j)
                 ),
                 meter=meter,
+                txn=(payload, desc),
             )
             err = self._dispatch(prog_key, data, ins_keys, ctx, load, store, logs)
             if err:
@@ -310,12 +320,19 @@ class Executor:
         if ctx.depth >= MAX_INVOKE_STACK:
             return "max invoke stack depth"
         ctx = InstrCtx(
-            ctx.signers, ctx.writables, ctx.stack + (prog_key,), ctx.meter
+            ctx.signers, ctx.writables, ctx.stack + (prog_key,),
+            ctx.meter, ctx.txn,
         )
         if prog_key == SYSTEM_PROGRAM_ID:
             return self._system(data, ins_keys, ctx, load, store)
         if prog_key == ALT_PROGRAM_ID:
             return self._alt_program(data, ins_keys, ctx, load, store)
+        if prog_key == CONFIG_PROGRAM_ID:
+            return self._config_program(data, ins_keys, ctx, load, store)
+        if prog_key == ED25519_PROGRAM_ID:
+            if not self.features.active("ed25519_program_enabled", self.slot):
+                return "unknown program"
+            return self._ed25519_program(data, ctx)
         prog = load(prog_key)
         if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
             return self._bpf(
@@ -415,6 +432,113 @@ class Executor:
             store(table_k, acct)
             return ""
         return "alt: unsupported instruction"
+
+    def _config_program(self, data, ins_keys, ctx: InstrCtx, load,
+                        store) -> str:
+        """Config native program (reference fd_config_program.c /
+        config_processor.rs): instruction data = short_vec ConfigKeys
+        (pubkey, is_signer u8) followed by opaque config payload, stored
+        into the config account (no realloc).  Every listed signer key
+        must have signed; previously stored signer keys must re-sign
+        every update (simplified: the deserialize-and-compare core,
+        without the account-data-as-current-signers edge cases)."""
+        if len(ins_keys) < 1:
+            return "config: missing account"
+        cfg_k = ins_keys[0]
+        acct = load(cfg_k)
+        if acct is None or acct.owner != CONFIG_PROGRAM_ID:
+            return "config: bad account owner"
+        if cfg_k not in ctx.writables:
+            return "config: account not writable"
+
+        def parse_keys(buf):
+            if not buf:
+                return None
+            n, off = buf[0], 1  # short_vec length (single-byte for <128)
+            if n & 0x80:
+                return None  # >127 keys unsupported (reference caps too)
+            out = []
+            for _ in range(n):
+                if off + 33 > len(buf):
+                    return None
+                out.append((buf[off:off + 32], buf[off + 32] != 0))
+                off += 33
+            return out
+
+        new_keys = parse_keys(data)
+        if new_keys is None:
+            return "config: bad instruction data"
+        stored_keys = parse_keys(acct.data) or []
+        cfg_signed = cfg_k in ctx.signers
+        for pk, is_signer in new_keys:
+            if not is_signer:
+                continue
+            if pk == cfg_k:
+                if not cfg_signed:
+                    return "config: config account must sign"
+            elif pk not in ctx.signers:
+                return "config: missing signer " + pk.hex()[:8]
+        # stored signers must approve every update (the config account
+        # satisfies its own entry only by actually signing)
+        for pk, was_signer in stored_keys:
+            if not was_signer:
+                continue
+            if pk == cfg_k:
+                if not cfg_signed:
+                    return "config: config account must sign"
+            elif pk not in ctx.signers:
+                return "config: stored signer did not sign"
+        if not stored_keys and not cfg_signed:
+            return "config: config account must sign"
+        if len(data) > len(acct.data):
+            return "config: instruction data too large"
+        acct.data = bytes(data) + acct.data[len(data):]
+        store(cfg_k, acct)
+        return ""
+
+    def _ed25519_program(self, data, ctx: InstrCtx) -> str:
+        """Ed25519 precompile (reference fd_ed25519_program.c): the
+        instruction data carries u8 count + 14-byte offset records
+        pointing at sig/pubkey/msg bytes inside this or any other
+        instruction's data (0xFFFF = this instruction); every referenced
+        signature must verify or the whole txn fails."""
+        from firedancer_tpu.ops.ed25519 import golden
+
+        if len(data) < 2:
+            return "ed25519: bad instruction data"
+        count = data[0]
+
+        def instr_data(idx: int):
+            if idx == 0xFFFF:
+                return data
+            if ctx.txn is None:
+                return None
+            payload, desc = ctx.txn
+            if idx >= desc.instr_cnt:
+                return None
+            ins = desc.instr[idx]
+            return payload[ins.data_off : ins.data_off + ins.data_sz]
+
+        off = 2
+        for _ in range(count):
+            if off + 14 > len(data):
+                return "ed25519: bad offsets"
+            (sig_off, sig_ix, pk_off, pk_ix, msg_off, msg_sz, msg_ix
+             ) = struct.unpack_from("<7H", data, off)
+            off += 14
+            parts = []
+            for d_ix, d_off, d_sz in (
+                (sig_ix, sig_off, 64), (pk_ix, pk_off, 32),
+                (msg_ix, msg_off, msg_sz),
+            ):
+                src = instr_data(d_ix)
+                if src is None or d_off + d_sz > len(src):
+                    return "ed25519: data offsets out of range"
+                parts.append(bytes(src[d_off : d_off + d_sz]))
+            sig, pk, msg = parts
+            if golden.verify(msg, sig, pk) != 0:
+                return "ed25519: invalid signature"
+        return ""
 
     def _system(self, data, ins_keys, ctx: InstrCtx, load, store) -> str:
         if len(data) < 4:

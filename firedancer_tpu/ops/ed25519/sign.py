@@ -51,26 +51,58 @@ def _base_mul_compress(r_bytes):
     return PT.compress(acc)
 
 
-def sign_batch(secret: bytes, msgs: list[bytes]) -> list[bytes]:
-    """Sign every message with one key; [r]B runs batched on device.
+def public_keys(secrets: list[bytes]) -> list[bytes]:
+    """Batch [a]B public-key derivation on device (one execution)."""
+    n = len(secrets)
+    a_arr = np.zeros((n, 32), np.uint8)
+    for i, s in enumerate(secrets):
+        a_int, _ = golden.secret_expand(s)
+        # clamped scalars exceed L; the digit recode expects canonical
+        # scalars, and [a mod l]B == [a]B (l divides B's order)
+        a_int %= golden.L
+        a_arr[i] = np.frombuffer(a_int.to_bytes(32, "little"), np.uint8)
+    A = np.asarray(_base_mul_compress(jnp.asarray(a_arr)))
+    return [A[i].tobytes() for i in range(n)]
+
+
+def sign_many(pairs: list[tuple[bytes, bytes]],
+              pubs: dict[bytes, bytes] | None = None) -> list[bytes]:
+    """Sign (secret, msg) pairs — keys may all differ; the [r]B fixed-
+    base mul runs as ONE device execution over every lane.
+
+    pubs: optional secret->pubkey map; missing keys are derived as one
+    device batch rather than per-key host scalar muls.
 
     RFC 8032: r = SHA512(prefix || M) mod L; R = [r]B;
     S = (r + SHA512(R || A || M) * a) mod L.  Returns 64-byte sigs.
     """
-    a_int, prefix = golden.secret_expand(secret)
-    pub = golden.public_from_secret(secret)
-    n = len(msgs)
-    rs = [
-        int.from_bytes(hashlib.sha512(prefix + m).digest(), "little")
-        % golden.L
-        for m in msgs
-    ]
+    n = len(pairs)
+    pubs = dict(pubs or {})
+    unique = []
+    for secret, _ in pairs:
+        if secret not in pubs and secret not in unique:
+            unique.append(secret)
+    if unique:
+        for s, pk in zip(unique, public_keys(unique)):
+            pubs[s] = pk
+    expanded = {}
+    for secret, _ in pairs:
+        if secret not in expanded:
+            a_int, prefix = golden.secret_expand(secret)
+            expanded[secret] = (a_int, prefix, pubs[secret])
+    rs = []
     r_arr = np.zeros((n, 32), np.uint8)
-    for i, r in enumerate(rs):
+    for i, (secret, m) in enumerate(pairs):
+        _, prefix, _ = expanded[secret]
+        r = int.from_bytes(
+            hashlib.sha512(prefix + m).digest(), "little"
+        ) % golden.L
+        rs.append(r)
         r_arr[i] = np.frombuffer(r.to_bytes(32, "little"), np.uint8)
     R = np.asarray(_base_mul_compress(jnp.asarray(r_arr)))
     sigs = []
-    for i, m in enumerate(msgs):
+    for i, (secret, m) in enumerate(pairs):
+        a_int, _, pub = expanded[secret]
         Rb = R[i].tobytes()
         k = int.from_bytes(
             hashlib.sha512(Rb + pub + m).digest(), "little"
@@ -78,3 +110,11 @@ def sign_batch(secret: bytes, msgs: list[bytes]) -> list[bytes]:
         S = (rs[i] + k * a_int) % golden.L
         sigs.append(Rb + S.to_bytes(32, "little"))
     return sigs
+
+
+def sign_batch(secret: bytes, msgs: list[bytes]) -> list[bytes]:
+    """Sign every message with one key (see sign_many)."""
+    return sign_many(
+        [(secret, m) for m in msgs],
+        pubs={secret: golden.public_from_secret(secret)},
+    )

@@ -70,11 +70,44 @@ def _apply_bitmatrix(B: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     return _pack_bits(acc & 1)
 
 
-def encode(data: np.ndarray, parity_cnt: int) -> np.ndarray:
+#: below this many data bytes a single encode runs on the HOST: one
+#: FEC set's worth of work never amortizes a device dispatch (and on the
+#: axon tunnel a dispatch costs ~110 ms serialized against the verify
+#: kernel).  The MXU path owns batch/recovery scale.
+HOST_MAX_BYTES = int(
+    __import__("os").environ.get("FDT_RS_HOST_MAX", str(1 << 20))
+)
+
+
+def _encode_host(data: np.ndarray, parity_cnt: int) -> np.ndarray:
+    """Host bit-matrix encode: identical math, numpy int ops."""
+    D, N = data.shape
+    B = _parity_bits_matrix(D, parity_cnt).astype(np.int32)  # (8P, 8D)
+    xi = data.astype(np.int32)
+    bits = np.stack(
+        [(xi >> i) & 1 for i in range(8)], axis=1
+    ).reshape(8 * D, N)
+    acc = (B @ bits) & 1                                      # (8P, N)
+    b = acc.reshape(parity_cnt, 8, N)
+    out = np.zeros((parity_cnt, N), np.int32)
+    for i in range(8):
+        out |= b[:, i, :] << i
+    return out.astype(np.uint8)
+
+
+def encode(data: np.ndarray, parity_cnt: int,
+           device: bool | None = None) -> np.ndarray:
     """data (D, N) u8 (D shreds of N bytes) -> parity (parity_cnt, N) u8.
 
-    Reference semantics: fd_reedsol_encode_init/add/fini one-shot."""
-    data = jnp.asarray(data, jnp.uint8)
+    Reference semantics: fd_reedsol_encode_init/add/fini one-shot.
+    device: None = auto by size (host under HOST_MAX_BYTES), True/False
+    force the MXU / host path."""
+    data_np = np.asarray(data, np.uint8)
+    if device is None:
+        device = data_np.size > HOST_MAX_BYTES
+    if not device:
+        return _encode_host(data_np, parity_cnt)
+    data = jnp.asarray(data_np, jnp.uint8)
     D = data.shape[0]
     B = jnp.asarray(_parity_bits_matrix(D, parity_cnt))
     return np.asarray(_apply_bitmatrix(B, data))

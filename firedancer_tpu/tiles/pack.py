@@ -70,6 +70,7 @@ class PackTile(Tile):
             "microblocks",
             "microblock_txns",
             "completions",
+            "blocks",
         ),
     )
 
@@ -81,17 +82,26 @@ class PackTile(Tile):
         cu_limit: int = 1_500_000,
         txn_limit: int = 31,
         microblock_ns: int = MICROBLOCK_DURATION_NS,
+        slot_ns: int = 400_000_000,
         use_device_select: bool = False,
         name: str = "pack",
     ):
+        """slot_ns: block-budget rollover period.  The reference resets
+        pack's block/vote/writer budgets at leader-slot boundaries
+        (fd_pack_end_block); this tile approximates the slot clock with
+        wall time at the mainnet slot duration — without the rollover the
+        48M-CU block budget is consumed exactly once and scheduling
+        stops forever."""
         self.name = name
         self.n_banks = n_banks
         self.cu_limit = cu_limit
         self.txn_limit = txn_limit
         self.microblock_ns = microblock_ns
+        self.slot_ns = slot_ns
         self.engine = P.Pack(depth, max_banks=n_banks)
         self.bank_free = [True] * n_banks
         self._last_mb_ns = 0
+        self._block_started_ns = 0
         self._dev_select = None
         if use_device_select:
             from firedancer_tpu.ops import pack_select
@@ -122,6 +132,17 @@ class PackTile(Tile):
 
     def after_credit(self, ctx: MuxCtx) -> None:
         now = time.monotonic_ns()
+        if self._block_started_ns == 0:
+            self._block_started_ns = now
+        elif now - self._block_started_ns >= self.slot_ns:
+            # block boundary: stop scheduling and let in-flight
+            # microblocks complete, then reset the block budgets
+            # (end_block requires no outstanding microblocks)
+            if any(v for v in self.engine.outstanding.values()):
+                return
+            self.engine.end_block()
+            self._block_started_ns = now
+            ctx.metrics.inc("blocks")
         if now - self._last_mb_ns < self.microblock_ns:
             return
         for bank in range(self.n_banks):

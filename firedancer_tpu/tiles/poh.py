@@ -7,12 +7,12 @@ ticks_per_slot ticks, follow the leader schedule (become leader when our
 identity holds the slot, hand off when it passes), and mix executed
 microblocks into the chain ONLY while leader.
 
-TPU-native shape: ticks are batched — after_credit runs `tick_batch`
-appends as ONE device dispatch (lax.fori_loop of the fixed-32B SHA-256
-compression, ops/poh.append_n) instead of one hash per loop iteration.
-Entries out carry (prev_state, hashcnt, mixin, state) so a downstream
-verifier can batch-check them (ops/poh.verify_entries); slot boundaries
-emit a tick entry with the slot number in the sig field.
+The chain itself runs on the HOST: it is a sequential sha256 ladder
+with no batch parallelism for an accelerator to exploit (the reference
+burns a dedicated CPU core on it, fd_poh.c).  The DEVICE'S job is what
+parallelizes — ops/poh.verify_entries batch-checks entries, which is
+why entries out carry (prev_state, hashcnt, mixin, state).  Slot
+boundaries emit a tick entry with the slot number in the sig field.
 """
 
 from __future__ import annotations
@@ -21,8 +21,7 @@ import numpy as np
 
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
-from firedancer_tpu.ops import poh as POH
-from firedancer_tpu.ops import sha256 as SHA
+import hashlib as _hashlib
 
 ENTRY_SZ = 32 + 8 + 32 + 32  # prev_state | hashcnt u64 | mixin | state
 
@@ -70,8 +69,6 @@ class PohTile(Tile):
         self.ticks_in_slot = 0
         self.state = np.zeros(32, dtype=np.uint8)
         self.hashcnt = 0
-        self._append = None
-        self._mixin = None
 
     # ---- leader state ----------------------------------------------------
 
@@ -84,18 +81,6 @@ class PohTile(Tile):
         return self.leaders.leader_for_slot(s) == self.identity
 
     def on_boot(self, ctx: MuxCtx) -> None:
-        import functools
-
-        import jax
-
-        self._append = jax.jit(
-            functools.partial(POH.append_n, n=self.tick_batch)
-        )
-        self._mixin = jax.jit(POH.mixin)
-        # warm compiles
-        s = self.state[None, :]
-        np.asarray(self._append(s))
-        np.asarray(self._mixin(s, s))
         if self.is_leader():
             ctx.metrics.inc("leader_slots")
 
@@ -127,22 +112,55 @@ class PohTile(Tile):
             mb = rows[i, : frags["sz"][i]]
             # microblock hash = SHA-256 of its bytes (stand-in for the
             # entry merkle root the reference mixes in)
-            mix = np.asarray(
-                SHA.sha256(mb[None, :], np.array([len(mb)], np.int32))
-            )[0]
+            mix = np.frombuffer(
+                _hashlib.sha256(mb.tobytes()).digest(), np.uint8
+            )
             prev = self.state.copy()
-            self.state = np.asarray(
-                self._mixin(self.state[None, :], mix[None, :])
-            )[0]
+            self.state = np.frombuffer(
+                _hashlib.sha256(
+                    prev.tobytes() + mix.tobytes()
+                ).digest(), np.uint8,
+            )
             self.hashcnt += 1
             ctx.metrics.inc("hashcnt")
             ctx.metrics.inc("mixins")
             self._emit(ctx, prev, 1, mix, self.state)
 
+    def on_halt(self, ctx: MuxCtx) -> None:
+        # drain straggler bank mixins so the last microblocks of a run
+        # still enter the chain (banks may publish right up to HALT)
+        import time as _t
+
+        deadline = _t.monotonic() + 2.0
+        while _t.monotonic() < deadline:
+            got = 0
+            for i, il in enumerate(ctx.ins):
+                budget = min(
+                    o.cr_avail() for o in ctx.outs
+                ) if ctx.outs else 4096
+                if budget <= 0:
+                    break
+                frags, il.seq, _ = il.mcache.drain(il.seq, budget)
+                if len(frags):
+                    got += len(frags)
+                    self.on_frags(ctx, i, frags)
+            if got == 0:
+                break
+
     def after_credit(self, ctx: MuxCtx) -> None:
-        # batch-advance the clock: one device dispatch per tick_batch
+        # batch-advance the clock.  The PoH chain is a SEQUENTIAL sha256
+        # ladder — there is no batch parallelism for the device to
+        # exploit, and on the axon tunnel every dispatch costs ~110 ms
+        # serialized against the verify tile's executions (measured: PoH
+        # device calls throttled the whole landed-TPS pipeline to
+        # ~270 TPS).  The reference burns a dedicated CPU core on this
+        # chain (fd_poh.c); ops/poh.verify_entries keeps the DEVICE for
+        # what parallelizes — verifying many entries at once.
         prev = self.state.copy()
-        self.state = np.asarray(self._append(self.state[None, :]))[0]
+        st = self.state.tobytes()
+        for _ in range(self.tick_batch):
+            st = _hashlib.sha256(st).digest()
+        self.state = np.frombuffer(st, np.uint8)
         self.hashcnt += self.tick_batch
         ctx.metrics.inc("hashcnt", self.tick_batch)
         self._emit(ctx, prev, self.tick_batch, np.zeros(32, np.uint8),

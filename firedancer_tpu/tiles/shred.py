@@ -40,6 +40,12 @@ def shred_tag(slot: int, idx: int, is_code: bool) -> int:
 
 
 class ShredTile(Tile):
+    #: shred <-> keyguard form a request/response ring cycle; the loop's
+    #: global credit gate would deadlock when the sign-request ring
+    #: fills (we must keep draining sign RESPONSES to unblock the
+    #: keyguard), so every publish is gated per-ring here instead
+    manual_credits = True
+
     schema = MetricsSchema(
         counters=(
             "batches",
@@ -177,10 +183,18 @@ class ShredTile(Tile):
         )
         ctx.metrics.inc("sign_requests", n)
 
+    def in_budget(self, ctx: MuxCtx) -> int | None:
+        """Bound the internal queues (manual-credit contract): stop
+        absorbing entries while the signed-shred backlog is deep."""
+        return 0 if len(self._outq) > 8192 else None
+
     def after_credit(self, ctx: MuxCtx) -> None:
         self._drain_signq(ctx)
-        while self._outq and ctx.credits > 0:
-            n = min(len(self._outq), ctx.credits)
+        while self._outq:
+            budget = ctx.outs[0].cr_avail()
+            if budget <= 0:
+                break
+            n = min(len(self._outq), budget)
             items = [self._outq.popleft() for _ in range(n)]
             w = max(len(it[3]) for it in items)
             rows = np.zeros((n, w), np.uint8)
@@ -191,7 +205,6 @@ class ShredTile(Tile):
                 szs[i] = len(raw)
                 tags[i] = shred_tag(slot, idx, is_code)
             ctx.outs[0].publish(tags, rows, szs)
-            ctx.credits -= n
 
     def on_halt(self, ctx: MuxCtx) -> None:
         # flush the final partial slot so short-lived test topologies

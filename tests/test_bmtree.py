@@ -52,3 +52,19 @@ def test_inclusion_proofs(n):
         if n > 1:
             bad = b"x" * len(blobs[i])
             assert not BM.verify_inclusion(bad, i, proof, root, 20)
+
+
+def test_device_and_host_sha_paths_agree(monkeypatch):
+    """_sha_batch's host fast path and the device batch path produce
+    identical trees (the host path exists because a handful of hashes
+    never amortizes a device dispatch)."""
+    import numpy as np
+
+    from firedancer_tpu.ballet import bmtree as BM
+
+    rng = np.random.default_rng(8)
+    blobs = [rng.integers(0, 256, int(n), np.uint8).tobytes()
+             for n in rng.integers(1, 300, 21)]
+    host_root = BM.commit(blobs)
+    monkeypatch.setattr(BM, "HOST_MAX_MSGS", 0)  # force the device path
+    assert BM.commit(blobs) == host_root

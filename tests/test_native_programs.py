@@ -1,0 +1,161 @@
+"""Config program + ed25519 precompile.
+
+Reference analogs: src/flamenco/runtime/program/fd_config_program.c
+(ConfigKeys short_vec + signer continuity + stored payload) and
+fd_ed25519_program.c (offset records into instruction data, 0xFFFF =
+self; any bad signature fails the txn).
+"""
+
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import Account
+from firedancer_tpu.flamenco.runtime import (
+    CONFIG_PROGRAM_ID, ED25519_PROGRAM_ID, Executor,
+)
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.ops.ed25519 import golden
+
+
+def _keys(rng, n):
+    return [rng.integers(0, 256, 32, np.uint8).tobytes() for _ in range(n)]
+
+
+def _sign_stub(n):
+    return [bytes([7]) * 64 for _ in range(n)]
+
+
+def config_keys(entries) -> bytes:
+    out = bytes([len(entries)])
+    for pk, signer in entries:
+        out += pk + bytes([1 if signer else 0])
+    return out
+
+
+def test_config_store_and_signer_continuity():
+    rng = np.random.default_rng(61)
+    funk = Funk()
+    ex = Executor(funk)
+    payer, cfg, approver = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    ex.mgr.store(cfg, Account(1_000_000, CONFIG_PROGRAM_ID, False, 0,
+                              bytes(256)))
+
+    # initial store: config account signs; approver listed as signer
+    data1 = config_keys([(approver, True)]) + b"hello config"
+    r = ex.execute_txn(T.build(
+        _sign_stub(3), [payer, cfg, approver, CONFIG_PROGRAM_ID],
+        bytes(32), [(3, [1, 2], data1)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+    assert ex.mgr.load(cfg).data.startswith(data1)
+
+    # update WITHOUT the stored signer -> rejected
+    data2 = config_keys([]) + b"overwrite"
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, cfg, CONFIG_PROGRAM_ID], bytes(32),
+        [(2, [1], data2)], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "stored signer" in r.err
+
+    # update WITH the stored signer -> accepted
+    r = ex.execute_txn(T.build(
+        _sign_stub(3), [payer, cfg, approver, CONFIG_PROGRAM_ID],
+        bytes(32), [(3, [1, 2], data2)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+    assert ex.mgr.load(cfg).data.startswith(data2)
+
+    # unsigned listed signer -> rejected
+    ghost = _keys(rng, 1)[0]
+    d3 = config_keys([(ghost, True)]) + b"x"
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, cfg, CONFIG_PROGRAM_ID], bytes(32),
+        [(2, [1], d3)], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "missing signer" in r.err
+
+
+def _ed25519_instr_data(sig: bytes, pk: bytes, msg: bytes) -> bytes:
+    """count=1 + offsets(all 0xFFFF = this instruction) + sig + pk + msg."""
+    base = 2 + 14
+    sig_off = base
+    pk_off = sig_off + 64
+    msg_off = pk_off + 32
+    offs = struct.pack(
+        "<7H", sig_off, 0xFFFF, pk_off, 0xFFFF, msg_off, len(msg), 0xFFFF
+    )
+    return bytes([1, 0]) + offs + sig + pk + msg
+
+
+def test_ed25519_precompile_accepts_and_rejects():
+    rng = np.random.default_rng(62)
+    funk = Funk()
+    ex = Executor(funk)
+    (payer,) = _keys(rng, 1)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = golden.public_from_secret(sk)
+    msg = b"attested payload"
+    sig = golden.sign(sk, msg)
+
+    good = _ed25519_instr_data(sig, pk, msg)
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, ED25519_PROGRAM_ID], bytes(32),
+        [(1, [], good)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+
+    bad = _ed25519_instr_data(sig[:-1] + bytes([sig[-1] ^ 1]), pk, msg)
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, ED25519_PROGRAM_ID], bytes(32),
+        [(1, [], bad)], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "invalid signature" in r.err
+
+    # offsets past the data end fail cleanly
+    trunc = good[:-4]
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, ED25519_PROGRAM_ID], bytes(32),
+        [(1, [], trunc)], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "out of range" in r.err
+
+
+def test_ed25519_precompile_cross_instruction_refs():
+    """Offset records referencing ANOTHER instruction's data (the
+    transaction-level index form)."""
+    rng = np.random.default_rng(63)
+    funk = Funk()
+    ex = Executor(funk)
+    payer, memo = _keys(rng, 2)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = golden.public_from_secret(sk)
+    msg = b"data carried by instruction 0"
+    sig = golden.sign(sk, msg)
+    # instruction 0 carries sig+pk+msg as payload of an ed25519-program
+    # instruction with count=0 (valid, verifies nothing); instruction 1
+    # references instruction 0's bytes by index
+    carrier = bytes([0, 0]) + sig + pk + msg
+    offs = struct.pack(
+        "<7H", 2, 0, 2 + 64, 0, 2 + 96, len(msg), 0
+    )
+    checker = bytes([1, 0]) + offs
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, ED25519_PROGRAM_ID], bytes(32),
+        [(1, [], carrier), (1, [], checker)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+
+    # feature gate: disabling ed25519_program_enabled rejects the program
+    from firedancer_tpu.flamenco.features import DISABLED
+
+    ex.features.slots["ed25519_program_enabled"] = DISABLED
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, ED25519_PROGRAM_ID], bytes(32),
+        [(1, [], bytes([0, 0]))], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "unknown program" in r.err

@@ -10,11 +10,15 @@ from firedancer_tpu.ballet import txn as T
 
 
 def _mk_txn(rng, *, vote: bool, writable_key: bytes | None = None,
-            signer: bytes | None = None) -> bytes:
-    """A minimal txn; vote txns have one instr on the Vote program."""
+            signer: bytes | None = None,
+            program: bytes | None = None) -> bytes:
+    """A minimal txn; vote txns have one instr on the Vote program.
+    Non-vote default is an unknown (BPF-costed) program; pass program=
+    bytes(32) for a cheap builtin-costed txn."""
     signer = signer or rng.integers(0, 256, 32, np.uint8).tobytes()
     acct = writable_key or rng.integers(0, 256, 32, np.uint8).tobytes()
-    program = P.VOTE_PROGRAM_ID if vote else bytes(31) + b"\x01"
+    if program is None:
+        program = P.VOTE_PROGRAM_ID if vote else bytes(31) + b"\x01"
     blockhash = rng.integers(0, 256, 32, np.uint8).tobytes()
     data = rng.integers(0, 256, 16, np.uint8).tobytes()
     body = T.build(
@@ -41,7 +45,9 @@ def test_votes_scheduled_first_and_budgeted():
     for _ in range(20):
         assert pk.insert(_mk_txn(rng, vote=True)) == "ok"
     for _ in range(20):
-        assert pk.insert(_mk_txn(rng, vote=False)) == "ok"
+        # builtin-costed non-votes (system program): cheap enough to
+        # share a microblock whose budget is sized in vote costs
+        assert pk.insert(_mk_txn(rng, vote=False, program=bytes(32))) == "ok"
     vote_cost = int(pk.cost[pk.is_vote & (pk.state == 1)][0])
 
     # a budget that fits exactly 3 votes at 25% of the CU limit

@@ -77,9 +77,11 @@ def test_encode_matches_oracle(D, P):
     rng = np.random.default_rng(D * 100 + P)
     N = 64
     data = rng.integers(0, 256, (D, N)).astype(np.uint8)
-    got = RS.encode(data, P)
     want = _oracle_encode(data, P)
-    assert (got == want).all()
+    # both dispatch paths must agree with the oracle (auto-size picks
+    # host here; device=True forces the MXU bit-matrix kernel)
+    assert (RS.encode(data, P) == want).all()
+    assert (RS.encode(data, P, device=True) == want).all()
 
 
 @pytest.mark.parametrize(

@@ -63,9 +63,11 @@ def test_shred_store_pipeline(tmp_path):
         topo.halt()
         assert ms.counter("completed_slots") >= 2
         assert topo.metrics("shred").counter("sign_requests") > 0
+        # published requests == responses + in flight at the keyguard
+        # (_pending also counts queued-but-unsent requests in _signq)
         assert topo.metrics("shred").counter("sign_requests") == topo.metrics(
             "shred"
-        ).counter("sign_responses") + len(shred._pending)
+        ).counter("sign_responses") + len(shred._pending) - len(shred._signq)
         assert topo.metrics("sign").counter("refused") == 0
         bs = store.store
 

@@ -1,0 +1,77 @@
+"""waltz.ip: routing longest-prefix match + ARP cache states.
+
+Reference analog: src/waltz/ip/fd_ip.c (route_ip_addr + arp_query) and
+fd_netlink.c's mirrored tables; this build mirrors from /proc.
+"""
+
+from firedancer_tpu.waltz import ip as IP
+
+
+def _stack():
+    st = IP.IpStack()
+    st.add_route("0.0.0.0/0", "10.0.0.1", "eth0", metric=100)
+    st.add_route("10.0.0.0/8", None, "eth0")
+    st.add_route("10.1.0.0/16", "10.0.0.254", "eth1")
+    st.add_route("10.1.2.0/24", None, "eth2")
+    st.add_neighbor("10.0.0.1", bytes(range(6)), "eth0")
+    st.add_neighbor("10.1.2.9", b"\xaa" * 6, "eth2")
+    st.add_neighbor("10.0.0.254", b"\xbb" * 6, "eth1",
+                    state=IP.ARP_STALE)
+    return st
+
+
+def test_longest_prefix_match():
+    st = _stack()
+    assert st.lookup_route("10.1.2.3").ifname == "eth2"      # /24 wins
+    assert st.lookup_route("10.1.9.9").ifname == "eth1"      # /16
+    assert st.lookup_route("10.9.9.9").ifname == "eth0"      # /8
+    assert st.lookup_route("8.8.8.8").ifname == "eth0"       # default
+    assert st.lookup_route("8.8.8.8").gateway == IP.ip_to_int("10.0.0.1")
+
+
+def test_next_hop_gateway_vs_onlink():
+    st = _stack()
+    assert st.next_hop("10.1.2.3") == ("eth2", "10.1.2.3")   # on-link
+    assert st.next_hop("8.8.8.8") == ("eth0", "10.0.0.1")    # via gw
+    assert st.next_hop("10.1.5.5") == ("eth1", "10.0.0.254")
+
+
+def test_route_with_arp_states():
+    st = _stack()
+    # resolved neighbor -> mac returned
+    assert st.route("8.8.8.8") == ("eth0", "10.0.0.1", bytes(range(6)))
+    assert st.route("10.1.2.9") == ("eth2", "10.1.2.9", b"\xaa" * 6)
+    # stale neighbor -> probe recorded, no mac
+    ifname, hop, mac = st.route("10.1.5.5")
+    assert (ifname, hop, mac) == ("eth1", "10.0.0.254", None)
+    assert IP.ip_to_int("10.0.0.254") in st.probes_pending
+    # unknown neighbor on-link -> probe pending
+    ifname, hop, mac = st.route("10.1.2.77")
+    assert mac is None and IP.ip_to_int("10.1.2.77") in st.probes_pending
+
+
+def test_from_proc_smoke(tmp_path):
+    """Parse the real /proc format (fixture copies of the kernel's
+    layout; the live files also parse when present)."""
+    route = tmp_path / "route"
+    route.write_text(
+        "Iface\tDestination\tGateway \tFlags\tRefCnt\tUse\tMetric\t"
+        "Mask\t\tMTU\tWindow\tIRTT\n"
+        "eth0\t00000000\t0100000A\t0003\t0\t0\t100\t00000000\t0\t0\t0\n"
+        "eth0\t0000000A\t00000000\t0001\t0\t0\t0\t000000FF\t0\t0\t0\n"
+    )
+    arp = tmp_path / "arp"
+    arp.write_text(
+        "IP address       HW type     Flags       HW address"
+        "            Mask     Device\n"
+        "10.0.0.1         0x1         0x2         "
+        "00:11:22:33:44:55     *        eth0\n"
+    )
+    st = IP.IpStack.from_proc(str(route), str(arp))
+    assert st.next_hop("8.8.8.8") == ("eth0", "10.0.0.1")
+    assert st.next_hop("10.5.5.5") == ("eth0", "10.5.5.5")
+    r = st.route("8.8.8.8")
+    assert r == ("eth0", "10.0.0.1",
+                 bytes([0x00, 0x11, 0x22, 0x33, 0x44, 0x55]))
+    # live system files parse without raising
+    IP.IpStack.from_proc()
