@@ -92,15 +92,26 @@ def make_transfer_pool(
 
 
 class UdpBlaster:
-    """benchs analog: a sender thread blasting pool rows at a UDP addr."""
+    """benchs analog: a sender thread blasting pool rows at a UDP addr.
+
+    UDP severs the ring-credit backpressure the reference's benchs
+    tiles inherit, and pack DROPS inserts when its buffer is full — an
+    unpaced blast of a finite pool burns most of it as rejects within
+    seconds.  Feedback pacing restores the backpressure: the owner
+    updates `landed` (RPC-observed count) and the sender keeps
+    sent - landed <= window."""
 
     def __init__(self, rows: np.ndarray, addr: tuple[str, int],
-                 burst: int = 64, pace_s: float = 0.0):
+                 burst: int = 64, pace_s: float = 0.0,
+                 window: int | None = None):
         self.rows = rows
         self.addr = addr
         self.burst = burst
         self.pace_s = pace_s
+        self.window = window
         self.sent = 0
+        #: RPC-observed landed count, updated by the measuring loop
+        self.landed = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -112,7 +123,21 @@ class UdpBlaster:
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
             n = len(self.rows)
+            last_landed, last_progress = -1, time.monotonic()
             while not self._stop.is_set() and self.sent < n:
+                if (
+                    self.window is not None
+                    and self.sent - self.landed > self.window
+                ):
+                    # permanently lost txns (UDP drops, rejects) never
+                    # leave the window; if landing stalls, degrade to
+                    # unpaced sending rather than wedging forever
+                    now = time.monotonic()
+                    if self.landed != last_landed:
+                        last_landed, last_progress = self.landed, now
+                    if now - last_progress < 5.0:
+                        time.sleep(0.005)
+                        continue
                 end = min(self.sent + self.burst, n)
                 for i in range(self.sent, end):
                     try:

@@ -52,6 +52,7 @@ class PohTile(Tile):
         *,
         tick_batch: int = 64,
         ticks_per_slot: int = TICKS_PER_SLOT,
+        slot_ms: float = 400.0,
         leaders=None,
         identity: bytes | None = None,
         slot0: int = 0,
@@ -59,7 +60,13 @@ class PohTile(Tile):
     ):
         """leaders/identity: an EpochLeaders schedule (flamenco.leaders)
         plus our pubkey drive the leader-slot state machine; with
-        leaders=None the tile is always leader (single-node tests)."""
+        leaders=None the tile is always leader (single-node tests).
+
+        slot_ms paces the clock to wall time (mainnet: 400 ms slots,
+        hashcnt rate derived from it — fd_poh.c's hashcnt_duration_ns).
+        Unpaced ticking would burn a full core spinning sha256 (the
+        reference DEDICATES a core; shared-core hosts cannot) and starve
+        every other tile.  slot_ms=0 disables pacing (tests)."""
         self.name = name
         self.tick_batch = tick_batch
         self.ticks_per_slot = ticks_per_slot
@@ -69,6 +76,12 @@ class PohTile(Tile):
         self.ticks_in_slot = 0
         self.state = np.zeros(32, dtype=np.uint8)
         self.hashcnt = 0
+        #: seconds between tick batches (0 = free-run)
+        self._batch_interval = (
+            (slot_ms / 1000.0) * tick_batch / ticks_per_slot
+            if slot_ms else 0.0
+        )
+        self._next_batch = 0.0
 
     # ---- leader state ----------------------------------------------------
 
@@ -148,6 +161,17 @@ class PohTile(Tile):
                 break
 
     def after_credit(self, ctx: MuxCtx) -> None:
+        if self._batch_interval:
+            import time as _t
+
+            now = _t.monotonic()
+            if now < self._next_batch:
+                return
+            self._next_batch = (
+                now + self._batch_interval
+                if now - self._next_batch > 1.0
+                else self._next_batch + self._batch_interval
+            )
         # batch-advance the clock.  The PoH chain is a SEQUENTIAL sha256
         # ladder — there is no batch parallelism for the device to
         # exploit, and on the axon tunnel every dispatch costs ~110 ms

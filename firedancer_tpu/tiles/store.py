@@ -36,16 +36,23 @@ class Blockstore:
         os.makedirs(path, exist_ok=True)
         self._logs: dict[int, object] = {}
 
+    def _open(self, name: str, mode: str):
+        # scratch reapers in some environments delete long-lived dirs
+        # out from under the process; a blockstore must outlive them
+        try:
+            return open(os.path.join(self.path, name), mode)
+        except FileNotFoundError:
+            os.makedirs(self.path, exist_ok=True)
+            return open(os.path.join(self.path, name), mode)
+
     def append_shred(self, slot: int, raw: bytes) -> None:
         f = self._logs.get(slot)
         if f is None:
-            f = self._logs[slot] = open(
-                os.path.join(self.path, f"slot_{slot}.shreds"), "ab"
-            )
+            f = self._logs[slot] = self._open(f"slot_{slot}.shreds", "ab")
         f.write(struct.pack("<H", len(raw)) + raw)
 
     def write_block(self, slot: int, payload: bytes) -> None:
-        with open(os.path.join(self.path, f"slot_{slot}.block"), "wb") as f:
+        with self._open(f"slot_{slot}.block", "wb") as f:
             f.write(payload)
 
     def shreds(self, slot: int) -> list[bytes]:
