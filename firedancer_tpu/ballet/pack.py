@@ -19,10 +19,16 @@ numpy arrays instead of intrusive treaps/maps —
     own bitset fast path has the same one-sided property; divergence: we
     skip its exact slow path entirely, trading rare spurious delay for a
     data-parallel test)
-  * the greedy select loop itself can run on the device as a lax.scan
-    prefilter over the top-K candidates (ops/pack_select.py); this host
-    engine commits the device's speculative picks after enforcing the
-    caps that need exact per-account state (writer costs)
+  * per-account writer cost caps are keyed by 64-bit account-key hashes
+    (fdt_pack.c wc map), not exact keys — collisions merge cost buckets,
+    which can only UNDER-admit (never violate the consensus cap); the
+    reference keeps exact keys in a treap-side map
+  * the hot paths (batch parse + estimate, greedy select + commit, lock
+    release) are ONE native call each (tango/native/fdt_pack.c, GIL
+    released): the Python layer does slot bookkeeping and policy only
+  * the greedy select can also run on the device as a lax.scan prefilter
+    over the top-K candidates (ops/pack_select.py); this engine commits
+    the device's speculative picks through the same native commit path
 
 Consensus constants (fd_pack.h:17-23) are preserved exactly.
 """
@@ -33,6 +39,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from firedancer_tpu.tango import rings as R
+
 from . import compute_budget as CB
 from . import txn as T
 
@@ -41,6 +49,11 @@ MAX_VOTE_COST_PER_BLOCK = 36_000_000
 MAX_WRITE_COST_PER_ACCT = 12_000_000
 FEE_PER_SIGNATURE = 5000
 MAX_BANK_TILES = 62
+
+#: static account addrs with an MTU payload cap out near 34; every static
+#: writable key's hash fits a 34-wide row (fdt_txn_scan truncates past
+#: this, which would under-enforce the writer cap — unreachable at MTU)
+MAX_WRITERS = 34
 
 _FREE, _PENDING, _INFLIGHT = 0, 1, 2
 
@@ -66,8 +79,7 @@ def is_simple_vote(payload: bytes, desc: T.TxnDesc) -> bool:
 
 def _hash_acct(key: bytes) -> int:
     """Account pubkey -> stable 64-bit hash (splitmix64 finalizer over the
-    first 8 bytes XOR the last 8; adversarial spread matters less than in
-    the reference because collisions only delay, never corrupt)."""
+    first 8 bytes XOR the last 8; must agree with fdt_pack.c acct_hash)."""
     x = int.from_bytes(key[:8], "little") ^ int.from_bytes(key[24:], "little")
     x &= (1 << 64) - 1
     x ^= x >> 30
@@ -76,6 +88,96 @@ def _hash_acct(key: bytes) -> int:
     x = (x * 0x94D049BB133111EB) & ((1 << 64) - 1)
     x ^= x >> 31
     return x
+
+
+@dataclass
+class ScanResult:
+    """Per-txn outputs of one fdt_txn_scan call (views, length n)."""
+
+    ok: np.ndarray
+    is_vote: np.ndarray
+    fast: np.ndarray
+    cost: np.ndarray
+    rewards: np.ndarray
+    cu_limit: np.ndarray
+    tags: np.ndarray
+    lamports: np.ndarray
+    payer_off: np.ndarray
+    src_off: np.ndarray
+    dst_off: np.ndarray
+    fee: np.ndarray
+    bs_rw: np.ndarray | None = None
+    bs_w: np.ndarray | None = None
+    whash: np.ndarray | None = None
+    w_cnt: np.ndarray | None = None
+    trows: np.ndarray | None = None
+    tszs: np.ndarray | None = None
+    n_ok: int = 0
+
+
+def txn_scan(
+    rows: np.ndarray,
+    szs: np.ndarray,
+    *,
+    in_off: int = 0,
+    nbits: int = 0,
+    with_bitsets: bool = False,
+    with_trailer: bool = False,
+    trows: np.ndarray | None = None,
+) -> ScanResult:
+    """Batch parse + validate + estimate n txns in one native call
+    (fdt_txn_scan).  rows (n, width) u8; szs (n,) payload sizes.
+
+    with_bitsets: also produce the pack conflict bitsets + writable-key
+    hashes (requires nbits).  with_trailer: write payload+trailer into
+    `trows` (defaults to in-place when rows has 16 bytes of slack)."""
+    n, width = rows.shape
+    szs32 = np.ascontiguousarray(szs, np.uint32)
+    out = ScanResult(
+        ok=np.zeros(n, np.uint8),
+        is_vote=np.zeros(n, np.uint8),
+        fast=np.zeros(n, np.uint8),
+        cost=np.zeros(n, np.uint32),
+        rewards=np.zeros(n, np.uint64),
+        cu_limit=np.zeros(n, np.uint32),
+        tags=np.zeros(n, np.uint64),
+        lamports=np.zeros(n, np.uint64),
+        payer_off=np.zeros(n, np.uint32),
+        src_off=np.zeros(n, np.uint32),
+        dst_off=np.zeros(n, np.uint32),
+        fee=np.zeros(n, np.uint32),
+    )
+    W = nbits // 64 if with_bitsets else 0
+    if with_bitsets:
+        out.bs_rw = np.zeros((n, W), np.uint64)
+        out.bs_w = np.zeros((n, W), np.uint64)
+        out.whash = np.zeros((n, MAX_WRITERS), np.uint64)
+        out.w_cnt = np.zeros(n, np.uint8)
+    if with_trailer:
+        out.trows = rows if trows is None else trows
+        out.tszs = np.zeros(n, np.uint32)
+    assert rows.flags.c_contiguous
+    out.n_ok = int(
+        R._lib.fdt_txn_scan(
+            rows.ctypes.data, width, in_off, szs32.ctypes.data, n,
+            nbits if with_bitsets else 0,
+            out.ok.ctypes.data, out.is_vote.ctypes.data,
+            out.fast.ctypes.data, out.cost.ctypes.data,
+            out.rewards.ctypes.data, out.cu_limit.ctypes.data,
+            out.tags.ctypes.data, out.lamports.ctypes.data,
+            out.payer_off.ctypes.data, out.src_off.ctypes.data,
+            out.dst_off.ctypes.data, out.fee.ctypes.data,
+            out.bs_rw.ctypes.data if with_bitsets else None,
+            out.bs_w.ctypes.data if with_bitsets else None,
+            out.whash.ctypes.data if with_bitsets else None,
+            out.w_cnt.ctypes.data if with_bitsets else None,
+            MAX_WRITERS,
+            out.trows.ctypes.data if with_trailer else None,
+            out.trows.shape[1] if with_trailer else 0,
+            out.tszs.ctypes.data if with_trailer else None,
+        )
+    )
+    return out
 
 
 @dataclass
@@ -118,8 +220,9 @@ class Pack:
         # hashed account-conflict bitsets
         self.bs_rw = np.zeros((P, self.W), dtype=np.uint64)
         self.bs_w = np.zeros((P, self.W), dtype=np.uint64)
-        # exact writable-account keys per txn (for writer cost caps)
-        self.writable_keys: list[list[bytes]] = [[] for _ in range(P)]
+        # hashed writable-account keys per txn (writer cost caps)
+        self.whash = np.zeros((P, MAX_WRITERS), dtype=np.uint64)
+        self.w_cnt = np.zeros(P, dtype=np.uint8)
 
         # in-use state across outstanding microblocks
         self.in_use_rw = np.zeros(self.W, dtype=np.uint64)
@@ -127,7 +230,17 @@ class Pack:
         self.bit_ref_rw = np.zeros(nbits, dtype=np.int32)
         self.bit_ref_w = np.zeros(nbits, dtype=np.int32)
 
-        self.writer_costs: dict[bytes, int] = {}
+        # writer-cost map (hash-keyed open addressing, fdt_pack.c wc_*):
+        # sized for a full block of minimum-cost txns' writable keys —
+        # ~block_cost_limit/1500 CU admits ~32K txns, each with up to a
+        # few writable keys, so 4x that keeps the load factor low (a full
+        # map degrades to at-cap rejections, never a hang — wc_get bound)
+        block_txn_cap = max(block_cost_limit // 1500, depth)
+        map_cnt = 1 << max(14, (4 * block_txn_cap - 1).bit_length())
+        self._wc_mask = map_cnt - 1
+        self.wc_keys = np.zeros(map_cnt, dtype=np.uint64)
+        self.wc_vals = np.zeros(map_cnt, dtype=np.int64)
+
         self.cumulative_block_cost = 0
         self.cumulative_vote_cost = 0
         self.vote_cost_limit = MAX_VOTE_COST_PER_BLOCK
@@ -146,128 +259,177 @@ class Pack:
     def inflight_cnt(self) -> int:
         return int((self.state == _INFLIGHT).sum())
 
+    def writer_cost(self, key: bytes) -> int:
+        """Committed write cost against `key`'s hash bucket this block."""
+        h = _hash_acct(key) or 1
+        i = h & self._wc_mask
+        for _ in range(self._wc_mask + 1):
+            k = int(self.wc_keys[i])
+            if k == h:
+                return int(self.wc_vals[i])
+            if k == 0:
+                return 0
+            i = (i + 1) & self._wc_mask
+        return self.writer_cost_cap  # full map: at-cap (matches wc_get)
+
     # ---- insert ---------------------------------------------------------
 
-    def _bits_for(self, keys: list[bytes]) -> np.ndarray:
-        bs = np.zeros(self.W, dtype=np.uint64)
-        for k in keys:
-            b = _hash_acct(k) % self.nbits
-            bs[b >> 6] |= np.uint64(1) << np.uint64(b & 63)
-        return bs
+    def insert_batch(
+        self,
+        rows: np.ndarray,
+        szs: np.ndarray,
+        *,
+        expires_at: int = 0,
+        scan: ScanResult | None = None,
+    ) -> int:
+        """Insert a batch of raw txns ((n, width) u8 + payload sizes) in
+        one native scan + vectorized slot scatter.  Returns txns accepted
+        (rejects: parse/estimate failures, pool full after the
+        better-priority eviction policy).  `scan` reuses a caller's
+        fdt_txn_scan result (must include bitsets)."""
+        if scan is None:
+            scan = txn_scan(rows, szs, nbits=self.nbits, with_bitsets=True)
+        ok_idx = np.flatnonzero(scan.ok)
+        if not len(ok_idx):
+            return 0
+        free = np.flatnonzero(self.state == _FREE)
+        n_place = min(len(ok_idx), len(free))
+        placed = n_place
+        if n_place < len(ok_idx):
+            # pool full: evict strictly-worse pending txns for the best of
+            # the remainder (fd_pack_insert_txn_fini's priority eviction,
+            # batch-generalized: best incoming paired with worst pending —
+            # the pairing comparison is prefix-monotone, so the accepted
+            # set is exactly the evictions the one-at-a-time policy makes)
+            extra = ok_idx[n_place:]
+            pr_new = scan.rewards[extra].astype(np.float64) / np.maximum(
+                scan.cost[extra].astype(np.float64), 1.0
+            )
+            new_order = np.argsort(-pr_new, kind="stable")
+            extra = extra[new_order]
+            pending = np.flatnonzero(self.state == _PENDING)
+            if len(pending):
+                pr_old = self.rewards[pending].astype(
+                    np.float64
+                ) / np.maximum(self.cost[pending].astype(np.float64), 1.0)
+                worst_order = pending[np.argsort(pr_old, kind="stable")]
+                pr_old_sorted = np.sort(pr_old, kind="stable")
+                k = min(len(extra), len(worst_order))
+                take = np.flatnonzero(
+                    pr_new[new_order][:k] > pr_old_sorted[:k]
+                )
+                if len(take):
+                    slots = worst_order[take]
+                    self.state[slots] = _FREE
+                    self._scatter(
+                        slots, rows, szs, extra[take], scan, expires_at
+                    )
+                    placed += len(take)
+            ok_idx = ok_idx[:n_place]
+        if n_place:
+            self._scatter(free[:n_place], rows, szs, ok_idx, scan, expires_at)
+        return placed
+
+    def _scatter(self, slots, rows, szs, src, scan: ScanResult, expires_at):
+        w = min(rows.shape[1], self.rows.shape[1])
+        self.rows[slots, :w] = rows[src][:, :w]
+        self.szs[slots] = szs[src]
+        self.rewards[slots] = np.minimum(
+            scan.rewards[src], np.uint64(0xFFFFFFFF)
+        )
+        self.cost[slots] = scan.cost[src]
+        self.expires_at[slots] = expires_at
+        self.sig_tag[slots] = scan.tags[src]
+        self.is_vote[slots] = scan.is_vote[src].astype(bool)
+        self.bs_rw[slots] = scan.bs_rw[src]
+        self.bs_w[slots] = scan.bs_w[src]
+        self.whash[slots] = scan.whash[src]
+        self.w_cnt[slots] = scan.w_cnt[src]
+        self.state[slots] = _PENDING
 
     def insert(
         self, payload: bytes, *, expires_at: int = 0, sig_tag: int = 0
     ) -> str:
         """Insert one txn.  Returns 'ok', 'parse', 'estimate', or 'full'
         (mirrors fd_pack_insert_txn_fini's reject reasons)."""
-        desc = T.parse(payload)
-        if desc is None:
-            return "parse"
-        est = CB.estimate(payload, desc)
-        if not est.ok or est.cost == 0:
+        row = np.zeros((1, len(payload)), np.uint8)
+        row[0] = np.frombuffer(payload, np.uint8)
+        szs = np.array([len(payload)], np.uint32)
+        scan = txn_scan(row, szs, nbits=self.nbits, with_bitsets=True)
+        if not scan.ok[0]:
+            # distinguish the reject reason for the caller (one extra
+            # Python parse on the cold path only)
+            desc = T.parse(payload)
+            if desc is None:
+                return "parse"
             return "estimate"
-
-        free = np.flatnonzero(self.state == _FREE)
-        if len(free):
-            slot = int(free[0])
-        else:
-            # replacement policy: evict the worst pending txn if the new
-            # one has strictly better priority (reference behavior:
-            # fd_pack_insert_txn_fini's PRIORITY comparison + eviction)
-            pending = np.flatnonzero(self.state == _PENDING)
-            if not len(pending):
-                return "full"
-            pr = self.rewards[pending].astype(np.float64) / np.maximum(
-                self.cost[pending].astype(np.float64), 1.0
-            )
-            worst = int(pending[np.argmin(pr)])
-            if est.rewards / max(est.cost, 1) <= pr.min():
-                return "full"
-            slot = worst
-
-        n = len(payload)
-        self.rows[slot, :n] = np.frombuffer(payload, dtype=np.uint8)
-        self.szs[slot] = n
-        self.rewards[slot] = est.rewards
-        self.cost[slot] = est.cost
-        self.expires_at[slot] = expires_at
-        self.sig_tag[slot] = sig_tag
-        self.state[slot] = _PENDING
-        self.is_vote[slot] = is_simple_vote(payload, desc)
-
-        w_idx = desc.writable_idxs()
-        keys_w = [bytes(desc.acct_addr(payload, j)) for j in w_idx]
-        keys_all = [
-            bytes(desc.acct_addr(payload, j)) for j in range(desc.acct_addr_cnt)
-        ]
-        self.writable_keys[slot] = keys_w
-        self.bs_w[slot] = self._bits_for(keys_w)
-        self.bs_rw[slot] = self._bits_for(keys_all)
-        return "ok"
+        if sig_tag:
+            scan.tags[0] = sig_tag
+        placed = self.insert_batch(row, szs, expires_at=expires_at, scan=scan)
+        return "ok" if placed else "full"
 
     # ---- scheduling -----------------------------------------------------
 
-    def _select_pass(
-        self, cands, cu_limit, txn_limit, scan_limit, device_select,
-        sel_rw, sel_w,
-    ) -> list[int]:
-        """One greedy selection pass over `cands` (pool slots) against the
-        running conflict state sel_rw/sel_w (mutated in place)."""
-        if cu_limit <= 0 or txn_limit <= 0 or not len(cands):
-            return []
+    def _order(self, cands: np.ndarray, scan_limit: int) -> np.ndarray:
         pr = self.rewards[cands].astype(np.float64) / np.maximum(
             self.cost[cands].astype(np.float64), 1.0
         )
-        order = cands[np.argsort(-pr, kind="stable")][:scan_limit]
+        return np.ascontiguousarray(
+            cands[np.argsort(-pr, kind="stable")][:scan_limit], np.int64
+        )
+
+    def _commit(
+        self, order: np.ndarray, cu_limit: int, txn_limit: int,
+        byte_limit: int,
+    ) -> tuple[np.ndarray, int]:
+        """Greedy select + commit (native): returns (picks, cu_used)."""
+        if cu_limit <= 0 or txn_limit <= 0 or not len(order):
+            return np.zeros(0, np.int64), 0
+        picks = np.empty(min(len(order), txn_limit), np.int64)
+        cu_used = np.zeros(1, np.int64)
+        n = R._lib.fdt_pack_select(
+            order.ctypes.data, len(order),
+            self.bs_rw.ctypes.data, self.bs_w.ctypes.data, self.W,
+            self.cost.ctypes.data, self.szs.ctypes.data, byte_limit,
+            self.in_use_rw.ctypes.data, self.in_use_w.ctypes.data,
+            self.bit_ref_rw.ctypes.data, self.bit_ref_w.ctypes.data,
+            self.whash.ctypes.data, self.w_cnt.ctypes.data, MAX_WRITERS,
+            self.wc_keys.ctypes.data, self.wc_vals.ctypes.data,
+            self._wc_mask, self.writer_cost_cap, cu_limit, txn_limit,
+            picks.ctypes.data, cu_used.ctypes.data,
+        )
+        return picks[:n], int(cu_used[0])
+
+    def _select_speculative(
+        self, cands, cu_limit, txn_limit, scan_limit, device_select,
+        sel_rw, sel_w,
+    ) -> np.ndarray:
+        """Device-speculative selection (ops/pack_select): returns a
+        candidate pick ORDER; the native commit path re-enforces every
+        exact budget before committing."""
+        order = self._order(cands, scan_limit)
         cand_rw = self.bs_rw[order]
         cand_w = self.bs_w[order]
         costs = self.cost[order].astype(np.int64)
+        K = len(order)
+        if K < scan_limit:
+            pad = scan_limit - K
+            cand_rw = np.concatenate(
+                [cand_rw, np.zeros((pad, self.W), np.uint64)]
+            )
+            cand_w = np.concatenate(
+                [cand_w, np.zeros((pad, self.W), np.uint64)]
+            )
+            from firedancer_tpu.ops.pack_select import PAD_COST
 
-        if device_select is not None:
-            # pad candidates to the fixed scan_limit shape so the jitted
-            # select kernel compiles once; sentinel rows carry a cost above
-            # any cu_limit, so they are never taken
-            K = len(order)
-            if K < scan_limit:
-                pad = scan_limit - K
-                cand_rw = np.concatenate(
-                    [cand_rw, np.zeros((pad, self.W), np.uint64)]
-                )
-                cand_w = np.concatenate(
-                    [cand_w, np.zeros((pad, self.W), np.uint64)]
-                )
-                from firedancer_tpu.ops.pack_select import PAD_COST
-
-                costs = np.concatenate(
-                    [costs, np.full(pad, PAD_COST, np.int64)]
-                )
-            take = np.asarray(
-                device_select(
-                    cand_rw, cand_w, sel_rw.copy(), sel_w.copy(), costs,
-                    cu_limit, txn_limit,
-                )
-            )[:K]
-            picks = [int(s) for s in order[take]]
-            for slot in picks:
-                sel_rw |= self.bs_rw[slot]
-                sel_w |= self.bs_w[slot]
-            return picks
-
-        picks_l: list[int] = []
-        cu_used = 0
-        for j, slot in enumerate(order):
-            c = int(costs[j])
-            if cu_used + c > cu_limit:
-                continue
-            if (cand_w[j] & sel_rw).any() or (cand_rw[j] & sel_w).any():
-                continue
-            picks_l.append(int(slot))
-            sel_rw |= cand_rw[j]
-            sel_w |= cand_w[j]
-            cu_used += c
-            if len(picks_l) >= txn_limit:
-                break
-        return picks_l
+            costs = np.concatenate([costs, np.full(pad, PAD_COST, np.int64)])
+        take = np.asarray(
+            device_select(
+                cand_rw, cand_w, sel_rw.copy(), sel_w.copy(), costs,
+                cu_limit, txn_limit,
+            )
+        )[:K]
+        return np.ascontiguousarray(order[take], np.int64)
 
     def schedule_microblock(
         self,
@@ -278,6 +440,7 @@ class Pack:
         vote_fraction: float = 0.25,
         now: int = 0,
         scan_limit: int = 1024,
+        byte_limit: int = 0,
         device_select=None,
     ) -> _Microblock | None:
         """Greedy-select a non-conflicting microblock for `bank`
@@ -286,8 +449,9 @@ class Pack:
         capped by the per-block vote cost limit (MAX_VOTE_COST_PER_BLOCK,
         fd_pack.h:20), then non-votes with the remainder.  device_select,
         when given, is the TPU prefilter (ops/pack_select.select_noconflict)
-        used speculatively; the host still enforces writer-cost caps and
-        block budgets before committing."""
+        used speculatively; the native commit still enforces writer-cost
+        caps and budgets exactly.  byte_limit bounds the encoded
+        microblock size (0 = unbounded)."""
         if self.cumulative_block_cost >= self.block_cost_limit:
             return None
         cu_limit = min(
@@ -319,100 +483,43 @@ class Pack:
         vote_txn_limit = txn_limit
         if len(nonvotes):
             vote_txn_limit = max(1, int(txn_limit * vote_fraction))
-        sel_rw = self.in_use_rw.copy()
-        sel_w = self.in_use_w.copy()
-        # vote lane always uses the host greedy loop: the candidate set is
-        # tiny and the device prefilter's fixed scan_limit shape would pay
-        # a full 1024-row scan for it
-        vote_picks = self._select_pass(
-            votes, vote_budget, vote_txn_limit, scan_limit, None,
-            sel_rw, sel_w,
+        # vote lane always uses the host order: the candidate set is tiny
+        vote_picks, vote_used = self._commit(
+            self._order(votes, scan_limit), vote_budget, vote_txn_limit,
+            byte_limit,
+        ) if len(votes) else (np.zeros(0, np.int64), 0)
+        # the byte budget spans the WHOLE microblock: the nonvote pass
+        # only gets what the vote pass left (each txn costs sz + a
+        # 2-byte length prefix on the wire)
+        nv_byte_limit = byte_limit
+        if byte_limit > 0 and len(vote_picks):
+            nv_byte_limit = max(
+                1,
+                byte_limit - int(self.szs[vote_picks].sum())
+                - 2 * len(vote_picks),
+            )
+        if device_select is not None and len(nonvotes):
+            nv_order = self._select_speculative(
+                nonvotes, cu_limit - vote_used, txn_limit, scan_limit,
+                device_select, self.in_use_rw, self.in_use_w,
+            )
+        else:
+            nv_order = self._order(nonvotes, scan_limit)
+        nv_picks, nv_used = self._commit(
+            nv_order, cu_limit - vote_used,
+            txn_limit - len(vote_picks), nv_byte_limit,
         )
-        vote_cost = int(self.cost[vote_picks].sum()) if vote_picks else 0
-        # device pass keeps the STATIC txn_limit (it is a static jit arg;
-        # varying it would recompile); the host commit loop below enforces
-        # the remaining dynamic slot budget
-        nv_picks = self._select_pass(
-            nonvotes, cu_limit - vote_cost, txn_limit,
-            scan_limit, device_select, sel_rw, sel_w,
-        )
-        picks = vote_picks + nv_picks
-
-        # host-side exact enforcement: writer cost caps (+ re-derive
-        # budgets when the device speculated); votes enforce the vote
-        # budget exactly
-        final: list[int] = []
-        cu_used = 0
-        vote_used = 0
-        for slot in picks:
-            slot = int(slot)
-            c = int(self.cost[slot])
-            if cu_used + c > cu_limit:
-                continue
-            if self.is_vote[slot] and vote_used + c > vote_budget:
-                continue
-            over = False
-            for k in self.writable_keys[slot]:
-                if self.writer_costs.get(k, 0) + c > self.writer_cost_cap:
-                    over = True
-                    break
-            if over:
-                continue
-            final.append(slot)
-            cu_used += c
-            if self.is_vote[slot]:
-                vote_used += c
-            if len(final) >= txn_limit:
-                break
-        if not final:
+        picks = np.concatenate([vote_picks, nv_picks])
+        if not len(picks):
             return None
         self.cumulative_vote_cost += vote_used
-
-        idx = np.array(final, dtype=np.int64)
-        for slot in final:
-            c = int(self.cost[slot])
-            for k in self.writable_keys[slot]:
-                self.writer_costs[k] = self.writer_costs.get(k, 0) + c
-        # acquire bits with refcounts so overlapping reads across banks
-        # release correctly
-        for slot in final:
-            self._bit_acquire(self.bs_rw[slot], self.bit_ref_rw)
-            self._bit_acquire(self.bs_w[slot], self.bit_ref_w)
-        self._rebuild_in_use()
-        self.state[idx] = _INFLIGHT
-        total = int(self.cost[idx].sum())
+        total = vote_used + nv_used
         self.cumulative_block_cost += total
-        mb = _Microblock(self._next_handle, idx, total)
+        self.state[picks] = _INFLIGHT
+        mb = _Microblock(self._next_handle, picks, total)
         self._next_handle += 1
         self.outstanding[bank].append(mb)
         return mb
-
-    def _bit_acquire(self, bs: np.ndarray, ref: np.ndarray) -> None:
-        bits = np.flatnonzero(
-            (bs[:, None] >> np.arange(64, dtype=np.uint64)[None, :])
-            & np.uint64(1)
-        )
-        ref[bits] += 1
-
-    def _bit_release(self, bs: np.ndarray, ref: np.ndarray) -> None:
-        bits = np.flatnonzero(
-            (bs[:, None] >> np.arange(64, dtype=np.uint64)[None, :])
-            & np.uint64(1)
-        )
-        ref[bits] -= 1
-
-    def _rebuild_in_use(self) -> None:
-        for ref, out in (
-            (self.bit_ref_rw, "in_use_rw"),
-            (self.bit_ref_w, "in_use_w"),
-        ):
-            live = ref > 0
-            words = np.zeros(self.W, dtype=np.uint64)
-            bits = np.flatnonzero(live)
-            np.bitwise_or.at(
-                words, bits >> 6, np.uint64(1) << (bits & 63).astype(np.uint64)
-            )
-            setattr(self, out, words)
 
     def microblock_complete(self, bank: int, handle: int) -> None:
         """Bank finished executing a microblock: release account locks and
@@ -424,22 +531,24 @@ class Pack:
         else:
             raise KeyError(f"no outstanding microblock {handle} on bank {bank}")
         obs.pop(i)
-        for slot in mb.txn_idx:
-            self._bit_release(self.bs_rw[slot], self.bit_ref_rw)
-            self._bit_release(self.bs_w[slot], self.bit_ref_w)
-        self._rebuild_in_use()
+        idx = np.ascontiguousarray(mb.txn_idx, np.int64)
+        R._lib.fdt_pack_release(
+            idx.ctypes.data, len(idx),
+            self.bs_rw.ctypes.data, self.bs_w.ctypes.data, self.W,
+            self.bit_ref_rw.ctypes.data, self.bit_ref_w.ctypes.data,
+            self.in_use_rw.ctypes.data, self.in_use_w.ctypes.data,
+        )
         self._release_slots(mb.txn_idx)
 
     def _release_slots(self, idx: np.ndarray) -> None:
         self.state[idx] = _FREE
-        for slot in idx:
-            self.writable_keys[int(slot)] = []
 
     def end_block(self) -> None:
         """Slot boundary: reset block budgets and per-account write costs
         (fd_pack_end_block).  Outstanding microblocks must be completed
         first; pending txns carry over."""
         assert all(not v for v in self.outstanding.values())
-        self.writer_costs.clear()
+        self.wc_keys.fill(0)
+        self.wc_vals.fill(0)
         self.cumulative_block_cost = 0
         self.cumulative_vote_cost = 0

@@ -313,6 +313,135 @@ class Executor:
             True, fee=fee, logs=logs, cu_used=TXN_CU_BUDGET - meter[0]
         )
 
+    # ---- batched fast path ----------------------------------------------
+
+    def execute_fast_transfers(
+        self, payloads, fees, amounts, payer_offs, src_offs, dst_offs
+    ) -> tuple[int, int, int]:
+        """Execute a batch of scan-classified simple transfers (legacy
+        txns whose only non-compute-budget instruction is one system
+        transfer with a writable-signer source — fdt_txn_scan `fast`)
+        against the funk lamports cache, skipping the per-txn overlay
+        machinery.  Semantics are EXACTLY execute_txn's for this txn
+        class (fee-then-execute, failed transfer keeps the fee,
+        self-transfer no-op, dst account creation); any account that is
+        not a trivial system account falls back to execute_txn.
+
+        This is the reference's answer to bank throughput, re-shaped: it
+        executes via a batched external engine rather than the tile's own
+        interpreter loop (fd_bank.c:100-104 fd_ext_bank_load_and_execute
+        _txns); here the "external engine" is the native scan + this
+        allocation-free loop over the shared lamports cache.
+
+        Returns (fees_collected, executed_cnt, failed_cnt)."""
+        funk = self.funk
+        # the lamports cache is coherent ONLY over the published root fork
+        # (funk invalidates it on every root mutation; writes into in-prep
+        # txns bypass that) — a forked executor runs uncached
+        cache = funk.lam_cache if self.xid == ROOT_XID else {}
+        rec_read = funk.rec_read
+        rec_write = funk.rec_write
+        xid = self.xid
+        from firedancer_tpu.flamenco.accounts import _HDR
+
+        hdr_pack = _HDR.pack
+        hdr_sz = _HDR.size
+        zero_check = self.features.active(
+            "system_transfer_zero_check", self.slot
+        )
+        ABSENT, NONTRIVIAL = -1, -2
+
+        def lam_of(key: bytes) -> int:
+            v = cache.get(key)
+            if v is not None:
+                return v
+            raw = rec_read(xid, key)
+            if raw is None:
+                return ABSENT
+            if len(raw) != hdr_sz:
+                return NONTRIVIAL  # has data: not a trivial system acct
+            lam, owner, execu, rent = _HDR.unpack(raw)
+            if owner != SYSTEM_PROGRAM_ID or execu or rent:
+                return NONTRIVIAL
+            cache[key] = lam
+            return lam
+
+        def put(key: bytes, lam: int) -> None:
+            rec_write(xid, key, hdr_pack(lam, SYSTEM_PROGRAM_ID, 0, 0))
+            cache[key] = lam
+
+        fees_total = 0
+        executed = 0
+        failed = 0
+        for t in range(len(payloads)):
+            p = payloads[t]
+            po, so, do = payer_offs[t], src_offs[t], dst_offs[t]
+            payer = p[po : po + 32]
+            fee = fees[t]
+            amt = amounts[t]
+            pl = lam_of(payer)
+            if pl == NONTRIVIAL:
+                r = self.execute_txn(p)
+                fees_total += r.fee
+                executed += 1
+                failed += not r.ok
+                continue
+            if pl < fee:  # ABSENT or underfunded: txn rejected, no fee
+                failed += 1
+                executed += 1
+                continue
+            executed += 1
+            fees_total += fee
+            # per-txn mini-overlay: duplicate keys (dst aliasing the
+            # payer, etc.) must observe earlier writes exactly like the
+            # slow path's sequential load/store sequence
+            vals: dict = {payer: pl - fee}
+            src = payer if so == po else p[so : so + 32]
+            sl = vals.get(src)
+            if sl is None:
+                sl = lam_of(src)
+            if sl == NONTRIVIAL:
+                # fall back BEFORE committing (execute_txn redoes the fee)
+                fees_total -= fee
+                r = self.execute_txn(p)
+                fees_total += r.fee
+                failed += not r.ok
+                continue
+            if sl == ABSENT:
+                # missing source: pre-feature a 0-lamport transfer is a
+                # silent no-op; post-feature it is "insufficient funds"
+                if not (amt == 0 and not zero_check):
+                    failed += 1
+                put(payer, pl - fee)  # fee kept, transfer rolled back
+                continue
+            if sl < amt:
+                failed += 1
+                put(payer, pl - fee)
+                continue
+            dst = p[do : do + 32]
+            if src == dst:
+                put(payer, pl - fee)  # self-transfer no-op; fee applies
+                continue
+            vals[src] = sl - amt
+            dl = vals.get(dst)
+            if dl is None:
+                dl = lam_of(dst)
+            if dl == NONTRIVIAL:
+                # dst holds data/another owner: credit the full record
+                # via the account manager, commit the rest as trivials
+                a = self.mgr.load(dst)
+                a.lamports += amt
+                for k, v in vals.items():
+                    put(k, v)
+                self.mgr.store(dst, a)
+                continue
+            if dl == ABSENT:
+                dl = 0
+            vals[dst] = dl + amt
+            for k, v in vals.items():
+                put(k, v)
+        return fees_total, executed, failed
+
     # ---- dispatch -------------------------------------------------------
 
     def _dispatch(self, prog_key, data, ins_keys, ctx: InstrCtx, load, store,

@@ -47,6 +47,14 @@ class Funk:
     def __init__(self):
         self.root: dict[bytes, bytes] = {}
         self.txns: dict[bytes, _Txn] = {}
+        #: decoded-lamports cache over PUBLISHED (root) records holding
+        #: trivial system accounts (the bank's fast transfer path fills
+        #: and reads it, flamenco/runtime.py execute_fast_transfers).
+        #: Coherence rule: every root mutation below invalidates the
+        #: touched key, so a cached entry is always the decode of the
+        #: live root record; fast executors on unpublished forks run
+        #: uncached (their reads/writes never touch this dict).
+        self.lam_cache: dict[bytes, int] = {}
 
     # ---- transactions ---------------------------------------------------
 
@@ -107,6 +115,7 @@ class Funk:
                     self.root.pop(k, None)
                 else:
                     self.root[k] = v
+                self.lam_cache.pop(k, None)
         # surviving children of xid re-parent to root
         survivors = list(self.txns[xid].children)
         for child in survivors:
@@ -121,6 +130,7 @@ class Funk:
         if xid == ROOT_XID:
             assert not self.txn_is_frozen(ROOT_XID), "root frozen"
             self.root[key] = val
+            self.lam_cache.pop(key, None)
             return
         assert not self.txn_is_frozen(xid), "txn frozen (has children)"
         self.txns[xid].recs[key] = val
@@ -129,6 +139,7 @@ class Funk:
         if xid == ROOT_XID:
             assert not self.txn_is_frozen(ROOT_XID), "root frozen"
             self.root.pop(key, None)
+            self.lam_cache.pop(key, None)
             return
         assert not self.txn_is_frozen(xid)
         self.txns[xid].recs[key] = _TOMBSTONE

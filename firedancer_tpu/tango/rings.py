@@ -31,7 +31,11 @@ _HERE = Path(__file__).parent
 def _load() -> ct.CDLL:
     so = cbuild.build(
         "fdt_tango",
-        [_HERE / "native" / "fdt_tango.c", _HERE / "native" / "fdt_sha512.c"],
+        [
+            _HERE / "native" / "fdt_tango.c",
+            _HERE / "native" / "fdt_sha512.c",
+            _HERE / "native" / "fdt_pack.c",
+        ],
     )
     lib = ct.CDLL(str(so))
     u64, u32, u16, i32, vp = (
@@ -79,6 +83,39 @@ def _load() -> ct.CDLL:
             u64,
             [vp, vp, vp, u64, u64, vp, u64, vp, vp, vp, vp, vp, vp, vp, vp],
         ),
+        "fdt_pack_init_consts": (None, [vp, vp, vp, vp, ct.c_int64]),
+        "fdt_txn_scan": (
+            ct.c_int64,
+            [vp, ct.c_int64, ct.c_int64, vp, ct.c_int64, ct.c_int64]
+            + [vp] * 12
+            + [vp, vp, vp, vp, ct.c_int64, vp, ct.c_int64, vp],
+        ),
+        "fdt_pack_select": (
+            ct.c_int64,
+            [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             vp, vp, vp, vp, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             ct.c_int64, ct.c_int64, ct.c_int64, vp, vp],
+        ),
+        "fdt_pack_release": (
+            None,
+            [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, vp, vp],
+        ),
+        "fdt_mb_encode": (
+            ct.c_int64,
+            [vp, ct.c_int64, vp, vp, ct.c_int64, u32, u32, vp, ct.c_int64],
+        ),
+        "fdt_mb_decode": (
+            ct.c_int64,
+            [vp, ct.c_int64, vp, ct.c_int64, vp, ct.c_int64],
+        ),
+        "fdt_udp_recv_burst": (
+            ct.c_int64,
+            [i32, vp, ct.c_int64, vp, ct.c_int64, ct.c_int64],
+        ),
+        "fdt_udp_send_burst": (
+            ct.c_int64,
+            [i32, vp, ct.c_int64, vp, ct.c_int64, vp],
+        ),
         "fdt_sha512_init_consts": (None, [vp, vp]),
         "fdt_sha512_rpm": (None, [vp, vp, vp, u64, vp]),
         "fdt_sha512_batch": (None, [vp, vp, u64, u64, vp]),
@@ -94,6 +131,23 @@ def _load() -> ct.CDLL:
     k = np.array(K64, dtype=np.uint64)
     h = np.array(H64, dtype=np.uint64)
     lib.fdt_sha512_init_consts(k.ctypes.data, h.ctypes.data)
+    # inject the pack cost-model consensus constants (the Python tables in
+    # ballet/compute_budget.py stay authoritative; C never duplicates them)
+    from firedancer_tpu.ballet import compute_budget as _CB
+    from firedancer_tpu.ballet.base58 import decode_32 as _b58d
+
+    pids = np.frombuffer(
+        b"".join(_CB.BUILTIN_COSTS.keys()), np.uint8
+    ).copy()
+    costs = np.array(list(_CB.BUILTIN_COSTS.values()), np.uint64)
+    cb = np.frombuffer(_CB.COMPUTE_BUDGET_PROGRAM_ID, np.uint8).copy()
+    vote = np.frombuffer(
+        _b58d("Vote111111111111111111111111111111111111111"), np.uint8
+    ).copy()
+    lib.fdt_pack_init_consts(
+        cb.ctypes.data, vote.ctypes.data, pids.ctypes.data,
+        costs.ctypes.data, len(costs),
+    )
     return lib
 
 

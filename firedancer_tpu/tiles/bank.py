@@ -6,12 +6,16 @@ fd_ext_bank_load_and_execute_txns, fd_bank.c:100-104), flags itself free
 through the busy fseq, and forwards the executed microblock to the poh
 tile for mixin.
 
-Execution runs the flamenco runtime (flamenco/runtime.py: fee collection,
-system program, sBPF programs via the VM) against a funk account store
-when one is provided; without a funk the tile falls back to fee-only
-accounting (the round-1 stub, kept for plumbing-only tests).  Completion
-travels as a frag on the bank→pack ring (sig = bank<<32 | handle); the
-executed microblock is forwarded on the bank→poh ring.
+Execution is BATCHED: one native call (fdt_mb_decode + fdt_txn_scan)
+parses and classifies the whole microblock, the dominant txn class
+(simple system transfers) executes through the runtime's allocation-free
+fast path over the funk lamports cache
+(flamenco/runtime.py execute_fast_transfers), and only the remainder
+walks the general per-txn executor.  That is this build's analog of the
+reference never executing in the tile's own interpreter loop.
+
+Completion travels as a frag on the bank→pack ring (sig = bank<<32 |
+handle); the executed microblock is forwarded on the bank→poh ring.
 """
 
 from __future__ import annotations
@@ -19,11 +23,11 @@ from __future__ import annotations
 import numpy as np
 
 from firedancer_tpu.ballet import compute_budget as CB
+from firedancer_tpu.ballet import pack as P
 from firedancer_tpu.ballet import txn as T
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
-
-from . import pack as packtile
+from firedancer_tpu.tango import rings as R
 
 
 def execute_txns(txns: list[np.ndarray]) -> int:
@@ -46,6 +50,7 @@ class BankTile(Tile):
             "executed_microblocks",
             "executed_txns",
             "failed_txns",
+            "fast_txns",
             "fees_lamports",
         ),
     )
@@ -55,6 +60,9 @@ class BankTile(Tile):
         self.name = name or f"bank{bank_id}"
         self.funk = funk
         self._executor = None
+        # native-decode scratch (grown on demand)
+        self._srows = np.zeros((256, T.MTU), np.uint8)
+        self._sszs = np.zeros(256, np.uint32)
 
     def on_boot(self, ctx: MuxCtx) -> None:
         if self.funk is not None:
@@ -65,30 +73,70 @@ class BankTile(Tile):
             # slot start so programs can read them like any account
             self._executor.begin_slot(0)
 
+    def _decode(self, buf: np.ndarray):
+        """Native microblock decode -> (rows view, szs view) scratch."""
+        n = int(buf[6:8].view("<u2")[0])
+        if n > len(self._sszs):
+            cap = 1 << (n - 1).bit_length()
+            self._srows = np.zeros((cap, T.MTU), np.uint8)
+            self._sszs = np.zeros(cap, np.uint32)
+        got = R._lib.fdt_mb_decode(
+            np.ascontiguousarray(buf).ctypes.data, len(buf),
+            self._srows.ctypes.data, self._srows.shape[1],
+            self._sszs.ctypes.data, len(self._sszs),
+        )
+        assert got == n, "malformed microblock from pack"
+        return self._srows[:n], self._sszs[:n]
+
+    def _execute(self, ctx: MuxCtx, rows: np.ndarray, szs: np.ndarray) -> int:
+        """Execute one decoded microblock; returns fees collected."""
+        ex = self._executor
+        n = len(rows)
+        if ex is None:
+            return execute_txns([rows[i, : szs[i]] for i in range(n)])
+        scan = P.txn_scan(rows, szs)
+        fast_idx = np.flatnonzero(scan.fast)
+        fees = 0
+        if len(fast_idx):
+            payloads = [rows[i, : szs[i]].tobytes() for i in fast_idx]
+            f, executed, failed = ex.execute_fast_transfers(
+                payloads,
+                scan.fee[fast_idx].tolist(),
+                scan.lamports[fast_idx].tolist(),
+                scan.payer_off[fast_idx].tolist(),
+                scan.src_off[fast_idx].tolist(),
+                scan.dst_off[fast_idx].tolist(),
+            )
+            fees += f
+            ctx.metrics.inc("fast_txns", len(fast_idx))
+            if failed:
+                ctx.metrics.inc("failed_txns", failed)
+        slow_idx = np.flatnonzero(~scan.fast.astype(bool))
+        for i in slow_idx:
+            # one malformed txn must not take the bank down: record it as
+            # failed and keep executing the microblock
+            try:
+                res = ex.execute_txn(rows[i, : szs[i]].tobytes())
+            except Exception:
+                ctx.metrics.inc("failed_txns")
+                continue
+            fees += res.fee
+            if not res.ok:
+                ctx.metrics.inc("failed_txns")
+        return fees
+
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
         rows = il.gather(frags)
         for i in range(len(rows)):
             buf = rows[i, : frags["sz"][i]]
-            handle, bank, txns = packtile.mb_decode(buf)
+            handle = int(buf[0:4].view("<u4")[0])
+            bank = int(buf[4:6].view("<u2")[0])
             assert bank == self.bank_id
-            if self._executor is not None:
-                fees = 0
-                for t in txns:
-                    # one malformed txn must not take the bank down: record
-                    # it as failed and keep executing the microblock
-                    try:
-                        res = self._executor.execute_txn(bytes(t))
-                    except Exception:
-                        ctx.metrics.inc("failed_txns")
-                        continue
-                    fees += res.fee
-                    if not res.ok:
-                        ctx.metrics.inc("failed_txns")
-            else:
-                fees = execute_txns(txns)
+            trows, tszs = self._decode(buf)
+            fees = self._execute(ctx, trows, tszs)
             ctx.metrics.inc("executed_microblocks")
-            ctx.metrics.inc("executed_txns", len(txns))
+            ctx.metrics.inc("executed_txns", len(trows))
             ctx.metrics.inc("fees_lamports", fees)
             tag = np.array([(bank << 32) | handle], dtype=np.uint64)
             # forward to poh first, then free the bank at pack

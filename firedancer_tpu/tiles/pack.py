@@ -6,9 +6,18 @@ the microblock cadence (<= 2ms, MICROBLOCK_DURATION_NS fd_pack.c:26) has
 elapsed, emits fd_pack_schedule_next_microblock's output to that bank's
 ring and tracks completion via the bank-busy backchannel.
 
-Here the engine is ballet/pack.Pack (dense-array scheduler + optional TPU
-prefilter) and the completion backchannel is a reliable bank→pack ring
-carrying (bank, handle) frags.
+Here the engine is ballet/pack.Pack (dense-array scheduler backed by the
+native fdt_pack.c hot paths + optional TPU prefilter) and the completion
+backchannel is a reliable bank→pack ring carrying (bank, handle) frags.
+Ingress inserts are BATCHED: one fdt_txn_scan over the drained frag batch
+then a vectorized slot scatter — no per-txn Python on the hot path.
+
+Divergence from the reference, by design: `mb_inflight` microblocks may
+be outstanding per bank (the reference keeps one per bank tile and relies
+on dedicated cores; on a shared-core host the pack→bank→pack round-trip
+latency is scheduling-bound, so pipelining depth — not parallel cores —
+is what keeps the banks saturated).  Account locks are held per
+microblock exactly as in the reference, so conflict safety is unchanged.
 
 Microblock wire format (one frag per microblock on the pack_bank link):
     [ u32 handle | u16 bank | u16 txn_cnt | txn_cnt * ( u16 sz | sz bytes ) ]
@@ -23,6 +32,7 @@ import numpy as np
 from firedancer_tpu.ballet import pack as P
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.tango import rings as R
 
 from . import wire
 
@@ -30,19 +40,25 @@ MICROBLOCK_DURATION_NS = 2_000_000  # reference cadence: fd_pack.c:26
 MB_HDR = 8
 
 
-def mb_encode(handle: int, bank: int, rows: np.ndarray, szs: np.ndarray) -> np.ndarray:
-    n = len(szs)
-    total = MB_HDR + int(szs.sum()) + 2 * n
+def mb_encode(
+    handle: int, bank: int, rows: np.ndarray, szs: np.ndarray,
+    idx: np.ndarray | None = None,
+) -> np.ndarray:
+    """Native microblock encode.  idx selects rows (e.g. pool slots);
+    None encodes every row in order."""
+    szs16 = np.ascontiguousarray(szs, np.uint16)
+    if idx is None:
+        idx = np.arange(len(szs16), dtype=np.int64)
+    idx = np.ascontiguousarray(idx, np.int64)
+    n = len(idx)
+    total = MB_HDR + int(szs16[idx].sum()) + 2 * n
     out = np.zeros(total, dtype=np.uint8)
-    out[0:4].view("<u4")[0] = handle
-    out[4:6].view("<u2")[0] = bank
-    out[6:8].view("<u2")[0] = n
-    off = MB_HDR
-    for i in range(n):
-        sz = int(szs[i])
-        out[off : off + 2].view("<u2")[0] = sz
-        out[off + 2 : off + 2 + sz] = rows[i, :sz]
-        off += 2 + sz
+    got = R._lib.fdt_mb_encode(
+        np.ascontiguousarray(rows).ctypes.data, rows.shape[1],
+        szs16.ctypes.data, idx.ctypes.data, n, handle, bank,
+        out.ctypes.data, total,
+    )
+    assert got == total
     return out
 
 
@@ -81,6 +97,7 @@ class PackTile(Tile):
         depth: int = 4096,
         cu_limit: int = 1_500_000,
         txn_limit: int = 31,
+        mb_inflight: int = 1,
         microblock_ns: int = MICROBLOCK_DURATION_NS,
         slot_ns: int = 400_000_000,
         use_device_select: bool = False,
@@ -91,15 +108,20 @@ class PackTile(Tile):
         (fd_pack_end_block); this tile approximates the slot clock with
         wall time at the mainnet slot duration — without the rollover the
         48M-CU block budget is consumed exactly once and scheduling
-        stops forever."""
+        stops forever.
+
+        mb_inflight: outstanding microblocks per bank (pipelining depth;
+        see the module docstring)."""
         self.name = name
         self.n_banks = n_banks
         self.cu_limit = cu_limit
         self.txn_limit = txn_limit
+        self.mb_inflight = mb_inflight
         self.microblock_ns = microblock_ns
         self.slot_ns = slot_ns
         self.engine = P.Pack(depth, max_banks=n_banks)
-        self.bank_free = [True] * n_banks
+        self.bank_busy = [0] * n_banks
+        self._byte_limit = 0  # derived from the out-ring MTU at boot
         self._last_mb_ns = 0
         self._block_started_ns = 0
         self._dev_select = None
@@ -108,16 +130,28 @@ class PackTile(Tile):
 
             self._dev_select = pack_select.select_noconflict
 
+    def on_boot(self, ctx: MuxCtx) -> None:
+        if ctx.outs and ctx.outs[0].dcache is not None:
+            # the encoded microblock must fit one frag on the bank ring
+            # (frag sz is u16): headroom below both the dcache MTU and
+            # the meta field's ceiling
+            self._byte_limit = min(ctx.outs[0].dcache.mtu, 0xFFFF) - MB_HDR
+
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         if in_idx == 0:
             il = ctx.ins[0]
             rows = il.gather(frags)
-            tr = wire.parse_trailers(rows, frags["sz"].astype(np.int64))
-            n_ok = 0
-            for i in range(len(rows)):
-                payload = bytes(rows[i, : tr["txn_sz"][i]])
-                if self.engine.insert(payload, sig_tag=int(frags["sig"][i])) == "ok":
-                    n_ok += 1
+            # payload sizes: frag sz minus the 16-byte wire trailer
+            szs = frags["sz"].astype(np.int64) - wire.TRAILER_SZ
+            scan = P.txn_scan(
+                rows, np.maximum(szs, 0).astype(np.uint32),
+                nbits=self.engine.nbits, with_bitsets=True,
+            )
+            # dedup tags ride the frag sig field; keep them as sig_tag
+            scan.tags[:] = frags["sig"]
+            n_ok = self.engine.insert_batch(
+                rows, np.maximum(szs, 0).astype(np.uint32), scan=scan
+            )
             ctx.metrics.inc("inserted_txns", n_ok)
             if n_ok != len(rows):
                 ctx.metrics.inc("insert_rejected", len(rows) - n_ok)
@@ -127,7 +161,7 @@ class PackTile(Tile):
                 bank = int(sig) >> 32
                 handle = int(sig) & 0xFFFFFFFF
                 self.engine.microblock_complete(bank, handle)
-                self.bank_free[bank] = True
+                self.bank_busy[bank] -= 1
                 ctx.metrics.inc("completions")
 
     def after_credit(self, ctx: MuxCtx) -> None:
@@ -146,27 +180,31 @@ class PackTile(Tile):
         if now - self._last_mb_ns < self.microblock_ns:
             return
         for bank in range(self.n_banks):
-            if not self.bank_free[bank]:
+            if self.bank_busy[bank] >= self.mb_inflight:
+                continue
+            out = ctx.outs[bank]
+            if out.cr_avail() < 1:
                 continue
             mb = self.engine.schedule_microblock(
                 bank,
                 cu_limit=self.cu_limit,
                 txn_limit=self.txn_limit,
+                byte_limit=self._byte_limit,
                 device_select=self._dev_select,
             )
             if mb is None:
                 continue
+            # encode straight from the pool (no row gather copy)
             idx = mb.txn_idx
             payload = mb_encode(
-                mb.handle, bank, self.engine.rows[idx], self.engine.szs[idx]
+                mb.handle, bank, self.engine.rows, self.engine.szs, idx=idx
             )
-            out = ctx.outs[bank]
             out.publish(
                 np.array([(bank << 32) | mb.handle], dtype=np.uint64),
                 payload[None, :],
                 np.array([len(payload)], dtype=np.uint16),
             )
-            self.bank_free[bank] = False
+            self.bank_busy[bank] += 1
             self._last_mb_ns = now
             ctx.metrics.inc("microblocks")
             ctx.metrics.inc("microblock_txns", len(idx))

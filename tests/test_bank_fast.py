@@ -1,0 +1,192 @@
+"""Equivalence tests: the bank's batched fast-transfer path vs the
+general executor (flamenco/runtime.py execute_fast_transfers must be
+observationally identical to execute_txn for the scan-classified
+`fast` txn class — including fee-failure, aliasing, account-creation
+and nontrivial-destination edges)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import pack as P
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import (
+    Account, AccountMgr, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.flamenco.runtime import Executor
+from firedancer_tpu.funk.funk import Funk
+
+
+def _key(rng):
+    return bytes(rng.integers(0, 256, 32, np.uint8))
+
+
+def _xfer(payer, dest, amount, extra_ro=()):
+    data = (2).to_bytes(4, "little") + amount.to_bytes(8, "little")
+    return T.build(
+        [bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID, *extra_ro], bytes(32),
+        [(2, [0, 1], data)], readonly_unsigned_cnt=1 + len(extra_ro),
+    )
+
+
+def _self_xfer(payer, amount):
+    data = (2).to_bytes(4, "little") + amount.to_bytes(8, "little")
+    return T.build(
+        [bytes(64)], [payer, SYSTEM_PROGRAM_ID], bytes(32),
+        [(1, [0, 0], data)],
+        readonly_unsigned_cnt=1,
+    )
+
+
+def _run_both(txns, funding):
+    """Execute txns via the fast path and via execute_txn on twin funks;
+    return both account snapshots + (fees, executed, failed) tuples."""
+    outs = []
+    for mode in ("fast", "slow"):
+        funk = Funk()
+        mgr = AccountMgr(funk)
+        for k, acct in funding.items():
+            mgr.store(k, acct)
+        ex = Executor(funk)
+        ex.begin_slot(0)
+        fees = executed = failed = 0
+        if mode == "fast":
+            width = max(len(t) for t in txns)
+            rows = np.zeros((len(txns), width), np.uint8)
+            szs = np.zeros(len(txns), np.uint32)
+            for i, t in enumerate(txns):
+                rows[i, : len(t)] = np.frombuffer(t, np.uint8)
+                szs[i] = len(t)
+            scan = P.txn_scan(rows, szs)
+            assert scan.ok.all() and scan.fast.all(), "not fast-class txns"
+            fees, executed, failed = ex.execute_fast_transfers(
+                txns,
+                scan.fee.tolist(),
+                scan.lamports.tolist(),
+                scan.payer_off.tolist(),
+                scan.src_off.tolist(),
+                scan.dst_off.tolist(),
+            )
+        else:
+            for t in txns:
+                r = ex.execute_txn(t)
+                fees += r.fee
+                executed += 1
+                failed += not r.ok
+        snap = {
+            k: (a.lamports, a.owner, a.data)
+            for k, a in (
+                (k, AccountMgr(funk).load(k))
+                for k in funk.root.keys()
+            )
+            if a is not None
+        }
+        outs.append((snap, (fees, executed, failed)))
+    return outs
+
+
+def test_fast_matches_slow_basic_and_edges():
+    rng = np.random.default_rng(31)
+    payer1, payer2, payer3 = _key(rng), _key(rng), _key(rng)
+    dest1, dest2 = _key(rng), _key(rng)
+    poor = _key(rng)
+    funding = {
+        payer1: Account(10_000_000),
+        payer2: Account(10_000_000),
+        payer3: Account(10_000_000),
+        poor: Account(5_100),  # covers fee, not fee+amount
+    }
+    txns = [
+        _xfer(payer1, dest1, 1234),           # plain transfer, new dest
+        _xfer(payer2, dest1, 99),             # credit existing dest
+        _xfer(payer3, payer1, 777),           # dest aliases another payer
+        _xfer(poor, dest2, 1_000_000),        # insufficient: fee-only
+        _self_xfer(payer1, 50),               # self-transfer no-op
+        _xfer(payer1, dest2, 0),              # zero-lamport transfer
+    ]
+    (fast_snap, fast_stats), (slow_snap, slow_stats) = _run_both(
+        txns, funding
+    )
+    assert fast_stats == slow_stats
+    assert fast_snap == slow_snap
+
+
+def test_fast_fee_failure_no_debit():
+    rng = np.random.default_rng(33)
+    broke = _key(rng)
+    dest = _key(rng)
+    funding = {broke: Account(4_999)}  # below the 5000 fee
+    (fast_snap, fast_stats), (slow_snap, slow_stats) = _run_both(
+        [_xfer(broke, dest, 1)], funding
+    )
+    assert fast_stats == slow_stats == (0, 1, 1)
+    assert fast_snap == slow_snap
+    assert fast_snap[broke][0] == 4_999  # untouched
+
+
+def test_fast_nontrivial_dest_keeps_record():
+    rng = np.random.default_rng(35)
+    payer = _key(rng)
+    prog_owned = _key(rng)
+    owner = _key(rng)
+    funding = {
+        payer: Account(1_000_000),
+        prog_owned: Account(500, owner, False, 0, b"hello"),
+    }
+    (fast_snap, fast_stats), (slow_snap, slow_stats) = _run_both(
+        [_xfer(payer, prog_owned, 250)], funding
+    )
+    assert fast_stats == slow_stats
+    assert fast_snap == slow_snap
+    assert fast_snap[prog_owned] == (750, owner, b"hello")
+
+
+def test_fast_sequential_dependency_within_batch():
+    """txn 2 spends lamports that only exist because txn 1 landed —
+    the fast path must observe its own earlier writes."""
+    rng = np.random.default_rng(37)
+    a, b, c = _key(rng), _key(rng), _key(rng)
+    funding = {a: Account(1_000_000), b: Account(10_000)}
+    txns = [
+        _xfer(a, b, 500_000),
+        _xfer(b, c, 490_000),  # only affordable after txn 1
+    ]
+    (fast_snap, fast_stats), (slow_snap, slow_stats) = _run_both(
+        txns, funding
+    )
+    assert fast_stats == slow_stats == (10_000, 2, 0)
+    assert fast_snap == slow_snap
+    assert fast_snap[c][0] == 490_000
+
+
+def test_lam_cache_coherence_with_slow_writes():
+    """A slow-path write to a fast-cached account must invalidate the
+    cache (funk root writes pop lam_cache)."""
+    rng = np.random.default_rng(39)
+    payer, dest = _key(rng), _key(rng)
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    mgr.store(payer, Account(1_000_000))
+    ex = Executor(funk)
+    ex.begin_slot(0)
+    tx = _xfer(payer, dest, 100)
+    rows = np.zeros((1, len(tx)), np.uint8)
+    rows[0] = np.frombuffer(tx, np.uint8)
+    scan = P.txn_scan(rows, np.array([len(tx)], np.uint32))
+    ex.execute_fast_transfers(
+        [tx], scan.fee.tolist(), scan.lamports.tolist(),
+        scan.payer_off.tolist(), scan.src_off.tolist(),
+        scan.dst_off.tolist(),
+    )
+    assert funk.lam_cache[payer] == 1_000_000 - 5000 - 100
+    # now a general executor path rewrites the payer
+    mgr.store(payer, Account(42))
+    assert payer not in funk.lam_cache
+    assert mgr.load(payer).lamports == 42
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

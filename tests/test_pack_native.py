@@ -1,0 +1,344 @@
+"""Differential tests for the native pack/txn hot paths (fdt_pack.c).
+
+The authoritative txn-parse spec of this build is ballet/txn.py (itself a
+re-statement of fd_txn_parse's validation rules).  fdt_txn_scan must agree
+with it — and with ballet/compute_budget.estimate — on EVERY input, so
+this suite runs randomized differentials plus byte-mutation fuzzing, and
+exercises the native select/release/codec/mmsg paths.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import compute_budget as CB
+from firedancer_tpu.ballet import pack as P
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import SYSTEM_PROGRAM_ID
+from firedancer_tpu.tango import rings as R
+from firedancer_tpu.tiles import wire
+from firedancer_tpu.tiles.pack import mb_decode, mb_encode
+
+
+def _scan(payloads: list[bytes]):
+    width = max(len(p) for p in payloads) + 16
+    rows = np.zeros((len(payloads), width), np.uint8)
+    szs = np.zeros(len(payloads), np.uint32)
+    for i, p in enumerate(payloads):
+        rows[i, : len(p)] = np.frombuffer(p, np.uint8)
+        szs[i] = len(p)
+    return (
+        P.txn_scan(rows, szs, nbits=1024, with_bitsets=True,
+                   with_trailer=True),
+        rows,
+        szs,
+    )
+
+
+def _rand_txn(rng) -> bytes:
+    """A structurally valid random txn via the builder."""
+    n_sig = int(rng.integers(1, 4))
+    n_extra = int(rng.integers(1, 6))
+    accts = [bytes(rng.integers(0, 256, 32, np.uint8))
+             for _ in range(n_sig + n_extra)]
+    if rng.random() < 0.3:
+        accts[int(rng.integers(1, len(accts)))] = CB.COMPUTE_BUDGET_PROGRAM_ID
+    if rng.random() < 0.3:
+        accts[int(rng.integers(1, len(accts)))] = SYSTEM_PROGRAM_ID
+    if rng.random() < 0.2:
+        accts[int(rng.integers(1, len(accts)))] = P.VOTE_PROGRAM_ID
+    n_ins = int(rng.integers(0, 4))
+    instrs = []
+    for _ in range(n_ins):
+        pid = int(rng.integers(1, len(accts)))
+        n_a = int(rng.integers(0, min(4, len(accts))))
+        idxs = [int(rng.integers(0, len(accts))) for _ in range(n_a)]
+        dsz = int(rng.integers(0, 24))
+        data = bytes(rng.integers(0, 256, dsz, np.uint8)) if dsz else b""
+        if rng.random() < 0.4:
+            # plausible system-transfer-shaped data
+            data = (2).to_bytes(4, "little") + int(
+                rng.integers(0, 1 << 40)
+            ).to_bytes(8, "little")
+        if rng.random() < 0.3 and accts[pid] == CB.COMPUTE_BUDGET_PROGRAM_ID:
+            kind = int(rng.integers(0, 5))
+            body = {0: 8, 1: 4, 2: 4, 3: 8}.get(kind, 4)
+            data = bytes([kind]) + bytes(
+                rng.integers(0, 256, body, np.uint8)
+            )
+        instrs.append((pid, idxs, data))
+    ro_signed = int(rng.integers(0, n_sig))
+    ro_unsigned = int(rng.integers(0, n_extra))
+    version = T.V0 if rng.random() < 0.3 else T.VLEGACY
+    tables = []
+    if version == T.V0 and rng.random() < 0.5:
+        tables = [
+            (
+                bytes(rng.integers(0, 256, 32, np.uint8)),
+                [int(rng.integers(0, 4))],
+                [int(rng.integers(0, 4))],
+            )
+        ]
+    return T.build(
+        [bytes(rng.integers(0, 256, 64, np.uint8)) for _ in range(n_sig)],
+        accts,
+        bytes(rng.integers(0, 256, 32, np.uint8)),
+        instrs,
+        readonly_signed_cnt=ro_signed,
+        readonly_unsigned_cnt=ro_unsigned,
+        version=version,
+        address_tables=tables,
+    )
+
+
+def _py_verdict(p: bytes):
+    """(ok, cost, rewards, is_vote, writable_hashes) per the Python spec."""
+    d = T.parse(p)
+    if d is None:
+        return False, 0, 0, False, []
+    est = CB.estimate(p, d)
+    if not est.ok or est.cost == 0:
+        return False, 0, 0, False, []
+    wh = [P._hash_acct(bytes(d.acct_addr(p, j))) for j in d.writable_idxs()]
+    return True, est.cost, min(est.rewards, (1 << 32) - 1), \
+        P.is_simple_vote(p, d), wh
+
+
+def test_scan_differential_valid():
+    rng = np.random.default_rng(7)
+    payloads = [_rand_txn(rng) for _ in range(400)]
+    scan, rows, szs = _scan(payloads)
+    for i, p in enumerate(payloads):
+        ok, cost, rewards, is_vote, wh = _py_verdict(p)
+        assert bool(scan.ok[i]) == ok, (i, p.hex())
+        if not ok:
+            continue
+        assert int(scan.cost[i]) == cost
+        assert int(scan.rewards[i]) == rewards or (
+            int(scan.rewards[i]) >= (1 << 32) - 1 and rewards >= (1 << 32) - 1
+        )
+        assert bool(scan.is_vote[i]) == is_vote
+        assert int(scan.w_cnt[i]) == len(wh)
+        assert list(scan.whash[i][: len(wh)]) == wh
+        assert int(scan.tags[i]) == int.from_bytes(p[1:9], "little")
+        d = T.parse(p)
+        assert scan.trows[i, : scan.tszs[i]].tobytes() == \
+            wire.append_trailer(p, d)
+
+
+def test_scan_differential_mutated():
+    rng = np.random.default_rng(11)
+    payloads = []
+    for _ in range(300):
+        p = bytearray(_rand_txn(rng))
+        n_mut = int(rng.integers(1, 4))
+        for _ in range(n_mut):
+            kind = rng.random()
+            if kind < 0.5 and len(p) > 1:
+                p[int(rng.integers(0, len(p)))] = int(rng.integers(0, 256))
+            elif kind < 0.75:
+                del p[int(rng.integers(0, len(p))):]
+            else:
+                p += bytes(rng.integers(0, 256, int(rng.integers(1, 8)),
+                                        np.uint8))
+        if not p:
+            p = bytearray(b"\x00")
+        payloads.append(bytes(p[: T.MTU]))
+    # pure garbage too
+    for _ in range(50):
+        payloads.append(
+            bytes(rng.integers(0, 256, int(rng.integers(1, 300)), np.uint8))
+        )
+    scan, _, _ = _scan(payloads)
+    for i, p in enumerate(payloads):
+        ok, cost, rewards, _, _ = _py_verdict(p)
+        assert bool(scan.ok[i]) == ok, (i, p.hex())
+        if ok:
+            assert int(scan.cost[i]) == cost
+
+
+def test_scan_fast_transfer_shape():
+    rng = np.random.default_rng(3)
+    payer = bytes(rng.integers(0, 256, 32, np.uint8))
+    dest = bytes(rng.integers(0, 256, 32, np.uint8))
+    bh = bytes(32)
+    xfer = (2).to_bytes(4, "little") + (999).to_bytes(8, "little")
+    plain = T.build([bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID], bh,
+                    [(2, [0, 1], xfer)], readonly_unsigned_cnt=1)
+    # with a compute-budget instruction alongside: still fast
+    cb_data = bytes([2]) + (50_000).to_bytes(4, "little")
+    with_cb = T.build(
+        [bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID,
+                      CB.COMPUTE_BUDGET_PROGRAM_ID], bh,
+        [(3, [], cb_data), (2, [0, 1], xfer)], readonly_unsigned_cnt=2,
+    )
+    # create_account: not fast
+    create = T.build(
+        [bytes(64), bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID], bh,
+        [(2, [0, 1], (0).to_bytes(4, "little") + bytes(48))],
+        readonly_unsigned_cnt=1,
+    )
+    # two transfers: not fast
+    two = T.build([bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID], bh,
+                  [(2, [0, 1], xfer), (2, [0, 1], xfer)],
+                  readonly_unsigned_cnt=1)
+    scan, rows, _ = _scan([plain, with_cb, create, two])
+    assert scan.ok.all()
+    assert list(scan.fast) == [1, 1, 0, 0]
+    for i in (0, 1):
+        p = [plain, with_cb][i]
+        d = T.parse(p)
+        assert int(scan.lamports[i]) == 999
+        assert int(scan.fee[i]) == 5000 * d.signature_cnt
+        so, do = int(scan.src_off[i]), int(scan.dst_off[i])
+        assert p[so:so + 32] == payer and p[do:do + 32] == dest
+        po = int(scan.payer_off[i])
+        assert p[po:po + 32] == payer
+
+
+def test_mb_codec_native_matches_python():
+    rng = np.random.default_rng(5)
+    n = 17
+    width = 300
+    rows = rng.integers(0, 256, (n, width), np.uint8)
+    szs = rng.integers(40, width, n).astype(np.uint16)
+    idx = np.arange(n, dtype=np.int64)
+    cap = 8 + int(szs.sum()) + 2 * n
+    out = np.zeros(cap, np.uint8)
+    got = R._lib.fdt_mb_encode(
+        rows.ctypes.data, width, szs.ctypes.data, idx.ctypes.data, n,
+        123, 4, out.ctypes.data, cap,
+    )
+    ref = mb_encode(123, 4, rows, szs)
+    assert got == len(ref)
+    assert out[:got].tobytes() == ref.tobytes()
+    # native decode round-trip
+    drows = np.zeros((n, width), np.uint8)
+    dszs = np.zeros(n, np.uint32)
+    cnt = R._lib.fdt_mb_decode(
+        out.ctypes.data, got, drows.ctypes.data, width, dszs.ctypes.data, n
+    )
+    assert cnt == n
+    handle, bank, txns = mb_decode(out[:got])
+    assert handle == 123 and bank == 4
+    for i in range(n):
+        assert dszs[i] == szs[i]
+        assert drows[i, : szs[i]].tobytes() == txns[i].tobytes()
+    # over-cap encode refuses
+    assert R._lib.fdt_mb_encode(
+        rows.ctypes.data, width, szs.ctypes.data, idx.ctypes.data, n,
+        1, 0, out.ctypes.data, cap // 2,
+    ) == -1
+
+
+def _acct(i: int) -> bytes:
+    return bytes([i]) + bytes(31)
+
+
+def test_select_byte_limit():
+    pk = P.Pack(64, max_banks=1)
+    rng = np.random.default_rng(9)
+    payer_keys = [bytes(rng.integers(0, 256, 32, np.uint8)) for _ in range(8)]
+    for pay in payer_keys:
+        dest = bytes(rng.integers(0, 256, 32, np.uint8))
+        tx = T.build(
+            [bytes(64)], [pay, dest, SYSTEM_PROGRAM_ID], bytes(32),
+            [(2, [0, 1], (2).to_bytes(4, "little") + (5).to_bytes(8, "little"))],
+            readonly_unsigned_cnt=1,
+        )
+        assert pk.insert(tx) == "ok"
+    sz = int(pk.szs[pk.state == 1][0])
+    # byte budget for exactly 3 txns
+    mb = pk.schedule_microblock(
+        0, cu_limit=10_000_000, txn_limit=31, byte_limit=3 * (sz + 2) + 1
+    )
+    assert mb is not None and len(mb.txn_idx) == 3
+
+
+def test_writer_cost_cap_hashed():
+    pk = P.Pack(64, max_banks=2)
+    hot = _acct(7)
+    rng = np.random.default_rng(13)
+    txs = []
+    for _ in range(4):
+        payer = bytes(rng.integers(0, 256, 32, np.uint8))
+        txs.append(
+            T.build(
+                [bytes(64)], [payer, hot, SYSTEM_PROGRAM_ID], bytes(32),
+                [(2, [0, 1],
+                  (2).to_bytes(4, "little") + (1).to_bytes(8, "little"))],
+                readonly_unsigned_cnt=1,
+            )
+        )
+    for tx in txs:
+        assert pk.insert(tx) == "ok"
+    per_cost = int(pk.cost[pk.state == 1][0])
+    pk.writer_cost_cap = per_cost * 2
+    mbs = []
+    # hot is writable in every txn: conflict rules allow only one per
+    # microblock, and the hashed writer cap stops the block at 2 total
+    for _ in range(4):
+        mb = pk.schedule_microblock(0, cu_limit=10_000_000)
+        if mb is None:
+            break
+        mbs.append(mb)
+        assert pk.writer_cost(hot) == per_cost * len(mbs)
+        pk.microblock_complete(0, mb.handle)
+    assert len(mbs) == 2
+    pk.end_block()
+    assert pk.writer_cost(hot) == 0
+    assert pk.schedule_microblock(0, cu_limit=10_000_000) is not None
+
+
+def test_udp_mmsg_burst_roundtrip():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.setblocking(False)
+    try:
+        port = rx.getsockname()[1]
+        n, width = 32, 128
+        rng = np.random.default_rng(21)
+        rows = rng.integers(0, 256, (n, width), np.uint8)
+        szs = (np.arange(n) % 64 + 32).astype(np.uint32)
+        addr = np.zeros(6, np.uint8)
+        addr[:4] = [127, 0, 0, 1]
+        addr[4] = port & 0xFF
+        addr[5] = port >> 8
+        sent = R._lib.fdt_udp_send_burst(
+            tx.fileno(), rows.ctypes.data, width, szs.ctypes.data, n,
+            addr.ctypes.data,
+        )
+        assert sent == n
+        import time
+
+        got_rows = np.zeros((n, width + 6), np.uint8)
+        got_szs = np.zeros(n, np.uint32)
+        got = 0
+        deadline = time.monotonic() + 2.0
+        while got < n and time.monotonic() < deadline:
+            r = R._lib.fdt_udp_recv_burst(
+                rx.fileno(),
+                got_rows[got:].ctypes.data, width + 6,
+                got_szs[got:].ctypes.data, n - got, width + 6,
+            )
+            got += r
+        assert got == n
+        for i in range(n):
+            assert got_szs[i] == szs[i] + 6
+            assert bytes(got_rows[i, :4]) == bytes([127, 0, 0, 1])
+            assert got_rows[i, 6 : 6 + szs[i]].tobytes() == \
+                rows[i, : szs[i]].tobytes()
+    finally:
+        rx.close()
+        tx.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
