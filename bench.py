@@ -211,10 +211,12 @@ def _bench_landed_tps() -> float:
 
     import os
 
-    pool_n = int(os.environ.get("FDT_BENCH_POOL", str(1 << 17)))
+    pool_n = int(os.environ.get("FDT_BENCH_POOL", str(1 << 19)))
     # payer diversity IS pack's schedulable parallelism: with N payers a
-    # microblock holds at most N non-conflicting transfers
-    rows, payers = make_transfer_pool(pool_n, seed=11)
+    # microblock holds at most N non-conflicting transfers — and with
+    # mb_inflight pipelining the payers locked by in-flight microblocks
+    # must still leave enough unlocked ones to fill the next
+    rows, payers = make_transfer_pool(pool_n, seed=11, n_signers=4096)
 
     rng = np.random.default_rng(3)
     identity = rng.integers(0, 256, 32, np.uint8).tobytes()
@@ -227,6 +229,11 @@ def _bench_landed_tps() -> float:
         'name = "fdtbench"\n'
         "[tiles.verify]\ncount = 1\nmax_lanes = 16384\nmsg_width = 256\n"
         "[tiles.bank]\ncount = 4\n"
+        # mb_inflight: the pack->bank->pack completion round trip is
+        # GIL-scheduling-bound (~tens of ms) on a shared-core host, so
+        # pipelining depth — not the per-bank 2 ms cadence — is what
+        # keeps the banks saturated (PROFILE.md round 5)
+        "[tiles.pack]\ndepth = 32768\nmb_inflight = 16\ntxn_limit = 256\n"
         "[tiles.poh]\nticks_per_slot = 1024\n"
         "[links]\ndepth = 32768\n"
     )
@@ -250,7 +257,7 @@ def _bench_landed_tps() -> float:
             # buffer absorbs the flow instead of burning the finite
             # pool as full-buffer rejects (see UdpBlaster docstring)
             blaster = UdpBlaster(
-                rows, udp_addr, burst=128, pace_s=0.002, window=16384
+                rows, udp_addr, burst=256, pace_s=0.002, window=24576
             ).start()
             t0 = time.perf_counter()
             deadline = t0 + 240.0
@@ -292,7 +299,7 @@ def _bench_landed_tps() -> float:
                     and now - t_last > 3.0
                 ):
                     break  # drained: no progress for 3 s after send end
-                time.sleep(0.25)
+                time.sleep(0.1)
             if t_first is None or t_last is None or t_last <= t_first:
                 return 0.0
             return (last_cnt - first_cnt) / (t_last - t_first)

@@ -46,6 +46,10 @@ class Config:
     link_depth: int = 1024
     bank_count: int = 2
     pack_device_select: bool = False
+    pack_depth: int = 4096
+    pack_mb_inflight: int = 1
+    pack_microblock_ns: int = 2_000_000
+    pack_txn_limit: int = 31
     ticks_per_slot: int = 64
     shred_version: int = 1
     metrics_port: int = 0
@@ -70,6 +74,16 @@ def parse(text: str) -> Config:
         link_depth=doc.get("links", {}).get("depth", 1024),
         bank_count=t.get("bank", {}).get("count", 2),
         pack_device_select=t.get("pack", {}).get("device_select", False),
+        pack_depth=t.get("pack", {}).get("depth", 4096),
+        pack_mb_inflight=t.get("pack", {}).get("mb_inflight", 1),
+        pack_microblock_ns=t.get("pack", {}).get(
+            "microblock_ns", 2_000_000
+        ),
+        # reference parity default is 31 txns (MAX_TXN_PER_MICROBLOCK);
+        # on shared-core hosts the effective microblock period is loop-
+        # scheduling bound (~10x the reference's 2 ms), so proportionally
+        # larger microblocks preserve the reference's duty cycle
+        pack_txn_limit=t.get("pack", {}).get("txn_limit", 31),
         ticks_per_slot=t.get("poh", {}).get("ticks_per_slot", 64),
         shred_version=t.get("shred", {}).get("version", 1),
         metrics_port=t.get("metric", {}).get("port", 0),
@@ -100,7 +114,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     from firedancer_tpu.tiles.store import StoreTile
     from firedancer_tpu.ballet import shred as SH
 
-    mb_mtu = 40_000
+    mb_mtu = 65_535
     depth = cfg.link_depth
     n = cfg.verify_count
     n_banks = cfg.bank_count
@@ -139,12 +153,22 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
         ins=[(f"verify{i}_dedup", True) for i in range(n)],
         outs=["dedup_pack"],
     )
+    # bank-facing ring depths must cover the pipelining depth (inflight
+    # microblocks per bank) with headroom for completion batching
+    bank_ring = 1 << max(64, 4 * cfg.pack_mb_inflight).bit_length()
     for i in range(n_banks):
-        topo.link(f"pack_bank{i}", depth=64, mtu=mb_mtu)
-        topo.link(f"bank{i}_pack", depth=64)
-        topo.link(f"bank{i}_poh", depth=64, mtu=mb_mtu)
+        topo.link(f"pack_bank{i}", depth=bank_ring, mtu=mb_mtu)
+        topo.link(f"bank{i}_pack", depth=bank_ring)
+        topo.link(f"bank{i}_poh", depth=bank_ring, mtu=mb_mtu)
     topo.tile(
-        PackTile(n_banks, use_device_select=cfg.pack_device_select),
+        PackTile(
+            n_banks,
+            use_device_select=cfg.pack_device_select,
+            depth=cfg.pack_depth,
+            mb_inflight=cfg.pack_mb_inflight,
+            microblock_ns=cfg.pack_microblock_ns,
+            txn_limit=cfg.pack_txn_limit,
+        ),
         ins=[("dedup_pack", True)]
         + [(f"bank{i}_pack", True) for i in range(n_banks)],
         outs=[f"pack_bank{i}" for i in range(n_banks)],

@@ -139,18 +139,26 @@ class HttpServer:
             if isinstance(body, (bytes, bytearray)):
                 conn.sendall(build_response(status, bytes(body), ctype))
             else:
-                # streamed body (iterator of byte chunks): close-framed
-                # response, O(chunk) memory on both ends
+                # streamed body (iterator of byte chunks): CHUNKED
+                # framing, O(chunk) memory on both ends.  Close-framing
+                # would make a mid-stream server failure look like a
+                # clean EOF to the client; the terminal 0-chunk is what
+                # lets get_stream distinguish truncation from success.
                 reason = {200: "OK"}.get(status, "OK")
                 conn.sendall(
                     (
                         f"HTTP/1.1 {status} {reason}\r\n"
                         f"Content-Type: {ctype}\r\n"
+                        f"Transfer-Encoding: chunked\r\n"
                         f"Connection: close\r\n\r\n"
                     ).encode("latin1")
                 )
                 for chunk in body:
-                    conn.sendall(chunk)
+                    if chunk:
+                        conn.sendall(
+                            f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                        )
+                conn.sendall(b"0\r\n\r\n")
         except OSError:
             pass
         finally:
@@ -205,6 +213,8 @@ def get_stream(addr: tuple[str, int], path: str, sink,
         for ln in lines[1:]:
             k, v = ln.split(":", 1)
             headers[k.strip().lower()] = v.strip()
+        if headers.get("transfer-encoding", "").lower() == "chunked":
+            return status, _read_chunked(s, rest, sink)
         want = int(headers["content-length"]) if "content-length" in headers else None
         n = 0
         if rest:
@@ -218,4 +228,37 @@ def get_stream(addr: tuple[str, int], path: str, sink,
             n += len(chunk)
         if want is not None and n != want:
             raise ValueError("short body")
+        # want is None: close-framed legacy body — length UNVERIFIED
+        # (our own streamed responses are chunked; only foreign servers
+        # reach this path)
         return status, n
+
+
+def _read_chunked(s, buf: bytes, sink) -> int:
+    """Decode a chunked body; raises on truncation (the framing is what
+    makes a mid-stream peer death detectable — the terminal 0-chunk
+    never arrives)."""
+    buf = bytearray(buf)
+    n = 0
+
+    def fill() -> None:
+        blk = s.recv(262144)
+        if not blk:
+            raise ValueError("connection closed mid-chunk")
+        buf.extend(blk)
+
+    while True:
+        while b"\r\n" not in buf:
+            fill()
+        line, _, rest = bytes(buf).partition(b"\r\n")
+        buf = bytearray(rest)
+        size = int(line.split(b";")[0], 16)
+        while len(buf) < size + 2:
+            fill()
+        if size == 0:
+            return n
+        sink(bytes(buf[:size]))
+        n += size
+        if buf[size : size + 2] != b"\r\n":
+            raise ValueError("bad chunk terminator")
+        del buf[: size + 2]

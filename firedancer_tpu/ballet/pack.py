@@ -50,10 +50,13 @@ MAX_WRITE_COST_PER_ACCT = 12_000_000
 FEE_PER_SIGNATURE = 5000
 MAX_BANK_TILES = 62
 
-#: static account addrs with an MTU payload cap out near 34; every static
-#: writable key's hash fits a 34-wide row (fdt_txn_scan truncates past
-#: this, which would under-enforce the writer cap — unreachable at MTU)
-MAX_WRITERS = 34
+#: max static writable keys an MTU payload can carry: 1232 - 65 (1 sig)
+#: - 3 (header) - 1 (acct cu16) - 32 (blockhash) - 1 (instr cu16) leaves
+#: 1130 bytes = 35 addresses.  The row must cover the true maximum:
+#: fdt_txn_scan truncates hashes past this width, and a truncated
+#: writable key would escape the per-account writer cost cap
+#: (MAX_WRITE_COST_PER_ACCT, a consensus limit) -> over-admission
+MAX_WRITERS = 35
 
 _FREE, _PENDING, _INFLIGHT = 0, 1, 2
 

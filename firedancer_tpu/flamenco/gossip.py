@@ -46,6 +46,15 @@ BLOOM_KEYS = 3
 PRUNE_DUP_THRESHOLD = 3
 #: prune routes expire after this long (reference: prunes time out)
 PRUNE_TTL_S = 500.0
+#: stake-weighted push active set resample period (reference rotates its
+#: active set on a similar cadence)
+ACTIVE_SET_REFRESH_S = 7.5
+
+
+def _pong_token(ping_token: bytes) -> bytes:
+    """Pong token = sha256("SOLANA_PING_PONG" || ping token) — the
+    reference's response-hash domain separation (fd_gossip.c:496,745)."""
+    return hashlib.sha256(b"SOLANA_PING_PONG" + ping_token).digest()
 
 
 def bloom_pos(value_hash: bytes, key: int, nbits: int) -> int:
@@ -128,7 +137,11 @@ class GossipNode:
         tpu_addr=("127.0.0.1", 0),
         entrypoints: list[tuple[str, int]] | None = None,
         now=None,
+        stakes: dict | None = None,
     ):
+        """stakes: pubkey -> stake lamports; drives stake-weighted push
+        active-set selection (reference: fd_gossip.c maintains a
+        stake-ordered active push set and refreshes it periodically)."""
         self.secret = identity_secret
         self.pubkey = golden.public_from_secret(identity_secret)
         self.shred_version = shred_version
@@ -150,6 +163,10 @@ class GossipNode:
         self._pending_pings: dict[tuple[str, int], bytes] = {}
         self._now = now or time.monotonic
         self._rng = os.urandom
+        self.stakes: dict[bytes, int] = dict(stakes or {})
+        #: current push active set (origin pubkeys), stake-weight sampled
+        self._active_set: list[bytes] = []
+        self._active_refresh_at = 0.0
         self.stats = {
             "rx": 0, "tx": 0, "push_rx": 0, "pull_rx": 0,
             "bad_sig": 0, "stale": 0, "prune_rx": 0, "prune_tx": 0,
@@ -316,11 +333,25 @@ class GossipNode:
         if live:
             # push: values adopted since each peer's cursor (push-once,
             # like the reference's push queue), honoring prune routes
-            # (expired prunes reopen)
-            for p in live[:PUSH_FANOUT]:
+            # (expired prunes reopen).  Targets come from the
+            # stake-weighted active set, refreshed periodically.
+            for p in self._push_targets(live, now):
                 for origin, exp in list(p.pruned.items()):
                     if now >= exp:
                         del p.pruned[origin]
+                        # the push cursor advanced past values skipped
+                        # under this prune; rewind below the earliest
+                        # adopt-seq of the origin's values so they are
+                        # pushed after all (re-pushing a few other
+                        # values is harmless: upserts are idempotent)
+                        seqs = [
+                            seq
+                            for label, seq in self._adopt_seq.items()
+                            if GT.crds_origin(self.crds[label]["data"])
+                            == origin
+                        ]
+                        if seqs:
+                            p.push_seq = min(p.push_seq, min(seqs) - 1)
                 pending = sorted(
                     (seq, label)
                     for label, seq in self._adopt_seq.items()
@@ -348,6 +379,39 @@ class GossipNode:
             }), target.contact.gossip_addr)
             # prune relayers that keep pushing duplicates
             self._send_prunes()
+
+    def set_stakes(self, stakes: dict) -> None:
+        """Replace the stake map and force an active-set refresh."""
+        self.stakes = dict(stakes)
+        self._active_refresh_at = 0.0
+
+    def _push_targets(self, live: list, now: float) -> list:
+        """PUSH_FANOUT live peers sampled ∝ (stake + 1) without
+        replacement — the reference's stake-weighted active set
+        (fd_gossip.c active-set maintenance; +1 keeps zero-stake nodes
+        reachable).  Resampled every ACTIVE_SET_REFRESH_S so route
+        diversity rotates like the reference's periodic refresh."""
+        by_origin = {
+            origin: p for origin, p in self.peers.items() if p in live
+        }
+        if now >= self._active_refresh_at or not all(
+            o in by_origin for o in self._active_set
+        ):
+            self._active_refresh_at = now + ACTIVE_SET_REFRESH_S
+            pool = list(by_origin)
+            weights = [self.stakes.get(o, 0) + 1 for o in pool]
+            chosen: list[bytes] = []
+            while pool and len(chosen) < PUSH_FANOUT:
+                total = sum(weights)
+                r = int.from_bytes(self._rng(8), "little") % total
+                for i, w in enumerate(weights):
+                    r -= w
+                    if r < 0:
+                        break
+                chosen.append(pool.pop(i))
+                weights.pop(i)
+            self._active_set = chosen
+        return [by_origin[o] for o in self._active_set if o in by_origin]
 
     def _send_prunes(self) -> None:
         for origin, p in self.peers.items():
@@ -392,7 +456,14 @@ class GossipNode:
     def _on_msg(self, msg, addr) -> None:
         kind, body = msg
         if kind == "ping":
-            pong_token = hashlib.sha256(body["token"]).digest()
+            # the reference verifies the ping signature before answering
+            # (fd_gossip.c:475-485) and hashes the pong token as
+            # sha256("SOLANA_PING_PONG" || token) (fd_gossip.c:496)
+            if golden.verify(
+                body["token"], body["signature"], body["from"]
+            ):
+                return
+            pong_token = _pong_token(body["token"])
             self._send(("pong", {
                 "from": self.pubkey,
                 "token": pong_token,
@@ -403,17 +474,28 @@ class GossipNode:
                 "pubkey": self.pubkey, "crds": [self._self_value],
             }), addr)
         elif kind == "pong":
+            # verify the pong signature before trusting it for liveness
+            # (the reference verifies at fd_gossip.c:754-760)
             got = body["token"]
-            for p in self.peers.values():
-                if p.ping_token and hashlib.sha256(
+            if golden.verify(got, body["signature"], body["from"]):
+                return
+            # the signature must bind to the IDENTITY we pinged, not just
+            # any key: an on-path observer of the ping token could
+            # otherwise keep a dead peer marked alive with its own
+            # signature (the reference verifies against the pinged
+            # peer's key, fd_gossip.c:754-760)
+            for origin, p in self.peers.items():
+                if (
                     p.ping_token
-                ).digest() == got:
+                    and origin == body["from"]
+                    and _pong_token(p.ping_token) == got
+                ):
                     p.last_pong = self._now()
                     p.ping_token = b""
             # entrypoint pong (no peer entry yet): match against every
             # outstanding entrypoint token
             for ep, tok in list(self._pending_pings.items()):
-                if hashlib.sha256(tok).digest() == got:
+                if _pong_token(tok) == got:
                     del self._pending_pings[ep]
                     break
         elif kind == "push_msg":

@@ -42,6 +42,11 @@ ALT_PROGRAM_ID = decode_32("AddressLookupTab1e1111111111111111111111111")
 CONFIG_PROGRAM_ID = decode_32("Config1111111111111111111111111111111111111")
 #: ed25519 signature-verification precompile (fd_ed25519_program.c)
 ED25519_PROGRAM_ID = decode_32("Ed25519SigVerify111111111111111111111111111")
+#: keccak-secp256k1 precompile (eth-style ecrecover verification; the
+#: sibling of the ed25519 precompile)
+SECP256K1_PROGRAM_ID = decode_32(
+    "KeccakSecp256k11111111111111111111111111111"
+)
 
 #: ALT account layout: 56-byte header then packed 32-byte addresses
 _ALT_HDR = struct.Struct("<IQQBB32sH")
@@ -71,7 +76,62 @@ BPF_LOADER_ID = b"BPFLoader" + bytes(23)
 _SYS_CREATE = 0
 _SYS_ASSIGN = 1
 _SYS_TRANSFER = 2
+_SYS_ADVANCE_NONCE = 4
+_SYS_WITHDRAW_NONCE = 5
+_SYS_INIT_NONCE = 6
+_SYS_AUTHORIZE_NONCE = 7
 _SYS_ALLOCATE = 8
+
+#: nonce account data: Versions(u32) + State(u32) + authority(32) +
+#: durable_nonce(32) + fee_calculator.lamports_per_signature(u64)
+NONCE_STATE_SZ = 80
+_NONCE_VERSION_LEGACY = 0
+_NONCE_VERSION_CURRENT = 1
+_NONCE_UNINITIALIZED = 0
+_NONCE_INITIALIZED = 1
+
+
+def durable_nonce_from_blockhash(blockhash: bytes) -> bytes:
+    """sha256("DURABLE_NONCE" || blockhash) — the domain-separated nonce
+    value (reference: fd_durable_nonce_from_blockhash,
+    fd_system_program_nonce.c:67-72)."""
+    import hashlib
+
+    return hashlib.sha256(b"DURABLE_NONCE" + blockhash).digest()
+
+
+def _nonce_decode(data: bytes):
+    """-> (state, authority, durable, fee) with state in
+    {_NONCE_UNINITIALIZED, _NONCE_INITIALIZED}, or None on malformed
+    data.  Accepts both Legacy and Current versions (reference decode
+    switch, fd_system_program_nonce.c:155-168)."""
+    if len(data) < 8:
+        return None
+    version = int.from_bytes(data[:4], "little")
+    if version not in (_NONCE_VERSION_LEGACY, _NONCE_VERSION_CURRENT):
+        return None
+    state = int.from_bytes(data[4:8], "little")
+    if state == _NONCE_UNINITIALIZED:
+        return (_NONCE_UNINITIALIZED, None, None, 0)
+    if state != _NONCE_INITIALIZED or len(data) < NONCE_STATE_SZ:
+        return None
+    return (
+        _NONCE_INITIALIZED,
+        bytes(data[8:40]),
+        bytes(data[40:72]),
+        int.from_bytes(data[72:80], "little"),
+    )
+
+
+def _nonce_encode(state: int, authority: bytes = bytes(32),
+                  durable: bytes = bytes(32), fee: int = 0) -> bytes:
+    return (
+        _NONCE_VERSION_CURRENT.to_bytes(4, "little")
+        + state.to_bytes(4, "little")
+        + authority
+        + durable
+        + fee.to_bytes(8, "little")
+    )
 
 
 def rent_exempt_minimum(space: int) -> int:
@@ -194,15 +254,44 @@ class Executor:
         #: default is all-enabled, overridden by on-chain feature
         #: accounts at each slot boundary
         self.features = Features.all_enabled()
+        #: most recent blockhash (durable-nonce derivation; the bank
+        #: feeds the PoH state in via begin_slot)
+        self.recent_blockhash = bytes(32)
+        #: lamports/sig recorded into initialized nonce accounts
+        self.lamports_per_signature = FEE_PER_SIGNATURE
+        self._slot_hashes = None  # sysvar.SlotHashes, built lazily
 
-    def begin_slot(self, slot: int, unix_timestamp: int = 0) -> None:
+    def begin_slot(self, slot: int, unix_timestamp: int = 0,
+                   blockhash: bytes | None = None) -> None:
         """Advance the bank slot: refresh the sysvar accounts
-        (reference: fd_sysvar_clock_update at slot start)."""
+        (reference: fd_sysvar_clock_update at slot start).  blockhash
+        is the previous slot's bank/PoH hash; it extends the slot-hashes
+        history (fd_sysvar_slot_hashes.c slot_hashes_update) and drives
+        durable-nonce derivation."""
+        import hashlib
+
         from firedancer_tpu.flamenco import sysvar
         from firedancer_tpu.flamenco.features import Features
 
+        if self._slot_hashes is None:
+            self._slot_hashes = sysvar.SlotHashes()
+        prev = self.slot
         self.slot = slot
-        sysvar.install(self.mgr, slot, unix_timestamp=unix_timestamp)
+        if blockhash is None:
+            # deterministic stand-in chain when no PoH state is wired
+            blockhash = hashlib.sha256(
+                b"fdt-blockhash" + slot.to_bytes(8, "little")
+            ).digest()
+        if slot > 0 and slot != prev:
+            self._slot_hashes.add(prev, self.recent_blockhash)
+        self.recent_blockhash = blockhash
+        sysvar.install(
+            self.mgr, slot, unix_timestamp=unix_timestamp,
+            slot_hashes=self._slot_hashes,
+            recent_blockhashes=sysvar.RecentBlockhashes(
+                [(blockhash, self.lamports_per_signature)]
+            ),
+        )
         # refresh the feature table from the account database
         # (reference: fd_features derive from feature accounts)
         self.features = Features.from_accounts(
@@ -224,7 +313,9 @@ class Executor:
                 return "alt: table account missing"
             if len(acct.data) >= ALT_HEADER_SZ:
                 deact = int.from_bytes(acct.data[4:12], "little")
-                if deact != ALT_DEACT_NONE and self.slot >= deact + ALT_DEACT_COOLDOWN:
+                if deact != ALT_DEACT_NONE and self._alt_fully_deactivated(
+                    deact
+                ):
                     return "alt: table deactivated"
             addrs = alt_addresses(acct.data)
             if addrs is None:
@@ -239,6 +330,24 @@ class Executor:
                         return "alt: index out of range"
                     out.append(addrs[idx])
         return writable + readonly
+
+    def _alt_fully_deactivated(self, deact_slot: int) -> bool:
+        """A deactivating table serves lookups while its deactivation
+        slot is still in the slot-hashes history (reference: the table
+        status is derived from the SlotHashes sysvar,
+        fd_address_lookup_table_program.c); the fixed cooldown is the
+        fallback when no history exists yet (early tests, forked
+        executors that never ran begin_slot)."""
+        from firedancer_tpu.flamenco import sysvar
+
+        if deact_slot == self.slot:
+            return False  # deactivated this slot: still usable
+        acct = self.mgr.load(sysvar.SLOT_HASHES_ID)
+        if acct is not None and acct.data:
+            return not sysvar.SlotHashes.decode(acct.data).contains_slot(
+                deact_slot
+            )
+        return self.slot >= deact_slot + ALT_DEACT_COOLDOWN
 
     # ---- entry points ---------------------------------------------------
 
@@ -382,6 +491,11 @@ class Executor:
             pl = lam_of(payer)
             if pl == NONTRIVIAL:
                 r = self.execute_txn(p)
+                if xid != ROOT_XID:
+                    # funk only invalidates its root lam_cache on
+                    # writes; the fork-local dict must drop whatever
+                    # the general executor just rewrote
+                    cache.clear()
                 fees_total += r.fee
                 executed += 1
                 failed += not r.ok
@@ -404,6 +518,8 @@ class Executor:
                 # fall back BEFORE committing (execute_txn redoes the fee)
                 fees_total -= fee
                 r = self.execute_txn(p)
+                if xid != ROOT_XID:
+                    cache.clear()  # see the payer-fallback note above
                 fees_total += r.fee
                 failed += not r.ok
                 continue
@@ -462,6 +578,12 @@ class Executor:
             if not self.features.active("ed25519_program_enabled", self.slot):
                 return "unknown program"
             return self._ed25519_program(data, ctx)
+        if prog_key == SECP256K1_PROGRAM_ID:
+            if not self.features.active(
+                "secp256k1_program_enabled", self.slot
+            ):
+                return "unknown program"
+            return self._secp256k1_program(data, ctx)
         prog = load(prog_key)
         if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
             return self._bpf(
@@ -669,6 +791,58 @@ class Executor:
                 return "ed25519: invalid signature"
         return ""
 
+    def _secp256k1_program(self, data, ctx: InstrCtx) -> str:
+        """Keccak-secp256k1 precompile (the ed25519 precompile's sibling;
+        behavior contract: Solana's secp256k1_program, account-less):
+        data = u8 count, then count 11-byte offset records
+        {sig_off u16, sig_ix u8, eth_addr_off u16, eth_addr_ix u8,
+        msg_off u16, msg_sz u16, msg_ix u8}.  The signature field is 65
+        bytes (r||s||recovery_id); verification recovers the pubkey from
+        keccak256(msg) and compares keccak256(pubkey)[12:] against the
+        20-byte eth address."""
+        from firedancer_tpu.ballet import secp256k1 as K1
+        from firedancer_tpu.ops.keccak256 import digest_host
+
+        if len(data) < 1:
+            return "secp256k1: bad instruction data"
+        count = data[0]
+
+        def instr_data(idx: int):
+            if idx == 0xFF:
+                return data
+            if ctx.txn is None:
+                return None
+            payload, desc = ctx.txn
+            if idx >= desc.instr_cnt:
+                return None
+            ins = desc.instr[idx]
+            return payload[ins.data_off : ins.data_off + ins.data_sz]
+
+        off = 1
+        for _ in range(count):
+            if off + 11 > len(data):
+                return "secp256k1: bad offsets"
+            sig_off, sig_ix = struct.unpack_from("<HB", data, off)
+            ea_off, ea_ix = struct.unpack_from("<HB", data, off + 3)
+            msg_off, msg_sz, msg_ix = struct.unpack_from(
+                "<HHB", data, off + 6
+            )
+            off += 11
+            parts = []
+            for d_ix, d_off, d_sz in (
+                (sig_ix, sig_off, 65), (ea_ix, ea_off, 20),
+                (msg_ix, msg_off, msg_sz),
+            ):
+                src = instr_data(d_ix)
+                if src is None or d_off + d_sz > len(src):
+                    return "secp256k1: data offsets out of range"
+                parts.append(bytes(src[d_off : d_off + d_sz]))
+            sig65, eth_addr, msg = parts
+            pub = K1.recover(digest_host(msg), sig65[:64], sig65[64])
+            if pub is None or K1.eth_address(pub) != eth_addr:
+                return "secp256k1: invalid signature"
+        return ""
+
     def _system(self, data, ins_keys, ctx: InstrCtx, load, store) -> str:
         if len(data) < 4:
             return "bad system instruction"
@@ -735,6 +909,11 @@ class Executor:
             a.owner = data[4:36]
             store(k, a)
             return ""
+        if disc in (
+            _SYS_ADVANCE_NONCE, _SYS_WITHDRAW_NONCE, _SYS_INIT_NONCE,
+            _SYS_AUTHORIZE_NONCE,
+        ):
+            return self._system_nonce(disc, data, ins_keys, ctx, load, store)
         if disc == _SYS_ALLOCATE:
             if len(ins_keys) < 1 or len(data) < 12:
                 return "bad allocate"
@@ -755,6 +934,114 @@ class Executor:
             store(k, a)
             return ""
         return "unsupported system instruction"
+
+    def _system_nonce(self, disc, data, ins_keys, ctx: InstrCtx, load,
+                      store) -> str:
+        """Durable-nonce system instructions (behavior contract:
+        fd_system_program_nonce.c — advance :121-230, withdraw :277-470,
+        initialize :495-600, authorize :700-790; account orders match
+        system_processor.rs).
+
+        The "recent blockhashes" the reference reads through the sysvar
+        is this executor's recent_blockhash (set by begin_slot from the
+        bank's PoH state)."""
+        next_durable = durable_nonce_from_blockhash(self.recent_blockhash)
+        nonce_k = ins_keys[0] if ins_keys else None
+        if nonce_k is None:
+            return "nonce: missing account"
+        if nonce_k not in ctx.writables:
+            return "nonce: account not writable"
+        acct = load(nonce_k)
+        if acct is None or acct.owner != SYSTEM_PROGRAM_ID:
+            return "nonce: bad account"
+        st = _nonce_decode(acct.data)
+        if st is None:
+            return "nonce: invalid account data"
+        state, authority, durable, _fee = st
+
+        if disc == _SYS_ADVANCE_NONCE:
+            # accounts: [nonce, recent_blockhashes sysvar, authority]
+            if len(ins_keys) < 3:
+                return "nonce: not enough accounts"
+            if state != _NONCE_INITIALIZED:
+                return "nonce: uninitialized"
+            if authority not in ctx.signers:
+                return "nonce: missing authority signature"
+            if durable == next_durable:
+                return "nonce: can only advance once per slot"
+            acct.data = _nonce_encode(
+                _NONCE_INITIALIZED, authority, next_durable,
+                self.lamports_per_signature,
+            )
+            store(nonce_k, acct)
+            return ""
+
+        if disc == _SYS_WITHDRAW_NONCE:
+            # accounts: [nonce, to, recent_blockhashes, rent, authority]
+            if len(ins_keys) < 5 or len(data) < 12:
+                return "nonce: bad withdraw"
+            lamports = int.from_bytes(data[4:12], "little")
+            to_k = ins_keys[1]
+            if to_k not in ctx.writables:
+                return "nonce: destination not writable"
+            if state == _NONCE_UNINITIALIZED:
+                if lamports > acct.lamports:
+                    return "insufficient funds"
+                signer = nonce_k
+            else:
+                if lamports == acct.lamports:
+                    # full withdrawal only after the stored nonce aged
+                    # to the current durable value (reference:
+                    # NONCE_BLOCKHASH_NOT_EXPIRED custom error)
+                    if durable != next_durable:
+                        return "nonce: blockhash not expired"
+                    acct.data = _nonce_encode(_NONCE_UNINITIALIZED)
+                else:
+                    if lamports + rent_exempt_minimum(
+                        len(acct.data)
+                    ) > acct.lamports:
+                        return "insufficient funds"
+                signer = authority
+            if signer not in ctx.signers:
+                return "nonce: missing authority signature"
+            if nonce_k == to_k:
+                store(nonce_k, acct)
+                return ""
+            acct.lamports -= lamports
+            store(nonce_k, acct)
+            dst = load(to_k) or Account(0)
+            dst.lamports += lamports
+            store(to_k, dst)
+            return ""
+
+        if disc == _SYS_INIT_NONCE:
+            # accounts: [nonce, recent_blockhashes, rent]; data: authority
+            if len(ins_keys) < 3 or len(data) < 36:
+                return "nonce: bad initialize"
+            if state != _NONCE_UNINITIALIZED:
+                return "nonce: already initialized"
+            if acct.lamports < rent_exempt_minimum(len(acct.data)):
+                return "insufficient funds"
+            acct.data = _nonce_encode(
+                _NONCE_INITIALIZED, bytes(data[4:36]), next_durable,
+                self.lamports_per_signature,
+            )
+            store(nonce_k, acct)
+            return ""
+
+        # _SYS_AUTHORIZE_NONCE: accounts [nonce, authority]; data: new auth
+        if len(data) < 36:
+            return "nonce: bad authorize"
+        if state != _NONCE_INITIALIZED:
+            return "nonce: uninitialized"
+        if authority not in ctx.signers:
+            return "nonce: missing authority signature"
+        acct.data = _nonce_encode(
+            _NONCE_INITIALIZED, bytes(data[4:36]), durable,
+            self.lamports_per_signature,
+        )
+        store(nonce_k, acct)
+        return ""
 
     def _bpf(self, prog: Account, prog_key: bytes, data, ins_keys,
              ctx: InstrCtx, load, store, logs) -> str:

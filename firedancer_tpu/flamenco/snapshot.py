@@ -16,23 +16,41 @@ real snapshots are tens of GB and must never be held whole in RAM
 (reference: fd_snapshot_http.c:1-30).
 
 Layout inside the tar:
-    manifest.json              {"slot": N, "accounts_hash": hex, "n": N}
+    manifest.bin               bincode MANIFEST (version/slot/hash/count)
     accounts/<hex key>         raw record bytes (accounts.Account codec)
+
+The manifest is a typed bincode struct (flamenco/bincode.py schema) like
+the reference's AccountsDb manifest (src/flamenco/types/fd_types.json
+SnapshotManifest types), with an explicit version field so a format
+change (e.g. round 4's flat-sha256 -> sharded accounts hash) fails
+restore with "unsupported snapshot format", never with a misleading
+hash-mismatch error.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
-import json
 import os
+import struct
 import tarfile
 
 from firedancer_tpu.ballet import zstd as Z
+from firedancer_tpu.flamenco import bincode as BC
 from firedancer_tpu.funk.funk import Funk
 
 #: read/write granularity for the streaming paths
 CHUNK = 256 * 1024
+
+#: bumped whenever the archive layout or accounts-hash tree changes
+MANIFEST_VERSION = 3
+
+MANIFEST = BC.struct_of(
+    ("version", "u32"),
+    ("slot", "u64"),
+    ("accounts_hash", ("bytes", 32)),
+    ("account_cnt", "u64"),
+)
 
 
 #: shards of the accounts-hash tree (fixed so the hash value is stable
@@ -123,14 +141,16 @@ def create(funk: Funk, path: str, *, slot: int = 0) -> bytes:
     with open(tmp, "wb") as f:
         sink = _CompressingWriter(f)
         with tarfile.open(fileobj=sink, mode="w|") as tar:
-            manifest = json.dumps(
+            manifest = BC.encode(
+                MANIFEST,
                 {
+                    "version": MANIFEST_VERSION,
                     "slot": slot,
-                    "accounts_hash": root_hash.hex(),
-                    "n": len(funk.root),
-                }
-            ).encode()
-            mi = tarfile.TarInfo("manifest.json")
+                    "accounts_hash": root_hash,
+                    "account_cnt": len(funk.root),
+                },
+            )
+            mi = tarfile.TarInfo("manifest.bin")
             mi.size = len(manifest)
             tar.addfile(mi, io.BytesIO(manifest))
             for k in sorted(funk.root):
@@ -178,20 +198,35 @@ def restore(path: str) -> tuple[Funk, int, bytes]:
                     if not m.isfile():
                         continue
                     body = tar.extractfile(m).read()
-                    if m.name == "manifest.json":
-                        manifest = json.loads(body)
+                    if m.name == "manifest.bin":
+                        manifest, _ = BC.decode(MANIFEST, body)
+                    elif m.name == "manifest.json":
+                        # pre-v3 archives (json manifest, flat accounts
+                        # hash): a format mismatch, not corruption
+                        raise SnapshotError(
+                            "unsupported snapshot format (pre-v3 "
+                            "manifest)"
+                        )
                     elif m.name.startswith("accounts/"):
                         funk.root[
                             bytes.fromhex(m.name.split("/", 1)[1])
                         ] = body
-    except (Z.ZstdError, tarfile.TarError, ValueError) as e:
+    except SnapshotError:
+        raise
+    except (Z.ZstdError, tarfile.TarError, ValueError, struct.error) as e:
+        # struct.error: a truncated manifest.bin fails inside BC.decode
         raise SnapshotError(f"corrupt snapshot: {e}") from None
     if manifest is None:
         raise SnapshotError("missing manifest")
+    if manifest["version"] != MANIFEST_VERSION:
+        raise SnapshotError(
+            f"unsupported snapshot format (manifest v{manifest['version']},"
+            f" want v{MANIFEST_VERSION})"
+        )
     got = _pooled_accounts_hash(funk.root)
-    if got.hex() != manifest["accounts_hash"]:
+    if got != manifest["accounts_hash"]:
         raise SnapshotError("accounts hash mismatch")
-    if manifest["n"] != len(funk.root):
+    if manifest["account_cnt"] != len(funk.root):
         raise SnapshotError("account count mismatch")
     return funk, int(manifest["slot"]), got
 

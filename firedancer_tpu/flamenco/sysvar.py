@@ -23,6 +23,13 @@ SYSVAR_OWNER_ID = decode_32("Sysvar1111111111111111111111111111111111111")
 CLOCK_ID = decode_32("SysvarC1ock11111111111111111111111111111111")
 RENT_ID = decode_32("SysvarRent111111111111111111111111111111111")
 EPOCH_SCHEDULE_ID = decode_32("SysvarEpochSchedu1e111111111111111111111111")
+SLOT_HASHES_ID = decode_32("SysvarS1otHashes111111111111111111111111111")
+RECENT_BLOCKHASHES_ID = decode_32(
+    "SysvarRecentB1ockHashes11111111111111111111"
+)
+
+#: SlotHashes capacity (reference: fd_sysvar_slot_hashes.c slot_hashes_max)
+SLOT_HASHES_MAX = 512
 
 
 @dataclass
@@ -98,6 +105,81 @@ class EpochSchedule:
         return slot // self.slots_per_epoch  # post-warmup schedule
 
 
+@dataclass
+class SlotHashes:
+    """Most-recent-first (slot, hash) pairs, capped at SLOT_HASHES_MAX.
+
+    Layout is the Solana bincode Vec<(u64, [u8;32])> the reference
+    serializes in fd_sysvar_slot_hashes.c (u64 count + packed entries).
+    Consumers: ALT deactivation cooldown (a deactivating table serves
+    lookups while its deactivation slot is still present here).
+    """
+
+    entries: list = None  # list[(slot, hash32)]
+
+    def __post_init__(self):
+        if self.entries is None:
+            self.entries = []
+
+    def add(self, slot: int, h: bytes) -> None:
+        self.entries.insert(0, (slot, h))
+        del self.entries[SLOT_HASHES_MAX:]
+
+    def contains_slot(self, slot: int) -> bool:
+        return any(s == slot for s, _ in self.entries)
+
+    def encode(self) -> bytes:
+        out = bytearray(len(self.entries).to_bytes(8, "little"))
+        for s, h in self.entries:
+            out += s.to_bytes(8, "little") + h
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "SlotHashes":
+        n = int.from_bytes(raw[:8], "little")
+        entries = []
+        off = 8
+        for _ in range(min(n, SLOT_HASHES_MAX)):
+            s = int.from_bytes(raw[off : off + 8], "little")
+            entries.append((s, bytes(raw[off + 8 : off + 40])))
+            off += 40
+        return cls(entries)
+
+
+@dataclass
+class RecentBlockhashes:
+    """Vec<(hash, fee_calculator)> newest first (deprecated sysvar the
+    nonce instructions still account-check; reference
+    fd_sysvar_recent_hashes.c)."""
+
+    entries: list = None  # list[(hash32, lamports_per_signature)]
+
+    def __post_init__(self):
+        if self.entries is None:
+            self.entries = []
+
+    def encode(self) -> bytes:
+        out = bytearray(len(self.entries).to_bytes(8, "little"))
+        for h, lps in self.entries:
+            out += h + lps.to_bytes(8, "little")
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "RecentBlockhashes":
+        n = int.from_bytes(raw[:8], "little")
+        entries = []
+        off = 8
+        for _ in range(n):
+            entries.append(
+                (
+                    bytes(raw[off : off + 32]),
+                    int.from_bytes(raw[off + 32 : off + 40], "little"),
+                )
+            )
+            off += 40
+        return cls(entries)
+
+
 def install(
     mgr: AccountMgr,
     slot: int,
@@ -105,6 +187,8 @@ def install(
     unix_timestamp: int = 0,
     rent: Rent | None = None,
     schedule: EpochSchedule | None = None,
+    slot_hashes: SlotHashes | None = None,
+    recent_blockhashes: RecentBlockhashes | None = None,
 ) -> None:
     """Materialize/refresh the sysvar accounts for `slot` (the bank calls
     this at slot start; reference: fd_sysvar_clock_update)."""
@@ -117,11 +201,18 @@ def install(
         leader_schedule_epoch=epoch + 1,
         unix_timestamp=unix_timestamp,
     )
-    for key, body in (
+    bodies = [
         (CLOCK_ID, clock.encode()),
         (RENT_ID, rent.encode()),
         (EPOCH_SCHEDULE_ID, schedule.encode()),
-    ):
+    ]
+    if slot_hashes is not None:
+        bodies.append((SLOT_HASHES_ID, slot_hashes.encode()))
+    if recent_blockhashes is not None:
+        bodies.append(
+            (RECENT_BLOCKHASHES_ID, recent_blockhashes.encode())
+        )
+    for key, body in bodies:
         mgr.store(
             key,
             Account(

@@ -105,6 +105,18 @@ def identity_niels(batch: int):
     return (one, one, jnp.zeros_like(one), one + one)
 
 
+def to_niels_affine(p):
+    """Extended point with Z == 1 (a decompress output) ->
+    (y+x, y-x, 2dxy) affine niels, all carried."""
+    x, y, _, t = p
+    return (F.carry(y + x), F.carry(y - x), F.mul_rr(t, F.c("D2")))
+
+
+def identity_niels_affine(batch: int):
+    one = jnp.broadcast_to(F.c("ONE"), (F.NLIMB, batch))
+    return (one, one, jnp.zeros_like(one))
+
+
 def add_niels(p, e, with_t: bool = True):
     """p + e where e = (Y+X, Y-X, 2dT, 2Z) niels form (projective)."""
     x1, y1, z1, t1 = p
@@ -211,6 +223,17 @@ def is_small_order(p):
     q = double(double(double(p, with_t=False), with_t=False), with_t=False)
     x8, y8, z8, _ = q
     return F.is_zero(x8) & F.eq(y8, z8)
+
+
+def eq_points(p, q):
+    """General projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1."""
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    z1c = F.carry1(z1)
+    z2c = F.carry1(z2)
+    return F.eq(F.mul_rr(F.carry1(x1), z2c), F.mul_rr(F.carry1(x2), z1c)) & (
+        F.eq(F.mul_rr(F.carry1(y1), z2c), F.mul_rr(F.carry1(y2), z1c))
+    )
 
 
 def eq_external(acc, r):
@@ -328,6 +351,25 @@ def lookup9_affine(table, digit):
         jnp.where(neg, ypx, ymx),
         jnp.where(neg, -t2d, t2d),
     )
+
+
+def scalar_mul_base(s_digits):
+    """[s]B from (64, B) signed digits — fixed-base Strauss over the
+    shared affine B-table.  Used for the [u]B term of batch (RLC)
+    verification; B here is tiny (typically 1)."""
+    batch = s_digits.shape[-1]
+    b_table = F.c("B_TABLE9")
+
+    def body(j, acc):
+        idx = 63 - j
+        d = jax.lax.dynamic_slice_in_dim(s_digits, idx, 1, axis=0)[0]
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=False)
+        acc = double(acc, with_t=True)
+        return add_niels_affine(acc, lookup9_affine(b_table, d), with_t=True)
+
+    return jax.lax.fori_loop(0, 64, body, identity(batch))
 
 
 def double_scalar_mul(k_digits, neg_a_table9, s_digits):

@@ -121,6 +121,103 @@ def verify_batch(msgs, lens, sigs, pubs):
     )
 
 
+def _z_limbs(zbytes):
+    """(B, 16) uint8 random z -> (10, B) 13-bit limbs (128 -> 130 bits)."""
+    padded = jnp.concatenate(
+        [zbytes, jnp.zeros(zbytes.shape[:-1] + (16,), zbytes.dtype)], axis=-1
+    )
+    return F.from_bytes(padded)[:10]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _verify_digest_rlc_impl(digests, sigs, pubs, zbytes, interpret=False):
+    """Batch (RLC) verification: returns (lane_ok (B,), batch_ok ()).
+
+    lane_ok is the per-lane prologue verdict (canonical s, small-order
+    blocklist, decompress); batch_ok is the one RLC group equation over
+    the lanes that passed the prologue.  Accept lane i iff
+    batch_ok & lane_ok[i]; on !batch_ok the caller falls back to the
+    strict per-sig kernel.  See msm_kernel.py for semantics.
+    """
+    from . import msm_kernel as MSM
+
+    # prologue checks, shared with the per-sig path.  Decompress + niels
+    # conversion run in a fused Pallas pass: the sqrt chain is ~250
+    # sequential field ops and dominates the batch under plain XLA
+    # (PROFILE.md round 5)
+    s_limbs = SC.from_bytes(sigs[:, 32:])
+    ok = SC.is_canonical(s_limbs)
+    ok = ok & ~_is_small_order_enc(pubs) & ~_is_small_order_enc(sigs[:, :32])
+    a_y, a_sign = PT.decompress_bytes(pubs)
+    r_y, r_sign = PT.decompress_bytes(sigs[:, :32])
+    an3_raw, rn3_raw, dc_ok = MSM.decompress_niels(
+        a_y, a_sign, r_y, r_sign, interpret=interpret
+    )
+    ok = ok & dc_ok
+    okm = ok[None, :]
+
+    k_limbs = SC.reduce512(digests)
+    z10 = _z_limbs(zbytes)
+    c_limbs = SC.mulmod(z10, k_limbs)  # z*k mod L
+    z20 = jnp.concatenate([z10, jnp.zeros_like(z10)], axis=0)
+    cdig = jnp.where(okm, SC.to_signed_digits(c_limbs), 0)
+    zdig = jnp.where(okm, SC.to_signed_digits(z20)[:33], 0)
+
+    su = jnp.where(okm, SC.mulmod(z10, s_limbs), 0)
+    u = SC.summod(su)  # sum z_i s_i mod L over included lanes
+    udig = SC.to_signed_digits(u)  # (64, 1)
+
+    def mask_niels(n3):
+        ident = jnp.concatenate(
+            PT.identity_niels_affine(n3.shape[-1]), axis=0
+        )
+        return jnp.where(okm, n3, ident)
+
+    batch_ok = MSM.msm_check(
+        cdig, zdig, mask_niels(an3_raw), mask_niels(rn3_raw), udig,
+        interpret=interpret,
+    )
+    return ok, batch_ok
+
+
+def _use_rlc() -> bool:
+    """Batch (RLC) verification is the default fast path on TPU;
+    FDT_VERIFY_RLC=0 forces strict per-sig verification everywhere."""
+    import os
+
+    env = os.environ.get("FDT_VERIFY_RLC")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "no", "off")
+    return jax.default_backend() == "tpu"
+
+
+def verify_batch_digest_rlc(digests, sigs, pubs, zbytes=None):
+    """Batch-verify from precomputed k-digests: RLC accept fast path with
+    strict per-sig fallback whenever the batch equation fails.
+
+    zbytes: (B, 16) uint8 per-batch secret randomness (odd z enforced
+    here); defaults to os.urandom.  Returns (B,) bool.
+    """
+    import os
+
+    digests = jnp.asarray(digests, jnp.uint8)
+    sigs = jnp.asarray(sigs, jnp.uint8)
+    pubs = jnp.asarray(pubs, jnp.uint8)
+    B = sigs.shape[0]
+    if zbytes is None:
+        zbytes = np.frombuffer(os.urandom(16 * B), np.uint8).reshape(B, 16)
+    zbytes = np.asarray(zbytes).copy()
+    zbytes[:, 0] |= 1  # odd z: no 8-torsion residual survives one lane
+    lane_ok, batch_ok = _verify_digest_rlc_impl(
+        digests, sigs, pubs, jnp.asarray(zbytes),
+        # Pallas interpret mode off-TPU (tests); Mosaic on TPU
+        interpret=jax.default_backend() != "tpu",
+    )
+    if bool(np.asarray(batch_ok)):
+        return lane_ok
+    return verify_batch_digest(digests, sigs, pubs)
+
+
 @functools.partial(jax.jit, static_argnames=("use_pallas",))
 def _verify_digest_impl(digests, sigs, pubs, use_pallas=False):
     # step 4's SHA512 was done on the host (fdt_sha512_rpm inside

@@ -122,7 +122,11 @@ class PackTile(Tile):
         self.engine = P.Pack(depth, max_banks=n_banks)
         self.bank_busy = [0] * n_banks
         self._byte_limit = 0  # derived from the out-ring MTU at boot
-        self._last_mb_ns = 0
+        # per-BANK cadence, as in the reference (fd_pack.c:193 sets
+        # bank_ready_at[i] = now + MICROBLOCK_DURATION_NS per bank) — a
+        # global gate would cap the whole tile at 1/cadence regardless
+        # of bank count
+        self._bank_ready_at = [0] * n_banks
         self._block_started_ns = 0
         self._dev_select = None
         if use_device_select:
@@ -177,9 +181,9 @@ class PackTile(Tile):
             self.engine.end_block()
             self._block_started_ns = now
             ctx.metrics.inc("blocks")
-        if now - self._last_mb_ns < self.microblock_ns:
-            return
         for bank in range(self.n_banks):
+            if now < self._bank_ready_at[bank]:
+                continue
             if self.bank_busy[bank] >= self.mb_inflight:
                 continue
             out = ctx.outs[bank]
@@ -205,6 +209,6 @@ class PackTile(Tile):
                 np.array([len(payload)], dtype=np.uint16),
             )
             self.bank_busy[bank] += 1
-            self._last_mb_ns = now
+            self._bank_ready_at[bank] = now + self.microblock_ns
             ctx.metrics.inc("microblocks")
             ctx.metrics.inc("microblock_txns", len(idx))

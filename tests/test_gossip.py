@@ -300,3 +300,98 @@ def test_prune_protocol():
         assert p is not None and a.pubkey in p.pruned
     finally:
         a.close(); b.close()
+
+
+def test_stake_weighted_push_selection():
+    """Push targets are sampled ∝ stake and change under a stake
+    redistribution (reference: fd_gossip.c active-set maintenance)."""
+    rng = np.random.default_rng(47)
+    secret = rng.integers(0, 256, 32, np.uint8).tobytes()
+    n = G.GossipNode(secret)
+    try:
+        peers = {}
+        for i in range(12):
+            pk = bytes([i + 1]) * 32
+            ci = G.ContactInfo(
+                pk, 1, ("127.0.0.1", 2000 + i), ("127.0.0.1", 3000 + i),
+            )
+            p = G._Peer(ci, last_pong=1.0)
+            n.peers[pk] = p
+            peers[pk] = p
+        live = list(peers.values())
+        whale = bytes([1]) * 32
+
+        def selection_counts(stakes, rounds=120):
+            n.set_stakes(stakes)
+            hits = {pk: 0 for pk in peers}
+            for r in range(rounds):
+                n._active_refresh_at = 0.0  # force a resample
+                for p in n._push_targets(live, now=float(r)):
+                    for pk, q in peers.items():
+                        if q is p:
+                            hits[pk] += 1
+            return hits
+
+        # whale holds ~all stake: it must appear in nearly every sample
+        hits = selection_counts({whale: 10_000_000})
+        assert hits[whale] >= 110
+        # redistribution: zero the whale, stake someone else — the
+        # selection distribution must follow
+        other = bytes([7]) * 32
+        hits2 = selection_counts({other: 10_000_000})
+        assert hits2[other] >= 110
+        assert hits2[whale] < hits[whale] // 2
+        # zero-stake peers are still reachable (the +1 smoothing)
+        assert sum(hits2.values()) > hits2[other]
+    finally:
+        n.close()
+
+
+def test_fixture_bytes_against_independent_encoder():
+    """The same gossip messages encoded by an INDEPENDENT minimal
+    encoder (direct struct packing below, written from fd_types.json
+    field order, sharing no code with flamenco/bincode.py) must produce
+    byte-identical output, and both must equal the checked-in fixture
+    bytes.  One transcription error in the schema AND the hand-derived
+    goldens now requires the same error here too."""
+    import struct as _s
+
+    pk = bytes(range(32))
+    token = bytes(range(32, 64))
+    sig = bytes(range(64, 128))
+
+    def indep_ping(from_pk, tok, s):
+        return _s.pack("<I", 4) + from_pk + tok + s
+
+    enc = GT.encode_msg(("ping", {
+        "from": pk, "token": token, "signature": sig,
+    }))
+    assert enc == indep_ping(pk, token, sig)
+    FIXTURE_PING_HEAD = bytes.fromhex("04000000000102030405060708")
+    assert enc[:13] == FIXTURE_PING_HEAD
+
+    # CRDS vote value: independent packing of
+    # crds_value { signature[64], crds_data enum tag 1 = vote {
+    #   index u8, from pubkey, txn vec<u8>, wallclock u64 } }
+    vote_txn = bytes([9, 9, 9, 9])
+    data = ("vote", {
+        "index": 3, "from": pk, "txn": vote_txn,
+        "wallclock": 0x0102030405060708,
+    })
+    enc2 = encode(GT.CRDS_VALUE, {"signature": sig, "data": data})
+
+    def indep_vote(s, index, from_pk, txn, wallclock):
+        # fd_types embeds the vote transaction RAW (flamenco_txn is
+        # parsed in place by structure, never length-prefixed)
+        return (
+            s
+            + _s.pack("<I", 1)           # crds_data tag 1 = vote
+            + bytes([index])
+            + from_pk
+            + txn
+            + _s.pack("<Q", wallclock)
+        )
+
+    assert enc2 == indep_vote(sig, 3, pk, vote_txn, 0x0102030405060708)
+    FIXTURE_VOTE_TAIL = bytes.fromhex("09090909" + "0807060504030201")
+    assert enc2.endswith(FIXTURE_VOTE_TAIL)

@@ -1,0 +1,142 @@
+"""Batch (RLC) verification: scalar arithmetic + MSM kernel + policy.
+
+The MSM kernel itself runs in Pallas interpret mode on CPU (slow tier);
+the mod-L scalar helpers are plain XLA and stay in the fast tier.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.ed25519 import field as F
+from firedancer_tpu.ops.ed25519 import golden
+from firedancer_tpu.ops.ed25519 import scalar as SC
+
+L = golden.L
+
+
+def _limbs_of(x: int, rows: int = 20) -> np.ndarray:
+    return np.array(
+        [(x >> (13 * i)) & 0x1FFF for i in range(rows)], np.int32
+    ).reshape(rows, 1)
+
+
+def _int_of(limbs) -> int:
+    a = np.asarray(limbs).reshape(limbs.shape[0], -1)[:, 0]
+    return sum(int(v) << (13 * i) for i, v in enumerate(a))
+
+
+def test_mulmod_matches_python():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        z = int.from_bytes(rng.bytes(16), "little") | 1
+        k = int.from_bytes(rng.bytes(32), "little") % L
+        got = SC.mulmod(_limbs_of(z, 10), _limbs_of(k))
+        assert _int_of(got) == z * k % L
+
+
+def test_mulmod_batch_and_noncanonical_s():
+    # s up to 2^256 (non-canonical lanes flow through the data path)
+    rng = np.random.default_rng(1)
+    zs = [int.from_bytes(rng.bytes(16), "little") | 1 for _ in range(8)]
+    ss = [int.from_bytes(rng.bytes(32), "little") for _ in range(8)]
+    za = np.concatenate([_limbs_of(z, 10) for z in zs], axis=1)
+    sa = np.concatenate([_limbs_of(s) for s in ss], axis=1)
+    got = np.asarray(SC.mulmod(za, sa))
+    for j in range(8):
+        assert _int_of(got[:, j : j + 1]) == zs[j] * ss[j] % L
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 64, 1000])
+def test_summod(n):
+    rng = np.random.default_rng(n)
+    vals = [int.from_bytes(rng.bytes(32), "little") % L for _ in range(n)]
+    arr = np.concatenate([_limbs_of(v) for v in vals], axis=1)
+    got = SC.summod(arr)
+    assert _int_of(got) == sum(vals) % L
+
+
+def test_scalar_mul_base():
+    from firedancer_tpu.ops.ed25519 import point as PT
+
+    rng = np.random.default_rng(3)
+    s = int.from_bytes(rng.bytes(32), "little") % L
+    digits = SC.to_signed_digits(_limbs_of(s))
+    pt = PT.scalar_mul_base(np.asarray(digits))
+    enc = np.asarray(PT.compress(pt))[0].tobytes()
+    assert enc == golden.point_compress(golden.scalar_mul(s, golden.B))
+
+
+def _make_batch(rng, n, n_keys=4):
+    secrets = [rng.bytes(32) for _ in range(n_keys)]
+    pubs_of = {s: golden.public_from_secret(s) for s in secrets}
+    sigs = np.zeros((n, 64), np.uint8)
+    pubs = np.zeros((n, 32), np.uint8)
+    digs = np.zeros((n, 64), np.uint8)
+    for i in range(n):
+        sec = secrets[i % n_keys]
+        pub = pubs_of[sec]
+        m = rng.bytes(48)
+        s = golden.sign(sec, m)
+        sigs[i] = np.frombuffer(s, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+        digs[i] = np.frombuffer(
+            hashlib.sha512(s[:32] + pub + m).digest(), np.uint8
+        )
+    return digs, sigs, pubs
+
+
+@pytest.mark.slow
+def test_rlc_honest_batch_accepts():
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    rng = np.random.default_rng(10)
+    digs, sigs, pubs = _make_batch(rng, 12)
+    ok = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
+    assert ok.all()
+
+
+@pytest.mark.slow
+def test_rlc_corrupt_lane_falls_back_to_per_sig():
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    rng = np.random.default_rng(11)
+    digs, sigs, pubs = _make_batch(rng, 12)
+    sigs[5, 7] ^= 4
+    ok = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
+    assert not ok[5]
+    assert ok.sum() == 11
+
+
+@pytest.mark.slow
+def test_rlc_prologue_rejects_do_not_poison_batch():
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    rng = np.random.default_rng(12)
+    digs, sigs, pubs = _make_batch(rng, 12)
+    # lane 2: non-canonical s (s + L), lane 9: small-order pubkey —
+    # both excluded by the prologue; the rest must still batch-accept
+    s_int = int.from_bytes(bytes(sigs[2, 32:]), "little") + L
+    sigs[2, 32:] = np.frombuffer(s_int.to_bytes(32, "little"), np.uint8)
+    pubs[9] = np.frombuffer(
+        golden.small_order_blocklist()[3], np.uint8
+    )
+    ok = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
+    assert not ok[2] and not ok[9]
+    assert ok.sum() == 10
+
+
+@pytest.mark.slow
+def test_rlc_matches_per_sig_on_mixed_random_batch():
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    rng = np.random.default_rng(13)
+    digs, sigs, pubs = _make_batch(rng, 8)
+    # corrupt half the lanes in assorted ways
+    sigs[0, 0] ^= 1  # R corrupt
+    sigs[3, 40] ^= 1  # s corrupt
+    digs[6, 1] ^= 1  # digest (message) corrupt
+    want = np.asarray(V.verify_batch_digest(digs, sigs, pubs))
+    got = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
+    assert (want == got).all()

@@ -1,0 +1,270 @@
+"""System nonce instructions, SlotHashes sysvar wiring, and the
+keccak-secp256k1 precompile.
+
+Reference analogs: src/flamenco/runtime/program/fd_system_program_nonce.c,
+src/flamenco/runtime/sysvar/fd_sysvar_slot_hashes.c, and the
+Keccak-Secp256k1 native program (ed25519 precompile's sibling).
+"""
+
+import struct
+
+import numpy as np
+
+from firedancer_tpu.ballet import secp256k1 as K1
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco import sysvar
+from firedancer_tpu.flamenco.accounts import (
+    Account, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.flamenco.runtime import (
+    NONCE_STATE_SZ, SECP256K1_PROGRAM_ID, Executor,
+    durable_nonce_from_blockhash, rent_exempt_minimum,
+)
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.ops.keccak256 import digest_host
+
+
+def _keys(rng, n):
+    return [rng.integers(0, 256, 32, np.uint8).tobytes() for _ in range(n)]
+
+
+def _sign_stub(n):
+    return [bytes([7]) * 64 for _ in range(n)]
+
+
+def _nonce_setup(rng):
+    funk = Funk()
+    ex = Executor(funk)
+    ex.begin_slot(1)
+    payer, nonce_k, auth = _keys(rng, 3)
+    ex.mgr.store(payer, Account(10_000_000_000))
+    ex.mgr.store(
+        nonce_k,
+        Account(
+            rent_exempt_minimum(NONCE_STATE_SZ) + 500_000,
+            SYSTEM_PROGRAM_ID, False, 0, bytes(NONCE_STATE_SZ),
+        ),
+    )
+    return ex, payer, nonce_k, auth
+
+
+def _init_ins(auth):
+    return (6).to_bytes(4, "little") + auth
+
+
+def test_nonce_initialize_advance_authorize():
+    rng = np.random.default_rng(70)
+    ex, payer, nonce_k, auth = _nonce_setup(rng)
+    rb = sysvar.RECENT_BLOCKHASHES_ID
+    rent = sysvar.RENT_ID
+
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, nonce_k, rb, rent, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [1, 2, 3], _init_ins(auth))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    data = ex.mgr.load(nonce_k).data
+    assert data[4:8] == (1).to_bytes(4, "little")  # initialized
+    assert data[8:40] == auth
+    first = data[40:72]
+    assert first == durable_nonce_from_blockhash(ex.recent_blockhash)
+
+    # advance in the SAME slot: durable unchanged -> rejected
+    adv = (4).to_bytes(4, "little")
+    r = ex.execute_txn(T.build(
+        _sign_stub(3), [payer, auth, nonce_k, rb, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [2, 3, 1], adv)], readonly_unsigned_cnt=2,
+    ))
+    assert not r.ok and "once per slot" in r.err
+
+    # next slot: advance succeeds and rotates the durable value
+    ex.begin_slot(2)
+    r = ex.execute_txn(T.build(
+        _sign_stub(3), [payer, auth, nonce_k, rb, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [2, 3, 1], adv)], readonly_unsigned_cnt=2,
+    ))
+    assert r.ok, r.err
+    second = ex.mgr.load(nonce_k).data[40:72]
+    assert second != first
+    assert second == durable_nonce_from_blockhash(ex.recent_blockhash)
+
+    # advance without the authority's signature -> rejected
+    ex.begin_slot(3)
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, nonce_k, rb, SYSTEM_PROGRAM_ID],
+        bytes(32), [(3, [1, 2, 0], adv)], readonly_unsigned_cnt=2,
+    ))
+    assert not r.ok and "authority" in r.err
+
+    # authorize rotates the authority (old one signs)
+    new_auth = _keys(rng, 1)[0]
+    authz = (7).to_bytes(4, "little") + new_auth
+    r = ex.execute_txn(T.build(
+        _sign_stub(3), [payer, auth, nonce_k, SYSTEM_PROGRAM_ID],
+        bytes(32), [(3, [2, 1], authz)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+    assert ex.mgr.load(nonce_k).data[8:40] == new_auth
+
+
+def test_nonce_withdraw_partial_and_full():
+    rng = np.random.default_rng(71)
+    ex, payer, nonce_k, auth = _nonce_setup(rng)
+    rb, rent = sysvar.RECENT_BLOCKHASHES_ID, sysvar.RENT_ID
+    dest = _keys(rng, 1)[0]
+
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, nonce_k, rb, rent, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [1, 2, 3], _init_ins(auth))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    bal = ex.mgr.load(nonce_k).lamports
+
+    # partial withdraw must keep the rent-exempt minimum
+    # (accounts: [nonce, to, recent_blockhashes, rent, authority])
+    too_much = bal - rent_exempt_minimum(NONCE_STATE_SZ) + 1
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32),
+        [(6, [2, 3, 4, 5, 1],
+          (5).to_bytes(4, "little") + too_much.to_bytes(8, "little"))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert not r.ok and "insufficient" in r.err
+
+    ok_amt = 400_000
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32),
+        [(6, [2, 3, 4, 5, 1],
+          (5).to_bytes(4, "little") + ok_amt.to_bytes(8, "little"))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    assert ex.mgr.load(dest).lamports == ok_amt
+    assert ex.mgr.load(nonce_k).lamports == bal - ok_amt
+
+    # full withdrawal while the nonce is fresh (stored == current
+    # durable) succeeds and uninitializes the account; after an advance
+    # in a LATER slot the stored value goes stale and full withdrawal
+    # is "blockhash not expired" until re-derived
+    remaining = bal - ok_amt
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32),
+        [(6, [2, 3, 4, 5, 1],
+          (5).to_bytes(4, "little") + remaining.to_bytes(8, "little"))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    acct = ex.mgr.load(nonce_k)
+    assert acct.lamports == 0
+    assert acct.data[4:8] == (0).to_bytes(4, "little")  # uninitialized
+
+
+def test_nonce_full_withdraw_stale_rejected():
+    rng = np.random.default_rng(72)
+    ex, payer, nonce_k, auth = _nonce_setup(rng)
+    rb, rent = sysvar.RECENT_BLOCKHASHES_ID, sysvar.RENT_ID
+    dest = _keys(rng, 1)[0]
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, nonce_k, rb, rent, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [1, 2, 3], _init_ins(auth))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    ex.begin_slot(2)  # stored durable is now stale
+    bal = ex.mgr.load(nonce_k).lamports
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32),
+        [(6, [2, 3, 4, 5, 1],
+          (5).to_bytes(4, "little") + bal.to_bytes(8, "little"))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert not r.ok and "not expired" in r.err
+
+
+def test_slot_hashes_sysvar_and_alt_deactivation():
+    funk = Funk()
+    ex = Executor(funk)
+    for s in range(1, 5):
+        ex.begin_slot(s)
+    acct = ex.mgr.load(sysvar.SLOT_HASHES_ID)
+    sh = sysvar.SlotHashes.decode(acct.data)
+    # slots 0..3 entered history (newest first); slot 4 is current
+    assert [s for s, _ in sh.entries] == [3, 2, 1, 0]
+    assert sh.contains_slot(2) and not sh.contains_slot(4)
+
+    # ALT deactivated at slot 2: usable while 2 is in slot hashes,
+    # dead once 512 newer slots push it out
+    assert not ex._alt_fully_deactivated(2)
+    for s in range(5, 5 + sysvar.SLOT_HASHES_MAX):
+        ex.begin_slot(s)
+    assert ex._alt_fully_deactivated(2)
+
+
+def _secp_instr_data(sig65: bytes, eth_addr: bytes, msg: bytes) -> bytes:
+    hdr_sz = 1 + 11
+    sig_off = hdr_sz
+    ea_off = sig_off + 65
+    msg_off = ea_off + 20
+    offsets = struct.pack(
+        "<HBHBHHB", sig_off, 0xFF, ea_off, 0xFF, msg_off, len(msg), 0xFF
+    )
+    return bytes([1]) + offsets + sig65 + eth_addr + msg
+
+
+def test_secp256k1_recover_roundtrip():
+    secret = 0xC0FFEE ^ (1 << 200)
+    pub = K1.pubkey_of(secret)
+    digest = digest_host(b"hello eth")
+    sig, recid = K1.sign(digest, secret, k=12345)
+    got = K1.recover(digest, sig, recid)
+    assert got == pub
+    # wrong recid recovers a different key (or nothing)
+    other = K1.recover(digest, sig, recid ^ 1)
+    assert other != pub
+
+
+def test_secp256k1_precompile_accepts_and_rejects():
+    rng = np.random.default_rng(73)
+    funk = Funk()
+    ex = Executor(funk)
+    payer = _keys(rng, 1)[0]
+    ex.mgr.store(payer, Account(10_000_000_000))
+
+    secret = 0x1234567890ABCDEF ^ (7 << 180)
+    pub = K1.pubkey_of(secret)
+    addr = K1.eth_address(pub)
+    msg = b"gm"
+    sig, recid = K1.sign(digest_host(msg), secret, k=999)
+    data = _secp_instr_data(sig + bytes([recid]), addr, msg)
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, SECP256K1_PROGRAM_ID], bytes(32),
+        [(1, [], data)], readonly_unsigned_cnt=1,
+    ))
+    assert r.ok, r.err
+
+    bad = bytearray(data)
+    bad[1 + 11 + 3] ^= 1  # flip a signature byte
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, SECP256K1_PROGRAM_ID], bytes(32),
+        [(1, [], bytes(bad))], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok and "secp256k1" in r.err
+
+    wrong_addr = _secp_instr_data(
+        sig + bytes([recid]), bytes(20), msg
+    )
+    r = ex.execute_txn(T.build(
+        _sign_stub(1), [payer, SECP256K1_PROGRAM_ID], bytes(32),
+        [(1, [], wrong_addr)], readonly_unsigned_cnt=1,
+    ))
+    assert not r.ok
