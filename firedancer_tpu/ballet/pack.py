@@ -57,6 +57,8 @@ MAX_BANK_TILES = 62
 #: writable key would escape the per-account writer cost cap
 #: (MAX_WRITE_COST_PER_ACCT, a consensus limit) -> over-admission
 MAX_WRITERS = 35
+#: same bound applies to readonly static keys (exact lock conflicts)
+MAX_READERS = 35
 
 _FREE, _PENDING, _INFLIGHT = 0, 1, 2
 
@@ -113,6 +115,8 @@ class ScanResult:
     bs_w: np.ndarray | None = None
     whash: np.ndarray | None = None
     w_cnt: np.ndarray | None = None
+    rhash: np.ndarray | None = None
+    r_cnt: np.ndarray | None = None
     trows: np.ndarray | None = None
     tszs: np.ndarray | None = None
     n_ok: int = 0
@@ -156,6 +160,8 @@ def txn_scan(
         out.bs_w = np.zeros((n, W), np.uint64)
         out.whash = np.zeros((n, MAX_WRITERS), np.uint64)
         out.w_cnt = np.zeros(n, np.uint8)
+        out.rhash = np.zeros((n, MAX_READERS), np.uint64)
+        out.r_cnt = np.zeros(n, np.uint8)
     if with_trailer:
         out.trows = rows if trows is None else trows
         out.tszs = np.zeros(n, np.uint32)
@@ -175,6 +181,9 @@ def txn_scan(
             out.whash.ctypes.data if with_bitsets else None,
             out.w_cnt.ctypes.data if with_bitsets else None,
             MAX_WRITERS,
+            out.rhash.ctypes.data if with_bitsets else None,
+            out.r_cnt.ctypes.data if with_bitsets else None,
+            MAX_READERS,
             out.trows.ctypes.data if with_trailer else None,
             out.trows.shape[1] if with_trailer else 0,
             out.tszs.ctypes.data if with_trailer else None,
@@ -223,15 +232,40 @@ class Pack:
         # hashed account-conflict bitsets
         self.bs_rw = np.zeros((P, self.W), dtype=np.uint64)
         self.bs_w = np.zeros((P, self.W), dtype=np.uint64)
-        # hashed writable-account keys per txn (writer cost caps)
+        # hashed writable/readonly account keys per txn (writer cost
+        # caps + exact lock tables)
         self.whash = np.zeros((P, MAX_WRITERS), dtype=np.uint64)
         self.w_cnt = np.zeros(P, dtype=np.uint8)
+        self.rhash = np.zeros((P, MAX_READERS), dtype=np.uint64)
+        self.r_cnt = np.zeros(P, dtype=np.uint8)
 
-        # in-use state across outstanding microblocks
+        # hashed-bitset in-use state: kept ONLY for the speculative
+        # device prefilter (ops/pack_select); the authoritative conflict
+        # check is the exact lock tables below — a 1024-bit bloom
+        # saturates under deep microblock pipelining and collapses fill
+        # (measured round 5: 47 of 256 txns/microblock).  The in_use
+        # masks stay zero now (nothing maintains them), so the prefilter
+        # only resolves candidate-vs-candidate conflicts; the exact
+        # commit re-checks everything it admits.
         self.in_use_rw = np.zeros(self.W, dtype=np.uint64)
         self.in_use_w = np.zeros(self.W, dtype=np.uint64)
         self.bit_ref_rw = np.zeros(nbits, dtype=np.int32)
         self.bit_ref_w = np.zeros(nbits, dtype=np.int32)
+
+        # EXACT account locks across outstanding microblocks (reference:
+        # fd_pack's acct_in_use map): open-addressing u64-hash ->
+        # refcount, writable + readonly tables.  4*depth entries covers
+        # realistic workloads (a few distinct keys per inflight txn) at
+        # low load factor; a pathological many-account workload (up to
+        # 35+35 keys/txn) can fill it, in which case lock_add FAILS
+        # CLOSED — fill degrades, over-admission is impossible
+        # (lock_table_load() exposes occupancy for monitors/tests).
+        lock_cnt = 1 << max(14, (4 * depth - 1).bit_length())
+        self._lock_mask = lock_cnt - 1
+        self.lw_keys = np.zeros(lock_cnt, dtype=np.uint64)
+        self.lw_vals = np.zeros(lock_cnt, dtype=np.int64)
+        self.lr_keys = np.zeros(lock_cnt, dtype=np.uint64)
+        self.lr_vals = np.zeros(lock_cnt, dtype=np.int64)
 
         # writer-cost map (hash-keyed open addressing, fdt_pack.c wc_*):
         # sized for a full block of minimum-cost txns' writable keys —
@@ -261,6 +295,14 @@ class Pack:
     @property
     def inflight_cnt(self) -> int:
         return int((self.state == _INFLIGHT).sum())
+
+    def lock_table_load(self) -> float:
+        """Occupancy of the fuller exact-lock table (0..1); near 1.0
+        means lock_add is failing closed and fill is degrading."""
+        cap = self._lock_mask + 1
+        return max(
+            int((self.lw_keys != 0).sum()), int((self.lr_keys != 0).sum())
+        ) / cap
 
     def writer_cost(self, key: bytes) -> int:
         """Committed write cost against `key`'s hash bucket this block."""
@@ -348,6 +390,8 @@ class Pack:
         self.bs_w[slots] = scan.bs_w[src]
         self.whash[slots] = scan.whash[src]
         self.w_cnt[slots] = scan.w_cnt[src]
+        self.rhash[slots] = scan.rhash[src]
+        self.r_cnt[slots] = scan.r_cnt[src]
         self.state[slots] = _PENDING
 
     def insert(
@@ -385,18 +429,21 @@ class Pack:
         self, order: np.ndarray, cu_limit: int, txn_limit: int,
         byte_limit: int,
     ) -> tuple[np.ndarray, int]:
-        """Greedy select + commit (native): returns (picks, cu_used)."""
+        """Greedy select + commit (native, EXACT account locks):
+        returns (picks, cu_used)."""
         if cu_limit <= 0 or txn_limit <= 0 or not len(order):
             return np.zeros(0, np.int64), 0
         picks = np.empty(min(len(order), txn_limit), np.int64)
         cu_used = np.zeros(1, np.int64)
-        n = R._lib.fdt_pack_select(
+        n = R._lib.fdt_pack_select_x(
             order.ctypes.data, len(order),
-            self.bs_rw.ctypes.data, self.bs_w.ctypes.data, self.W,
-            self.cost.ctypes.data, self.szs.ctypes.data, byte_limit,
-            self.in_use_rw.ctypes.data, self.in_use_w.ctypes.data,
-            self.bit_ref_rw.ctypes.data, self.bit_ref_w.ctypes.data,
             self.whash.ctypes.data, self.w_cnt.ctypes.data, MAX_WRITERS,
+            self.rhash.ctypes.data, self.r_cnt.ctypes.data, MAX_READERS,
+            self.lw_keys.ctypes.data, self.lw_vals.ctypes.data,
+            self._lock_mask,
+            self.lr_keys.ctypes.data, self.lr_vals.ctypes.data,
+            self._lock_mask,
+            self.cost.ctypes.data, self.szs.ctypes.data, byte_limit,
             self.wc_keys.ctypes.data, self.wc_vals.ctypes.data,
             self._wc_mask, self.writer_cost_cap, cu_limit, txn_limit,
             picks.ctypes.data, cu_used.ctypes.data,
@@ -535,11 +582,14 @@ class Pack:
             raise KeyError(f"no outstanding microblock {handle} on bank {bank}")
         obs.pop(i)
         idx = np.ascontiguousarray(mb.txn_idx, np.int64)
-        R._lib.fdt_pack_release(
+        R._lib.fdt_pack_release_x(
             idx.ctypes.data, len(idx),
-            self.bs_rw.ctypes.data, self.bs_w.ctypes.data, self.W,
-            self.bit_ref_rw.ctypes.data, self.bit_ref_w.ctypes.data,
-            self.in_use_rw.ctypes.data, self.in_use_w.ctypes.data,
+            self.whash.ctypes.data, self.w_cnt.ctypes.data, MAX_WRITERS,
+            self.rhash.ctypes.data, self.r_cnt.ctypes.data, MAX_READERS,
+            self.lw_keys.ctypes.data, self.lw_vals.ctypes.data,
+            self._lock_mask,
+            self.lr_keys.ctypes.data, self.lr_vals.ctypes.data,
+            self._lock_mask,
         )
         self._release_slots(mb.txn_idx)
 

@@ -69,8 +69,22 @@ RENT_BASE = 890_880
 
 #: consensus cap on account data size (10 MiB, MAX_PERMITTED_DATA_LENGTH)
 MAX_DATA_LEN = 10 * 1024 * 1024
+#: spare bytes after each account's data in the VM input region — the
+#: realloc headroom of Solana's aligned serializer (10 KiB)
+MAX_PERMITTED_DATA_INCREASE = 10 * 1024
 
 BPF_LOADER_ID = b"BPFLoader" + bytes(23)
+
+#: BPF loader v4 (reference: runtime/program/fd_bpf_loader_v4_program.c)
+LOADER_V4_ID = decode_32("LoaderV411111111111111111111111111111111111")
+#: loader-v4 account state header: u64 slot | authority[32] | u64 status
+LOADER_V4_STATE_SZ = 48
+_V4_RETRACTED, _V4_DEPLOYED, _V4_FINALIZED = 0, 1, 2
+#: slots between deploy/retract status flips (fd_bpf_loader_v4_program.c
+#: DEPLOYMENT_COOLDOWN_IN_SLOTS)
+V4_DEPLOYMENT_COOLDOWN = 750
+# loader-v4 instruction discriminants (bincode u32le)
+_V4_WRITE, _V4_TRUNCATE, _V4_DEPLOY, _V4_RETRACT, _V4_XFER_AUTH = range(5)
 
 # system instruction discriminants (bincode u32le)
 _SYS_CREATE = 0
@@ -136,6 +150,24 @@ def _nonce_encode(state: int, authority: bytes = bytes(32),
 
 def rent_exempt_minimum(space: int) -> int:
     return RENT_BASE + RENT_PER_BYTE * space
+
+
+def _v4_state(data: bytes):
+    """Loader-v4 state header -> (slot, authority, status) or None."""
+    if len(data) < LOADER_V4_STATE_SZ:
+        return None
+    return (
+        int.from_bytes(data[0:8], "little"),
+        bytes(data[8:40]),
+        int.from_bytes(data[40:48], "little"),
+    )
+
+
+def _v4_state_encode(slot: int, authority: bytes, status: int) -> bytes:
+    return (
+        slot.to_bytes(8, "little") + authority
+        + status.to_bytes(8, "little")
+    )
 
 
 def alt_addresses(table_data: bytes) -> list[bytes] | None:
@@ -282,8 +314,21 @@ class Executor:
             blockhash = hashlib.sha256(
                 b"fdt-blockhash" + slot.to_bytes(8, "little")
             ).digest()
-        if slot > 0 and slot != prev:
-            self._slot_hashes.add(prev, self.recent_blockhash)
+        if slot > prev:
+            # one entry per slot in (prev, slot), newest last-added: the
+            # reference's sysvar covers every slot (consecutive on
+            # mainnet) — a sparse bank clock must not leave holes, or a
+            # table deactivated in a skipped slot would read as expired
+            # immediately (fd_sysvar_slot_hashes.c slot_hashes_update)
+            lo = max(prev, slot - sysvar.SLOT_HASHES_MAX)
+            for s in range(lo, slot):
+                h = (
+                    self.recent_blockhash if s == prev
+                    else hashlib.sha256(
+                        b"fdt-slot" + s.to_bytes(8, "little")
+                    ).digest()
+                )
+                self._slot_hashes.add(s, h)
         self.recent_blockhash = blockhash
         sysvar.install(
             self.mgr, slot, unix_timestamp=unix_timestamp,
@@ -584,10 +629,22 @@ class Executor:
             ):
                 return "unknown program"
             return self._secp256k1_program(data, ctx)
+        if prog_key == LOADER_V4_ID:
+            return self._loader_v4(data, ins_keys, ctx, load, store)
         prog = load(prog_key)
         if prog is not None and prog.owner == BPF_LOADER_ID and prog.executable:
             return self._bpf(
                 prog, prog_key, data, ins_keys, ctx, load, store, logs
+            )
+        if prog is not None and prog.owner == LOADER_V4_ID:
+            # a loader-v4 program account: ELF bytes follow the 48-byte
+            # state header; only DEPLOYED programs execute
+            st = _v4_state(prog.data)
+            if st is None or st[2] == _V4_RETRACTED:
+                return "program not deployed"
+            return self._bpf(
+                prog, prog_key, data, ins_keys, ctx, load, store, logs,
+                elf=bytes(prog.data[LOADER_V4_STATE_SZ:]),
             )
         return "unknown program"
 
@@ -790,6 +847,203 @@ class Executor:
             if golden.verify(msg, sig, pk) != 0:
                 return "ed25519: invalid signature"
         return ""
+
+    def _v4_check_program(self, ins_keys, ctx: InstrCtx, load):
+        """check_program_account (fd_bpf_loader_v4_program.c:43-104):
+        -> (account, state, authority_key) or an error string."""
+        if len(ins_keys) < 2:
+            return "v4: not enough accounts"
+        prog_k, auth_k = ins_keys[0], ins_keys[1]
+        acct = load(prog_k)
+        if acct is None or acct.owner != LOADER_V4_ID:
+            return "v4: program not owned by loader"
+        if len(acct.data) == 0:
+            return "v4: program is uninitialized"
+        st = _v4_state(acct.data)
+        if st is None:
+            return "v4: account data too small"
+        if prog_k not in ctx.writables:
+            return "v4: program account not writable"
+        if auth_k not in ctx.signers:
+            return "v4: authority did not sign"
+        if st[1] != auth_k:
+            return "v4: incorrect authority"
+        if st[2] == _V4_FINALIZED:
+            return "v4: program is finalized"
+        return acct, st, auth_k
+
+    def _loader_v4(self, data, ins_keys, ctx: InstrCtx, load, store) -> str:
+        """BPF loader v4 meta-instructions: write / truncate / deploy /
+        retract / transfer_authority (behavior contract:
+        fd_bpf_loader_v4_program.c — write :166-232, truncate :234-264,
+        deploy :366-560, retract :560-620, transfer_authority :623-680).
+        Program bytes live after the 48-byte state header; deployment
+        cooldown and status machine match the reference."""
+        if len(data) < 4:
+            return "v4: bad instruction"
+        disc = int.from_bytes(data[:4], "little")
+
+        if disc == _V4_WRITE:
+            if len(data) < 16:
+                return "v4: bad write"
+            offset = int.from_bytes(data[4:8], "little")
+            n = int.from_bytes(data[8:16], "little")
+            if len(data) < 16 + n:
+                return "v4: bad write"
+            chk = self._v4_check_program(ins_keys, ctx, load)
+            if isinstance(chk, str):
+                return chk
+            acct, st, _ = chk
+            if st[2] != _V4_RETRACTED:
+                return "v4: program is not retracted"
+            body_sz = len(acct.data) - LOADER_V4_STATE_SZ
+            if offset + n > body_sz:
+                return "v4: write out of bounds"
+            off = LOADER_V4_STATE_SZ + offset
+            acct.data = (
+                acct.data[:off] + bytes(data[16 : 16 + n])
+                + acct.data[off + n :]
+            )
+            store(ins_keys[0], acct)
+            return ""
+
+        if disc == _V4_TRUNCATE:
+            if len(data) < 8 or len(ins_keys) < 2:
+                return "v4: bad truncate"
+            new_sz = int.from_bytes(data[4:8], "little")
+            prog_k, auth_k = ins_keys[0], ins_keys[1]
+            acct = load(prog_k)
+            if acct is None:
+                return "v4: no program account"
+            is_init = new_sz > 0 and len(acct.data) < LOADER_V4_STATE_SZ
+            if is_init:
+                if acct.owner != LOADER_V4_ID:
+                    return "v4: program not owned by loader"
+                if prog_k not in ctx.writables:
+                    return "v4: program account not writable"
+                if prog_k not in ctx.signers:
+                    return "v4: program did not sign"
+                if auth_k not in ctx.signers:
+                    return "v4: authority did not sign"
+            else:
+                chk = self._v4_check_program(ins_keys, ctx, load)
+                if isinstance(chk, str):
+                    return chk
+                acct, st, _ = chk
+                if st[2] != _V4_RETRACTED:
+                    return "v4: program is not retracted"
+            required = (
+                0 if new_sz == 0
+                else rent_exempt_minimum(LOADER_V4_STATE_SZ + new_sz)
+            )
+            if acct.lamports < required:
+                return "v4: insufficient lamports"
+            if acct.lamports > required:
+                # excess goes to the recipient account (index 2)
+                if len(ins_keys) < 3:
+                    return "v4: recipient missing"
+                rcpt_k = ins_keys[2]
+                if rcpt_k not in ctx.writables:
+                    return "v4: recipient not writable"
+                excess = acct.lamports - required
+                rcpt = load(rcpt_k) or Account(0)
+                acct.lamports -= excess
+                rcpt.lamports += excess
+                store(rcpt_k, rcpt)
+            raw_new = (
+                0 if new_sz == 0 else LOADER_V4_STATE_SZ + new_sz
+            )
+            if raw_new > MAX_DATA_LEN:
+                return "v4: program too large"
+            if raw_new > len(acct.data):
+                acct.data = acct.data + bytes(raw_new - len(acct.data))
+            else:
+                acct.data = acct.data[:raw_new]
+            if new_sz and is_init:
+                acct.data = (
+                    _v4_state_encode(0, auth_k, _V4_RETRACTED)
+                    + acct.data[LOADER_V4_STATE_SZ:]
+                )
+            store(prog_k, acct)
+            return ""
+
+        if disc == _V4_DEPLOY:
+            chk = self._v4_check_program(ins_keys, ctx, load)
+            if isinstance(chk, str):
+                return chk
+            acct, st, auth_k = chk
+            if st[0] + V4_DEPLOYMENT_COOLDOWN > self.slot:
+                return "v4: deployment cooldown in effect"
+            if st[2] != _V4_RETRACTED:
+                return "v4: program is not retracted"
+            source_k = ins_keys[2] if len(ins_keys) >= 3 else None
+            if source_k is not None:
+                src_chk = self._v4_check_program(
+                    [source_k, auth_k], ctx, load
+                )
+                if isinstance(src_chk, str):
+                    return src_chk
+                src, src_st, _ = src_chk
+                if src_st[2] != _V4_RETRACTED:
+                    return "v4: source program is not retracted"
+                # move the source's data region + top up rent
+                transfer = max(
+                    0, rent_exempt_minimum(len(src.data)) - acct.lamports
+                )
+                acct.data = bytes(src.data)
+                src.data = b""
+                src.lamports -= transfer
+                acct.lamports += transfer
+                store(source_k, src)
+            if len(acct.data) < LOADER_V4_STATE_SZ:
+                return "v4: account data too small"
+            acct.data = (
+                _v4_state_encode(self.slot, st[1], _V4_DEPLOYED)
+                + acct.data[LOADER_V4_STATE_SZ:]
+            )
+            acct.executable = True
+            store(ins_keys[0], acct)
+            return ""
+
+        if disc == _V4_RETRACT:
+            chk = self._v4_check_program(ins_keys, ctx, load)
+            if isinstance(chk, str):
+                return chk
+            acct, st, _ = chk
+            if st[0] + V4_DEPLOYMENT_COOLDOWN > self.slot:
+                return "v4: deployment cooldown in effect"
+            if st[2] == _V4_RETRACTED:
+                return "v4: program is not deployed"
+            acct.data = (
+                _v4_state_encode(st[0], st[1], _V4_RETRACTED)
+                + acct.data[LOADER_V4_STATE_SZ:]
+            )
+            store(ins_keys[0], acct)
+            return ""
+
+        if disc == _V4_XFER_AUTH:
+            chk = self._v4_check_program(ins_keys, ctx, load)
+            if isinstance(chk, str):
+                return chk
+            acct, st, _ = chk
+            new_auth = ins_keys[2] if len(ins_keys) >= 3 else None
+            if new_auth is not None:
+                if new_auth not in ctx.signers:
+                    return "v4: new authority did not sign"
+                acct.data = (
+                    _v4_state_encode(st[0], new_auth, st[2])
+                    + acct.data[LOADER_V4_STATE_SZ:]
+                )
+            elif st[2] == _V4_DEPLOYED:
+                acct.data = (
+                    _v4_state_encode(st[0], st[1], _V4_FINALIZED)
+                    + acct.data[LOADER_V4_STATE_SZ:]
+                )
+            else:
+                return "v4: program must be deployed to be finalized"
+            store(ins_keys[0], acct)
+            return ""
+        return "v4: unsupported instruction"
 
     def _secp256k1_program(self, data, ctx: InstrCtx) -> str:
         """Keccak-secp256k1 precompile (the ed25519 precompile's sibling;
@@ -1044,18 +1298,29 @@ class Executor:
         return ""
 
     def _bpf(self, prog: Account, prog_key: bytes, data, ins_keys,
-             ctx: InstrCtx, load, store, logs) -> str:
+             ctx: InstrCtx, load, store, logs, elf: bytes | None = None
+             ) -> str:
         """Execute an sBPF program with the instruction's accounts
-        serialized into the VM input region.
+        serialized into the VM input region in SOLANA'S aligned input
+        layout (the reference implements the same region in
+        fd_vm_context.c; layout from the Solana SDK's aligned
+        serializer):
 
-        Input ABI (this build's serialization; the reference implements
-        Solana's own input layout in fd_vm_context):
-          u16 acct_cnt
-          per account: pubkey[32] | u8 flags (1=writable, 2=signer)
-                       | u64 lamports | owner[32] | u64 data_len | data
-          u64 ins_data_len | ins_data
-        Writable accounts' lamports + data (same length; no realloc) are
-        committed back after a successful run.
+          u64 acct_cnt
+          per account, first occurrence:
+            u8  dup marker = 0xFF
+            u8  is_signer | u8 is_writable | u8 executable
+            u32 original_data_len
+            pubkey[32] | owner[32] | u64 lamports | u64 data_len
+            data | 10240 spare bytes (MAX_PERMITTED_DATA_INCREASE)
+            pad to 8 | u64 rent_epoch
+          per duplicate: u8 index-of-original + 7 pad bytes
+          u64 ins_data_len | ins_data | program_id[32]
+
+        Writable accounts commit back lamports, owner, and data — with
+        REALLOC honored: the program may rewrite data_len up to
+        original + 10240 (and under MAX_DATA_LEN); the spare region is
+        what makes in-place growth addressable.
 
         CPI: sol_invoke_signed_c re-enters _dispatch with caller-granted
         privileges + PDA signers (reference: fd_vm_syscalls.c
@@ -1064,37 +1329,55 @@ class Executor:
         from firedancer_tpu.flamenco.vm import Vm, VmError
 
         try:
-            program = sbpf.load(prog.data)
+            program = sbpf.load(elf if elf is not None else prog.data)
         except sbpf.SbpfError as e:
             return f"elf: {e}"
         vm = Vm(program, cu_limit=ctx.meter[0])
 
         buf = bytearray()
-        buf += len(ins_keys).to_bytes(2, "little")
-        offsets = []  # (key, writable, lamports_off, data_off, data_len)
-        for k in ins_keys:
+        buf += len(ins_keys).to_bytes(8, "little")
+        offsets = []  # (key, writable, lam_off, len_off, data_off,
+        #               orig_len, owner_off)
+        seen: dict[bytes, int] = {}
+        for idx, k in enumerate(ins_keys):
+            if k in seen:
+                buf += bytes([seen[k]]) + bytes(7)
+                continue
+            seen[k] = idx
             a = load(k) or Account(0)
             writable = k in ctx.writables
-            flags = (1 if writable else 0) | (2 if k in ctx.signers else 0)
-            buf += k + bytes([flags])
+            buf += bytes([
+                0xFF,
+                1 if k in ctx.signers else 0,
+                1 if writable else 0,
+                1 if a.executable else 0,
+            ])
+            buf += len(a.data).to_bytes(4, "little")
+            buf += k
+            owner_off = len(buf)
+            buf += a.owner
             lam_off = len(buf)
             buf += a.lamports.to_bytes(8, "little")
-            buf += a.owner
+            len_off = len(buf)
             buf += len(a.data).to_bytes(8, "little")
             data_off = len(buf)
             buf += a.data
-            offsets.append((k, writable, lam_off, data_off, len(a.data)))
+            buf += bytes(MAX_PERMITTED_DATA_INCREASE)
+            buf += bytes((-len(a.data)) % 8)
+            buf += int(a.rent_epoch).to_bytes(8, "little")
+            offsets.append(
+                (k, writable, lam_off, len_off, data_off, len(a.data),
+                 owner_off)
+            )
         buf += len(data).to_bytes(8, "little") + data
+        buf += prog_key
         vm.input_mem = bytearray(buf)
 
         # lamport conservation baseline BEFORE execution: CPI commits into
         # the overlay mid-run, so the post-run overlay is not the baseline
         pre_sum = 0
-        seen = set()
         for k, *_ in offsets:
-            if k not in seen:
-                seen.add(k)
-                pre_sum += (load(k) or Account(0)).lamports
+            pre_sum += (load(k) or Account(0)).lamports
 
         self._register_cpi(
             vm, prog_key, ins_keys, offsets, ctx, load, store, logs
@@ -1111,27 +1394,52 @@ class Executor:
         if r0 != 0:
             return f"program error {r0}"
         # Lamport conservation (ref fd_instr_info sum check): the sum of
-        # lamports across the instruction's unique accounts must not change.
-        post = {}  # key -> (lamports, data) committed values
-        for k, writable, lam_off, data_off, dlen in offsets:
-            if k in post and post[k][1] is not None:
-                continue  # first writable occurrence wins
+        # lamports across the instruction's unique accounts must not
+        # change.  `offsets` holds one entry per unique account (dups
+        # serialize as index references).
+        post = {}  # key -> (lamports, data | None, owner | None)
+        for k, writable, lam_off, len_off, data_off, orig_len, owner_off \
+                in offsets:
             if writable:
-                post[k] = (
-                    int.from_bytes(vm.input_mem[lam_off : lam_off + 8], "little"),
-                    bytes(vm.input_mem[data_off : data_off + dlen]),
+                new_len = int.from_bytes(
+                    vm.input_mem[len_off : len_off + 8], "little"
                 )
-            elif k not in post:
+                if (
+                    new_len > orig_len + MAX_PERMITTED_DATA_INCREASE
+                    or new_len > MAX_DATA_LEN
+                ):
+                    return "invalid account data realloc"
+                new_owner = bytes(
+                    vm.input_mem[owner_off : owner_off + 32]
+                )
+                cur = load(k) or Account(0)
+                if new_owner != cur.owner:
+                    # owner reassignment through the input region is
+                    # legal only for the account's CURRENT owning
+                    # program on a non-executable account (reference:
+                    # fd_account_set_owner / Agave ModifiedProgramId)
+                    if cur.owner != prog_key or cur.executable:
+                        return "instruction illegally modified " \
+                               "account owner"
+                post[k] = (
+                    int.from_bytes(
+                        vm.input_mem[lam_off : lam_off + 8], "little"
+                    ),
+                    bytes(vm.input_mem[data_off : data_off + new_len]),
+                    new_owner,
+                )
+            else:
                 a = load(k) or Account(0)
-                post[k] = (a.lamports, None)
-        if sum(lam for lam, _ in post.values()) != pre_sum:
+                post[k] = (a.lamports, None, None)
+        if sum(lam for lam, _, _ in post.values()) != pre_sum:
             return "instruction changed total lamports"
-        for k, (lam, new_data) in post.items():
+        for k, (lam, new_data, new_owner) in post.items():
             if new_data is None:
                 continue
             a = load(k) or Account(0)
             a.lamports = lam
             a.data = new_data
+            a.owner = new_owner
             store(k, a)
         return ""
 
@@ -1154,32 +1462,56 @@ class Executor:
 
         def _sync_down():
             """Caller's input-region writes -> overlay (callee must see
-            the caller's in-flight state)."""
-            done = set()
-            for k, writable, lam_off, data_off, dlen in offsets:
-                if not writable or k in done:
+            the caller's in-flight state, including in-place reallocs)."""
+            for k, writable, lam_off, len_off, data_off, orig_len, \
+                    owner_off in offsets:
+                if not writable:
                     continue
-                done.add(k)
+                cur_len = int.from_bytes(
+                    vm.input_mem[len_off : len_off + 8], "little"
+                )
+                if cur_len > orig_len + MAX_PERMITTED_DATA_INCREASE:
+                    raise VmError("cpi: invalid account data realloc")
                 a = load(k) or Account(0)
+                new_owner = bytes(
+                    vm.input_mem[owner_off : owner_off + 32]
+                )
+                if new_owner != a.owner:
+                    # same owner-reassignment rule as the commit path
+                    if a.owner != prog_key or a.executable:
+                        raise VmError(
+                            "cpi: instruction illegally modified "
+                            "account owner"
+                        )
+                    a.owner = new_owner
                 a.lamports = int.from_bytes(
                     vm.input_mem[lam_off : lam_off + 8], "little"
                 )
-                if len(a.data) == dlen:
-                    a.data = bytes(vm.input_mem[data_off : data_off + dlen])
+                a.data = bytes(vm.input_mem[data_off : data_off + cur_len])
                 store(k, a)
 
         def _sync_up():
-            """Overlay -> caller's input region after the callee ran."""
-            for k, writable, lam_off, data_off, dlen in offsets:
+            """Overlay -> caller's input region after the callee ran.
+            A callee-side realloc copies back into the caller's spare
+            region (reference: CPI copy-back honors resized accounts up
+            to the serialized headroom)."""
+            for k, writable, lam_off, len_off, data_off, orig_len, \
+                    owner_off in offsets:
                 if not writable:
                     continue
                 a = load(k) or Account(0)
-                if len(a.data) != dlen:
-                    raise VmError("cpi: account resized (realloc unsupported)")
+                if len(a.data) > orig_len + MAX_PERMITTED_DATA_INCREASE:
+                    raise VmError(
+                        "cpi: account grown beyond realloc headroom"
+                    )
                 vm.input_mem[lam_off : lam_off + 8] = a.lamports.to_bytes(
                     8, "little"
                 )
-                vm.input_mem[data_off : data_off + dlen] = a.data
+                vm.input_mem[len_off : len_off + 8] = len(a.data).to_bytes(
+                    8, "little"
+                )
+                vm.input_mem[data_off : data_off + len(a.data)] = a.data
+                vm.input_mem[owner_off : owner_off + 32] = a.owner
 
         def _seed_array(addr, count):
             """Read a SolSignerSeedC[count] array -> list of seed bytes,

@@ -181,14 +181,16 @@ def _verify_digest_rlc_impl(digests, sigs, pubs, zbytes, interpret=False):
 
 
 def _use_rlc() -> bool:
-    """Batch (RLC) verification is the default fast path on TPU;
-    FDT_VERIFY_RLC=0 forces strict per-sig verification everywhere."""
+    """Opt-in (FDT_VERIFY_RLC=1).  Measured round 5 (PROFILE.md): the
+    bucket-MSM batch path runs at ~298K sigs/s vs the per-sig Strauss
+    kernel's ~388K on this chip — the per-update bucket overhead eats
+    the curve-op savings — so per-sig stays the default."""
     import os
 
     env = os.environ.get("FDT_VERIFY_RLC")
     if env is not None:
         return env.strip().lower() not in ("", "0", "false", "no", "off")
-    return jax.default_backend() == "tpu"
+    return False
 
 
 def verify_batch_digest_rlc(digests, sigs, pubs, zbytes=None):

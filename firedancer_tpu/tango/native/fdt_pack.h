@@ -43,6 +43,8 @@ void fdt_pack_init_consts( uint8_t const * cb_pid, uint8_t const * vote_pid,
         INTO THE PAYLOAD, i.e. relative to rows[i*stride + in_off])
      bs_rw, bs_w  (n x nbits/64) hashed account conflict bitsets
      whash (n x max_w) + w_cnt[i]  64-bit hashes of writable static keys
+     rhash (n x max_r) + r_cnt[i]  64-bit hashes of readonly static keys
+       (exact read-vs-write conflict input for fdt_pack_select_x)
      trows + tszs: payload + 16-byte wire trailer (tiles/wire.py format)
        written at trows[i*tstride]; tszs[i] = txn_sz + 16
    Returns number of ok txns. */
@@ -55,6 +57,7 @@ int64_t fdt_txn_scan( uint8_t const * rows, int64_t stride, int64_t in_off,
                       uint32_t * src_off, uint32_t * dst_off, uint32_t * fee,
                       uint64_t * bs_rw, uint64_t * bs_w,
                       uint64_t * whash, uint8_t * w_cnt, int64_t max_w,
+                      uint64_t * rhash, uint8_t * r_cnt, int64_t max_r,
                       uint8_t * trows, int64_t tstride, uint32_t * tszs );
 
 /* Greedy conflict-aware select + commit for one microblock.  Walks `order`
@@ -81,6 +84,35 @@ void fdt_pack_release( int64_t const * idx, int64_t n,
                        uint64_t const * bs_rw, uint64_t const * bs_w,
                        int64_t W, int32_t * ref_rw, int32_t * ref_w,
                        uint64_t * in_use_rw, uint64_t * in_use_w );
+
+/* EXACT-lock select + release: same greedy walk as fdt_pack_select, but
+   conflicts are checked against exact refcounted account-hash lock
+   tables (lw = writable locks, lr = readonly locks) instead of the
+   hashed bitsets, which saturate under deep microblock pipelining (the
+   reference's acct_in_use map is exact for the same reason).  Tables
+   are open-addressing u64->refcount with backward-shift deletion; a
+   full table fails closed (conflict).  lw_mask/lr_mask = table_size-1,
+   power of two. */
+int64_t fdt_pack_select_x( int64_t const * order, int64_t n_cand,
+                           uint64_t const * whash, uint8_t const * w_cnt,
+                           int64_t max_w, uint64_t const * rhash,
+                           uint8_t const * r_cnt, int64_t max_r,
+                           uint64_t * lw_keys, int64_t * lw_vals,
+                           int64_t lw_mask, uint64_t * lr_keys,
+                           int64_t * lr_vals, int64_t lr_mask,
+                           uint32_t const * cost, uint16_t const * szs,
+                           int64_t byte_limit, uint64_t * wc_keys,
+                           int64_t * wc_vals, int64_t wc_mask,
+                           int64_t writer_cap, int64_t cu_limit,
+                           int64_t txn_limit, int64_t * picks,
+                           int64_t * cu_used_out );
+void fdt_pack_release_x( int64_t const * idx, int64_t n,
+                         uint64_t const * whash, uint8_t const * w_cnt,
+                         int64_t max_w, uint64_t const * rhash,
+                         uint8_t const * r_cnt, int64_t max_r,
+                         uint64_t * lw_keys, int64_t * lw_vals,
+                         int64_t lw_mask, uint64_t * lr_keys,
+                         int64_t * lr_vals, int64_t lr_mask );
 
 /* Microblock wire codec (tiles/pack.py format:
    u32 handle | u16 bank | u16 txn_cnt | txn_cnt * ( u16 sz | sz bytes )).

@@ -88,7 +88,8 @@ def _load() -> ct.CDLL:
             ct.c_int64,
             [vp, ct.c_int64, ct.c_int64, vp, ct.c_int64, ct.c_int64]
             + [vp] * 12
-            + [vp, vp, vp, vp, ct.c_int64, vp, ct.c_int64, vp],
+            + [vp, vp, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+               vp, ct.c_int64, vp],
         ),
         "fdt_pack_select": (
             ct.c_int64,
@@ -99,6 +100,18 @@ def _load() -> ct.CDLL:
         "fdt_pack_release": (
             None,
             [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, vp, vp],
+        ),
+        "fdt_pack_select_x": (
+            ct.c_int64,
+            [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             ct.c_int64, ct.c_int64, ct.c_int64, vp, vp],
+        ),
+        "fdt_pack_release_x": (
+            None,
+            [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
+             vp, vp, ct.c_int64, vp, vp, ct.c_int64],
         ),
         "fdt_mb_encode": (
             ct.c_int64,

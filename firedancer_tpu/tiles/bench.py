@@ -130,12 +130,17 @@ class UdpBlaster:
                     and self.sent - self.landed > self.window
                 ):
                     # permanently lost txns (UDP drops, rejects) never
-                    # leave the window; if landing stalls, degrade to
-                    # unpaced sending rather than wedging forever
+                    # leave the window; a long landing stall (device
+                    # tunnel hiccups block the verify tile for tens of
+                    # seconds) must NOT trigger unpaced sending — that
+                    # burns the finite pool as full-buffer rejects in
+                    # seconds (measured round 5: a 20 s stall torched
+                    # 300K of a 512K pool).  Hold position unless the
+                    # stall outlives any observed tunnel hiccup.
                     now = time.monotonic()
                     if self.landed != last_landed:
                         last_landed, last_progress = self.landed, now
-                    if now - last_progress < 5.0:
+                    if now - last_progress < 120.0:
                         time.sleep(0.005)
                         continue
                 end = min(self.sent + self.burst, n)

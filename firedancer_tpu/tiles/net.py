@@ -45,7 +45,7 @@ class NetTile(Tile):
     name = "net"
     schema = MetricsSchema(
         counters=("rx_dgrams", "tx_dgrams", "rx_bytes", "tx_bytes",
-                  "oversize_drops"),
+                  "oversize_drops", "tx_routed", "tx_unrouted"),
     )
 
     def __init__(
@@ -72,6 +72,18 @@ class NetTile(Tile):
     def on_boot(self, ctx: MuxCtx) -> None:
         self.quic_sock = UdpSock(self._quic_addr_req)
         self.udp_sock = UdpSock(self._udp_addr_req)
+        # egress routing observability: mirror the host tables (the
+        # reference's net tile consults fd_ip to pick the egress
+        # interface/next hop for every tx, src/waltz/ip/fd_ip.c; with
+        # kernel UDP sockets the kernel routes for real, so the mirror's
+        # job is surfacing that decision in metrics)
+        from firedancer_tpu.waltz.ip import IpStack
+
+        try:
+            self._ip = IpStack.from_proc()
+        except OSError:
+            self._ip = IpStack()
+        self._route_cache: dict[str, bool] = {}
 
     def on_halt(self, ctx: MuxCtx) -> None:
         for s in (self.quic_sock, self.udp_sock):
@@ -85,9 +97,24 @@ class NetTile(Tile):
         pkts = []
         for i in range(len(rows)):
             row = rows[i, : frags["sz"][i]]
-            addr = addr_unpack(row[:ADDR_SZ])
-            pkts.append((row[ADDR_SZ:].tobytes(), addr))
+            pkts.append((row[ADDR_SZ:].tobytes(), addr_unpack(row[:ADDR_SZ])))
         n = self.quic_sock.send_burst(pkts)
+        # route classification covers only packets actually SENT, so
+        # tx_routed + tx_unrouted == tx_dgrams holds across partial
+        # bursts (EAGAIN drops)
+        routed = unrouted = 0
+        for _, addr in pkts[:n]:
+            hit = self._route_cache.get(addr[0])
+            if hit is None:
+                hit = self._ip.lookup_route(addr[0]) is not None
+                if len(self._route_cache) < 4096:
+                    self._route_cache[addr[0]] = hit
+            routed += hit
+            unrouted += not hit
+        if routed:
+            ctx.metrics.inc("tx_routed", routed)
+        if unrouted:
+            ctx.metrics.inc("tx_unrouted", unrouted)
         ctx.metrics.inc("tx_dgrams", n)
         ctx.metrics.inc("tx_bytes", int(frags["sz"].sum()) - ADDR_SZ * len(rows))
 

@@ -22,7 +22,12 @@ Round 4 added RFC 9000 section 6 version negotiation (stateless VN
 packets from the server, client abort on incompatible VN) and RFC 9001
 section 6 key update (phase bit, per-generation secrets via "quic ku",
 constant header-protection keys, previous-generation receive window).
-Remaining scope note: no connection migration.
+
+Round 5 added RFC 9000 section 9 connection migration: the server
+routes short-header packets from unknown addresses by DCID, adopts the
+new path, probes it with PATH_CHALLENGE/PATH_RESPONSE, and offers spare
+CIDs via NEW_CONNECTION_ID after the handshake so a migrating client
+rotates its destination CID (9.5).
 
 Sans-IO: Connection.datagrams_out() drains UDP payloads to send; feed
 received payloads via Connection.on_datagram(); call on_timer(now)
@@ -282,6 +287,20 @@ class Connection:
         #: cached next-generation rx trial keys (one derivation per
         #: generation, not per phase-mismatched packet)
         self._rx_next: Keys | None = None
+        # ---- connection migration state (RFC 9000 section 9) ----
+        #: CIDs we issued via NEW_CONNECTION_ID (all route to us)
+        self.scids: set[bytes] = {scid}
+        self._cid_seq = 0
+        self._cids_issued = False
+        #: CIDs the peer issued to us: list of (seq, cid)
+        self.peer_cids: list[tuple[int, bytes]] = []
+        #: outstanding PATH_CHALLENGE data (one at a time)
+        self._path_challenge_sent: bytes | None = None
+        #: set when a matching PATH_RESPONSE arrives (owner consumes)
+        self.path_response: bytes | None = None
+        #: packets that passed AEAD authentication (the migration gate:
+        #: an address change is only honored for a packet that decrypts)
+        self.rx_auth_cnt = 0
 
     # -- key install ---------------------------------------------------------
 
@@ -457,6 +476,7 @@ class Connection:
             self.sent[INITIAL].clear()
         if level == APPLICATION:
             self.sent[HANDSHAKE].clear()
+        self.rx_auth_cnt += 1  # packets that AUTHENTICATED (migration gate)
         self.largest_rx[level] = max(self.largest_rx[level], pn)
         self._range_add(level, pn)
         if self._on_frames(level, payload):
@@ -480,6 +500,50 @@ class Connection:
         self._rx_next = None
         self.key_phase ^= 1
         self.key_updates += 1
+
+    # -- connection migration (RFC 9000 section 9) ---------------------------
+
+    def issue_new_cids(self, n: int = 2) -> list[bytes]:
+        """Queue NEW_CONNECTION_ID frames offering n fresh CIDs; returns
+        them so the owner can route future short-header packets
+        addressed to any of them (fd_quic keeps a CID map per conn)."""
+        out = []
+        for _ in range(n):
+            cid = os.urandom(8)
+            self._cid_seq += 1
+            frame = (
+                b"\x18"
+                + vi_enc(self._cid_seq)
+                + vi_enc(0)
+                + bytes([len(cid)])
+                + cid
+                + bytes(16)  # stateless reset token (unused)
+            )
+            self._pending_frames[APPLICATION].append(frame)
+            self.scids.add(cid)
+            out.append(cid)
+        self._drive()
+        return out
+
+    def send_path_challenge(self) -> bytes:
+        """Probe the current peer path: queue PATH_CHALLENGE with fresh
+        random data (RFC 9000 8.2.1); a matching PATH_RESPONSE sets
+        self.path_response."""
+        data = os.urandom(8)
+        self._path_challenge_sent = data
+        self._pending_frames[APPLICATION].append(b"\x1a" + data)
+        self._drive()
+        return data
+
+    def migrate_dcid(self) -> bool:
+        """Switch to the next CID the peer issued (a migrating endpoint
+        SHOULD rotate its destination CID, RFC 9000 9.5).  Returns False
+        when the peer never offered spare CIDs."""
+        if not self.peer_cids:
+            return False
+        _, cid = self.peer_cids.pop(0)
+        self.dcid = cid
+        return True
 
     def initiate_key_update(self) -> None:
         """Start sending 1-RTT packets under the next key generation
@@ -584,10 +648,31 @@ class Connection:
                     _, off = vi_dec(payload, off)
             elif ft == 0x18:  # NEW_CONNECTION_ID
                 off += 1
-                _, off = vi_dec(payload, off)
-                _, off = vi_dec(payload, off)
+                seq, off = vi_dec(payload, off)
+                _, off = vi_dec(payload, off)  # retire_prior_to
                 cl = payload[off]
-                off += 1 + cl + 16
+                cid = payload[off + 1 : off + 1 + cl]
+                off += 1 + cl + 16  # + stateless reset token
+                if not any(s == seq for s, _ in self.peer_cids):
+                    self.peer_cids.append((seq, bytes(cid)))
+            elif ft == 0x19:  # RETIRE_CONNECTION_ID
+                off += 1
+                _, off = vi_dec(payload, off)
+            elif ft == 0x1A:  # PATH_CHALLENGE
+                off += 1
+                data = bytes(payload[off : off + 8])
+                off += 8
+                # echo on PATH_RESPONSE (RFC 9000 8.2.2); the response
+                # rides the normal tx path, which the owner points at
+                # the probed address during migration
+                self._pending_frames[APPLICATION].append(b"\x1b" + data)
+            elif ft == 0x1B:  # PATH_RESPONSE
+                off += 1
+                data = bytes(payload[off : off + 8])
+                off += 8
+                if data == self._path_challenge_sent:
+                    self.path_response = data
+                    self._path_challenge_sent = None
             elif ft in (0x1C, 0x1D):  # CONNECTION_CLOSE
                 self.closed = True
                 return eliciting
@@ -937,9 +1022,14 @@ class QuicServer:
         self.lru = Lru(max_conns)
         #: stateless packets to send: (datagram, addr) — Retry responses
         self.stateless_out: list[tuple[bytes, object]] = []
+        #: address migrations adopted (path challenges sent)
+        self.migrations = 0
+        #: migrations whose PATH_RESPONSE validated the new path
+        self.paths_validated = 0
 
     def _reap(self, addr, conn) -> None:
-        self.conns.pop(conn.scid, None)
+        for cid in conn.scids:
+            self.conns.pop(cid, None)
         self.by_addr.pop(addr, None)
         self.lru.remove(addr)
 
@@ -1007,6 +1097,36 @@ class QuicServer:
         if conn is not None and conn.closed:
             self._reap(addr, conn)
             conn = None
+        if conn is None and len(data) >= 9 and not (data[0] & 0x80):
+            # short header from an UNKNOWN address: route by DCID — an
+            # established peer migrating (NAT rebind, multihome).  RFC
+            # 9000 section 9: the address change is honored ONLY if the
+            # packet AUTHENTICATES (DCIDs are plaintext — an off-path
+            # attacker echoing an observed CID from its own address must
+            # not be able to steal the path), then the new path is
+            # validated with PATH_CHALLENGE.  fd_quic routes through its
+            # CID map the same way.
+            cand = self.conns.get(bytes(data[1:9]))
+            if cand is not None and cand.established and not cand.closed:
+                auth0 = cand.rx_auth_cnt
+                cand.on_datagram(data)
+                if cand.rx_auth_cnt == auth0:
+                    return None  # did not decrypt: ignore, keep old path
+                old = getattr(cand, "_addr", None)
+                if old is not None and old != addr:
+                    self.by_addr.pop(old, None)
+                    self.lru.remove(old)
+                self.by_addr[addr] = cand
+                cand._addr = addr
+                self.lru.acquire(addr)
+                self.migrations += 1
+                cand.send_path_challenge()
+                if cand.path_response is not None:
+                    self.paths_validated += 1
+                    cand.path_response = None
+                if cand.closed:
+                    self._reap(addr, cand)
+                return cand
         if conn is None:
             if len(data) < 7 or not (data[0] & 0x80):
                 return None  # short header / runt for unknown conn
@@ -1084,8 +1204,18 @@ class QuicServer:
             conn.validated = conn.validated or validated
             self.conns[scid] = conn
             self.by_addr[addr] = conn
+        conn._addr = addr
         self.lru.acquire(addr)
         conn.on_datagram(data)
+        if conn.path_response is not None:
+            self.paths_validated += 1
+            conn.path_response = None
+        if conn.established and not conn._cids_issued:
+            # offer spare CIDs so a migrating client can rotate its
+            # destination CID (RFC 9000 9.5); register them for routing
+            conn._cids_issued = True
+            for cid in conn.issue_new_cids():
+                self.conns[cid] = conn
         if conn.closed:
             self._reap(addr, conn)
         return conn

@@ -37,6 +37,112 @@ ZWIN = M.ZWIN
 ROWS = M.ROWS
 
 
+def make_kernel_nocat(wpb: int, tile: int):
+    """Coordinate-wise update: no reshape/concatenate of the bucket
+    stack — gather/scatter run per coord on (9, NL, tile) slices, so
+    Mosaic never materializes an (80, tile) flat copy."""
+
+    def kernel(one_ref, cd_ref, zd_ref, an_ref, rn_ref, out_ref):
+        wb = pl.program_id(0)
+        t = pl.program_id(1)
+        w0 = wb * wpb
+        one = one_ref[...]
+        zero = jnp.zeros_like(one)
+
+        @pl.when(t == 0)
+        def _init():
+            for j in range(wpb):
+                for b in range(9):
+                    base = b * 4 * NL
+                    out_ref[j, base : base + NL, :] = zero
+                    out_ref[j, base + NL : base + 2 * NL, :] = one
+                    out_ref[j, base + 2 * NL : base + 3 * NL, :] = one
+                    out_ref[j, base + 3 * NL : base + 4 * NL, :] = zero
+
+        def sel(j, coord, v):
+            """Gather coord c of the v-selected bucket: tree over 9."""
+            ent = [
+                out_ref[j, b * 4 * NL + coord * NL :
+                        b * 4 * NL + (coord + 1) * NL, :]
+                for b in range(9)
+            ]
+            b0 = ((v & 1) != 0)[None, :]
+            b1 = ((v & 2) != 0)[None, :]
+            b2 = ((v & 4) != 0)[None, :]
+            b3 = (v >= 8)[None, :]
+            s0 = jnp.where(b0, ent[1], ent[0])
+            s2 = jnp.where(b0, ent[3], ent[2])
+            s4 = jnp.where(b0, ent[5], ent[4])
+            s6 = jnp.where(b0, ent[7], ent[6])
+            t0 = jnp.where(b1, s2, s0)
+            t4 = jnp.where(b1, s6, s4)
+            return jnp.where(b3, ent[8], jnp.where(b2, t4, t0))
+
+        def update(j, digit, niels3):
+            v = jnp.abs(digit)
+            neg = (digit < 0)[None, :]
+            ypx = niels3[0:NL]
+            ymx = niels3[NL : 2 * NL]
+            t2d = niels3[2 * NL : 3 * NL]
+            e = (
+                jnp.where(neg, ymx, ypx),
+                jnp.where(neg, ypx, ymx),
+                jnp.where(neg, -t2d, t2d),
+            )
+            p = tuple(sel(j, c, v) for c in range(4))
+            newp = PT.add_niels_affine(p, e, with_t=True)
+            for b in range(1, 9):
+                m = (v == b)[None, :]
+                for c in range(4):
+                    base = b * 4 * NL + c * NL
+                    old = out_ref[j, base : base + NL, :]
+                    out_ref[j, base : base + NL, :] = jnp.where(
+                        m, newp[c], old
+                    )
+
+        for j in range(wpb):
+            d = jnp.squeeze(cd_ref[pl.ds(w0 + j, 1), :], axis=0)
+            update(j, d, an_ref[...])
+
+        @pl.when(wb < ZWIN // wpb)
+        def _():
+            for j in range(wpb):
+                d = jnp.squeeze(zd_ref[pl.ds(w0 + j, 1), :], axis=0)
+                update(j, d, rn_ref[...])
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(cdig, zdig, an3, rn3):
+        B = cdig.shape[-1]
+        nt = B // tile
+        one_tile = jnp.broadcast_to(F.c("ONE"), (NL, tile)).astype(
+            jnp.int32
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((NWIN, ROWS, tile), jnp.int32),
+            grid=(NWIN // wpb, nt),
+            in_specs=[
+                pl.BlockSpec((NL, tile), lambda w, t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((NWIN, tile), lambda w, t: (0, t),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((ZWIN, tile), lambda w, t: (0, t),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((3 * NL, tile), lambda w, t: (0, t),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((3 * NL, tile), lambda w, t: (0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (wpb, ROWS, tile), lambda w, t: (w, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            interpret=False,
+        )(one_tile, cdig, zdig, an3, rn3)
+
+    return run
+
+
 def make_kernel(wpb: int, scatter: bool, do_add: bool, with_z: bool):
     def kernel(one_ref, cd_ref, zd_ref, an_ref, rn_ref, out_ref):
         wb = pl.program_id(0)
@@ -150,31 +256,51 @@ def main() -> None:
         jax.device_put(x) for x in (cdig, zdig, ident, ident.copy())
     )
 
-    variants = [
-        ("base", dict(wpb=4, scatter=True, do_add=True, with_z=True)),
-        ("noscatter", dict(wpb=4, scatter=False, do_add=True, with_z=True)),
-        ("noadd", dict(wpb=4, scatter=True, do_add=False, with_z=True)),
-        ("nozd", dict(wpb=4, scatter=True, do_add=True, with_z=False)),
-        ("wpb8", dict(wpb=8, scatter=True, do_add=True, with_z=True)),
-        ("wpb16", dict(wpb=16, scatter=True, do_add=True, with_z=True)),
-    ]
-    for name, cfg in variants:
-        fn = make_kernel(**cfg)
-        t0 = time.perf_counter()
-        out = fn(*args)
-        np.asarray(out[:1, :1, :1])
-        compile_s = time.perf_counter() - t0
-        best = float("inf")
-        for _ in range(3):
+    import os
+
+    names = os.environ.get(
+        "FDT_MSM_VARIANTS", "base,noscatter,noadd,nozd,wpb1,wpb2,wpb8"
+    ).split(",")
+    all_variants = {
+        "base": dict(wpb=4, scatter=True, do_add=True, with_z=True),
+        "noscatter": dict(wpb=4, scatter=False, do_add=True, with_z=True),
+        "noadd": dict(wpb=4, scatter=True, do_add=False, with_z=True),
+        "nozd": dict(wpb=4, scatter=True, do_add=True, with_z=False),
+        "wpb1": dict(wpb=1, scatter=True, do_add=True, with_z=True),
+        "wpb2": dict(wpb=2, scatter=True, do_add=True, with_z=True),
+        "wpb8": dict(wpb=8, scatter=True, do_add=True, with_z=True),
+        "wpb16": dict(wpb=16, scatter=True, do_add=True, with_z=True),
+    }
+    special = {
+        "nocat": lambda: make_kernel_nocat(4, 256),
+        "nocat512": lambda: make_kernel_nocat(2, 512),
+        "nocat512w4": lambda: make_kernel_nocat(4, 512),
+    }
+    for name in names:
+        try:
+            if name in special:
+                cfg = {"wpb": 0}
+                fn = special[name]()
+            else:
+                cfg = all_variants[name]
+                fn = make_kernel(**cfg)
             t0 = time.perf_counter()
             out = fn(*args)
             np.asarray(out[:1, :1, :1])
-            best = min(best, time.perf_counter() - t0)
-        print(
-            f"{name:10s} wpb={cfg['wpb']:2d} best={best*1e3:8.1f} ms"
-            f"  ({best/B*1e9:6.1f} ns/sig)  compile={compile_s:.0f}s",
-            flush=True,
-        )
+            compile_s = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                np.asarray(out[:1, :1, :1])
+                best = min(best, time.perf_counter() - t0)
+            print(
+                f"{name:10s} wpb={cfg['wpb']:2d} best={best*1e3:8.1f} ms"
+                f"  ({best/B*1e9:6.1f} ns/sig)  compile={compile_s:.0f}s",
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 — survey must survive OOMs
+            print(f"{name:10s} FAILED: {str(e)[:160]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -61,13 +61,22 @@ def _sign_stub(n):
     return [bytes([7]) * 64 for _ in range(n)]
 
 
+SPARE = 10 * 1024  # MAX_PERMITTED_DATA_INCREASE
+
+
+def entry_sz(d):
+    """Serialized size of one non-dup account with d data bytes
+    (Solana aligned input layout; see Executor._bpf)."""
+    return 8 + 32 + 32 + 8 + 8 + d + SPARE + (-d % 8) + 8
+
+
 def acct_off(i, data_lens):
-    """Input-ABI offset of account i's pubkey (see Executor._bpf)."""
-    return 2 + sum(81 + d for d in data_lens[:i])
+    """Input-ABI offset of account i's pubkey (all accounts distinct)."""
+    return 8 + sum(entry_sz(d) for d in data_lens[:i]) + 8
 
 
 def ins_data_off(data_lens):
-    return 2 + sum(81 + d for d in data_lens) + 8
+    return 8 + sum(entry_sz(d) for d in data_lens) + 8
 
 
 H = sbpf.MM_HEAP
@@ -203,12 +212,12 @@ def test_cpi_depth_limit():
     # until the invoke stack cap stops it
     t = b""
     t += lddw(6, H)
-    t += set_dw(6, 0, I + 2)     # program id = own key (first account)
+    t += set_dw(6, 0, I + 16)    # program id = own key (pubkey at +16)
     t += set_dw(6, 8, H + 0x80)  # one meta: itself, readonly non-signer
     t += set_dw(6, 16, 1)
     t += set_dw(6, 24, 0)        # no data
     t += set_dw(6, 32, 0)
-    t += set_dw(6, 0x80, I + 2)
+    t += set_dw(6, 0x80, I + 16)
     t += lddw(1, 0) + stxh(6, 0x88, 1)
     t += ins(0xBF, dst=1, src=6)
     t += ins(0xB7, dst=2, imm=0) + ins(0xB7, dst=3, imm=0)
@@ -251,14 +260,13 @@ def test_cpi_indirect_reentrancy_rejected():
         t += MOV0_EXIT
         return t
 
-    # B's input will hold [a_key (0 B data)]: A's key sits at I+2
-    b_elf = sbpf.build_elf(invoke_text(I + 2))
+    # B's input will hold [a_key (0 B data)]: A's key sits at I+16
+    b_elf = sbpf.build_elf(invoke_text(I + 16))
     ex.mgr.store(b_key, Account(1, BPF_LOADER_ID, True, 0, b_elf))
-    # A's input holds [b_key (elf data), a_key? no]: A passes a_key as the
-    # callee's meta, so A's accounts = [b_key, a_key]; b at I+2,
-    # a at I+2+81+len(b_elf)
+    # A's input holds [b_key (elf data), a_key]: A passes a_key as the
+    # callee's meta, so A's accounts = [b_key, a_key]; b's key at I+16
     a_off = I + acct_off(1, [len(b_elf), 0])
-    a_elf = sbpf.build_elf(invoke_text(I + 2, meta_addr=a_off))
+    a_elf = sbpf.build_elf(invoke_text(I + 16, meta_addr=a_off))
     ex.mgr.store(a_key, Account(1, BPF_LOADER_ID, True, 0, a_elf))
 
     txn = T.build(
@@ -280,7 +288,8 @@ def test_create_program_address_syscall():
     # account layout: [payer(0B), scratch(32B), prog(elf)]
     # seeds @ heap: one SolSignerSeedC {ptr->"vault", len 5}
     # result -> scratch data region in the input
-    scratch_data = I + acct_off(1, [0, 32]) + 32 + 1 + 8 + 32 + 8
+    # data region = pubkey + 32 (owner) + 32 (lamports..) + 8 + 8
+    scratch_data = I + acct_off(1, [0, 32]) + 80
     prog_pk = I + acct_off(2, [0, 32, 0])  # data len of prog irrelevant: last
     t = b""
     t += lddw(6, H)

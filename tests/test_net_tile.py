@@ -87,6 +87,13 @@ def test_net_quic_pipeline_real_sockets():
         assert topo.metrics("net").counter("rx_dgrams") > 0
         assert topo.metrics("net").counter("tx_dgrams") > 0
         assert topo.metrics("quic").counter("rx_txns_quic") == 4
+        # egress routing observability (waltz.ip wired into the tile):
+        # every tx datagram was classified routed or unrouted
+        nm = topo.metrics("net")
+        assert (
+            nm.counter("tx_routed") + nm.counter("tx_unrouted")
+            == nm.counter("tx_dgrams")
+        )
     finally:
         sock.close()
         topo.close()

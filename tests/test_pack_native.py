@@ -342,3 +342,103 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_exact_locks_fill_under_deep_pipelining():
+    """Regression for the round-5 bloom-saturation collapse: with many
+    microblocks outstanding, fill must stay at txn_limit as long as
+    enough distinct payers exist (exact lock tables; the old 1024-bit
+    bloom collapsed to ~47 of 256)."""
+    import numpy as np
+
+    from firedancer_tpu.ballet import pack as P
+    from firedancer_tpu.tiles.bench import make_transfer_pool
+
+    n = 1 << 14
+    rows, _ = make_transfer_pool(n, n_signers=n, seed=9)
+    szs = np.full(n, rows.shape[1], np.uint32)
+    eng = P.Pack(n, max_banks=4)
+    assert eng.insert_batch(rows, szs) == n
+    # 48 outstanding microblocks of 256 distinct-payer transfers:
+    # 12288 writable payer locks + as many readonly locks live at once
+    mbs = []
+    for k in range(48):
+        mb = eng.schedule_microblock(
+            k % 4, cu_limit=1_500_000, txn_limit=256, byte_limit=60_000
+        )
+        assert mb is not None, f"mb {k} not scheduled"
+        assert len(mb.txn_idx) == 256, (
+            f"mb {k} fill {len(mb.txn_idx)} != 256 (lock saturation?)"
+        )
+        mbs.append((k % 4, mb))
+    # completion releases every lock: the tables drain to empty
+    for bank, mb in mbs:
+        eng.microblock_complete(bank, mb.handle)
+    assert int((eng.lw_vals > 0).sum()) == 0
+    assert int((eng.lr_vals > 0).sum()) == 0
+    assert int((eng.lw_keys != 0).sum()) == 0  # backward-shift deletes
+
+
+def test_exact_lock_table_churn_matches_dict_model():
+    """Randomized schedule/complete churn: the native lock tables must
+    agree with a python dict refcount model at every step."""
+    import numpy as np
+
+    from firedancer_tpu.ballet import pack as P
+    from firedancer_tpu.tiles.bench import make_transfer_pool
+
+    rng = np.random.default_rng(17)
+    n = 2048
+    rows, _ = make_transfer_pool(n, n_signers=256, seed=13)
+    szs = np.full(n, rows.shape[1], np.uint32)
+    eng = P.Pack(n, max_banks=2)
+    assert eng.insert_batch(rows, szs) == n
+
+    model_w: dict[int, int] = {}
+    model_r: dict[int, int] = {}
+
+    def apply(idx, sign):
+        for s in idx:
+            for j in range(eng.w_cnt[s]):
+                h = int(eng.whash[s, j]) or 1
+                model_w[h] = model_w.get(h, 0) + sign
+                if not model_w[h]:
+                    del model_w[h]
+            for j in range(eng.r_cnt[s]):
+                h = int(eng.rhash[s, j]) or 1
+                model_r[h] = model_r.get(h, 0) + sign
+                if not model_r[h]:
+                    del model_r[h]
+
+    live = []
+    for step in range(200):
+        if live and (len(live) > 24 or rng.random() < 0.4):
+            k = int(rng.integers(len(live)))
+            bank, mb = live.pop(k)
+            eng.microblock_complete(bank, mb.handle)
+            apply(mb.txn_idx, -1)
+        else:
+            bank = int(rng.integers(2))
+            mb = eng.schedule_microblock(
+                bank, cu_limit=200_000, txn_limit=8, byte_limit=8_000
+            )
+            if mb is None:
+                eng.end_block() if not any(
+                    v for v in eng.outstanding.values()
+                ) else None
+                continue
+            live.append((bank, mb))
+            apply(mb.txn_idx, +1)
+        # table state == model state
+        held_w = {
+            int(k): int(v)
+            for k, v in zip(eng.lw_keys[eng.lw_vals > 0],
+                            eng.lw_vals[eng.lw_vals > 0])
+        }
+        held_r = {
+            int(k): int(v)
+            for k, v in zip(eng.lr_keys[eng.lr_vals > 0],
+                            eng.lr_vals[eng.lr_vals > 0])
+        }
+        assert held_w == model_w, f"step {step}: writable divergence"
+        assert held_r == model_r, f"step {step}: readonly divergence"

@@ -192,3 +192,59 @@ def test_adversarial_garbage_storm():
     client.conn.send_txn(t)
     _pump(client.conn, server)
     assert t in sconn.txns
+
+
+def test_connection_migration_address_hop():
+    """RFC 9000 section 9: an established client hops to a new source
+    address mid-stream — the server routes by DCID, adopts + validates
+    the new path (PATH_CHALLENGE/RESPONSE), rotates the client's
+    destination CID, and the stream completes."""
+    rng = np.random.default_rng(31)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    addr1 = ("10.0.0.1", 1111)
+    addr2 = ("10.9.9.9", 2222)
+    sconn = _pump(client.conn, server, addr=addr1)
+    assert sconn is not None and client.conn.established
+    # server offered spare CIDs after the handshake
+    _pump(client.conn, server, addr=addr1)
+    assert client.conn.peer_cids, "no NEW_CONNECTION_ID received"
+    assert server.by_addr.get(addr1) is sconn
+
+    txn1 = rng.integers(0, 256, 300, np.uint8).tobytes()
+    client.conn.send_txn(txn1)
+    _pump(client.conn, server, addr=addr1)
+    assert sconn.txns == [txn1]
+
+    # hop: rotate the destination CID and send from a NEW address
+    assert client.conn.migrate_dcid()
+    txn2 = rng.integers(0, 256, 400, np.uint8).tobytes()
+    client.conn.send_txn(txn2)
+    _pump(client.conn, server, addr=addr2)
+    assert sconn.txns == [txn1, txn2]
+    # server adopted + validated the new path
+    assert server.by_addr.get(addr2) is sconn
+    assert addr1 not in server.by_addr
+    assert server.migrations == 1
+    assert server.paths_validated == 1
+
+    # txns keep flowing on the new path
+    txn3 = rng.integers(0, 256, 64, np.uint8).tobytes()
+    client.conn.send_txn(txn3)
+    _pump(client.conn, server, addr=addr2)
+    assert sconn.txns == [txn1, txn2, txn3]
+
+
+def test_migration_unknown_dcid_ignored():
+    """A short-header packet from an unknown address with an unknown
+    DCID opens nothing and migrates nothing."""
+    rng = np.random.default_rng(32)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    _pump(client.conn, server, addr=("10.0.0.1", 1))
+    fake = bytes([0x40]) + bytes(8) + bytes(24)
+    assert server.on_datagram(fake, ("6.6.6.6", 6)) is None
+    assert server.migrations == 0
+    assert ("6.6.6.6", 6) not in server.by_addr

@@ -326,8 +326,8 @@ def test_bpf_lamport_conservation_enforced():
     payer, prog_key, victim = _keys(rng, 3)
     ex.mgr.store(payer, Account(10_000_000_000))
     ex.mgr.store(victim, Account(500, bytes(32), False, 0, b""))
-    # input ABI with 1 account: u16 cnt | pubkey 32 | flags 1 | lamports 8
-    lam_off = 2 + 32 + 1
+    # aligned input ABI, account 0: u64 cnt | hdr 8 | pk 32 | owner 32
+    lam_off = 8 + 8 + 32 + 32
     text = (
         lddw(1, sbpf.MM_INPUT + lam_off)
         + ins(0x79, dst=2, src=1)        # r2 = lamports
@@ -362,11 +362,13 @@ def test_bpf_program_reads_clock_sysvar():
         scratch, Account(rent_exempt_minimum(8), bytes(32), False, 0, bytes(8))
     )
 
-    # input ABI offsets with 2 accounts: [0]=clock (data 40B), [1]=scratch:
-    #   u16 cnt | acct0: 32+1+8+32+8+40 | acct1: 32+1 |lam 8| 32 |8| data 8
-    a0_data = 2 + 32 + 1 + 8 + 32 + 8
-    a1_lam = a0_data + 40 + 32 + 1
-    a1_data = a1_lam + 8 + 32 + 8
+    # Solana aligned input ABI with 2 accounts: [0]=clock (data 40B),
+    # [1]=scratch (data 8B).  Entry: 8 hdr | pk 32 | owner 32 | lam 8 |
+    # dlen 8 | data | 10240 spare | pad8 | rent 8
+    spare = 10 * 1024
+    a0_data = 8 + 8 + 32 + 32 + 8 + 8
+    a0_end = a0_data + 40 + spare + 8  # 40 % 8 == 0: no pad
+    a1_data = a0_end + 8 + 32 + 32 + 8 + 8
     text = (
         # r6 = clock.slot (first u64 of clock sysvar data)
         lddw(1, sbpf.MM_INPUT + a0_data)

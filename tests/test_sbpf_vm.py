@@ -154,12 +154,13 @@ def test_bpf_program_through_runtime():
     prog_key = rng.integers(0, 256, 32, np.uint8).tobytes()
     bh = rng.integers(0, 256, 32, np.uint8).tobytes()
 
-    # program: r0 = first instruction-data byte - 7.  Input ABI
-    # (Executor._bpf): u16 acct_cnt | accounts | u64 data_len | data;
-    # one account (payer, empty data) = 32+1+8+32+8 = 81 bytes, so the
-    # instruction data starts at 2 + 81 + 8 = 91.
+    # program: r0 = first instruction-data byte - 7.  Solana aligned
+    # input ABI (Executor._bpf): u64 acct_cnt | entries | u64 data_len |
+    # data; one account with 0 data bytes serializes to
+    # 8 hdr + 32 pk + 32 owner + 8 lam + 8 dlen + 10240 spare + 8 rent
+    # = 10336 bytes, so instruction data starts at 8 + 10336 + 8.
     text = (
-        lddw(3, sbpf.MM_INPUT + 91)
+        lddw(3, sbpf.MM_INPUT + 8 + 10336 + 8)
         + ins(0x71, dst=0, src=3, off=0)
         + ins(0x17, dst=0, imm=7)
         + EXIT
