@@ -91,15 +91,28 @@ class Monitor:
         return out
 
     def alarms(self, snap: dict) -> list[str]:
-        """Stale heartbeats + failed tiles, rendered as alarm lines."""
+        """Stale heartbeats, failed tiles, and supervisor degradation
+        state (circuit breaker open / restart churn), as alarm lines."""
         out = []
         for name, row in snap.items():
             if name == "_links":
+                continue
+            c = row.get("counters", {})
+            if c.get("degraded"):
+                out.append(
+                    f"ALARM {name}: degraded (supervisor circuit breaker "
+                    f"open after {c.get('restarts', 0)} restarts)"
+                )
                 continue
             if row["signal"] == "FAIL":
                 out.append(f"ALARM {name}: FAIL signal")
             elif row.get("stale"):
                 out.append(f"ALARM {name}: heartbeat stale")
+            if c.get("fallback_batches"):
+                out.append(
+                    f"NOTE {name}: {c['fallback_batches']} batches on the "
+                    f"host fallback path"
+                )
         return out
 
     def render(self, prev: dict | None, cur: dict, dt: float) -> str:
@@ -119,6 +132,10 @@ class Monitor:
             else:
                 rin = rout = 0.0
             flag = " STALE" if row.get("stale") else ""
+            if c.get("degraded"):
+                flag += " DEGRADED"
+            elif c.get("restarts"):
+                flag += f" restarts={c['restarts']}"
             lines.append(
                 f"{name:>10} {row['signal']:>5} {rin:12,.0f} {rout:12,.0f} "
                 f"{c['in_frags']:12,} {c['out_frags']:12,}{flag}"

@@ -9,6 +9,8 @@ work over device-sized batches and the per-frag work happens in native
 code or on the TPU, never in the Python loop body.
 """
 
+from .faultinj import Fault, FaultInjector  # noqa: F401
 from .metrics import Metrics, MetricsSchema  # noqa: F401
 from .mux import InLink, MuxCtx, OutLink, Tile, run_loop  # noqa: F401
+from .supervisor import RestartPolicy, Supervisor  # noqa: F401
 from .topo import Topology  # noqa: F401

@@ -1,0 +1,291 @@
+"""Deterministic, schedule-driven fault injection for tile topologies.
+
+The chaos-test analog of the reference's test harnesses that wedge and
+kill tiles by hand (e.g. src/tango/test_frag_tx/rx killing producers
+mid-stream): a seeded `FaultInjector` holds a schedule of `Fault`s and
+the mux loop (disco/mux.py) consults a per-tile `TileFaults` view at
+three well-defined points —
+
+  1. top of every iteration, BEFORE the heartbeat: `tick()` fires
+     scripted kills (raise), stalls (heartbeat starvation: sleep without
+     beating, abandonable via ctx.interrupt) and arms credit squeezes;
+  2. after the credit computation: `squeeze_credits()` forces zero
+     credits (scripted backpressure);
+  3. between the ring drain and the tile callback: `mangle_frags()`
+     drops frags or corrupts their payload bytes in the dcache.
+
+`FallbackPolicy` (tiles/verify.py) additionally calls `device_error()`
+once per device batch to fire scripted TPU/Pallas dispatch failures.
+
+Determinism contract: every stochastic choice (which frag is dropped,
+which byte is flipped) is a pure hash of (seed, fault index, per-link
+frag index), NOT of batch boundaries or wall time — two runs over the
+same input stream inject byte-identical fault effects regardless of how
+the loop happened to batch the frags.  The injector records every fired
+event in `events` (append order follows wall-clock firing and is NOT
+deterministic across trigger domains); `fired()` returns the canonical
+merged record, which IS equal across replays of the same seed —
+chaos tests diff that.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class FaultKill(RuntimeError):
+    """A scripted tile crash (the injected analog of an unhandled tile
+    exception): propagates out of the run loop through the normal
+    CNC_FAIL path."""
+
+
+class DeviceFault(RuntimeError):
+    """A scripted device-dispatch failure (the injected analog of a
+    TPU/Pallas runtime error): raised into FallbackPolicy's dispatch."""
+
+
+@dataclass
+class Fault:
+    """One scheduled fault.
+
+    tile:  target tile name.
+    kind:  kill | stall | backpressure | drop | corrupt | device_error.
+    at:    trigger index — loop-iteration tick (kill/stall/backpressure
+           with on="tick"), cumulative in-frag count (on="frag", and
+           always for drop/corrupt), or device-batch index
+           (device_error).  All indices are cumulative across restarts.
+    on:    "tick" or "frag" trigger domain for kill/stall/backpressure.
+    count: frags affected (drop/corrupt), iterations squeezed
+           (backpressure), or device batches failed (device_error).
+    frac:  per-frag probability within the [at, at+count) window for
+           drop/corrupt (seeded hash, batch-boundary independent).
+    duration_s: stall length (heartbeat starvation time).
+    link:  restrict drop/corrupt to one in-link name (None = all).
+    """
+
+    tile: str
+    kind: str
+    at: int = 0
+    on: str = "tick"
+    count: int = 1
+    frac: float = 1.0
+    duration_s: float = 0.0
+    link: str | None = None
+    fired: bool = field(default=False, compare=False)
+
+
+def _hash_u64(seed: int, fault_idx: int, idx: np.ndarray) -> np.ndarray:
+    """splitmix64-style mix of (seed, fault, frag index) -> u64, the
+    batch-independent randomness source for drop/corrupt decisions."""
+    x = (
+        np.asarray(idx, np.uint64)
+        + np.uint64((seed * 0x9E3779B97F4A7C15 + fault_idx) & (2**64 - 1))
+    )
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class FaultInjector:
+    """Seeded schedule of faults + shared event log."""
+
+    def __init__(self, seed: int = 0, faults: list[Fault] | None = None):
+        self.seed = int(seed)
+        self.faults: list[Fault] = list(faults or [])
+        self.events: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def add(self, tile: str, kind: str, **kw) -> "FaultInjector":
+        self.faults.append(Fault(tile, kind, **kw))
+        return self
+
+    def log(self, tile: str, kind: str, where: int, detail=None) -> None:
+        with self._lock:
+            self.events.append((tile, kind, int(where), detail))
+
+    def view(self, tile_name: str) -> "TileFaults":
+        """The per-tile hook object the mux loop consults.  Each tile
+        only ever touches its own view (no cross-tile locking on the
+        hot path)."""
+        mine = [
+            (i, f) for i, f in enumerate(self.faults) if f.tile == tile_name
+        ]
+        return TileFaults(self, tile_name, mine)
+
+    def fired(self) -> list[tuple]:
+        """Canonical record of everything that fired: drop/corrupt
+        windows merged per fault (their per-batch log entries depend on
+        batch boundaries; their union does not), then sorted.  Two runs
+        with the same seed, schedule, and input stream produce EQUAL
+        lists — this is the replay-diffable artifact."""
+        with self._lock:
+            frag: dict[tuple, list] = {}
+            rest = []
+            for t, k, w, d in self.events:
+                if k in ("drop", "corrupt"):
+                    frag.setdefault((t, k, w), []).extend(d)
+                else:
+                    rest.append((t, k, w, d))
+        merged = [
+            (t, k, w, tuple(sorted(d))) for (t, k, w), d in frag.items()
+        ]
+        return sorted(merged + rest, key=repr)
+
+    def count(self, kind: str, tile: str | None = None) -> int:
+        with self._lock:
+            return sum(
+                1
+                for e in self.events
+                if e[1] == kind and (tile is None or e[0] == tile)
+            )
+
+    def dropped_frags(self, tile: str | None = None) -> int:
+        with self._lock:
+            return sum(
+                len(e[3])
+                for e in self.events
+                if e[1] == "drop" and (tile is None or e[0] == tile)
+            )
+
+    def corrupted_frags(self, tile: str | None = None) -> int:
+        with self._lock:
+            return sum(
+                len(e[3])
+                for e in self.events
+                if e[1] == "corrupt" and (tile is None or e[0] == tile)
+            )
+
+
+class TileFaults:
+    """One tile's fault hooks (held on MuxCtx.faults)."""
+
+    def __init__(self, inj: FaultInjector, tile: str, faults: list):
+        self.inj = inj
+        self.tile = tile
+        self.ticks = 0
+        self.frags_seen = 0  # across all in-links (on="frag" triggers)
+        self._link_idx: dict[str, int] = {}  # per-link cumulative index
+        self.dev_batches = 0
+        self._squeeze = 0
+        self._tick_faults = [
+            (i, f)
+            for i, f in faults
+            if f.kind in ("kill", "stall", "backpressure")
+        ]
+        self._frag_faults = [
+            (i, f) for i, f in faults if f.kind in ("drop", "corrupt")
+        ]
+        self._dev_faults = [
+            (i, f) for i, f in faults if f.kind == "device_error"
+        ]
+
+    # -- point 1: loop top ------------------------------------------------
+
+    def tick(self, ctx) -> None:
+        self.ticks += 1
+        for _, f in self._tick_faults:
+            if f.fired:
+                continue
+            ref = self.ticks if f.on == "tick" else self.frags_seen
+            if ref < f.at:
+                continue
+            f.fired = True
+            if f.kind == "kill":
+                self.inj.log(self.tile, "kill", f.at)
+                raise FaultKill(f"{self.tile}: scripted kill at {f.at}")
+            if f.kind == "stall":
+                self.inj.log(self.tile, "stall", f.at, f.duration_s)
+                self._stall(ctx, f.duration_s)
+            elif f.kind == "backpressure":
+                self.inj.log(self.tile, "backpressure", f.at, f.count)
+                self._squeeze += f.count
+
+    def _stall(self, ctx, duration_s: float) -> None:
+        """Heartbeat starvation: hold the loop without beating.  The
+        supervisor's only handle on a wedged tile is ctx.interrupt —
+        honoring it here is what the interrupt protocol guarantees for
+        any stall that sleeps cooperatively."""
+        from .mux import TileInterrupted
+
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            if ctx.interrupt.is_set():
+                raise TileInterrupted(
+                    f"{self.tile}: stall abandoned by supervisor"
+                )
+            time.sleep(2e-3)
+
+    # -- point 2: credit gate ---------------------------------------------
+
+    def squeeze_credits(self) -> bool:
+        if self._squeeze > 0:
+            self._squeeze -= 1
+            return True
+        return False
+
+    # -- point 3: drained frags -------------------------------------------
+
+    def mangle_frags(self, il, frags: np.ndarray) -> np.ndarray:
+        n = len(frags)
+        self.frags_seen += n
+        # drop/corrupt windows index the PER-LINK frag stream: each link
+        # is a FIFO, so these indices are deterministic even when a tile
+        # drains several in-links in timing-dependent interleavings
+        base = self._link_idx.get(il.name, 0)
+        self._link_idx[il.name] = base + n
+        if not self._frag_faults:
+            return frags
+        gidx = np.arange(base, base + n, dtype=np.uint64)
+        keep = np.ones(n, dtype=bool)
+        for fi, f in self._frag_faults:
+            if f.link is not None and f.link != il.name:
+                continue
+            sel = (gidx >= f.at) & (gidx < f.at + f.count)
+            if f.frac < 1.0:
+                h = _hash_u64(self.inj.seed, fi, gidx)
+                sel &= (h >> np.uint64(11)).astype(np.float64) / float(
+                    1 << 53
+                ) < f.frac
+            if not sel.any():
+                continue
+            hit = np.flatnonzero(sel)
+            if f.kind == "drop":
+                keep[hit] = False
+                self.inj.log(
+                    self.tile, "drop", fi, [int(g) for g in gidx[hit]]
+                )
+            else:  # corrupt: flip a deterministic signature byte in place
+                pos = _hash_u64(self.inj.seed, fi ^ 0x5A5A, gidx[hit])
+                for t, j in enumerate(hit):
+                    sz = int(frags["sz"][j])
+                    # byte 1..64 lies inside the (first) signature for
+                    # the wire txn format: structurally harmless, but
+                    # cryptographically fatal — verify must reject it
+                    span = np.uint64(min(64, max(sz - 1, 1)))
+                    off = int(frags["chunk"][j]) * 64 + 1 + int(
+                        pos[t] % span
+                    )
+                    il.dcache.mem[off] ^= 0xFF
+                self.inj.log(
+                    self.tile, "corrupt", fi, [int(g) for g in gidx[hit]]
+                )
+        if keep.all():
+            return frags
+        return frags[keep]
+
+    # -- device batches (FallbackPolicy hook) -----------------------------
+
+    def device_error(self) -> None:
+        b = self.dev_batches
+        self.dev_batches = b + 1
+        for _, f in self._dev_faults:
+            if f.at <= b < f.at + f.count:
+                self.inj.log(self.tile, "device_error", b)
+                raise DeviceFault(
+                    f"{self.tile}: scripted device failure at batch {b}"
+                )

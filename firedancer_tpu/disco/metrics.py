@@ -43,6 +43,14 @@ class MetricsSchema:
         "backpressure_iters",
         "housekeep_iters",
         "loop_iters",
+        # supervision counters, written by disco/supervisor.py (distinct
+        # slots from the tile's own, so the single-writer-per-word
+        # discipline holds): crash/stall restarts, heartbeat deadline
+        # misses, and the circuit-breaker latch (1 = tile degraded,
+        # supervisor gave up restarting)
+        "restarts",
+        "hb_misses",
+        "degraded",
     )
     #: loop phase durations are sampled every 16th iteration (reference:
     #: fd_mux.c histograms every loop phase via tickcount)

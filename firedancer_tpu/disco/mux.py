@@ -16,6 +16,7 @@ viable at millions of frags/s.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -24,6 +25,13 @@ import numpy as np
 from firedancer_tpu.tango import rings as R
 
 from .metrics import Metrics, MetricsSchema
+
+
+class TileInterrupted(RuntimeError):
+    """Raised inside a tile loop when the supervisor abandons this
+    incarnation (stall recovery): the thread unwinds through the normal
+    failure path (CNC_FAIL + fseq finalize) so a fresh incarnation can
+    rejoin the rings safely."""
 
 
 def now_ts() -> int:
@@ -124,6 +132,19 @@ class MuxCtx:
         self.wksp = wksp
         self.credits = 0  # refreshed by the loop before each callback round
         self.halted = False
+        #: supervision hooks: the supervisor sets `interrupt` to abandon a
+        #: stalled incarnation; `faults` is a faultinj.TileFaults view the
+        #: loop consults at its well-defined injection points; incarnation
+        #: counts restarts so on_boot can distinguish join-vs-init of
+        #: workspace state that must survive a crash (dedup's tcache)
+        self.interrupt = threading.Event()
+        self.faults = None
+        self.incarnation = 0
+        #: True once the current incarnation's on_boot completed — lets
+        #: the topology distinguish "died during boot" (raise at start)
+        #: from "crashed after RUN" (fail-stop via poll_failure)
+        self.booted = False
+        self._local_allocs: dict[str, np.ndarray] = {}
 
     def out(self, name: str) -> OutLink:
         for o in self.outs:
@@ -134,10 +155,27 @@ class MuxCtx:
     def alloc(self, name: str, footprint: int) -> np.ndarray:
         """Observable tile state: allocated in the shared workspace when
         the topology provides one (so a monitor process can map it), else
-        process-local memory (standalone tile tests)."""
+        process-local memory (standalone tile tests).
+
+        Idempotent by name (Workspace.alloc's contract): a restarted
+        incarnation re-running on_boot gets the SAME region back, so
+        state that must survive a crash (dedup's tag cache) persists
+        across restarts — the tile decides whether to re-init it or
+        rejoin it via `ctx.incarnation`."""
+        key = f"{self.name}_{name}"
         if self.wksp is not None:
-            return self.wksp.alloc(f"{self.name}_{name}", footprint)
-        return np.zeros(footprint, dtype=np.uint8)
+            return self.wksp.alloc(key, footprint)
+        buf = self._local_allocs.get(key)
+        if buf is None:
+            buf = self._local_allocs[key] = np.zeros(
+                footprint, dtype=np.uint8
+            )
+        elif len(buf) != footprint:
+            raise ValueError(
+                f"realloc of {key!r} with footprint {footprint} != "
+                f"existing {len(buf)}"
+            )
+        return buf
 
     def publish(self, sigs, rows=None, szs=None, ctls=None, tsorigs=None) -> int:
         """Publish to every out link (the common single-out case)."""
@@ -194,6 +232,13 @@ class Tile:
 
     def on_halt(self, ctx: MuxCtx) -> None: ...
 
+    def on_crash(self, ctx: MuxCtx) -> None:
+        """Called by the supervisor (on the supervisor thread, after the
+        dead incarnation's thread has been joined) before on_boot re-runs:
+        release resources the dead incarnation held (worker threads,
+        sockets) and drop in-flight host-side state — ring state is
+        resynced separately via the rejoin helpers."""
+
 
 def run_loop(
     tile: Tile,
@@ -215,7 +260,15 @@ def run_loop(
 
     m = ctx.metrics
     cnc = ctx.cnc
-    tile.on_boot(ctx)
+    faults = ctx.faults
+    try:
+        tile.on_boot(ctx)
+    except Exception:
+        # boot failures must still be visible on the cnc (the supervisor
+        # and topology boot-wait key off FAIL, not thread liveness)
+        cnc.signal(R.CNC_FAIL)
+        raise
+    ctx.booted = True
     cnc.signal(R.CNC_RUN)
     if lazy_ns is None:
         depths = [il.mcache.depth for il in ctx.ins] + [
@@ -227,6 +280,14 @@ def run_loop(
     iters = 0
     try:
         while True:
+            # fault-injection point 1: scripted kill / stall / credit
+            # squeeze fire at the top of the iteration, BEFORE the
+            # heartbeat — a stall here starves the heartbeat exactly like
+            # a wedged tile would
+            if faults is not None:
+                faults.tick(ctx)
+            if ctx.interrupt.is_set():
+                raise TileInterrupted(f"{ctx.name}: abandoned by supervisor")
             now = time.monotonic_ns()
             # phase durations are histogram-sampled every 16th iteration
             # (the reference histograms every phase, fd_mux.c:435-444; a
@@ -253,6 +314,9 @@ def run_loop(
                 cr = batch_max
                 for o in ctx.outs:
                     cr = min(cr, o.cr_avail())
+                # fault-injection point 2: forced zero-credit backpressure
+                if faults is not None and faults.squeeze_credits():
+                    cr = 0
                 if ctx.outs and cr == 0:
                     m.inc("backpressure_iters")
                     idle += 1
@@ -288,6 +352,11 @@ def run_loop(
                 if ovr:
                     m.inc("overrun_frags", ovr)
                     il.fseq.diag_add(0, ovr)
+                # fault-injection point 3: drop / corrupt frag payloads
+                # between the ring and the tile callback (injected drops
+                # are declared in the injector's event log, not metrics)
+                if faults is not None and len(frags):
+                    frags = faults.mangle_frags(il, frags)
                 if len(frags):
                     got += len(frags)
                     m.inc("in_frags", len(frags))

@@ -1,0 +1,285 @@
+"""Tile supervisor: heartbeat watchdog + crash-restart + circuit breaker.
+
+Reference model: the reference splits this between `fdctl monitor`
+(monitor.c:233 — snapshot every tile's cnc heartbeat/signal and render
+the diffs) and `fdctl run`'s process supervisor (run/run.c — a failed
+tile kills the topology).  This build goes one step further than the
+reference's fail-stop: because ALL state crossing tile boundaries lives
+in single-writer tango rings, a dead tile can be restarted IN PLACE —
+its peers keep running, the new incarnation resyncs its consumer seqs
+from the published fseqs (tango.rings.consumer_rejoin), its producer
+cursor from the mcache (producer_rejoin), re-attaches its workspace
+allocations (MuxCtx.alloc is idempotent by name) and re-runs on_boot.
+
+Policy knobs mirror classic supervision trees: a heartbeat deadline
+turns a wedged tile into a detected failure (the supervisor abandons the
+stuck incarnation via ctx.interrupt and re-incarnates the tile), capped
+exponential backoff stops a crash-looping tile from burning the host,
+and a circuit breaker (N failures inside a sliding window) marks the
+tile degraded — surfaced through the shared metrics region so
+`app/monitor.py` alarms on it from another process.
+
+Restart loss accounting: reliable in-links can be rewound `replay` frags
+on rejoin (at-least-once redelivery).  A downstream dedup stage whose
+tag cache survives restarts (tiles/dedup.py joins, never re-inits, on
+incarnation > 0) collapses the replay back to exactly-once, so the only
+survivor loss a crash can cause is (a) frags a dead incarnation consumed
+beyond the replay window and never forwarded, and (b) jump-to-head skips
+on unreliable links — which are declared in `overrun_frags`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+from firedancer_tpu.tango import rings as R
+
+from .topo import Topology
+
+
+@dataclass
+class RestartPolicy:
+    """Supervision knobs (per supervisor; replay may vary per tile)."""
+
+    #: heartbeat older than this (while RUN) is a miss -> stall restart
+    hb_timeout_s: float = 1.0
+    #: watchdog sampling period
+    poll_s: float = 0.02
+    #: how long to wait for a dead/abandoned incarnation's thread to exit
+    #: before declaring the tile wedged-degraded (threads cannot be
+    #: killed; a truly wedged tile needs the process-per-tile runner)
+    join_timeout_s: float = 10.0
+    #: capped exponential restart backoff
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    #: uptime after which the backoff resets to base
+    healthy_after_s: float = 5.0
+    #: circuit breaker: this many failures inside the window -> degraded
+    breaker_n: int = 5
+    breaker_window_s: float = 30.0
+    #: a (re-)incarnation still in BOOT after this long is treated as a
+    #: failure (on_boot hang: device re-init, stuck bind, wedged native
+    #: call) — generous because first boots compile device kernels
+    boot_timeout_s: float = 600.0
+    #: reliable-link replay window on rejoin, in frags (int = all tiles,
+    #: dict = per tile name); see tango.rings.consumer_rejoin
+    replay: int | dict = 0
+
+
+class _TileState:
+    def __init__(self) -> None:
+        self.fail_times: collections.deque = collections.deque()
+        self.backoff_s = 0.0
+        self.boot_mono_ns = 0
+        self.degraded: str | None = None
+        self.respawn_at = 0.0  # monotonic; 0 = running
+        self.restarts = 0
+
+
+class Supervisor:
+    """Run a Topology's tiles under heartbeat/crash supervision.
+
+    Usage:
+        topo = Topology(); ...declare links/tiles...
+        sup = Supervisor(topo, policy=RestartPolicy(...), faults=inj)
+        sup.start(batch_max=...)
+        ...
+        sup.halt(); topo.close()
+    """
+
+    def __init__(
+        self,
+        topo: Topology,
+        policy: RestartPolicy | None = None,
+        faults=None,
+    ):
+        self.topo = topo
+        self.policy = policy or RestartPolicy()
+        self.faults = faults
+        self._state: dict[str, _TileState] = {}
+        self._loop_kw: dict = {}
+        self._halting = False
+        self._watchdog: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self, boot_timeout_s: float = 600.0, **loop_kw) -> None:
+        topo = self.topo
+        if topo.wksp is None:
+            topo.build()
+        self._loop_kw = loop_kw
+        for name, ts in topo.tiles.items():
+            self._state[name] = _TileState()
+            if self.faults is not None:
+                ts.ctx.faults = self.faults.view(name)
+        for name in topo.tiles:
+            self._spawn(name)
+        # boot-wait: every tile leaves BOOT (RUN, or FAIL -> the watchdog
+        # will treat the boot crash like any other failure)
+        deadline = time.monotonic() + boot_timeout_s
+        for name, ts in topo.tiles.items():
+            while topo._cncs[name].signal_query() == R.CNC_BOOT:
+                if time.monotonic() > deadline:
+                    self.halt()
+                    raise TimeoutError(f"tile {name!r} stuck in BOOT")
+                time.sleep(1e-3)
+        topo.export_manifest()
+        self._watchdog = threading.Thread(
+            target=self._watch, name="supervisor", daemon=True
+        )
+        self._watchdog.start()
+
+    def _spawn(self, name: str) -> None:
+        topo, ts, st = self.topo, self.topo.tiles[name], self._state[name]
+        ts.error = None
+        st.boot_mono_ns = time.monotonic_ns()
+        st.respawn_at = 0.0
+        t = threading.Thread(
+            target=topo._tile_main,
+            args=(ts, self._loop_kw),
+            name=f"tile:{name}",
+        )
+        t.daemon = True
+        ts.thread = t
+        t.start()
+
+    def halt(self, timeout_s: float = 30.0) -> None:
+        self._halting = True
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=timeout_s)
+            self._watchdog = None
+        # wedged incarnations only ever exit via the interrupt flag
+        for name, st in self._state.items():
+            if st.degraded is not None:
+                self.topo.tiles[name].ctx.interrupt.set()
+        self.topo.halt(timeout_s=timeout_s)
+
+    # ---- watchdog -------------------------------------------------------
+
+    def _watch(self) -> None:
+        p = self.policy
+        while not self._stop.wait(p.poll_s):
+            now_ns = time.monotonic_ns()
+            now = time.monotonic()
+            for name, ts in self.topo.tiles.items():
+                st = self._state[name]
+                if st.degraded is not None or self._halting:
+                    continue
+                if st.respawn_at:  # waiting out the backoff
+                    if now >= st.respawn_at:
+                        self._spawn(name)
+                    continue
+                cnc = self.topo._cncs[name]
+                sig = cnc.signal_query()
+                if sig == R.CNC_FAIL or (
+                    ts.thread is not None
+                    and not ts.thread.is_alive()
+                    and sig == R.CNC_RUN
+                ):
+                    self._handle_failure(name, "crash")
+                    continue
+                if sig == R.CNC_RUN:
+                    hb = cnc.heartbeat_query()
+                    ref = max(hb, st.boot_mono_ns)
+                    if now_ns - ref > int(p.hb_timeout_s * 1e9):
+                        self.topo._metrics[name].inc("hb_misses")
+                        self._handle_failure(name, "heartbeat")
+                elif sig == R.CNC_BOOT:
+                    # a re-incarnation hung in on_boot never reaches RUN
+                    # or FAIL on its own — without this deadline it
+                    # would be invisible to every other clause forever
+                    if now_ns - st.boot_mono_ns > int(
+                        p.boot_timeout_s * 1e9
+                    ):
+                        self._handle_failure(name, "boot timeout")
+
+    def _handle_failure(self, name: str, reason: str) -> None:
+        from firedancer_tpu.utils import log
+
+        p = self.policy
+        topo, ts, st = self.topo, self.topo.tiles[name], self._state[name]
+        ctx = ts.ctx
+        metrics = topo._metrics[name]
+        # abandon the incarnation: a stalled loop exits at its next
+        # interrupt check; a crashed one is already on its way out
+        ctx.interrupt.set()
+        ts.thread.join(timeout=p.join_timeout_s)
+        if ts.thread.is_alive():
+            # the thread ignored the interrupt: restarting over a live
+            # writer would break the single-writer ring discipline
+            st.degraded = "wedged"
+            metrics.set("degraded", 1)
+            log.err("tile %s wedged (interrupt ignored); degraded", name)
+            return
+        now = time.monotonic()
+        # circuit breaker over a sliding failure window
+        st.fail_times.append(now)
+        while st.fail_times and now - st.fail_times[0] > p.breaker_window_s:
+            st.fail_times.popleft()
+        if len(st.fail_times) >= p.breaker_n:
+            st.degraded = "breaker"
+            metrics.set("degraded", 1)
+            log.err(
+                "tile %s: %d failures in %.0fs; circuit breaker open",
+                name, len(st.fail_times), p.breaker_window_s,
+            )
+            return
+        # capped exponential backoff, reset after a healthy uptime
+        uptime_s = (time.monotonic_ns() - st.boot_mono_ns) / 1e9
+        if st.backoff_s and uptime_s > p.healthy_after_s:
+            st.backoff_s = 0.0
+        st.backoff_s = (
+            p.backoff_base_s
+            if not st.backoff_s
+            else min(st.backoff_s * 2.0, p.backoff_max_s)
+        )
+        # ring rejoin: consumer seqs from the published fseqs (with the
+        # configured replay window), producer cursors from the mcaches
+        replay = p.replay
+        if isinstance(replay, dict):
+            replay = replay.get(name, 0)
+        for il in ctx.ins:
+            il.seq, skipped = R.consumer_rejoin(
+                il.mcache, il.fseq, reliable=il.reliable, replay=replay
+            )
+            if skipped:
+                metrics.inc("overrun_frags", skipped)
+                il.fseq.diag_add(0, skipped)
+            il.fseq.update(il.seq)
+        for o in ctx.outs:
+            o.seq = R.producer_rejoin(o.mcache)
+        ts.tile.on_crash(ctx)
+        ctx.interrupt.clear()
+        ctx.booted = False
+        ctx.incarnation += 1
+        st.restarts += 1
+        metrics.inc("restarts")
+        topo._cncs[name].signal(R.CNC_BOOT)
+        st.respawn_at = time.monotonic() + st.backoff_s
+        log.info(
+            "tile %s restarting (%s, incarnation %d, backoff %.0fms)",
+            name, reason, ctx.incarnation, st.backoff_s * 1e3,
+        )
+
+    # ---- introspection --------------------------------------------------
+
+    def restarts(self, name: str) -> int:
+        return self._state[name].restarts
+
+    def degraded(self, name: str) -> str | None:
+        return self._state[name].degraded
+
+    def status(self) -> dict:
+        out = {}
+        for name, st in self._state.items():
+            out[name] = {
+                "restarts": st.restarts,
+                "degraded": st.degraded,
+                "backoff_s": st.backoff_s,
+            }
+        return out

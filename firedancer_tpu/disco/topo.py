@@ -223,6 +223,23 @@ class Topology:
                     self.halt()
                     raise TimeoutError(f"tile {name!r} stuck in BOOT")
                 time.sleep(1e-3)
+            if self._cncs[name].signal_query() == R.CNC_FAIL:
+                # run_loop signals FAIL before the exception reaches
+                # _tile_main, so give the error a moment to land
+                if ts.thread is not None:
+                    ts.thread.join(timeout=10.0)
+                if not ts.ctx.booted:
+                    # died DURING on_boot (bad config, missing device):
+                    # that is a construction error — raise now.  A tile
+                    # that reached RUN and then crashed (a race with
+                    # fast-failing workloads) stays fail-stop via
+                    # poll_failure, as before this supervision work.
+                    self.halt()
+                    if ts.error is not None:
+                        raise ts.error
+                    raise RuntimeError(
+                        f"tile {name!r} failed during boot"
+                    )
         # publish AFTER boot: tile on_boot workspace allocations (tcaches
         # etc.) must appear in the directory the monitor attaches to
         self.export_manifest()

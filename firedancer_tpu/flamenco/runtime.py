@@ -1244,10 +1244,16 @@ class Executor:
                 signer = nonce_k
             else:
                 if lamports == acct.lamports:
-                    # full withdrawal only after the stored nonce aged
-                    # to the current durable value (reference:
-                    # NONCE_BLOCKHASH_NOT_EXPIRED custom error)
-                    if durable != next_durable:
+                    # full withdrawal is allowed only once the stored
+                    # durable nonce EXPIRED (differs from the current
+                    # slot's value): closing a nonce whose stored value
+                    # still equals the live durable nonce would let the
+                    # protected transaction be replayed (Agave
+                    # NonceBlockhashNotExpired; the reference snapshot's
+                    # inverted 0 != memcmp at
+                    # fd_system_program_nonce.c:366 contradicts the
+                    # Agave lines it cites and is not followed here)
+                    if durable == next_durable:
                         return "nonce: blockhash not expired"
                     acct.data = _nonce_encode(_NONCE_UNINITIALIZED)
                 else:
@@ -1259,8 +1265,10 @@ class Executor:
             if signer not in ctx.signers:
                 return "nonce: missing authority signature"
             if nonce_k == to_k:
-                store(nonce_k, acct)
-                return ""
+                # Agave fails this with an account-borrow error (source
+                # and destination cannot be borrowed simultaneously); a
+                # silent no-op success would diverge on txn status
+                return "nonce: source and destination are the same account"
             acct.lamports -= lamports
             store(nonce_k, acct)
             dst = load(to_k) or Account(0)
@@ -1413,6 +1421,9 @@ class Executor:
                     vm.input_mem[owner_off : owner_off + 32]
                 )
                 cur = load(k) or Account(0)
+                new_data = bytes(
+                    vm.input_mem[data_off : data_off + new_len]
+                )
                 if new_owner != cur.owner:
                     # owner reassignment through the input region is
                     # legal only for the account's CURRENT owning
@@ -1421,11 +1432,19 @@ class Executor:
                     if cur.owner != prog_key or cur.executable:
                         return "instruction illegally modified " \
                                "account owner"
+                    # ... and only with all-zero account data
+                    # (fd_account_is_zeroed): handing an account with
+                    # live crafted bytes to a new owner would let that
+                    # owner mistake attacker data for self-initialized
+                    # state
+                    if any(new_data):
+                        return "instruction illegally modified " \
+                               "account owner"
                 post[k] = (
                     int.from_bytes(
                         vm.input_mem[lam_off : lam_off + 8], "little"
                     ),
-                    bytes(vm.input_mem[data_off : data_off + new_len]),
+                    new_data,
                     new_owner,
                 )
             else:
@@ -1476,9 +1495,18 @@ class Executor:
                 new_owner = bytes(
                     vm.input_mem[owner_off : owner_off + 32]
                 )
+                new_data = bytes(
+                    vm.input_mem[data_off : data_off + cur_len]
+                )
                 if new_owner != a.owner:
-                    # same owner-reassignment rule as the commit path
-                    if a.owner != prog_key or a.executable:
+                    # same owner-reassignment rule as the commit path:
+                    # current owner only, non-executable, and all-zero
+                    # data (fd_account_is_zeroed)
+                    if (
+                        a.owner != prog_key
+                        or a.executable
+                        or any(new_data)
+                    ):
                         raise VmError(
                             "cpi: instruction illegally modified "
                             "account owner"
@@ -1487,7 +1515,7 @@ class Executor:
                 a.lamports = int.from_bytes(
                     vm.input_mem[lam_off : lam_off + 8], "little"
                 )
-                a.data = bytes(vm.input_mem[data_off : data_off + cur_len])
+                a.data = new_data
                 store(k, a)
 
         def _sync_up():

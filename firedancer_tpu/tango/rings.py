@@ -217,6 +217,18 @@ class Workspace:
             self._path = path
 
     def alloc(self, name: str, footprint: int, align: int = 128) -> np.ndarray:
+        # idempotent by name: re-allocating an existing name returns the
+        # SAME region (a restarted tile re-running on_boot must re-attach
+        # its state, not leak a second copy) — with the footprint checked
+        # so a size change can never silently hand back a stale region
+        if name in self._allocs:
+            off, fp = self._allocs[name]
+            if fp != footprint:
+                raise ValueError(
+                    f"realloc of {name!r} with footprint {footprint} != "
+                    f"existing {fp} (free() it first)"
+                )
+            return self.buf[off : off + fp]
         # first fit from the free list (freed regions are reusable, the
         # reference's treap free/used discipline in miniature), else bump
         free = self._free
@@ -577,6 +589,41 @@ class FSeq:
 
 def cr_avail(seq_prod: int, seq_cons_min: int, cr_max: int) -> int:
     return _lib.fdt_fctl_cr_avail(seq_prod, seq_cons_min, cr_max)
+
+
+def consumer_rejoin(
+    mcache: "MCache", fseq: "FSeq", *, reliable: bool = True, replay: int = 0
+) -> tuple[int, int]:
+    """Resync point for a consumer rejoining a ring after a crash.
+    Returns (seq, skipped).
+
+    Reliable links resume at the published fseq — the producer's credit
+    gate guarantees everything from there forward is still in the ring —
+    optionally REWOUND by up to `replay` frags (clamped to the oldest
+    frag the ring still holds).  Replay gives at-least-once delivery
+    across a restart: frags the dead incarnation consumed but never
+    forwarded are re-seen, and a downstream dedup stage (whose tag cache
+    survives restarts, tiles/dedup.py) collapses the re-delivery back to
+    exactly-once.
+
+    Unreliable links jump to the producer's head; the gap is returned as
+    `skipped` for the caller to account as overrun_frags (the same
+    book-keeping an overrun during normal operation gets)."""
+    prod = mcache.seq_query()
+    last = fseq.query()
+    if not reliable:
+        return prod, max(prod - last, 0)
+    oldest = max(prod - mcache.depth, 0)
+    seq = max(min(last, prod) - max(replay, 0), oldest, 0)
+    return seq, 0
+
+
+def producer_rejoin(mcache: "MCache") -> int:
+    """Resync point for a producer rejoining its ring after a crash: the
+    mcache's own published cursor (fdt_mcache_seq_query reads the seq the
+    last publish advanced to), so the new incarnation continues the
+    sequence instead of overwriting live frags from seq 0."""
+    return mcache.seq_query()
 
 
 CNC_BOOT, CNC_RUN, CNC_HALT, CNC_FAIL = 0, 1, 2, 3

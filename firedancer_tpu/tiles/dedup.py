@@ -31,7 +31,15 @@ class DedupTile(Tile):
     def on_boot(self, ctx: MuxCtx) -> None:
         map_cnt = R.TCache.map_cnt_for(self.depth)
         fp = R.TCache.footprint(self.depth, map_cnt)
-        self._tc = R.TCache(ctx.alloc("tcache", fp), self.depth, map_cnt)
+        # restart semantics: REJOIN the existing tag cache instead of
+        # re-initializing it.  The supervisor replays reliable in-links
+        # across a restart (at-least-once); the surviving history is
+        # exactly what collapses that replay back to exactly-once — a
+        # fresh cache here would re-admit every replayed txn downstream.
+        self._tc = R.TCache(
+            ctx.alloc("tcache", fp), self.depth, map_cnt,
+            join=ctx.incarnation > 0,
+        )
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         dup = self._tc.dedup(frags["sig"])

@@ -74,10 +74,14 @@ class QuicIngressTile(Tile):
         return self.udp_sock.addr
 
     def on_boot(self, ctx: MuxCtx) -> None:
-        if not self.via_net:
+        if not self.via_net and self.quic_sock is None:
+            # restart-safe: a supervised re-incarnation keeps the bound
+            # sockets (senders hold the addresses) — only a first boot
+            # opens them
             self.quic_sock = UdpSock(self._quic_addr_req)
             self.udp_sock = UdpSock(self._udp_addr_req)
-        self.server = Q.QuicServer(self.identity_secret)
+        if self.server is None:
+            self.server = Q.QuicServer(self.identity_secret)
 
     def on_halt(self, ctx: MuxCtx) -> None:
         if self.quic_sock:

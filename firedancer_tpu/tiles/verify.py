@@ -41,21 +41,125 @@ PRE_DEDUP_DEPTH = 16
 _STOP = object()
 
 
+class FallbackPolicy:
+    """Graceful degradation for the batched device-verify path.
+
+    Wraps the device dispatch in a catch → host-retry → circuit-trip
+    state machine: a TPU/Pallas dispatch (or D2H sync) error reroutes
+    THAT batch through the strict host verifier
+    (ops/ed25519/hostpath.py) instead of killing the tile; `trip_after`
+    consecutive device failures latch host-only mode, and every
+    `reprobe_every` batches one batch re-probes the device so a
+    recovered accelerator is picked back up automatically.
+
+    `fault_hook` is the faultinj device_error injection point — called
+    once per device-batch attempt, raising a scripted DeviceFault that
+    exercises exactly the production failure path.
+
+    Counter attributes are mirrored into the tile's shared metrics
+    (fallback_batches etc.) by VerifyTile so a monitor process sees the
+    degradation state live.
+    """
+
+    def __init__(
+        self,
+        device_fn,
+        host_fn,
+        *,
+        trip_after: int = 3,
+        reprobe_every: int = 64,
+        fault_hook=None,
+    ):
+        self.device_fn = device_fn
+        self.host_fn = host_fn
+        self.trip_after = max(trip_after, 1)
+        self.reprobe_every = max(reprobe_every, 1)
+        self.fault_hook = fault_hook
+        self.consec_failures = 0
+        self.tripped = False  # latched host-only mode
+        self._since_trip = 0
+        # counters (mirrored into metrics by the owning tile)
+        self.fallback_batches = 0
+        self.device_errors = 0
+        self.device_trips = 0
+        self.host_reprobes = 0
+
+    def _try_device(self) -> bool:
+        if self.device_fn is None:
+            return False
+        if not self.tripped:
+            return True
+        self._since_trip += 1
+        if self._since_trip >= self.reprobe_every:
+            self._since_trip = 0
+            self.host_reprobes += 1
+            return True
+        return False
+
+    def _device_failed(self) -> None:
+        self.device_errors += 1
+        self.consec_failures += 1
+        if (
+            not self.tripped
+            and self.consec_failures >= self.trip_after
+        ):
+            self.tripped = True
+            self.device_trips += 1
+            self._since_trip = 0
+
+    def dispatch(self, args):
+        """Start a batch.  Device dispatch is async (returns a future);
+        the host path defers all work to land()."""
+        if self._try_device():
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook()
+                return ("dev", self.device_fn(*args))
+            except Exception:
+                self._device_failed()
+        return ("host", None)
+
+    def land(self, fut, args, lanes: int | None = None) -> np.ndarray:
+        """Finish a batch: sync the device future (where JAX's async
+        dispatch surfaces runtime errors) or run the host verifier."""
+        kind, val = fut
+        if kind == "dev":
+            try:
+                out = np.asarray(val)
+                self.consec_failures = 0
+                if self.tripped:
+                    self.tripped = False  # re-probe succeeded: recovered
+                return out
+            except Exception:
+                self._device_failed()
+        if self.device_fn is not None:
+            # fallback_batches measures DEGRADATION — batches a
+            # configured device failed to serve.  An intentional
+            # host-only tile (device="off") is healthy, not degraded:
+            # counting it would leave monitors alarming forever on
+            # CPU-only deployments.
+            self.fallback_batches += 1
+        return self.host_fn(*args, lanes=lanes)
+
+
 class _DeviceWorker:
     """Push-request/push-result engine (the wd_f1.c interface shape).
 
     One dedicated thread owns all device interaction.  `depth` batches
     ride in flight: the thread dispatches every queued request before it
     blocks on the oldest result's D2H copy, so transfer and compute of
-    batch N+1 overlap the sync of batch N.
+    batch N+1 overlap the sync of batch N.  All dispatch/land calls go
+    through the FallbackPolicy, so a device failure degrades to the host
+    path instead of killing this thread.
     """
 
-    def __init__(self, fn, depth: int = 3):
-        self.fn = fn
+    def __init__(self, policy: FallbackPolicy, depth: int = 3):
+        self.policy = policy
         self.depth = depth
         self.reqq: queue.Queue = queue.Queue(maxsize=depth)
         self.results: collections.deque = collections.deque()
         self.error: BaseException | None = None
+        self.aborted = False
         self.thread = threading.Thread(
             target=self._main, name="verify-dev", daemon=True
         )
@@ -65,14 +169,31 @@ class _DeviceWorker:
         self.reqq.put((meta, args))
 
     def stop(self) -> None:
-        self.reqq.put(_STOP)
+        while self.thread.is_alive():
+            try:
+                self.reqq.put(_STOP, timeout=0.1)
+                break
+            except queue.Full:
+                continue  # a dead worker never drains: is_alive re-checks
         self.thread.join()
+
+    def abort(self, timeout_s: float = 10.0) -> None:
+        """Crash-recovery teardown: drop queued and in-flight work (the
+        supervisor's ring replay re-delivers it) and stop the thread."""
+        self.aborted = True
+        try:
+            self.reqq.put_nowait(_STOP)
+        except queue.Full:
+            pass
+        self.thread.join(timeout=timeout_s)
 
     def _main(self) -> None:
         pending: collections.deque = collections.deque()
         stopped = False
         try:
             while not (stopped and not pending):
+                if self.aborted:
+                    return
                 while not stopped and len(pending) < self.depth:
                     try:
                         item = self.reqq.get(
@@ -84,12 +205,16 @@ class _DeviceWorker:
                         stopped = True
                         break
                     meta, args = item
-                    # async dispatch: returns a device future immediately
-                    pending.append((meta, self.fn(*args)))
+                    # async dispatch: returns immediately
+                    pending.append(
+                        (meta, args, self.policy.dispatch(args))
+                    )
                 if pending:
-                    meta, fut = pending.popleft()
+                    meta, args, fut = pending.popleft()
                     # D2H copy is the only reliable sync on this platform
-                    self.results.append((meta, np.asarray(fut)))
+                    self.results.append(
+                        (meta, self.policy.land(fut, args, meta["lanes"]))
+                    )
         except BaseException as e:  # noqa: BLE001 — surfaced by the tile
             self.error = e
 
@@ -101,6 +226,12 @@ class VerifyTile(Tile):
             "dedup_drop_txns",
             "verified_sigs",
             "device_batches",
+            # FallbackPolicy state, mirrored each loop so monitors see
+            # degradation live
+            "fallback_batches",
+            "device_errors",
+            "device_trips",
+            "host_reprobes",
         ),
         hists=("lane_batch",),
     )
@@ -114,6 +245,10 @@ class VerifyTile(Tile):
         pad_full: bool = False,
         shard: tuple[int, int] | None = None,
         async_depth: int = 3,
+        device: str = "auto",
+        device_fn=None,
+        fallback_trip: int = 3,
+        fallback_reprobe: int = 64,
         name: str = "verify",
     ):
         """pad_full: always pad sub-batches to max_lanes (one compiled
@@ -127,7 +262,13 @@ class VerifyTile(Tile):
         without gathering payloads.
 
         async_depth: device batches in flight (the wiredancer request
-        pipe depth); 1 degenerates to synchronous dispatch."""
+        pipe depth); 1 degenerates to synchronous dispatch.
+
+        device: "auto" jits the batched kernel; "off" never touches JAX
+        and verifies every batch on the strict host path (CPU-only tests,
+        chaos harnesses, degraded deploys).  device_fn overrides the
+        jitted kernel outright (fault-injection stubs).  fallback_trip /
+        fallback_reprobe parameterize the FallbackPolicy."""
         assert max_lanes & (max_lanes - 1) == 0, (
             "max_lanes must be a power of two (pad buckets + warm compiles "
             "assume it)"
@@ -139,9 +280,15 @@ class VerifyTile(Tile):
         self.pad_full = pad_full
         self.shard = shard
         self.async_depth = max(async_depth, 1)
+        self.device = device
+        self._device_fn_override = device_fn
+        self.fallback_trip = fallback_trip
+        self.fallback_reprobe = fallback_reprobe
         self._tc: R.TCache | None = None
         self._fn = None
+        self._policy: FallbackPolicy | None = None
         self._worker: _DeviceWorker | None = None
+        self._interrupt = None  # ctx.interrupt, bound at boot
         #: staged host-prepared lanes not yet submitted (list of dicts)
         self._staged: collections.deque = collections.deque()
         self._staged_lanes = 0
@@ -157,31 +304,57 @@ class VerifyTile(Tile):
         )
 
     def on_boot(self, ctx: MuxCtx) -> None:
-        import jax
+        from firedancer_tpu.ops.ed25519 import hostpath
 
-        from firedancer_tpu.ops.ed25519 import verify as fver
-
-        # digest-input variant: host hashes SHA512(R||A||M) during lane
-        # expansion, so each lane ships 160 device bytes (digest+sig+pub)
-        # instead of msg_width+100 — the pipeline is host->device
-        # bandwidth bound, not compute bound (PROFILE.md)
-        self._fn = jax.jit(fver.verify_batch_digest)
+        self._interrupt = ctx.interrupt
         if self.pre_dedup:
             depth = PRE_DEDUP_DEPTH
             map_cnt = R.TCache.map_cnt_for(depth)
             fp = R.TCache.footprint(depth, map_cnt)
+            # re-initialized (join=False) even on restart: a replayed
+            # frag the dead incarnation consumed but never forwarded
+            # must NOT be swallowed by a stale pre-dedup entry — the
+            # real dedup tile downstream keeps the durable history
             self._tc = R.TCache(ctx.alloc("tcache", fp), depth, map_cnt)
-        # warm the full-batch shape so the steady state never compiles;
-        # smaller pow2 buckets (trickle traffic) compile on first use —
-        # warming every bucket cost minutes of boot on CPU hosts
-        np.asarray(
-            self._fn(
-                np.zeros((self.max_lanes, 64), dtype=np.uint8),
-                np.zeros((self.max_lanes, 64), np.uint8),
-                np.zeros((self.max_lanes, 32), np.uint8),
+        dev = self._device_fn_override
+        if dev is None and self.device == "auto" and self._fn is None:
+            import jax
+
+            from firedancer_tpu.ops.ed25519 import verify as fver
+
+            # digest-input variant: host hashes SHA512(R||A||M) during
+            # lane expansion, so each lane ships 160 device bytes
+            # (digest+sig+pub) instead of msg_width+100 — the pipeline is
+            # host->device bandwidth bound, not compute bound (PROFILE.md)
+            self._fn = jax.jit(fver.verify_batch_digest)
+            # warm the full-batch shape so the steady state never
+            # compiles; smaller pow2 buckets (trickle traffic) compile on
+            # first use — warming every bucket cost minutes of boot on
+            # CPU hosts
+            np.asarray(
+                self._fn(
+                    np.zeros((self.max_lanes, 64), dtype=np.uint8),
+                    np.zeros((self.max_lanes, 64), np.uint8),
+                    np.zeros((self.max_lanes, 32), np.uint8),
+                )
             )
-        )
-        self._worker = _DeviceWorker(self._fn, self.async_depth)
+        if dev is None and self.device == "auto":
+            dev = self._fn
+        if self._policy is None:
+            # policy (and its degradation counters) persists across
+            # supervisor restarts; only the worker thread is per-life
+            self._policy = FallbackPolicy(
+                dev,
+                hostpath.verify_batch_digest_host,
+                trip_after=self.fallback_trip,
+                reprobe_every=self.fallback_reprobe,
+                fault_hook=(
+                    ctx.faults.device_error
+                    if ctx.faults is not None
+                    else None
+                ),
+            )
+        self._worker = _DeviceWorker(self._policy, self.async_depth)
 
     # ---- ingress: host prep + staging -----------------------------------
 
@@ -209,7 +382,14 @@ class VerifyTile(Tile):
         b["tsorigs"] = frags["tsorig"].copy()
         self._staged.append(b)
         self._staged_lanes += lanes
-        while self._staged_lanes >= self.max_lanes:
+        # submit only while the request pipe has room: a full pipe means
+        # the device/host worker is behind, and the right response is to
+        # hold frags in the RING (in_budget -> credit backpressure), not
+        # to block this thread past its heartbeat deadline
+        while (
+            self._staged_lanes >= self.max_lanes
+            and not self._worker.reqq.full()
+        ):
             self._submit_front(self.max_lanes)
 
     def in_budget(self, ctx: MuxCtx) -> int | None:
@@ -276,7 +456,7 @@ class VerifyTile(Tile):
             rows=b["rows"], szs=b["szs"], tsorigs=b["tsorigs"],
             sig_cnt=b["sig_cnt"], tags=b["tags"], lanes=lanes,
         )
-        self._worker.submit(
+        self._submit(
             meta,
             (
                 _pad2(b["digests"], pad),
@@ -284,6 +464,27 @@ class VerifyTile(Tile):
                 _pad2(b["pubs"], pad),
             ),
         )
+
+    def _submit(self, meta, args) -> None:
+        """Interruptible submit: a full request pipe behind a slow host
+        path must not turn into an unbounded blocking put — the
+        supervisor's interrupt (stall recovery) and a dead worker both
+        have to be able to unwedge the loop thread."""
+        w = self._worker
+        while True:
+            if w.error is not None:
+                raise w.error
+            if w.aborted:
+                return  # crash teardown: ring replay re-delivers
+            if self._interrupt is not None and self._interrupt.is_set():
+                from firedancer_tpu.disco.mux import TileInterrupted
+
+                raise TileInterrupted(f"{self.name}: submit abandoned")
+            try:
+                w.reqq.put((meta, args), timeout=0.05)
+                return
+            except queue.Full:
+                continue
 
     # ---- egress: results -> publish --------------------------------------
 
@@ -350,6 +551,49 @@ class VerifyTile(Tile):
         # has room and nothing fuller is coming (trickle traffic)
         if self._staged_lanes and not self._worker.reqq.full():
             self._submit_front(self.max_lanes)
+        self._mirror_policy_metrics(ctx)
+
+    def _mirror_policy_metrics(self, ctx: MuxCtx) -> None:
+        """Expose the FallbackPolicy degradation state in the shared
+        metrics region (monitors read it live)."""
+        p = self._policy
+        m = ctx.metrics
+        m.set("fallback_batches", p.fallback_batches)
+        m.set("device_errors", p.device_errors)
+        m.set("device_trips", p.device_trips)
+        m.set("host_reprobes", p.host_reprobes)
+
+    def on_crash(self, ctx: MuxCtx) -> None:
+        # drop in-flight host state: the supervisor's ring replay
+        # re-delivers anything the dead incarnation consumed but never
+        # forwarded, and the downstream dedup collapses re-delivery of
+        # what it DID forward.  The policy object (device fn + trip
+        # state) survives into the next incarnation.
+        if self._worker is not None:
+            self._worker.abort()
+            if self._worker.thread.is_alive() and self._policy is not None:
+                # the zombie worker (stuck mid host-verify; threads are
+                # unkillable) still holds the old policy — detach a
+                # fresh copy so its late dispatch/land calls can't
+                # corrupt the live incarnation's degradation state
+                old = self._policy
+                p = FallbackPolicy(
+                    old.device_fn, old.host_fn,
+                    trip_after=self.fallback_trip,
+                    reprobe_every=self.fallback_reprobe,
+                    fault_hook=old.fault_hook,
+                )
+                for attr in (
+                    "consec_failures", "tripped", "fallback_batches",
+                    "device_errors", "device_trips", "host_reprobes",
+                ):
+                    setattr(p, attr, getattr(old, attr))
+                self._policy = p
+            self._worker = None
+        self._staged.clear()
+        self._staged_lanes = 0
+        self._outq.clear()
+        self._outq_txns = 0
 
     def on_halt(self, ctx: MuxCtx) -> None:
         # drain everything: staged -> device -> results -> downstream.
@@ -369,6 +613,7 @@ class VerifyTile(Tile):
                 continue
             ctx.credits = cr
             self._publish_ready(ctx)
+        self._mirror_policy_metrics(ctx)
 
 
 def _split_chunk(chunk: dict, k_txns: int, k_lanes: int) -> tuple[dict, dict]:

@@ -298,9 +298,19 @@ class Connection:
         self._path_challenge_sent: bytes | None = None
         #: set when a matching PATH_RESPONSE arrives (owner consumes)
         self.path_response: bytes | None = None
-        #: packets that passed AEAD authentication (the migration gate:
-        #: an address change is only honored for a packet that decrypts)
-        self.rx_auth_cnt = 0
+        #: NON-PROBING application packets that authenticated with a
+        #: not-previously-received pn strictly above largest_rx — the
+        #: only packets that may trigger a server-side path migration
+        #: (RFC 9000 9.2/9.3)
+        self.migrate_auth_cnt = 0
+        self._rx_non_probing = False
+        #: when set (by the server, around an off-path datagram), any
+        #: PATH_RESPONSE generated while processing is diverted to
+        #: _path_response_out instead of the active tx path, so ONLY the
+        #: response — not coalesced acks/data — leaves on the
+        #: unvalidated arriving path
+        self._divert_path_response = False
+        self._path_response_out: list[bytes] = []
 
     # -- key install ---------------------------------------------------------
 
@@ -476,13 +486,24 @@ class Connection:
             self.sent[INITIAL].clear()
         if level == APPLICATION:
             self.sent[HANDSHAKE].clear()
-        self.rx_auth_cnt += 1  # packets that AUTHENTICATED (migration gate)
+        fresh = pn > self.largest_rx[level]
         self.largest_rx[level] = max(self.largest_rx[level], pn)
         self._range_add(level, pn)
+        self._rx_non_probing = False
         if self._on_frames(level, payload):
             # only ack-eliciting packets trigger sending an ACK
             # (acking pure-ACK packets would ping-pong forever)
             self.ack_pending[level] = True
+        if level == APPLICATION and fresh and self._rx_non_probing:
+            # migration gate (RFC 9000 sections 9.2/9.3): only a
+            # NON-PROBING packet with a not-previously-received packet
+            # number strictly above everything seen in the application
+            # space may move the path.  A replayed datagram still
+            # AUTHENTICATES (AEAD keys don't change) but its pn is <=
+            # largest_rx, and a path-validation probe (PATH_CHALLENGE /
+            # PATH_RESPONSE / NEW_CONNECTION_ID / PADDING only) must not
+            # rebind the return path before the peer commits to it.
+            self.migrate_auth_cnt += 1
 
     def _advance_generation(self, rx_keys: "Keys | None" = None) -> None:
         """Step both directions to the next key generation and flip the
@@ -524,6 +545,18 @@ class Connection:
             out.append(cid)
         self._drive()
         return out
+
+    def take_path_response_datagram(self) -> bytes | None:
+        """Probing-only datagram carrying diverted PATH_RESPONSE frames
+        (see _divert_path_response).  The caller sends it out the path
+        the challenge ARRIVED on (RFC 9000 8.2.2); the packet is not
+        registered for retransmission (a lost response is answered by
+        the peer re-challenging, and it must not migrate paths)."""
+        frames, self._path_response_out = self._path_response_out, []
+        if not frames or APPLICATION not in self.keys_tx:
+            return None
+        pkt, _pn = self._build_packet(APPLICATION, b"".join(frames))
+        return pkt
 
     def send_path_challenge(self) -> bytes:
         """Probe the current peer path: queue PATH_CHALLENGE with fresh
@@ -581,6 +614,11 @@ class Connection:
             ft = payload[off]
             if ft not in (0x00, 0x02, 0x03):
                 eliciting = True
+            if ft not in (0x00, 0x18, 0x1A, 0x1B):
+                # anything beyond PADDING / NEW_CONNECTION_ID /
+                # PATH_CHALLENGE / PATH_RESPONSE makes the packet
+                # non-probing (RFC 9000 9.2 — the migration gate)
+                self._rx_non_probing = True
             if ft == 0x00:  # PADDING
                 off += 1
             elif ft == 0x01:  # PING
@@ -662,10 +700,15 @@ class Connection:
                 off += 1
                 data = bytes(payload[off : off + 8])
                 off += 8
-                # echo on PATH_RESPONSE (RFC 9000 8.2.2); the response
-                # rides the normal tx path, which the owner points at
-                # the probed address during migration
-                self._pending_frames[APPLICATION].append(b"\x1b" + data)
+                # echo on PATH_RESPONSE (RFC 9000 8.2.2).  Normally the
+                # response rides the tx path (the owner points it at the
+                # probed address during migration); for an off-path
+                # probe the server diverts it so it leaves on the
+                # ARRIVING path without dragging acks/data along
+                if self._divert_path_response:
+                    self._path_response_out.append(b"\x1b" + data)
+                else:
+                    self._pending_frames[APPLICATION].append(b"\x1b" + data)
             elif ft == 0x1B:  # PATH_RESPONSE
                 off += 1
                 data = bytes(payload[off : off + 8])
@@ -1108,10 +1151,24 @@ class QuicServer:
             # CID map the same way.
             cand = self.conns.get(bytes(data[1:9]))
             if cand is not None and cand.established and not cand.closed:
-                auth0 = cand.rx_auth_cnt
-                cand.on_datagram(data)
-                if cand.rx_auth_cnt == auth0:
-                    return None  # did not decrypt: ignore, keep old path
+                auth0 = cand.migrate_auth_cnt
+                cand._divert_path_response = True
+                try:
+                    cand.on_datagram(data)
+                finally:
+                    cand._divert_path_response = False
+                # any PATH_RESPONSE this datagram provoked goes out the
+                # ARRIVING path (RFC 9000 8.2.2) — and ONLY the
+                # response: acks/data stay queued for the active path
+                resp = cand.take_path_response_datagram()
+                if resp is not None:
+                    self.stateless_out.append((resp, addr))
+                if cand.migrate_auth_cnt == auth0:
+                    # did not decrypt, a replay (pn not above
+                    # largest_rx), or a probing-only packet: keep the
+                    # old path — RFC 9000 9.3 honors an address change
+                    # only for the highest-numbered non-probing packet
+                    return None
                 old = getattr(cand, "_addr", None)
                 if old is not None and old != addr:
                     self.by_addr.pop(old, None)

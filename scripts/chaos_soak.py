@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Randomized supervision chaos soak.
+
+Generates a random fault schedule (kills, heartbeat-starving stalls,
+frag drops, payload corruption, credit squeezes, device-verify failures)
+from a seed, drives a synth -> verify -> dedup -> sink topology through
+it under the supervisor, and checks the survival invariants:
+
+  * no duplicate transaction is ever admitted past dedup,
+  * every missing survivor is accounted for (injected drops/corruptions,
+    declared overruns, or the documented u64-tag collision budget),
+  * every scripted kill/stall was repaired by a restart and no tile
+    ended degraded.
+
+The seed is printed up front and again on failure — re-running with
+--seed replays the identical fault sequence (disco/faultinj.py hashes
+every stochastic choice from the seed and stable frag indices, never
+from batch boundaries or wall time).
+
+Usage:
+    python scripts/chaos_soak.py [--seed N] [--txns N] [--faults N]
+                                 [--repeat N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+from firedancer_tpu.disco import (  # noqa: E402
+    Fault,
+    FaultInjector,
+    RestartPolicy,
+    Supervisor,
+    Topology,
+)
+from firedancer_tpu.ops.ed25519 import hostpath  # noqa: E402
+from firedancer_tpu.tiles import wire  # noqa: E402
+from firedancer_tpu.tiles.dedup import DedupTile  # noqa: E402
+from firedancer_tpu.tiles.sink import SinkTile  # noqa: E402
+from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool  # noqa: E402
+from firedancer_tpu.tiles.verify import VerifyTile  # noqa: E402
+
+BLOOM_FP_BUDGET = 2
+RING_DEPTH = 256
+
+
+def _random_schedule(rng: np.random.Generator, n_txns: int, n_faults: int):
+    faults = []
+    kinds = ["kill", "stall", "drop", "corrupt", "backpressure",
+             "device_error"]
+    for _ in range(n_faults):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        if kind in ("kill", "stall"):
+            tile = ["verify", "dedup"][int(rng.integers(2))]
+            at = int(rng.integers(n_txns // 4, 3 * n_txns // 4))
+            faults.append(Fault(
+                tile, kind, at=at, on="frag",
+                duration_s=5.0 if kind == "stall" else 0.0,
+            ))
+        elif kind in ("drop", "corrupt"):
+            at = int(rng.integers(0, max(n_txns - 16, 1)))
+            faults.append(Fault(
+                "verify", kind, at=at,
+                count=int(rng.integers(1, 8)),
+                frac=float(rng.uniform(0.3, 1.0)),
+                link="synth_verify",
+            ))
+        elif kind == "backpressure":
+            tile = ["verify", "dedup"][int(rng.integers(2))]
+            faults.append(Fault(
+                tile, "backpressure", on="tick",
+                at=int(rng.integers(10, 500)),
+                count=int(rng.integers(1, 32)),
+            ))
+        else:
+            faults.append(Fault(
+                "verify", "device_error",
+                at=int(rng.integers(0, 4)),
+                count=int(rng.integers(1, 3)),
+            ))
+    return faults
+
+
+def run_soak(
+    seed: int | None = None,
+    n_txns: int = 256,
+    n_faults: int = 6,
+    deadline_s: float = 180.0,
+    verbose: bool = False,
+) -> dict:
+    """One soak iteration.  Returns a report dict with ok=True/False."""
+    if seed is None:
+        seed = int.from_bytes(os.urandom(4), "little")
+    print(f"chaos_soak: seed={seed} txns={n_txns} faults={n_faults}")
+    rng = np.random.default_rng(seed)
+    faults = _random_schedule(rng, n_txns, n_faults)
+    inj = FaultInjector(seed=seed, faults=faults)
+
+    rows, szs, _ = make_txn_pool(n_txns, seed=seed)
+    synth = SynthTile(rows, szs, total=n_txns)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off",
+        # a working "device" stub keeps the device path alive so
+        # device_error faults exercise the real FallbackPolicy route
+        device_fn=lambda d, s, p: hostpath.verify_batch_digest_host(
+            d, s, p
+        ),
+        async_depth=2,
+    )
+    dedup = DedupTile(depth=1 << 12)
+    sink = SinkTile(record=True)
+    topo = Topology()
+    topo.link("synth_verify", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
+    topo.tile(dedup, ins=[("verify_dedup", True)], outs=["dedup_sink"])
+    topo.tile(sink, ins=[("dedup_sink", True)])
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=0.5,
+            backoff_base_s=0.05,
+            breaker_n=2 * n_faults + 4,
+            replay={"verify": RING_DEPTH, "dedup": RING_DEPTH},
+        ),
+        faults=inj,
+    )
+    report: dict = {"ok": False, "seed": seed}
+    sup.start(batch_max=32)
+    try:
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            injected = inj.dropped_frags() + inj.corrupted_frags()
+            if len(set(sink.all_sigs().tolist())) >= n_txns - injected:
+                break
+            time.sleep(0.1)
+    finally:
+        sup.halt()
+    try:
+        sunk = sink.all_sigs().tolist()
+        uniq = set(sunk)
+        overruns = sum(
+            topo.metrics(n).counter("overrun_frags") for n in topo.tiles
+        )
+        restarts = {n: sup.restarts(n) for n in topo.tiles}
+        degraded = {
+            n: d for n in topo.tiles
+            if (d := sup.degraded(n)) is not None
+        }
+        injected = inj.dropped_frags() + inj.corrupted_frags()
+        report.update(
+            sent=n_txns,
+            sunk=len(sunk),
+            unique=len(uniq),
+            injected_loss=injected,
+            overruns=overruns,
+            restarts=restarts,
+            degraded=degraded,
+            fired=inj.fired(),
+        )
+        checks = {
+            "no_duplicates": len(uniq) == len(sunk),
+            "only_known_tags": uniq <= set(synth.tags.tolist()),
+            "loss_accounted": (
+                n_txns - len(uniq)
+                <= injected + overruns + BLOOM_FP_BUDGET
+            ),
+            "faults_repaired": sum(restarts.values())
+            >= inj.count("kill") + inj.count("stall"),
+            "nothing_degraded": not degraded,
+        }
+        report["checks"] = checks
+        report["ok"] = all(checks.values())
+        if verbose or not report["ok"]:
+            print(f"chaos_soak report (seed={seed}):")
+            for k, v in report.items():
+                print(f"  {k}: {v}")
+        if not report["ok"]:
+            print(f"chaos_soak FAILED — replay with --seed {seed}")
+        return report
+    finally:
+        topo.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--txns", type=int, default=256)
+    ap.add_argument("--faults", type=int, default=6)
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="soak iterations (fresh random seed each)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    for i in range(args.repeat):
+        report = run_soak(
+            seed=args.seed, n_txns=args.txns, n_faults=args.faults,
+            verbose=args.verbose,
+        )
+        if not report["ok"]:
+            return 1
+        print(
+            f"iteration {i + 1}/{args.repeat} ok: "
+            f"{report['unique']}/{report['sent']} survived, "
+            f"restarts={report['restarts']}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

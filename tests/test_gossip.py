@@ -308,6 +308,11 @@ def test_stake_weighted_push_selection():
     rng = np.random.default_rng(47)
     secret = rng.integers(0, 256, 32, np.uint8).tobytes()
     n = G.GossipNode(secret)
+    # deterministic sampling source: the node's default is os.urandom,
+    # which made this statistical assertion flake ~1 run in 5 — seed it
+    # so the selection counts are exact and replayable
+    det = np.random.default_rng(48)
+    n._rng = lambda sz: det.integers(0, 256, sz, np.uint8).tobytes()
     try:
         peers = {}
         for i in range(12):

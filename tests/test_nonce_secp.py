@@ -148,26 +148,40 @@ def test_nonce_withdraw_partial_and_full():
     assert ex.mgr.load(dest).lamports == ok_amt
     assert ex.mgr.load(nonce_k).lamports == bal - ok_amt
 
-    # full withdrawal while the nonce is fresh (stored == current
-    # durable) succeeds and uninitializes the account; after an advance
-    # in a LATER slot the stored value goes stale and full withdrawal
-    # is "blockhash not expired" until re-derived
+    # full withdrawal while the nonce is FRESH (stored == current
+    # durable) is rejected — the protected txn could still be replayed
+    # (Agave NonceBlockhashNotExpired); once a later slot rotates the
+    # live durable value the stored one is expired and the close
+    # succeeds, uninitializing the account
     remaining = bal - ok_amt
+    full_ins = [(6, [2, 3, 4, 5, 1],
+                 (5).to_bytes(4, "little")
+                 + remaining.to_bytes(8, "little"))]
     r = ex.execute_txn(T.build(
         _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
                         SYSTEM_PROGRAM_ID],
-        bytes(32),
-        [(6, [2, 3, 4, 5, 1],
-          (5).to_bytes(4, "little") + remaining.to_bytes(8, "little"))],
-        readonly_unsigned_cnt=3,
+        bytes(32), full_ins, readonly_unsigned_cnt=3,
+    ))
+    assert not r.ok and "not expired" in r.err
+
+    ex.begin_slot(2)  # stored durable is now expired
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32), full_ins, readonly_unsigned_cnt=3,
     ))
     assert r.ok, r.err
     acct = ex.mgr.load(nonce_k)
     assert acct.lamports == 0
     assert acct.data[4:8] == (0).to_bytes(4, "little")  # uninitialized
+    assert ex.mgr.load(dest).lamports == ok_amt + remaining
 
 
-def test_nonce_full_withdraw_stale_rejected():
+def test_nonce_full_withdraw_fresh_rejected_expired_allowed():
+    """Regression for the inverted NonceBlockhashNotExpired check: the
+    reference snapshot errored when stored != current (blocking every
+    legitimate close and allowing the replay-risky one); Agave errors
+    when stored == current."""
     rng = np.random.default_rng(72)
     ex, payer, nonce_k, auth = _nonce_setup(rng)
     rb, rent = sysvar.RECENT_BLOCKHASHES_ID, sysvar.RENT_ID
@@ -178,17 +192,53 @@ def test_nonce_full_withdraw_stale_rejected():
         readonly_unsigned_cnt=3,
     ))
     assert r.ok, r.err
-    ex.begin_slot(2)  # stored durable is now stale
     bal = ex.mgr.load(nonce_k).lamports
+    full_ins = [(6, [2, 3, 4, 5, 1],
+                 (5).to_bytes(4, "little") + bal.to_bytes(8, "little"))]
+
+    # same slot: stored durable == current -> close rejected
     r = ex.execute_txn(T.build(
         _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
                         SYSTEM_PROGRAM_ID],
-        bytes(32),
-        [(6, [2, 3, 4, 5, 1],
-          (5).to_bytes(4, "little") + bal.to_bytes(8, "little"))],
-        readonly_unsigned_cnt=3,
+        bytes(32), full_ins, readonly_unsigned_cnt=3,
     ))
     assert not r.ok and "not expired" in r.err
+    assert ex.mgr.load(nonce_k).lamports == bal  # nothing moved
+
+    ex.begin_slot(2)  # stored durable expired -> close allowed
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, dest, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32), full_ins, readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    assert ex.mgr.load(dest).lamports == bal
+
+
+def test_nonce_withdraw_to_self_rejected():
+    """Regression: destination == nonce account must be an error, not a
+    silent no-op success (Agave fails the duplicate account borrow)."""
+    rng = np.random.default_rng(73)
+    ex, payer, nonce_k, auth = _nonce_setup(rng)
+    rb, rent = sysvar.RECENT_BLOCKHASHES_ID, sysvar.RENT_ID
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, nonce_k, rb, rent, SYSTEM_PROGRAM_ID],
+        bytes(32), [(4, [1, 2, 3], _init_ins(auth))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert r.ok, r.err
+    bal = ex.mgr.load(nonce_k).lamports
+    # accounts: [nonce, to=nonce, recent_blockhashes, rent, authority]
+    r = ex.execute_txn(T.build(
+        _sign_stub(2), [payer, auth, nonce_k, rb, rent,
+                        SYSTEM_PROGRAM_ID],
+        bytes(32),
+        [(5, [2, 2, 3, 4, 1],
+          (5).to_bytes(4, "little") + (100).to_bytes(8, "little"))],
+        readonly_unsigned_cnt=3,
+    ))
+    assert not r.ok and "same account" in r.err
+    assert ex.mgr.load(nonce_k).lamports == bal
 
 
 def test_slot_hashes_sysvar_and_alt_deactivation():

@@ -236,6 +236,96 @@ def test_connection_migration_address_hop():
     assert sconn.txns == [txn1, txn2, txn3]
 
 
+def test_migration_replayed_datagram_ignored():
+    """RFC 9000 section 9.3 regression: a REPLAYED 1-RTT datagram still
+    authenticates (AEAD keys don't change), but its packet number is not
+    above largest_rx — an off-path attacker echoing a captured datagram
+    from its own address must not steal the return path."""
+    rng = np.random.default_rng(33)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    addr1 = ("10.0.0.1", 1111)
+    evil = ("6.6.6.6", 666)
+    sconn = _pump(client.conn, server, addr=addr1)
+    assert sconn is not None and client.conn.established
+    _pump(client.conn, server, addr=addr1)
+
+    # capture the genuine short-header datagrams carrying a txn
+    txn1 = rng.integers(0, 256, 200, np.uint8).tobytes()
+    client.conn.send_txn(txn1)
+    captured = []
+    for _ in range(20):
+        outs = client.conn.datagrams_out()
+        if not outs:
+            break
+        for d in outs:
+            if not (d[0] & 0x80):  # short header only
+                captured.append(d)
+            server.on_datagram(d, addr1)
+        for d in sconn.datagrams_out():
+            client.conn.on_datagram(d)
+    assert sconn.txns == [txn1] and captured
+
+    # replay every captured datagram from the attacker's address: the
+    # packets decrypt but carry already-seen pns -> no path migration
+    for d in captured:
+        server.on_datagram(d, evil)
+    assert server.migrations == 0
+    assert server.by_addr.get(addr1) is sconn
+    assert evil not in server.by_addr
+
+    # the genuine client is undisturbed on its original path
+    txn2 = rng.integers(0, 256, 120, np.uint8).tobytes()
+    client.conn.send_txn(txn2)
+    _pump(client.conn, server, addr=addr1)
+    assert sconn.txns == [txn1, txn2]
+
+
+def test_migration_probe_first_path_validation():
+    """RFC 9000 sections 8.2.2 + 9.2: a client validating a new path
+    BEFORE migrating sends a probing-only packet (PATH_CHALLENGE) from
+    the new address.  The server must answer out the ARRIVING path but
+    must NOT rebind the connection until a non-probing packet commits."""
+    rng = np.random.default_rng(34)
+    identity = rng.integers(0, 256, 32, np.uint8).tobytes()
+    server = quic.QuicServer(identity)
+    client = quic.QuicClient()
+    addr1 = ("10.0.0.1", 1111)
+    addr2 = ("10.9.9.9", 2222)
+    sconn = _pump(client.conn, server, addr=addr1)
+    assert sconn is not None and client.conn.established
+    _pump(client.conn, server, addr=addr1)  # settle acks + spare CIDs
+
+    # probe the new path: PATH_CHALLENGE-bearing datagrams from addr2
+    client.conn.send_path_challenge()
+    probed = False
+    for d in client.conn.datagrams_out():
+        server.on_datagram(d, addr2)
+        probed = True
+    assert probed
+    # no rebind yet...
+    assert server.migrations == 0
+    assert server.by_addr.get(addr1) is sconn
+    assert addr2 not in server.by_addr
+    # ...but the response went out the arriving path
+    resp = [d for d, a in server.stateless_out if a == addr2]
+    assert resp, "no datagram routed to the probed path"
+    server.stateless_out.clear()
+    for d in resp:
+        client.conn.on_datagram(d)
+    assert client.conn.path_response is not None
+
+    # path validated: the client commits with a non-probing packet
+    assert client.conn.migrate_dcid()
+    txn = rng.integers(0, 256, 200, np.uint8).tobytes()
+    client.conn.send_txn(txn)
+    _pump(client.conn, server, addr=addr2)
+    assert sconn.txns == [txn]
+    assert server.migrations == 1
+    assert server.by_addr.get(addr2) is sconn
+
+
 def test_migration_unknown_dcid_ignored():
     """A short-header packet from an unknown address with an unknown
     DCID opens nothing and migrates nothing."""

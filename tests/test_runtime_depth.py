@@ -348,6 +348,56 @@ def test_bpf_lamport_conservation_enforced():
     assert ex.mgr.load(victim).lamports == 500  # nothing committed
 
 
+def test_bpf_owner_reassignment_requires_zeroed_data():
+    """fd_account_set_owner parity: the owning program may reassign a
+    writable non-executable account, but ONLY when the account data is
+    all zeroes — live bytes handed to a new owner could masquerade as
+    that owner's self-initialized state."""
+    rng = np.random.default_rng(21)
+
+    def run(data: bytes):
+        funk = _funk()
+        ex = Executor(funk)
+        payer, prog_key, victim = _keys(rng, 3)
+        ex.mgr.store(payer, Account(10_000_000_000))
+        ex.mgr.store(
+            victim,
+            Account(rent_exempt_minimum(len(data)), prog_key, False, 0,
+                    data),
+        )
+        # input ABI, account 0: u64 cnt | hdr 8 | pk 32 | owner 32 | ...
+        owner_off = 8 + 8 + 32
+        text = (
+            # stomp the first 8 owner bytes -> a different owner pubkey
+            lddw(1, sbpf.MM_INPUT + owner_off)
+            + lddw(2, 0x1122334455667788)
+            + ins(0x7B, dst=1, src=2)  # stxdw [r1+0], r2
+            + ins(0xB7, dst=0, imm=0)
+            + EXIT
+        )
+        ex.mgr.store(
+            prog_key,
+            Account(1, BPF_LOADER_ID, True, 0, sbpf.build_elf(text)),
+        )
+        txn = T.build(
+            _sign_stub(1), [payer, victim, prog_key], bytes(32),
+            [(2, [1], b"")], readonly_unsigned_cnt=1,
+        )
+        return ex, victim, prog_key, ex.execute_txn(txn)
+
+    # live data: reassignment rejected, nothing committed
+    ex, victim, prog_key, r = run(b"\x05" + bytes(7))
+    assert not r.ok and "owner" in r.err
+    assert ex.mgr.load(victim).owner == prog_key
+
+    # zeroed data: the owning program may hand the account off
+    ex, victim, prog_key, r = run(bytes(8))
+    assert r.ok, r.err
+    new_owner = ex.mgr.load(victim).owner
+    assert new_owner != prog_key
+    assert new_owner[:8] == (0x1122334455667788).to_bytes(8, "little")
+
+
 def test_bpf_program_reads_clock_sysvar():
     """A deployed program reads the clock sysvar account (first
     instruction account) out of the input ABI and writes lamports into a
