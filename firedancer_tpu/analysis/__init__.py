@@ -1,0 +1,22 @@
+"""fdtlint — project-specific static analysis for the native/ctypes/JAX
+trust boundaries.
+
+Three checkers (see README.md in this directory for the rules table):
+
+  abi       ctypes ABI cross-checker: C prototypes in tango/native/*.{c,h}
+            diffed against the ctypes signature tables and every
+            `lib.fdt_*` call site in the binding modules.
+  ringlint  tango ring-discipline linter: AST pass over tiles/ and disco/
+            encoding the mcache/fseq/fctl protocol
+            (fd_tango_base.h seq/ctl model).
+  purity    JAX hot-path purity lint: functions marked @hot_path
+            (firedancer_tpu.utils.hotpath) must not host-sync, use Python
+            float arithmetic, or branch on traced arguments.
+
+Run as a tier-1 test (tests/test_fdtlint.py) and standalone via
+scripts/fdtlint.py.  The package is deliberately stdlib-only (ast + re):
+linting the repo must not require jax, numpy, or a native build.
+"""
+
+from .findings import Finding  # noqa: F401
+from .engine import Report, run_paths, run_repo  # noqa: F401

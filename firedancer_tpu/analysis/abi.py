@@ -1,0 +1,346 @@
+"""ctypes ABI cross-checker.
+
+The native layer's trust boundary is a hand-maintained ctypes signature
+table (tango/rings.py `sigs`) plus direct `lib.fdt_*` call sites spread
+across the binding modules.  Nothing in CPython checks any of it against
+the C: a wrong argtypes entry silently truncates a 64-bit argument, a
+missing entry leaves cdecl defaults (int return!), and an arity slip at a
+call site corrupts the callee's stack view.  This checker diffs all three
+layers:
+
+  C prototypes  (tango/native/*.{c,h}, via analysis.cparse)
+     x ctypes tables  (any `{ "fdt_...": (restype, [argtypes...]) }` dict
+       literal, evaluated symbolically from the AST — no import needed)
+     x call sites     (every `<expr>.fdt_*(...)` Call node)
+
+Rules: see README.md.  All paths are AST/regex level: linting must not
+require building or loading the native library.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import cparse
+from .findings import Finding, apply_pragmas
+from .cparse import PTR, VOID, CType, fmt_ctype
+
+#: ctypes attribute -> ABI triple
+_CTYPES_MAP: dict[str, CType] = {
+    "c_uint64": ("int", 8, False),
+    "c_int64": ("int", 8, True),
+    "c_uint32": ("int", 4, False),
+    "c_int32": ("int", 4, True),
+    "c_int": ("int", 4, True),
+    "c_uint": ("int", 4, False),
+    "c_uint16": ("int", 2, False),
+    "c_int16": ("int", 2, True),
+    "c_uint8": ("int", 1, False),
+    "c_int8": ("int", 1, True),
+    "c_ubyte": ("int", 1, False),
+    "c_byte": ("int", 1, True),
+    "c_size_t": ("int", 8, False),
+    "c_ssize_t": ("int", 8, True),
+    "c_double": ("float", 8, True),
+    "c_float": ("float", 4, True),
+    "c_void_p": PTR,
+    "c_char_p": PTR,
+    "c_bool": ("int", 1, False),
+}
+
+
+# ---------------------------------------------------------------------------
+# AST-level extraction
+
+
+def _ctypes_attr(node: ast.AST) -> str | None:
+    """`ct.c_uint64` / `ctypes.c_int` -> attribute name, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("ct", "ctypes")
+        and node.attr in _CTYPES_MAP
+    ):
+        return node.attr
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One pass over a binding module: ctypes aliases, sigs tables, fdt_*
+    call sites."""
+
+    def __init__(self) -> None:
+        self.env: dict[str, CType] = {}  # alias name -> ABI triple
+        #: [(table_line, {symbol: (line, ret, args|None)})]
+        self.tables: list[tuple[int, dict[str, tuple[int, CType, list[CType] | None]]]] = []
+        #: [(line, symbol, positional_argc | None-if-starred)]
+        self.calls: list[tuple[int, str, int | None]] = []
+
+    # -- ctype expression evaluation ------------------------------------
+
+    def _eval_ctype(self, node: ast.AST) -> CType | None:
+        if isinstance(node, ast.Constant) and node.value is None:
+            return VOID
+        attr = _ctypes_attr(node)
+        if attr is not None:
+            return _CTYPES_MAP[attr]
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        return None
+
+    def _eval_arglist(self, node: ast.AST) -> list[CType] | None:
+        """Evaluate an argtypes expression: list literals, list + list,
+        list * int.  None = not statically evaluable."""
+        if isinstance(node, ast.List):
+            out = []
+            for el in node.elts:
+                t = self._eval_ctype(el)
+                if t is None:
+                    return None
+                out.append(t)
+            return out
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self._eval_arglist(node.left)
+            right = self._eval_arglist(node.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            for seq, n in ((node.left, node.right), (node.right, node.left)):
+                lst = self._eval_arglist(seq)
+                if (
+                    lst is not None
+                    and isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)
+                ):
+                    return lst * n.value
+            return None
+        return None
+
+    # -- visitors --------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # alias bindings:  u64, vp = ct.c_uint64, ct.c_void_p   or
+        #                  u64 = ct.c_uint64
+        targets = node.targets[0]
+        if isinstance(targets, ast.Tuple) and isinstance(node.value, ast.Tuple):
+            for t, v in zip(targets.elts, node.value.elts):
+                if isinstance(t, ast.Name):
+                    ct = self._eval_ctype(v)
+                    if ct is not None:
+                        self.env[t.id] = ct
+        elif isinstance(targets, ast.Name):
+            ct = self._eval_ctype(node.value)
+            if ct is not None:
+                self.env[targets.id] = ct
+        # signature tables: dict literal keyed by "fdt_*" strings
+        if isinstance(node.value, ast.Dict):
+            entries: dict[str, tuple[int, CType, list[CType] | None]] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and k.value.startswith("fdt_")
+                ):
+                    continue
+                ret: CType | None = None
+                args: list[CType] | None = None
+                if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                    ret = self._eval_ctype(v.elts[0])
+                    args = self._eval_arglist(v.elts[1])
+                entries[k.value] = (k.lineno, ret if ret is not None else VOID, args)
+            if entries:
+                self.tables.append((node.lineno, entries))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) and node.func.attr.startswith("fdt_"):
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                argc: int | None = None
+            else:
+                argc = len(node.args) + len(node.keywords)
+            self.calls.append((node.lineno, node.func.attr, argc))
+        self.generic_visit(node)
+
+
+def scan_module(path: Path) -> _ModuleScan:
+    scan = _ModuleScan()
+    scan.visit(ast.parse(path.read_text(), filename=str(path)))
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# the cross-check
+
+
+def _compat(c: CType, py: CType) -> bool:
+    """Is the ctypes triple ABI-compatible with the C triple?"""
+    return c == py
+
+
+def check(
+    c_paths: list[Path],
+    py_paths: list[Path],
+    rel: Path | None = None,
+) -> tuple[list[Finding], dict]:
+    """Cross-check C prototypes x ctypes tables x call sites.
+
+    Returns (findings, coverage).  coverage records what was actually
+    examined so tests can assert the checker saw every binding module —
+    a checker that silently scans nothing always "passes".
+    """
+
+    def _rel(p: Path | str) -> str:
+        p = Path(p)
+        if rel is not None:
+            try:
+                return p.relative_to(rel).as_posix()
+            except ValueError:
+                pass
+        return p.as_posix()
+
+    findings: list[Finding] = []
+
+    # -- 1. C surface ----------------------------------------------------
+    decls: dict[str, cparse.CDecl] = {}
+    for cp in c_paths:
+        file_decls, issues = cparse.parse_c_decls(cp)
+        for issue in issues:
+            findings.append(
+                Finding(_rel(issue.path), issue.line, "abi-cparse", f"{issue.name}: {issue.msg}")
+            )
+        for d in file_decls:
+            prev = decls.get(d.name)
+            if prev is None:
+                decls[d.name] = d
+                continue
+            if (prev.ret, prev.args) != (d.ret, d.args):
+                findings.append(
+                    Finding(
+                        _rel(d.path),
+                        d.line,
+                        "abi-decl-conflict",
+                        f"{d.name}: declaration disagrees with "
+                        f"{_rel(prev.path)}:{prev.line} "
+                        f"({fmt_ctype(d.ret)}({len(d.args)} args) vs "
+                        f"{fmt_ctype(prev.ret)}({len(prev.args)} args))",
+                    )
+                )
+            # keep the definition as canonical when both exist
+            if d.is_definition:
+                decls[d.name] = d
+
+    # -- 2. tables vs C --------------------------------------------------
+    bound: dict[str, tuple[CType, list[CType] | None]] = {}
+    coverage_modules: list[str] = []
+    table_count = 0
+    call_count = 0
+    scans: list[tuple[Path, _ModuleScan, list[str]]] = []
+    for pp in py_paths:
+        scan = scan_module(pp)
+        src_lines = pp.read_text().splitlines()
+        scans.append((pp, scan, src_lines))
+        coverage_modules.append(_rel(pp))
+        for _table_line, entries in scan.tables:
+            table_count += 1
+            mod_findings: list[Finding] = []
+            for name, (line, ret, args) in entries.items():
+                bound[name] = (ret, args)
+                d = decls.get(name)
+                if d is None:
+                    mod_findings.append(
+                        Finding(
+                            _rel(pp), line, "abi-unknown-symbol",
+                            f"{name}: bound in ctypes table but not "
+                            "declared by any native source",
+                        )
+                    )
+                    continue
+                if args is None:
+                    mod_findings.append(
+                        Finding(
+                            _rel(pp), line, "abi-argtype",
+                            f"{name}: argtypes expression is not statically "
+                            "evaluable; the ABI cannot be checked",
+                        )
+                    )
+                    continue
+                if len(args) != len(d.args):
+                    mod_findings.append(
+                        Finding(
+                            _rel(pp), line, "abi-arity",
+                            f"{name}: ctypes table declares {len(args)} args, "
+                            f"C declares {len(d.args)} "
+                            f"({_rel(d.path)}:{d.line})",
+                        )
+                    )
+                else:
+                    for i, (ca, pa) in enumerate(zip(d.args, args)):
+                        if not _compat(ca, pa):
+                            mod_findings.append(
+                                Finding(
+                                    _rel(pp), line, "abi-argtype",
+                                    f"{name}: arg {i} is {fmt_ctype(pa)} in the "
+                                    f"ctypes table but {fmt_ctype(ca)} in C "
+                                    f"({_rel(d.path)}:{d.line})",
+                                )
+                            )
+                if not _compat(d.ret, ret):
+                    mod_findings.append(
+                        Finding(
+                            _rel(pp), line, "abi-restype",
+                            f"{name}: restype is {fmt_ctype(ret)} in the ctypes "
+                            f"table but {fmt_ctype(d.ret)} in C "
+                            f"({_rel(d.path)}:{d.line})",
+                        )
+                    )
+            findings.extend(apply_pragmas(mod_findings, src_lines))
+
+    # -- 3. call sites vs tables ----------------------------------------
+    for pp, scan, src_lines in scans:
+        mod_findings = []
+        for line, name, argc in scan.calls:
+            call_count += 1
+            if name not in bound:
+                mod_findings.append(
+                    Finding(
+                        _rel(pp), line, "abi-call-unknown",
+                        f"{name}: called but not bound in any ctypes table "
+                        "(restype/argtypes default to int — UB on 64-bit "
+                        "returns and pointer args)",
+                    )
+                )
+                continue
+            _ret, args = bound[name]
+            if args is not None and argc is not None and argc != len(args):
+                mod_findings.append(
+                    Finding(
+                        _rel(pp), line, "abi-call-arity",
+                        f"{name}: called with {argc} args but the ctypes "
+                        f"table declares {len(args)}",
+                    )
+                )
+        findings.extend(apply_pragmas(mod_findings, src_lines))
+
+    # -- 4. unbound exports ---------------------------------------------
+    for name, d in sorted(decls.items()):
+        if name not in bound:
+            findings.append(
+                Finding(
+                    _rel(d.path), d.line, "abi-unbound-export",
+                    f"{name}: exported by the native layer but absent from "
+                    "every ctypes table (callable with default int "
+                    "restype/argtypes — bind it or make it static)",
+                )
+            )
+
+    coverage = {
+        "modules": coverage_modules,
+        "c_files": [_rel(p) for p in c_paths],
+        "tables": table_count,
+        "table_symbols": sorted(bound),
+        "c_symbols": sorted(decls),
+        "call_sites": call_count,
+    }
+    return findings, coverage

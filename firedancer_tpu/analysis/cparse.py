@@ -1,0 +1,183 @@
+"""Minimal C declaration parser for the native layer's exported surface.
+
+Extracts `fdt_*` function prototypes (return type + parameter types) from
+the tango/native sources without a real C frontend: the native layer is
+deliberately plain C11 — no macros in signatures, no function pointers,
+no nested parens in parameter lists — so a comment-stripping pass plus a
+declaration-shaped regex is exact for this codebase.  Anything the parser
+cannot classify becomes an explicit "unparsed" record rather than a
+silent skip, so grammar drift in the C surfaces as a lint finding instead
+of a coverage hole.
+
+Types are normalized to ABI-relevant triples (kind, width, signed):
+    kind  "int" | "float" | "ptr" | "void"
+    width bytes as passed through the ctypes call boundary
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+# ABI triple: (kind, width-bytes, signed).  Pointers are all equivalent at
+# the ctypes boundary (ctypes.c_void_p carries no pointee type).
+CType = tuple[str, int, bool]
+
+VOID: CType = ("void", 0, False)
+PTR: CType = ("ptr", 8, False)
+
+#: C type word -> ABI triple, for non-pointer params/returns.  Checked in
+#: declaration order: the first word present in the declarator wins.
+_C_SCALARS: list[tuple[str, CType]] = [
+    ("uint64_t", ("int", 8, False)),
+    ("int64_t", ("int", 8, True)),
+    ("uint32_t", ("int", 4, False)),
+    ("int32_t", ("int", 4, True)),
+    ("uint16_t", ("int", 2, False)),
+    ("int16_t", ("int", 2, True)),
+    ("uint8_t", ("int", 1, False)),
+    ("int8_t", ("int", 1, True)),
+    ("size_t", ("int", 8, False)),
+    ("ssize_t", ("int", 8, True)),
+    ("double", ("float", 8, True)),
+    ("float", ("float", 4, True)),
+    ("char", ("int", 1, True)),
+    ("void", VOID),
+    ("int", ("int", 4, True)),  # after the *intN_t words ("int" substring)
+]
+
+#: words allowed in the prefix of an exported declaration
+_DECL_QUALIFIERS = {"extern", "const", "inline", "static", "unsigned", "signed"}
+
+_NAME_RE = re.compile(r"\b(fdt_[a-z0-9_]+)\s*\(")
+
+
+@dataclass
+class CDecl:
+    name: str
+    ret: CType
+    args: list[CType]
+    path: str
+    line: int
+    is_definition: bool  # followed by `{` (a .c body) vs `;` (prototype)
+
+
+@dataclass
+class CParseIssue:
+    """A declaration-shaped construct the parser could not classify."""
+
+    name: str
+    path: str
+    line: int
+    msg: str
+
+
+def strip_comments(text: str) -> str:
+    """Remove /*...*/ and //... comments, preserving line structure so
+    reported line numbers stay exact."""
+
+    def _block(m: re.Match) -> str:
+        return "\n" * m.group(0).count("\n")
+
+    text = re.sub(r"/\*.*?\*/", _block, text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def classify_c_type(decl: str) -> CType | None:
+    """Normalize one C declarator (e.g. `uint8_t const * rows`) to an ABI
+    triple.  Returns None when no known type word is present."""
+    if "*" in decl:
+        return PTR
+    words = re.findall(r"[A-Za-z_][A-Za-z0-9_]*", decl)
+    # enums in this codebase are argument-position-free; treat a lone
+    # `unsigned` as unsigned int
+    for key, ctype in _C_SCALARS:
+        if key in words:
+            if not ctype[2] or "unsigned" not in words:
+                return ctype
+            return (ctype[0], ctype[1], False)
+    if words and set(words) <= {"unsigned", "signed", "const"}:
+        return ("int", 4, "signed" in words)
+    return None
+
+
+def _split_params(paramtext: str) -> list[str]:
+    params = [p.strip() for p in paramtext.split(",")]
+    if params == [""] or params == ["void"]:
+        return []
+    return params
+
+
+def parse_c_decls(path: Path) -> tuple[list[CDecl], list[CParseIssue]]:
+    """All exported fdt_* declarations/definitions in one C source file."""
+    raw = path.read_text()
+    text = strip_comments(raw)
+    decls: list[CDecl] = []
+    issues: list[CParseIssue] = []
+    for m in _NAME_RE.finditer(text):
+        name = m.group(1)
+        line = text.count("\n", 0, m.start()) + 1
+        # prefix: text since the previous statement/block delimiter must
+        # look like a return type, otherwise this is a call site
+        start = max(
+            text.rfind(c, 0, m.start()) for c in (";", "{", "}", "\x00")
+        )
+        prefix = text[start + 1 : m.start()].strip()
+        if "#" in prefix:  # preprocessor line (e.g. a guarded prototype)
+            prefix = prefix.split("\n")[-1].strip()
+        words = re.findall(r"[A-Za-z_][A-Za-z0-9_]*|\*", prefix)
+        if not words:
+            continue  # bare call statement
+        known_types = {k for k, _ in _C_SCALARS}
+        if any(
+            w not in known_types and w not in _DECL_QUALIFIERS and w != "*"
+            for w in words
+        ):
+            continue  # assignment / return / cast — a call, not a decl
+        if "static" in words:
+            continue  # not exported: invisible to ctypes
+        ret = classify_c_type(prefix)
+        if ret is None:
+            issues.append(
+                CParseIssue(name, str(path), line, f"unparsed return type {prefix!r}")
+            )
+            continue
+        # parameter list: the native layer has no nested parens
+        close = text.find(")", m.end())
+        if close < 0:
+            issues.append(CParseIssue(name, str(path), line, "unterminated parameter list"))
+            continue
+        params = _split_params(text[m.end() : close])
+        args: list[CType] = []
+        bad = False
+        for p in params:
+            ct = classify_c_type(p)
+            if ct is None or ct == VOID:
+                issues.append(
+                    CParseIssue(name, str(path), line, f"unparsed parameter {p!r}")
+                )
+                bad = True
+                break
+            args.append(ct)
+        if bad:
+            continue
+        after = text[close + 1 : close + 40].lstrip()
+        decls.append(
+            CDecl(
+                name=name,
+                ret=ret,
+                args=args,
+                path=str(path),
+                line=line,
+                is_definition=after.startswith("{"),
+            )
+        )
+    return decls, issues
+
+
+def fmt_ctype(t: CType) -> str:
+    kind, width, signed = t
+    if kind in ("void", "ptr"):
+        return kind
+    return f"{'i' if signed else 'u'}{width * 8}"

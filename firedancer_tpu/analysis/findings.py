@@ -1,0 +1,49 @@
+"""Finding model + suppression pragmas shared by all fdtlint checkers."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import asdict, dataclass
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint violation, pinned to path:line."""
+
+    path: str  # repo-relative (or as-given for out-of-tree fixtures)
+    line: int
+    rule: str  # stable slug, e.g. "ring-overrun" (pragma key)
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+_PRAGMA_RE = re.compile(r"fdtlint:\s*allow\[([a-z0-9_,\- ]+)\]")
+
+
+def suppressed_rules(source_lines: list[str], line: int) -> set[str]:
+    """Rules suppressed at `line` (1-based) by an explicit pragma on the
+    same line or the line directly above:
+
+        x = thing()  # fdtlint: allow[ring-credit] why it is safe
+    """
+    out: set[str] = set()
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(source_lines):
+            m = _PRAGMA_RE.search(source_lines[ln - 1])
+            if m:
+                out |= {r.strip() for r in m.group(1).split(",")}
+    return out
+
+
+def apply_pragmas(findings: list[Finding], source_lines: list[str]) -> list[Finding]:
+    """Drop findings their source explicitly allows."""
+    return [
+        f
+        for f in findings
+        if f.rule not in suppressed_rules(source_lines, f.line)
+    ]

@@ -1,0 +1,191 @@
+"""JAX hot-path purity lint.
+
+Functions marked `@hot_path` (firedancer_tpu.utils.hotpath) declare
+themselves part of the device dispatch pipeline: traced by jit (or called
+from traced code), consensus-critical, and required to stay asynchronous.
+This pass enforces the marker's contract by AST:
+
+  purity-host-sync       host synchronization inside a hot function:
+                         `.item()`, `.tolist()`, `block_until_ready`,
+                         `jax.device_get`, `np.asarray` / `np.array` /
+                         `np.frombuffer` — each forces a device->host
+                         copy (or silently materializes a traced value)
+                         and stalls the in-flight batch pipeline.
+  purity-float           Python float literals / float() casts: the
+                         crypto and consensus math is exact integer limb
+                         arithmetic; a float sneaking in is a
+                         nondeterminism bug, not a style issue.
+  purity-untraced-branch `if`/`while`/ternary on a non-static argument:
+                         under jit the condition is a tracer — the branch
+                         either raises ConcretizationError or silently
+                         specializes.  Branch on arguments listed in
+                         `hot_path(static=...)` only.
+
+Only marked functions are checked: the tile/host layer is free to sync
+(that is its job — it owns the dispatch boundary).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import Finding, apply_pragmas
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_NAMES = {"np", "numpy", "onp"}
+_NP_SYNC_FUNCS = {"asarray", "array", "frombuffer"}
+_JAX_SYNC_FUNCS = {"device_get", "block_until_ready"}
+
+
+def _hot_path_meta(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> tuple[bool, set[str]]:
+    """(is_marked, static_arg_names) from the decorator list."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name != "hot_path":
+            continue
+        static: set[str] = set()
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg == "static" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    static |= {
+                        el.value
+                        for el in kw.value.elts
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                    }
+        return True, static
+    return False, set()
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    a = fn.args
+    params = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg)
+    if a.kwarg:
+        params.append(a.kwarg)
+    return {p.arg for p in params}
+
+
+def _check_hot_function(
+    path: str, fn: ast.FunctionDef | ast.AsyncFunctionDef, static: set[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    traced = _param_names(fn) - static
+
+    for node in ast.walk(fn):
+        # -- purity-host-sync -------------------------------------------
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            base = node.func.value
+            if attr in _SYNC_METHODS:
+                findings.append(
+                    Finding(
+                        path, node.lineno, "purity-host-sync",
+                        f".{attr}() inside @hot_path code forces a "
+                        "device->host sync; return the value and sync at "
+                        "the dispatch boundary (the tile loop)",
+                    )
+                )
+            elif (
+                isinstance(base, ast.Name)
+                and base.id in _NP_NAMES
+                and attr in _NP_SYNC_FUNCS
+            ):
+                findings.append(
+                    Finding(
+                        path, node.lineno, "purity-host-sync",
+                        f"{base.id}.{attr}() materializes a traced value on "
+                        "the host inside @hot_path code; use jnp or hoist "
+                        "to the caller",
+                    )
+                )
+            elif (
+                isinstance(base, ast.Name)
+                and base.id == "jax"
+                and attr in _JAX_SYNC_FUNCS
+            ):
+                findings.append(
+                    Finding(
+                        path, node.lineno, "purity-host-sync",
+                        f"jax.{attr}() inside @hot_path code is a host sync; "
+                        "the dispatch boundary owns synchronization",
+                    )
+                )
+
+        # -- purity-float ------------------------------------------------
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            findings.append(
+                Finding(
+                    path, node.lineno, "purity-float",
+                    f"float literal {node.value!r} in @hot_path code — "
+                    "consensus-critical math must stay exact integer limb "
+                    "arithmetic",
+                )
+            )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "float"
+        ):
+            findings.append(
+                Finding(
+                    path, node.lineno, "purity-float",
+                    "float() cast in @hot_path code — consensus-critical "
+                    "math must stay exact integer limb arithmetic",
+                )
+            )
+
+        # -- purity-untraced-branch -------------------------------------
+        test = None
+        kind = None
+        if isinstance(node, (ast.If, ast.While)):
+            test, kind = node.test, "if" if isinstance(node, ast.If) else "while"
+        elif isinstance(node, ast.IfExp):
+            test, kind = node.test, "ternary"
+        if test is not None:
+            names = {
+                n.id
+                for n in ast.walk(test)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            }
+            hits = sorted(names & traced)
+            if hits:
+                findings.append(
+                    Finding(
+                        path, test.lineno, "purity-untraced-branch",
+                        f"Python {kind} on traced argument(s) "
+                        f"{', '.join(hits)} inside @hot_path code — use "
+                        "jnp.where / lax.cond, or declare the argument "
+                        "static via hot_path(static=(...))",
+                    )
+                )
+    return findings
+
+
+def check_file(path: Path, rel: Path | None = None) -> tuple[list[Finding], int]:
+    """Lint one module.  Returns (findings, hot-function count) — the
+    count feeds coverage reporting so a repo where the marker silently
+    vanished is distinguishable from a clean one."""
+    text = path.read_text()
+    tree = ast.parse(text, filename=str(path))
+    disp = path.as_posix()
+    if rel is not None:
+        try:
+            disp = path.relative_to(rel).as_posix()
+        except ValueError:
+            pass
+    findings: list[Finding] = []
+    hot_fn_count = 0
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            marked, static = _hot_path_meta(node)
+            if marked:
+                hot_fn_count += 1
+                findings.extend(_check_hot_function(disp, node, static))
+    return apply_pragmas(sorted(set(findings)), text.splitlines()), hot_fn_count

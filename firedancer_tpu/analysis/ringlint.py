@@ -1,0 +1,235 @@
+"""tango ring-discipline linter.
+
+Encodes the mcache/fseq/fctl protocol the native layer documents
+(tango/native/fdt_tango.h, mirroring the reference's seq/ctl model in
+fd_tango_base.h:4-110 and the credit model in fd_fctl.h) as AST rules
+over the tile layer (tiles/, disco/):
+
+  ring-fseq-owner      an fseq is a CONSUMER's progress backchannel; only
+                       the consumer that owns it may update() it.  A
+                       producer writing a consumer's fseq forges flow-
+                       control credit and the producer will overrun the
+                       ring.
+  ring-overrun         every poll/drain must observe the overrun result
+                       (poll rc == 1 / drain's overrun count).  Ignoring
+                       it turns a lap into silent frag loss.
+  ring-publish-order   payload bytes must be in the dcache BEFORE the
+                       frag metadata is published; consumers that see seq
+                       may read the chunk immediately (publish is the
+                       release barrier).
+  ring-credit          direct mcache publishes must be gated on credits
+                       (cr_avail / ctx.credits) so reliable consumers are
+                       never lapped.
+
+Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
+`*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
+attribute names are part of the tile API surface.  Violations that are
+deliberate must carry a `# fdtlint: allow[rule]` pragma with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import Finding, apply_pragmas
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ""
+
+
+def _is_attr_call(node: ast.Call, attr_names: set[str]) -> bool:
+    return isinstance(node.func, ast.Attribute) and node.func.attr in attr_names
+
+
+def _receiver(node: ast.Call) -> str:
+    return _src(node.func.value) if isinstance(node.func, ast.Attribute) else ""
+
+
+def _names_loaded(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+class _FunctionChecker:
+    """Rules that need whole-function context (statement order, later
+    uses of a bound name)."""
+
+    def __init__(self, path: str, fn: ast.AST) -> None:
+        self.path = path
+        self.fn = fn
+        self.findings: list[Finding] = []
+        # statement-level inventory, in source order
+        self.body_stmts = [
+            s for s in ast.walk(fn) if isinstance(s, ast.stmt)
+        ]
+
+    # -- ring-overrun ----------------------------------------------------
+
+    def _check_drain_poll(self) -> None:
+        handled: set[int] = set()
+        for stmt in self.body_stmts:
+            if not isinstance(stmt, (ast.Assign, ast.Expr)):
+                continue
+            value = stmt.value
+            for call in [
+                n for n in ast.walk(value) if isinstance(n, ast.Call)
+            ]:
+                if id(call) in handled:
+                    continue
+                is_drain = _is_attr_call(call, {"drain"}) and "mcache" in _receiver(call)
+                is_poll = _is_attr_call(call, {"poll"}) and "mcache" in _receiver(call)
+                if not (is_drain or is_poll):
+                    continue
+                handled.add(id(call))
+                kind = "drain" if is_drain else "poll"
+                slot = 2 if is_drain else 0  # overrun count / poll rc
+                what = (
+                    "overrun count (3rd element)"
+                    if is_drain
+                    else "rc (1st element; 1 == overrun)"
+                )
+                # the call must be the RHS of a tuple unpack that captures
+                # the overrun slot into a real name...
+                target = None
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and stmt.value is call
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Tuple)
+                    and len(stmt.targets[0].elts) == 3
+                ):
+                    target = stmt.targets[0].elts[slot]
+                if target is None:
+                    self.findings.append(
+                        Finding(
+                            self.path, call.lineno, "ring-overrun",
+                            f"mcache.{kind}() result must be unpacked into 3 "
+                            f"names so the {what} is observable",
+                        )
+                    )
+                    continue
+                name = target.id if isinstance(target, ast.Name) else None
+                used_later = False
+                if name is not None and name != "_":
+                    for later in self.body_stmts:
+                        if later.lineno <= stmt.lineno or later is stmt:
+                            continue
+                        if name in _names_loaded(later):
+                            used_later = True
+                            break
+                    # attribute targets (il.seq) or uses inside the same
+                    # statement line are out of pattern; require a later use
+                if not used_later:
+                    self.findings.append(
+                        Finding(
+                            self.path, call.lineno, "ring-overrun",
+                            f"mcache.{kind}() {what} is discarded — a lapped "
+                            "consumer must account the gap (metrics / "
+                            "fseq.diag_add) instead of silently losing frags",
+                        )
+                    )
+
+    # -- ring-publish-order / ring-credit --------------------------------
+
+    def _check_publish(self) -> None:
+        publishes: list[ast.Call] = []
+        writes: list[ast.Call] = []
+        credit_lines: list[int] = []
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Call):
+                recv = _receiver(node)
+                if _is_attr_call(node, {"publish", "publish_batch"}) and "mcache" in recv:
+                    publishes.append(node)
+                if _is_attr_call(node, {"write", "write_batch"}) and (
+                    "dcache" in recv or node.func.attr == "write_batch"
+                ):
+                    writes.append(node)
+                if _is_attr_call(node, {"cr_avail"}):
+                    credit_lines.append(node.lineno)
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                s = _src(node)
+                if s.endswith("credits") or s == "cr_avail":
+                    credit_lines.append(node.lineno)
+        if publishes and writes:
+            first_pub = min(p.lineno for p in publishes)
+            for w in writes:
+                if w.lineno > first_pub:
+                    self.findings.append(
+                        Finding(
+                            self.path, w.lineno, "ring-publish-order",
+                            "dcache payload written AFTER the frag was "
+                            "published at line "
+                            f"{first_pub} — consumers may already be reading "
+                            "the chunk (publish is the release barrier)",
+                        )
+                    )
+        for p in publishes:
+            if not any(line < p.lineno for line in credit_lines):
+                self.findings.append(
+                    Finding(
+                        self.path, p.lineno, "ring-credit",
+                        "direct mcache publish without a preceding credit "
+                        "check (cr_avail / ctx.credits) — reliable consumers "
+                        "can be overrun",
+                    )
+                )
+
+    def run(self) -> list[Finding]:
+        self._check_drain_poll()
+        self._check_publish()
+        return self.findings
+
+
+def check_file(path: Path, rel: Path | None = None) -> list[Finding]:
+    text = path.read_text()
+    tree = ast.parse(text, filename=str(path))
+    disp = path.as_posix()
+    if rel is not None:
+        try:
+            disp = path.relative_to(rel).as_posix()
+        except ValueError:
+            pass
+    findings: list[Finding] = []
+
+    # -- ring-fseq-owner: module-wide, no function context needed --------
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and _is_attr_call(node, {"update", "diag_add"})
+            and "consumer_fseqs" in _receiver(node)
+        ):
+            findings.append(
+                Finding(
+                    disp, node.lineno, "ring-fseq-owner",
+                    f"producer-side write to a consumer's fseq "
+                    f"({_src(node.func)}) — only the consumer that owns an "
+                    "fseq may update it (forged credit overruns the ring)",
+                )
+            )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "fdt_fseq_update"
+        ):
+            findings.append(
+                Finding(
+                    disp, node.lineno, "ring-fseq-owner",
+                    "raw fdt_fseq_update call outside tango.rings — go "
+                    "through FSeq.update on the owning consumer endpoint",
+                )
+            )
+
+    # -- function-scoped rules ------------------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_FunctionChecker(disp, node).run())
+
+    return apply_pragmas(sorted(set(findings)), text.splitlines())

@@ -104,6 +104,9 @@ class OutLink:
             chunks = self.dcache.write_batch(rows, szs)
         if tspub == 0:
             tspub = now_ts()
+        # run_loop gates every callback round on cr_avail() across outs;
+        # OutLink.publish is the one sanctioned wrapper under that gate
+        # (manual-credit tiles re-check per ring).  fdtlint: allow[ring-credit]
         self.seq = self.mcache.publish_batch(
             self.seq, sigs, chunks, szs, ctls, tspub, tsorigs
         )

@@ -35,15 +35,20 @@ falls back to the strict per-sig kernel (verify.py), so honest traffic
 pays ~1 bucket-add per window and adversarial traffic degrades to the
 per-sig rate.  The reference's own batch API
 (fd_ed25519_verify_batch_single_msg, same file :231-310) establishes
-batch-with-fallback as an acceptable verify shape.  One documented
-divergence: with odd z a single invalid signature always fails the batch
-(odd z annihilates no 8-torsion residual), but an adversary submitting
-MULTIPLE signatures whose residuals are pure small-order torsion (they
-pass cofactored but fail cofactorless verification) can craft residuals
-that cancel in the sum — e.g. two order-2 residuals.  Such signatures
-require mixed-order A or R constructed from known discrete logs; the
-strict per-sig path (FDT_VERIFY_RLC=0, or any batch containing one
-ordinary invalid sig) rejects them.
+batch-with-fallback as an acceptable verify shape.
+
+Torsion soundness: with odd z a single invalid signature always fails
+the batch (odd z annihilates no 8-torsion residual), but MULTIPLE
+signatures whose residuals are small-order torsion can craft residuals
+that cancel in the sum — two identical order-2 residuals always do,
+since odd z1 + odd z2 is even.  Such residuals require mixed-order A or
+R, so the RLC accept path additionally requires every included A/R to
+lie in the prime-order subgroup ([L]P == identity —
+verify._torsion_free_pair); any mixed-order point fails the batch and
+routes it to the strict per-sig path.  With all points subgroup-checked,
+residuals live in the prime-order group and random odd 128-bit z gives
+the standard soundness bound.  Regression: tests/test_msm_rlc.py
+crafts the order-2 cancellation pair and asserts batch rejection.
 """
 
 from __future__ import annotations
@@ -55,6 +60,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from firedancer_tpu.utils.hotpath import hot_path
 
 from . import field as F
 from . import point as PT
@@ -130,6 +137,7 @@ def _decompress_niels_kernel(c_ref, ay_ref, ry_ref, an_ref, rn_ref, ok_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+@hot_path(static=("interpret",))
 def decompress_niels(a_y, a_sign, r_y, r_sign, *, interpret=False):
     """(y limbs, sign) x2 -> (an3 (3NL, B), rn3 (3NL, B), ok (B,)).
 
@@ -253,6 +261,7 @@ def _tree_reduce_lanes(coords):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+@hot_path(static=("interpret",))
 def msm_check(cdig, zdig, an3, rn3, u_digits, *, interpret=False):
     """Does  sum [c_i]A_i + sum [z_i]R_i  ==  [u]B ?  -> () bool.
 

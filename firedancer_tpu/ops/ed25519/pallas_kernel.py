@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from firedancer_tpu.utils.hotpath import hot_path
+
 from . import field as F
 from . import point as PT
 
@@ -113,6 +115,7 @@ def _verify_core_kernel(c_ref, k_ref, s_ref, ay_ref, ry_ref, ok_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+@hot_path(static=("interpret",))
 def verify_core(k_digits, s_digits, a_y, a_sign, r_y, r_sign, *, interpret=False):
     """Fused decompress + ([k](-A) + [s]B == R).
 

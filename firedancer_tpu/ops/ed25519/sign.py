@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from firedancer_tpu.utils.hotpath import hot_path
+
 from . import field as F
 from . import golden
 from . import point as PT
@@ -28,6 +30,7 @@ from . import scalar as SC
 
 
 @functools.partial(jax.jit)
+@hot_path
 def _base_mul_compress(r_bytes):
     """(B, 32) uint8 little-endian scalars (< L) -> (B, 32) compressed
     [r]B encodings.  Strauss loop over the shared affine niels B-table

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from firedancer_tpu.utils.hotpath import hot_path
+
 from .. import sha512 as _sha
 from . import field as F
 from . import golden
@@ -61,6 +63,7 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+@hot_path(static=("use_pallas",))
 def _verify_from_digest(digest, sigs, pubs, use_pallas):
     """Steps 1-3 and 5 shared by the message and digest entry points;
     `digest` is SHA512(R || A || M) per lane (step 4, from either the
@@ -98,6 +101,7 @@ def _verify_from_digest(digest, sigs, pubs, use_pallas):
 
 
 @functools.partial(jax.jit, static_argnames=("msg_len", "use_pallas"))
+@hot_path(static=("msg_len", "use_pallas"))
 def _verify_impl(msgs, lens, sigs, pubs, msg_len, use_pallas=False):
     del msg_len  # captured statically via msgs.shape
     # 4. k = SHA512(R || A || M) mod L, on device
@@ -129,15 +133,77 @@ def _z_limbs(zbytes):
     return F.from_bytes(padded)[:10]
 
 
+def _signed_digits_of_int(n: int) -> np.ndarray:
+    """Host-side signed radix-16 recode (the plain-int analog of
+    scalar.to_signed_digits) for compile-time scalar constants."""
+    digs = []
+    for _ in range(64):
+        d = n & 15
+        n >>= 4
+        if d >= 8:
+            d -= 16
+            n += 1
+        digs.append(d)
+    assert n == 0, "scalar exceeds 64 signed radix-16 digits"
+    return np.array(digs, np.int32).reshape(64, 1)
+
+
+_L_DIGITS = _signed_digits_of_int(golden.L)
+#: 1/2 mod p: recovers x = (n0-n1)/2, y = (n0+n1)/2 from an affine niels
+#: triple (y+x, y-x, 2dxy) without re-running the decompress sqrt chain
+_INV2_LIMBS = F.int_to_limbs((golden.P + 1) // 2).reshape(F.NLIMB, 1)
+
+
+def _torsion_free(pts):
+    """(N,) bool: each point lies in the prime-order subgroup
+    ([L]P == identity), batched as one [L](-P) + [0]B dsm over
+    already-decompressed extended coords.
+
+    Why the RLC path needs this (ADVICE.md round 5, msm_kernel.py): the
+    batch equation weights each R_i directly by its odd z_i, and odd
+    weights can NEVER separate order-2 torsion components — two
+    signatures built on R' = R + T2 have residual T2 each, and
+    z1*T2 + z2*T2 = (odd+odd)*T2 = identity for EVERY z pair, so the
+    bare equation deterministically accepts both (A-side torsion is
+    weighted by (z*k mod L) mod 2 instead: randomized by the mod-L
+    reduction, still a coin-flip accept).  Mixed-order points are the
+    only source of torsion residuals; restricting the accept path to
+    subgroup points removes the component entirely, after which
+    random-z soundness is the standard prime-order argument.
+    """
+    n = pts[0].shape[-1]
+    ldig = jnp.broadcast_to(jnp.asarray(_L_DIGITS), (64, n))
+    acc = PT.double_scalar_mul(
+        ldig, PT.build_neg_table9(pts), jnp.zeros((64, n), jnp.int32)
+    )
+    return PT.eq_points(acc, PT.identity(n))
+
+
+def _torsion_free_pair(a_pt, r_pt):
+    """(B,) bool: BOTH A_i and R_i subgroup-checked in one dsm over the
+    2B stacked points.  See _torsion_free."""
+    both = tuple(
+        jnp.concatenate([a, r], axis=-1) for a, r in zip(a_pt, r_pt)
+    )
+    tf = _torsion_free(both)
+    b = a_pt[0].shape[-1]
+    return tf[:b] & tf[b:]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
+@hot_path(static=("interpret",))
 def _verify_digest_rlc_impl(digests, sigs, pubs, zbytes, interpret=False):
     """Batch (RLC) verification: returns (lane_ok (B,), batch_ok ()).
 
     lane_ok is the per-lane prologue verdict (canonical s, small-order
     blocklist, decompress); batch_ok is the one RLC group equation over
-    the lanes that passed the prologue.  Accept lane i iff
-    batch_ok & lane_ok[i]; on !batch_ok the caller falls back to the
-    strict per-sig kernel.  See msm_kernel.py for semantics.
+    the lanes that passed the prologue AND a per-lane prime-order
+    subgroup check on every included A/R ([L]P == identity,
+    _torsion_free_pair).  Accept lane i iff batch_ok & lane_ok[i]; on
+    !batch_ok the caller falls back to the strict per-sig kernel, so a
+    mixed-order point anywhere in the batch routes the WHOLE batch to
+    the strict path and the RLC accept can never diverge from it.  See
+    msm_kernel.py for semantics.
     """
     from . import msm_kernel as MSM
 
@@ -177,6 +243,26 @@ def _verify_digest_rlc_impl(digests, sigs, pubs, zbytes, interpret=False):
         cdig, zdig, mask_niels(an3_raw), mask_niels(rn3_raw), udig,
         interpret=interpret,
     )
+    # cofactor-gap closure: the batch accept is only sound over the
+    # prime-order subgroup; a mixed-order A or R on any included lane
+    # fails the batch so the caller's strict per-sig fallback decides.
+    # (Excluded lanes — !ok — are already masked to the identity and
+    # cannot poison the equation, so their torsion is irrelevant.)
+    # The gate's extended coords are RECONSTRUCTED from the niels forms
+    # the fused Pallas pass already computed — affine niels is
+    # (y+x, y-x, 2dxy), so x = (n0-n1)/2 and y = (n0+n1)/2, two constant
+    # muls per point — rather than re-running the decompress sqrt chain
+    # (~250 sequential field ops, the dominant prologue cost) over the
+    # 2B points.  Garbage on !dc_ok lanes is fine: masked via ~ok below.
+    n3 = jnp.concatenate([an3_raw, rn3_raw], axis=-1)  # (3*NL, 2B)
+    ypx, ymx = n3[: F.NLIMB], n3[F.NLIMB : 2 * F.NLIMB]
+    inv2 = jnp.asarray(_INV2_LIMBS)
+    x = F.carry1(F.mul_rr(inv2, F.carry1(ypx - ymx)))
+    y = F.carry1(F.mul_rr(inv2, F.carry1(ypx + ymx)))
+    z = jnp.broadcast_to(jnp.asarray(F.c("ONE")), x.shape).astype(x.dtype)
+    tf2 = _torsion_free((x, y, z, F.mul_rr(x, y)))
+    b = ok.shape[0]
+    batch_ok = batch_ok & jnp.all((tf2[:b] & tf2[b:]) | ~ok)
     return ok, batch_ok
 
 
@@ -221,6 +307,7 @@ def verify_batch_digest_rlc(digests, sigs, pubs, zbytes=None):
 
 
 @functools.partial(jax.jit, static_argnames=("use_pallas",))
+@hot_path(static=("use_pallas",))
 def _verify_digest_impl(digests, sigs, pubs, use_pallas=False):
     # step 4's SHA512 was done on the host (fdt_sha512_rpm inside
     # fdt_verify_expand); everything else is shared

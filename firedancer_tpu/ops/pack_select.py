@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from firedancer_tpu.utils.hotpath import hot_path
+
 #: largest cu_limit the int32 device scan supports; PAD_COST sentinel rows
 #: (used by ballet/pack.py to pad candidates to a fixed compiled shape)
 #: exceed it by construction, so they are never taken and cu_used + cost
@@ -40,6 +42,7 @@ PAD_COST = 1 << 30
 
 
 @functools.partial(jax.jit, static_argnames=("txn_limit",))
+@hot_path(static=("txn_limit",))
 def _select_impl(cand_rw, cand_w, in_use_rw, in_use_w, costs, cu_limit, txn_limit):
     K = cand_rw.shape[0]
 

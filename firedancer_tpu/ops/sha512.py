@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from firedancer_tpu.utils.hotpath import hot_path
+
 
 from firedancer_tpu.utils.shaconst import H64 as _H64
 from firedancer_tpu.utils.shaconst import K64 as _K64
@@ -178,6 +180,7 @@ def _pad(msgs, lens, max_blocks):
 
 
 @functools.partial(jax.jit, static_argnames=("max_len",))
+@hot_path(static=("max_len",))
 def _sha512_impl(msgs, lens, max_len):
     b = msgs.shape[0]
     max_blocks = (max_len + 17 + 127) // 128

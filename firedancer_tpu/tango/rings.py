@@ -28,6 +28,29 @@ from firedancer_tpu.utils import cbuild
 _HERE = Path(__file__).parent
 
 
+def _bind(lib, sigs: dict, origin: str = "fdt_tango") -> None:
+    """Apply a {symbol: (restype, argtypes)} table to a loaded library.
+
+    A symbol missing from the library raises immediately, NAMING the
+    symbol and where the drift is: the default AttributeError from a
+    ctypes attribute lookup surfaces mid-table with no indication of
+    which side (C source vs sigs table) is stale.  scripts/fdtlint.py
+    cross-checks the same table against the C prototypes statically.
+    """
+    for name, (res, args) in sigs.items():
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            raise RuntimeError(
+                f"native symbol {name!r} is bound in the {origin} ctypes "
+                f"table but missing from the built library — the sigs "
+                f"table and tango/native/*.c have drifted (run "
+                f"scripts/fdtlint.py for the full ABI diff)"
+            ) from None
+        fn.restype = res
+        fn.argtypes = args
+
+
 def _load() -> ct.CDLL:
     so = cbuild.build(
         "fdt_tango",
@@ -60,6 +83,7 @@ def _load() -> ct.CDLL:
         "fdt_dcache_chunk_cnt": (u64, [u64]),
         "fdt_dcache_compact_next": (u64, [u64, u64, u64, u64]),
         "fdt_dcache_gather": (None, [vp, vp, vp, u64, u64, vp]),
+        "fdt_fseq_align": (u64, []),
         "fdt_fseq_footprint": (u64, []),
         "fdt_fseq_new": (None, [vp, u64]),
         "fdt_fseq_query": (u64, [vp]),
@@ -67,12 +91,14 @@ def _load() -> ct.CDLL:
         "fdt_fseq_diag_query": (u64, [vp, u64]),
         "fdt_fseq_diag_add": (None, [vp, u64, u64]),
         "fdt_fctl_cr_avail": (u64, [u64, u64, u64]),
+        "fdt_cnc_align": (u64, []),
         "fdt_cnc_footprint": (u64, []),
         "fdt_cnc_new": (None, [vp]),
         "fdt_cnc_signal_query": (u64, [vp]),
         "fdt_cnc_signal": (None, [vp, u64]),
         "fdt_cnc_heartbeat": (None, [vp, u64]),
         "fdt_cnc_heartbeat_query": (u64, [vp]),
+        "fdt_tcache_align": (u64, []),
         "fdt_tcache_footprint": (u64, [u64, u64]),
         "fdt_tcache_new": (i32, [vp, u64, u64]),
         "fdt_tcache_depth": (u64, [vp]),
@@ -134,10 +160,7 @@ def _load() -> ct.CDLL:
         "fdt_sha512_batch": (None, [vp, vp, u64, u64, vp]),
         "fdt_xxh64": (u64, [vp, u64, u64]),
     }
-    for name, (res, args) in sigs.items():
-        fn = getattr(lib, name)
-        fn.restype = res
-        fn.argtypes = args
+    _bind(lib, sigs)
     # inject the derived SHA-512 constant tables (no constant block in C)
     from firedancer_tpu.utils.shaconst import H64, K64
 

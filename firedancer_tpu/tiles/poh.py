@@ -153,7 +153,10 @@ class PohTile(Tile):
                 ) if ctx.outs else 4096
                 if budget <= 0:
                     break
-                frags, il.seq, _ = il.mcache.drain(il.seq, budget)
+                frags, il.seq, ovr = il.mcache.drain(il.seq, budget)
+                if ovr:
+                    ctx.metrics.inc("overrun_frags", ovr)
+                    il.fseq.diag_add(0, ovr)
                 if len(frags):
                     got += len(frags)
                     self.on_frags(ctx, i, frags)

@@ -217,7 +217,10 @@ class ShredTile(Tile):
         while (self._outq or self._pending or self._signq) and _t.monotonic() < deadline:
             if len(ctx.ins) > 1 and self._pending:
                 il = ctx.ins[1]
-                frags, il.seq, _ = il.mcache.drain(il.seq, 256)
+                frags, il.seq, ovr = il.mcache.drain(il.seq, 256)
+                if ovr:
+                    ctx.metrics.inc("overrun_frags", ovr)
+                    il.fseq.diag_add(0, ovr)
                 if len(frags):
                     self._on_sign_responses(ctx, frags)
             ctx.credits = ctx.outs[0].cr_avail()

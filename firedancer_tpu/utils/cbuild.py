@@ -3,6 +3,15 @@
 Used by the tango layer (and any future native runtime component) to build
 its .so on first import.  The cache key is a hash of the source text +
 compile flags, so editing a .c file transparently rebuilds.
+
+Sanitizers: `FDT_SAN=1` builds with ASan + UBSan (-O1, frame pointers,
+no-recover) instead of -O3.  The flag participates in the cache key via
+the flag list, so sanitized and production artifacts coexist in the
+cache.  Loading an ASan'd shared library into a stock CPython requires
+the sanitizer runtimes to be preloaded — `sanitizer_preload()` resolves
+the LD_PRELOAD string; tests/test_sanitize.py (pytest -m sanitize, slow
+tier) drives the whole loop: sanitized rebuild in a scratch cache, then
+the tango/pack native test surface re-run under it.
 """
 
 from __future__ import annotations
@@ -15,6 +24,19 @@ from pathlib import Path
 
 _CC = os.environ.get("CC", "cc")
 _BASE_FLAGS = ["-O3", "-std=c11", "-fPIC", "-shared", "-Wall", "-Wextra", "-Werror"]
+#: appended when FDT_SAN=1; later flags win, so -O1 overrides -O3 and the
+#: build keeps symbolizable frames for sanitizer reports
+_SAN_FLAGS = [
+    "-O1",
+    "-g",
+    "-fno-omit-frame-pointer",
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=undefined",
+]
+
+
+def sanitize_enabled() -> bool:
+    return os.environ.get("FDT_SAN", "") == "1"
 
 
 def _cache_dir() -> Path:
@@ -23,9 +45,37 @@ def _cache_dir() -> Path:
     return d
 
 
+def sanitizer_preload() -> str | None:
+    """LD_PRELOAD string (libasan:libubsan) for running a python that
+    loads FDT_SAN=1 artifacts, or None when the toolchain has no
+    locatable sanitizer runtimes (the sanitize test skips then)."""
+    libs = []
+    for name in ("libasan.so", "libubsan.so"):
+        try:
+            out = subprocess.run(
+                [_CC, f"-print-file-name={name}"],
+                check=True,
+                capture_output=True,
+                text=True,
+            ).stdout.strip()
+        except (OSError, subprocess.CalledProcessError):  # pragma: no cover
+            return None
+        # an unresolved runtime echoes the bare name back
+        if "/" in out and Path(out).exists():
+            libs.append(out)
+    # partial preload is worse than none: an ASan-linked .so without the
+    # ASan runtime first in the library list aborts at load, so the
+    # sanitize test must skip (None) unless BOTH runtimes resolved
+    return ":".join(libs) if len(libs) == 2 else None
+
+
 def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) -> Path:
     """Compile `sources` into a shared library, returning its path."""
-    flags = _BASE_FLAGS + (extra_flags or [])
+    flags = list(_BASE_FLAGS)
+    if sanitize_enabled():
+        flags += _SAN_FLAGS
+        name = f"{name}-san"
+    flags += extra_flags or []
     h = hashlib.sha256()
     h.update(" ".join([_CC] + flags).encode())
     for src in sources:

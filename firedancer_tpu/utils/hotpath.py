@@ -1,0 +1,45 @@
+"""`@hot_path` — dispatch-boundary marker for consensus/throughput-critical
+JAX code.
+
+The marker is a no-op at runtime (it only records metadata on the
+function); its value is the contract it declares, which
+firedancer_tpu.analysis.purity enforces by AST:
+
+  * no host synchronization inside the marked function (`.item()`,
+    `np.asarray` / `np.array` on traced values, `block_until_ready`,
+    `jax.device_get`): the tile loop owns the single D2H sync point, and
+    a hidden sync inside kernel code serializes the async dispatch
+    pipeline (tiles/verify.py keeps several batches in flight).
+  * no Python float arithmetic: floats in consensus-critical code are a
+    nondeterminism hazard; all field/scalar math is integer limbs.
+  * no branching on traced (non-static) arguments: an untraced `if x:`
+    on a traced value either crashes under jit or, worse, bakes one
+    branch into the compiled program.
+
+Usage:
+
+    @functools.partial(jax.jit, static_argnames=("use_pallas",))
+    @hot_path(static=("use_pallas",))
+    def _impl(x, use_pallas=False): ...
+
+`static` names arguments that are compile-time constants (typically the
+jit's static_argnames): branching on those is fine and exempt from the
+untraced-branch rule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def hot_path(fn: F | None = None, *, static: tuple[str, ...] = ()) -> F:
+    """Mark `fn` as hot-path code (see module docstring).  Usable bare
+    (`@hot_path`) or configured (`@hot_path(static=("flag",))`)."""
+
+    def mark(f: F) -> F:
+        f.__fdt_hot_path__ = {"static": tuple(static)}
+        return f
+
+    return mark(fn) if fn is not None else mark

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""fdtlint — static analysis for the firedancer_tpu native/ctypes/JAX
+trust boundaries.
+
+Usage:
+    scripts/fdtlint.py                 # full repo pass (abi + ring + purity)
+    scripts/fdtlint.py --json          # machine-readable report
+    scripts/fdtlint.py PATH [PATH...]  # targeted: .py files or fixture dirs
+    scripts/fdtlint.py --root DIR      # lint a repo checkout other than ./
+
+Exit status: 0 clean, 1 findings, 2 usage/internal error.
+
+Stdlib-only on purpose: runs without jax/numpy or a native toolchain, so
+it is safe as a pre-commit / CI gate anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from firedancer_tpu.analysis import engine  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdtlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help=".py files or directories; empty = full repo pass")
+    ap.add_argument("--json", action="store_true", help="emit a JSON report")
+    ap.add_argument("--root", default=None, help="repo root for the full pass")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.paths:
+            report = engine.run_paths(args.paths)
+        else:
+            report = engine.run_repo(args.root)
+    except (FileNotFoundError, ValueError, SyntaxError) as e:
+        print(f"fdtlint: error: {e}", file=sys.stderr)
+        return 2
+
+    print(report.to_json() if args.json else report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""known-bad ctypes table + call sites for abi_bad/mini.h (see header
+comment for the rule inventory)."""
+
+import ctypes as ct
+
+u64, u32, vp = ct.c_uint64, ct.c_uint32, ct.c_void_p
+
+sigs = {
+    "fdt_mini_sum": (u64, [vp, u64]),  # abi-arity: C has 3 args
+    "fdt_mini_fill": (None, [vp, u32]),  # abi-argtype: n is uint64_t in C
+    "fdt_mini_scan": (u32, [vp, ct.c_int64]),  # abi-restype: C returns int64_t
+    "fdt_mini_ok": (u64, [vp, u64]),  # clean entry
+    "fdt_mini_phantom": (u64, [vp]),  # abi-unknown-symbol: no C decl
+}
+
+
+def drive(lib, buf, n):
+    lib.fdt_mini_ok(buf, n, 7)  # abi-call-arity: table declares 2 args
+    lib.fdt_mini_mystery(buf)  # abi-call-unknown: bound nowhere

@@ -1,0 +1,26 @@
+/* known-bad ABI fixture: prototypes the bindings.py table drifts from.
+   Exercises abi-arity, abi-argtype, abi-restype, abi-unknown-symbol,
+   abi-unbound-export, abi-call-arity, abi-call-unknown. */
+
+#ifndef MINI_H
+#define MINI_H
+
+#include <stdint.h>
+
+/* bound with the wrong arity (table declares 2 args) */
+uint64_t fdt_mini_sum( uint64_t const * xs, uint64_t n, uint64_t seed );
+
+/* bound with a narrowed arg width (table declares c_uint32 for `n`) */
+void fdt_mini_fill( uint8_t * dst, uint64_t n );
+
+/* bound with the wrong restype (table declares c_uint32; i64 returns
+   truncate on big counts) */
+int64_t fdt_mini_scan( uint8_t const * rows, int64_t n );
+
+/* correctly bound — must NOT be flagged */
+uint64_t fdt_mini_ok( void const * mem, uint64_t depth );
+
+/* never bound anywhere: abi-unbound-export */
+void fdt_mini_forgotten( void * mem );
+
+#endif /* MINI_H */

@@ -1,0 +1,19 @@
+"""known-good ctypes table + call sites for abi_good/mini.h."""
+
+import ctypes as ct
+
+u64, i64, i32, vp = ct.c_uint64, ct.c_int64, ct.c_int, ct.c_void_p
+
+sigs = {
+    "fdt_mini_sum": (u64, [vp, u64, u64]),
+    "fdt_mini_fill": (None, [vp, u64]),
+    "fdt_mini_scan": (i64, [vp, i64]),
+    "fdt_mini_rc": (i32, []),
+}
+
+
+def drive(lib, buf, n):
+    total = lib.fdt_mini_sum(buf, n, 7)
+    lib.fdt_mini_fill(buf, n)
+    got = lib.fdt_mini_scan(buf, n)
+    return total, got, lib.fdt_mini_rc()

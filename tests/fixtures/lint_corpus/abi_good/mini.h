@@ -1,0 +1,14 @@
+/* known-good ABI fixture: table and call sites in bindings.py agree
+   with these prototypes exactly.  Must cross-check clean. */
+
+#ifndef MINI_GOOD_H
+#define MINI_GOOD_H
+
+#include <stdint.h>
+
+uint64_t fdt_mini_sum( uint64_t const * xs, uint64_t n, uint64_t seed );
+void     fdt_mini_fill( uint8_t * dst, uint64_t n );
+int64_t  fdt_mini_scan( uint8_t const * rows, int64_t n );
+int      fdt_mini_rc( void );
+
+#endif /* MINI_GOOD_H */

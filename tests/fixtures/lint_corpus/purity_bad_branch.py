@@ -1,0 +1,14 @@
+"""known-bad: Python `if` on a traced argument inside @hot_path code —
+under jit this is a ConcretizationError at best, silent specialization
+at worst.  (rule: purity-untraced-branch)"""
+
+import jax.numpy as jnp
+
+from firedancer_tpu.utils.hotpath import hot_path
+
+
+@hot_path(static=("width",))
+def select(mask, lanes, width):
+    if mask.any():  # traced! should be jnp.where / lax.cond
+        return lanes[:width]
+    return jnp.zeros_like(lanes[:width])

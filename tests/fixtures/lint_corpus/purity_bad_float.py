@@ -1,0 +1,11 @@
+"""known-bad: Python float arithmetic in consensus-critical @hot_path
+code — the limb math is exact integers; floats are a nondeterminism
+hazard.  (rule: purity-float)"""
+
+from firedancer_tpu.utils.hotpath import hot_path
+
+
+@hot_path
+def fee_share(rewards, total):
+    scale = 0.5
+    return float(rewards) * scale / total

@@ -1,0 +1,15 @@
+"""known-bad: host syncs inside @hot_path code — .item() and np.asarray
+each force a device->host round trip inside the dispatch pipeline.
+(rule: purity-host-sync)"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.utils.hotpath import hot_path
+
+
+@hot_path
+def accumulate(ok, counts):
+    total = ok.sum().item()
+    host = np.asarray(counts)
+    return jnp.asarray(host[:total])

@@ -1,0 +1,15 @@
+"""known-good: @hot_path code that keeps everything on device — jnp
+ops, branches only on static arguments, exact integer constants.  Must
+scan clean."""
+
+import jax.numpy as jnp
+
+from firedancer_tpu.utils.hotpath import hot_path
+
+
+@hot_path(static=("use_wide", "width"))
+def fold(tags, acc, use_wide, width):
+    if use_wide:  # static argument: branch resolved at trace time
+        tags = tags.astype(jnp.uint64)
+    lanes = jnp.where(tags != 0, tags, acc[:width])
+    return lanes * 3 + 1

@@ -1,0 +1,10 @@
+"""known-bad: a PRODUCER writing its consumers' fseqs forges credit —
+cr_avail() then reports progress the consumer never made and the
+producer laps the ring.  (rule: ring-fseq-owner)"""
+
+
+def after_credit(ctx):
+    out = ctx.outs[0]
+    # "unsticking" a slow consumer by advancing its backchannel:
+    for i in range(len(out.consumer_fseqs)):
+        out.consumer_fseqs[i].update(out.seq)

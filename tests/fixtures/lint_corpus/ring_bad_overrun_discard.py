@@ -1,0 +1,8 @@
+"""known-bad: drain's overrun count thrown away — a lapped consumer
+silently loses frags with no metrics/diag trail.  (rule: ring-overrun)"""
+
+
+def poll_loop(il, tile, ctx):
+    frags, il.seq, _ = il.mcache.drain(il.seq, 4096)
+    if len(frags):
+        tile.on_frags(ctx, 0, frags)

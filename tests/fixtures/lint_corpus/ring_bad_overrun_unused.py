@@ -1,0 +1,8 @@
+"""known-bad: the overrun count is captured into a name but never read —
+the sentinel is still unhandled.  (rule: ring-overrun)"""
+
+
+def poll_loop(il, tile, ctx):
+    frags, il.seq, ovr = il.mcache.drain(il.seq, 4096)
+    if len(frags):
+        tile.on_frags(ctx, 0, frags)

@@ -1,0 +1,8 @@
+"""known-bad: direct mcache publish with no credit check anywhere in the
+function — reliable consumers can be overrun the moment the ring wraps.
+(rule: ring-credit)"""
+
+
+def emit(self, sig, chunk, sz):
+    self.mcache.publish(self.seq, sig, chunk, sz)
+    self.seq += 1

@@ -1,0 +1,12 @@
+"""known-bad: frag metadata published BEFORE the payload lands in the
+dcache — publish is the release barrier, so a consumer that sees the new
+seq may gather stale chunk bytes.  (rule: ring-publish-order)"""
+
+
+def flush(self, sigs, rows, szs):
+    cr = self.cr_avail()
+    n = min(cr, len(sigs))
+    self.seq = self.mcache.publish_batch(
+        self.seq, sigs[:n], self.chunks[:n], szs[:n], None, 0, None
+    )
+    self.chunks = self.dcache.write_batch(rows[:n], szs[:n])

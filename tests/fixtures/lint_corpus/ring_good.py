@@ -1,0 +1,36 @@
+"""known-good: the sanctioned ring idiom — overrun accounted, payload
+written before publish, publish gated on credits, only the owning
+consumer updates its fseq.  Must scan clean."""
+
+
+def consumer_loop(il, tile, ctx):
+    frags, il.seq, ovr = il.mcache.drain(il.seq, 4096)
+    if ovr:
+        ctx.metrics.inc("overrun_frags", ovr)
+        il.fseq.diag_add(0, ovr)
+    if len(frags):
+        tile.on_frags(ctx, 0, frags)
+    il.fseq.update(il.seq)
+
+
+def single_frag_poll(il):
+    rc, frag, seq_now = il.mcache.poll(il.seq)
+    if rc == 1:  # overrun: resynchronize at the producer's head
+        il.seq = seq_now
+        return None
+    if rc == 0:
+        il.seq += 1
+        return frag
+    return None
+
+
+def producer_flush(self, sigs, rows, szs):
+    cr = self.cr_avail()
+    n = min(cr, len(sigs))
+    if n == 0:
+        return 0
+    chunks = self.dcache.write_batch(rows[:n], szs[:n])
+    self.seq = self.mcache.publish_batch(
+        self.seq, sigs[:n], chunks, szs[:n], None, 0, None
+    )
+    return n

@@ -89,12 +89,27 @@ def _make_batch(rng, n, n_keys=4):
 
 @pytest.mark.slow
 def test_rlc_honest_batch_accepts():
+    import jax.numpy as jnp
+
     from firedancer_tpu.ops.ed25519 import verify as V
 
     rng = np.random.default_rng(10)
     digs, sigs, pubs = _make_batch(rng, 12)
     ok = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
     assert ok.all()
+    # the batch equation itself must have ACCEPTED (not fallen back to
+    # the strict path): pins the subgroup gate's false-positive-free
+    # behavior on honest points — a gate that wrongly flagged subgroup
+    # points would silently demote every batch to the strict path and
+    # the all-accept assertion above could never catch it
+    zb = np.ones((12, 16), np.uint8)
+    _, batch_ok = V._verify_digest_rlc_impl(
+        jnp.asarray(digs), jnp.asarray(sigs), jnp.asarray(pubs),
+        jnp.asarray(zb), interpret=True,
+    )
+    assert bool(np.asarray(batch_ok)), (
+        "honest batch must pass the RLC equation incl. the subgroup gate"
+    )
 
 
 @pytest.mark.slow
@@ -140,3 +155,111 @@ def test_rlc_matches_per_sig_on_mixed_random_batch():
     want = np.asarray(V.verify_batch_digest(digs, sigs, pubs))
     got = np.asarray(V.verify_batch_digest_rlc(digs, sigs, pubs))
     assert (want == got).all()
+
+
+# ---------------------------------------------------------------------------
+# cofactor-gap regression: order-2 torsion residual cancellation
+# (ADVICE.md round 5 / msm_kernel.py "Torsion soundness")
+# ---------------------------------------------------------------------------
+
+#: the order-2 torsion point (0, -1): doubling it gives the identity
+_T2 = (0, golden.P - 1)
+
+
+def _torsion2_pair():
+    """Two signatures with MIXED-ORDER R' = R + T2 whose cofactorless
+    residuals are both exactly T2: each fails strict verification, but
+    their z-weighted sum cancels DETERMINISTICALLY for every odd z pair
+    (R enters the batch equation weighted by z itself, so the torsion
+    coefficient is z mod 2 = 1 on both lanes and T2 + T2 = identity),
+    defeating the RLC batch equation alone.
+
+    The R side is the deterministic variant: A-side torsion is weighted
+    by (z*k mod L) mod 2, which the mod-L reduction randomizes per
+    verifier, so R-torsion is the strongest form of the attack.
+
+    Built from a known secret: R = rB, k hashed over the R' encoding,
+    s = r + k*a, so  sB - R' - kA = R - R' = -T2 = T2."""
+    assert golden.point_add(_T2, _T2) == golden.IDENT
+    sk = b"\x07" * 32
+    a, prefix = golden.secret_expand(sk)
+    a_enc = golden.public_from_secret(sk)
+    digs, sigs, msgs = [], [], []
+    for ctr in range(2):
+        m = b"torsion-cancel-%d" % ctr
+        r = golden._sha512_int(prefix, m) % L
+        r_mixed = golden.point_add(golden.scalar_mul(r, golden.B), _T2)
+        rs = golden.point_compress(r_mixed)
+        k = golden._sha512_int(rs, a_enc, m) % L
+        s = (r + k * a) % L
+        sigs.append(rs + s.to_bytes(32, "little"))
+        digs.append(hashlib.sha512(rs + a_enc + m).digest())
+        msgs.append(m)
+    to8 = lambda bs: np.stack([np.frombuffer(b, np.uint8) for b in bs])  # noqa: E731
+    return (
+        to8(digs), to8(sigs),
+        np.tile(np.frombuffer(a_enc, np.uint8), (2, 1)), msgs,
+    )
+
+
+@pytest.mark.slow
+def test_torsion_free_pair_detects_mixed_order():
+    # plain XLA (no Pallas interpret), but the dsm compile alone is ~1 min
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.ed25519 import point as PT
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    _, sigs_mixed, _, _ = _torsion2_pair()
+    honest = np.tile(
+        np.frombuffer(golden.public_from_secret(b"\x07" * 32), np.uint8),
+        (2, 1),
+    )
+    # lane 0: honest subgroup point; lane 1: mixed-order R' = R + T2
+    a_pt, a_ok = PT.decompress(
+        jnp.asarray(np.concatenate([honest[:1], sigs_mixed[1:2, :32]]))
+    )
+    r_pt, r_ok = PT.decompress(jnp.asarray(honest))
+    assert np.asarray(a_ok).all() and np.asarray(r_ok).all()
+    tf = np.asarray(V._torsion_free_pair(a_pt, r_pt))
+    assert tf[0], "honest subgroup point flagged as mixed-order"
+    assert not tf[1], "mixed-order P + T2 must fail [L]P == identity"
+
+
+@pytest.mark.slow
+def test_rlc_rejects_order2_torsion_cancellation():
+    """Regression for the RLC cofactor gap: two crafted signatures whose
+    residuals are the same order-2 torsion point cancel in the batch
+    equation for EVERY odd z, so the MSM check alone accepts lanes the
+    strict per-sig path rejects.  The subgroup gate must fail the batch
+    and route it to the strict fallback (verify_batch_digest_rlc's
+    contract on !batch_ok), which rejects both lanes.
+
+    Strict-path rejection is asserted against the pure-Python golden
+    oracle (fd_ed25519_verify parity) rather than recompiling the device
+    per-sig kernel here — tests/test_golden_ed25519.py pins kernel ==
+    oracle, and one interpret-mode RLC execution already dominates this
+    test's budget."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.ed25519 import verify as V
+
+    digs, sigs, pubs, msgs = _torsion2_pair()
+    # each signature individually fails strict (cofactorless) verification
+    for i in range(2):
+        assert (
+            golden.verify(msgs[i], bytes(sigs[i]), bytes(pubs[i]))
+            != golden.ERR_OK
+        )
+    # the batch equation itself must FAIL (pre-fix it passed: the two T2
+    # residuals cancel under any odd z pair, accepting both lanes)
+    zbytes = np.ones((2, 16), np.uint8)  # odd z, deterministic
+    lane_ok, batch_ok = V._verify_digest_rlc_impl(
+        jnp.asarray(digs), jnp.asarray(sigs), jnp.asarray(pubs),
+        jnp.asarray(zbytes), interpret=True,
+    )
+    assert np.asarray(lane_ok).all(), (
+        "prologue must NOT reject these lanes (mixed-order R' is not on "
+        "the small-order blocklist) — the batch gate is what catches them"
+    )
+    assert not bool(np.asarray(batch_ok))
