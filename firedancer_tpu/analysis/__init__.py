@@ -14,8 +14,15 @@ Three checkers (see README.md in this directory for the rules table):
             float arithmetic, or branch on traced arguments.
 
 Run as a tier-1 test (tests/test_fdtlint.py) and standalone via
-scripts/fdtlint.py.  The package is deliberately stdlib-only (ast + re):
-linting the repo must not require jax, numpy, or a native build.
+scripts/fdtlint.py.  The lint surface is deliberately stdlib-only
+(ast + re): linting the repo must not require jax, numpy, or a native
+build — and this package's __init__ must stay that way.
+
+The model-checking surface (fdtmc: sched.py, dpor.py, mcmodels.py,
+mcinvariants.py; scripts/fdtmc.py; tests/test_fdtmc.py) lives beside the
+linters but is imported lazily, NOT from here: it runs the real
+tango.rings code under a deterministic cooperative scheduler, so it
+needs numpy and the native build.
 """
 
 from .findings import Finding  # noqa: F401

@@ -1,0 +1,342 @@
+"""Schedule exploration: sleep-set DPOR, bounded DFS, and random walks.
+
+The explorer drives repeated executions of a scenario (analysis/
+mcmodels.py) through the cooperative scheduler (analysis/sched.py),
+enumerating interleavings stateless-ly: every execution re-runs the
+scenario from scratch, with the prefix of scheduling choices forced from
+an explicit DFS stack.
+
+Modes:
+
+  dpor    Flanagan & Godefroid dynamic partial-order reduction (POPL'05)
+          with sleep sets: the default first choice at every state is the
+          previously-running task (fewest context switches); executing a
+          transition that races with an earlier one by another task adds
+          that task to the earlier choice point's backtrack set, so only
+          race reversals grow the tree.  Dependence is conservative
+          (same object + overlapping location + a write, sched.Op).
+  dfs     exhaustive DFS over enabled tasks (sleep sets still prune
+          commutations) — the oracle mode dpor is validated against in
+          tests/test_fdtmc.py.
+  random  seeded uniform random walks (wide, shallow coverage for the
+          big scenarios; duplicates deduped by choice string).
+
+Bounds: max_steps per execution (livelock guard), preemption_bound
+(CHESS-style: only schedules with <= N preemptive switches are
+generated; DPOR race reversals are exempt so discovered races are always
+chased), max_schedules per scenario.  State hashing (blake2b over every
+registered ring buffer + task status) feeds the distinct-state metric.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from dataclasses import dataclass, field
+
+from .sched import (
+    McViolation,
+    Op,
+    Outcome,
+    ReplayDivergence,
+    Scheduler,
+    SchedulerAbort,
+    Task,
+    encode_seed,
+    ops_dependent,
+)
+
+
+@dataclass
+class ExploreConfig:
+    mode: str = "dpor"  # dpor | dfs | random
+    max_schedules: int = 400
+    max_steps: int = 3000
+    preemption_bound: int | None = 2
+    hash_states: bool = True
+    max_violations: int = 4
+    rng_seed: int = 0
+
+
+@dataclass
+class Violation:
+    rule: str
+    msg: str
+    seed: str
+    choices: list
+    trace: list
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "msg": self.msg,
+            "seed": self.seed,
+            "steps": len(self.choices),
+        }
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    mutation: str | None
+    schedules: int = 0
+    pruned: int = 0
+    states: set = field(default_factory=set)
+    violations: list[Violation] = field(default_factory=list)
+    budget_exhausted: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _CP:
+    """One choice point on the DFS stack."""
+
+    __slots__ = ("enabled", "pending", "chosen", "done", "backtrack", "sleep",
+                 "preemptions", "prev")
+
+    def __init__(self, enabled, pending, preemptions, prev):
+        self.enabled: list[int] = enabled
+        self.pending: dict[int, Op | None] = pending
+        self.chosen: int = -1
+        self.done: set[int] = set()
+        self.backtrack: set[int] = set()
+        self.sleep: set[int] = set()  # inherited + explored siblings
+        self.preemptions = preemptions
+        self.prev = prev  # previously-running task index (or None)
+
+
+def _default_pick(cands: list[int], prev: int | None) -> int:
+    """Fewest-switches default: continue the previous task when possible."""
+    if prev is not None and prev in cands:
+        return prev
+    return cands[0]
+
+
+class _StackChooser:
+    """Chooser for one execution: forced along the DFS stack prefix, then
+    extends the stack with fresh choice points."""
+
+    def __init__(self, explorer: "Explorer", stack: list[_CP]):
+        self.ex = explorer
+        self.stack = stack
+        self.depth = 0
+        self.pruned = False
+
+    def __call__(self, sched: Scheduler, runnable: list[Task]) -> Task:
+        cfg = self.ex.cfg
+        d = self.depth
+        self.depth += 1
+        by_idx = {t.index: t for t in runnable}
+        if d < len(self.stack):
+            cp = self.stack[d]
+            t = by_idx.get(cp.chosen)
+            if t is None:
+                raise ReplayDivergence(
+                    f"DFS prefix chose task {cp.chosen} at depth {d} but it "
+                    f"is not runnable — nondeterministic scenario?"
+                )
+            return t
+        enabled = sorted(by_idx)
+        pending = {i: by_idx[i].pending for i in enabled}
+        prev = sched.prev_choice
+        preemptions = self.stack[d - 1].preemptions if d else 0
+        if d and self.stack[d - 1].prev is not None:
+            # the previous choice preempted iff it switched away from a
+            # task that could have continued
+            last = self.stack[d - 1]
+            if last.chosen != last.prev and last.prev in last.enabled:
+                preemptions = last.preemptions + 1
+        cp = _CP(enabled, pending, preemptions, prev)
+        # inherit the sleep set: tasks whose exploration is redundant here
+        # because a sibling subtree already covered them, minus any whose
+        # pending op depends on the transition that led here
+        if d:
+            parent = self.stack[d - 1]
+            lead_op = parent.pending.get(parent.chosen)
+            for s in parent.sleep:
+                if s in by_idx and not ops_dependent(pending.get(s), lead_op):
+                    cp.sleep.add(s)
+        cands = [i for i in enabled if i not in cp.sleep]
+        if not cands:
+            self.pruned = True
+            raise SchedulerAbort()
+        if (
+            cfg.preemption_bound is not None
+            and cp.preemptions >= cfg.preemption_bound
+            and prev in cands
+        ):
+            cands = [prev]
+        if cfg.mode == "dfs":
+            cp.backtrack = set(cands)
+        cp.chosen = _default_pick(cands, prev)
+        cp.backtrack.add(cp.chosen)
+        self.stack.append(cp)
+        return by_idx[cp.chosen]
+
+
+class Explorer:
+    """Drives a scenario's executions; see module docstring."""
+
+    def __init__(self, scenario: str, mutation: str | None, make_execution,
+                 cfg: ExploreConfig):
+        """make_execution() -> (scheduler, finalize) where the scheduler is
+        fully set up (tasks spawned, monitors installed, hook routed) and
+        `finalize(outcome)` releases per-run resources."""
+        self.scenario = scenario
+        self.mutation = mutation
+        self.make_execution = make_execution
+        self.cfg = cfg
+
+    def _run_one(self, choose) -> Outcome:
+        sched, finalize = self.make_execution()
+        sched.max_steps = self.cfg.max_steps
+        sched.hash_states = self.cfg.hash_states
+        try:
+            out = sched.run(choose)
+        finally:
+            finalize()
+        if out.error is not None:
+            raise RuntimeError(
+                f"fdtmc internal error in scenario {self.scenario!r}"
+            ) from out.error
+        return out
+
+    def _record(self, res: ExploreResult, out: Outcome) -> None:
+        res.schedules += 1
+        res.states.update(out.state_hashes)
+        if out.violation is not None:
+            res.violations.append(
+                Violation(
+                    rule=out.violation.rule,
+                    msg=out.violation.msg,
+                    seed=encode_seed(self.scenario, self.mutation, out.choices),
+                    choices=list(out.choices),
+                    trace=list(out.trace),
+                )
+            )
+
+    def explore(self) -> ExploreResult:
+        res = ExploreResult(self.scenario, self.mutation)
+        if self.cfg.mode == "random":
+            self._explore_random(res)
+        else:
+            self._explore_dfs(res)
+        return res
+
+    # ---- dfs / dpor -----------------------------------------------------
+
+    def _explore_dfs(self, res: ExploreResult) -> None:
+        cfg = self.cfg
+        stack: list[_CP] = []
+        while True:
+            if res.schedules + res.pruned >= cfg.max_schedules:
+                res.budget_exhausted = True
+                return
+            chooser = _StackChooser(self, stack)
+            out = self._run_one(chooser)
+            if out.aborted:
+                res.pruned += 1
+            else:
+                self._record(res, out)
+                if len(res.violations) >= cfg.max_violations:
+                    return
+                if cfg.mode == "dpor":
+                    self._add_races(stack, out)
+            # backtrack: pop exhausted choice points, advance the deepest
+            # one with unexplored backtrack candidates
+            while stack:
+                cp = stack[-1]
+                cp.done.add(cp.chosen)
+                cp.sleep.add(cp.chosen)
+                rest = sorted(cp.backtrack - cp.done)
+                if rest:
+                    cp.chosen = rest[0]
+                    break
+                stack.pop()
+            if not stack:
+                return
+
+    def _add_races(self, stack: list[_CP], out: Outcome) -> None:
+        """POPL'05 race detection: for each executed transition, find the
+        most recent earlier transition by another task whose op it depends
+        on, and add this task to that choice point's backtrack set (or all
+        enabled there if it wasn't enabled yet)."""
+        ops = out.ops  # (task_index, Op|None) per depth
+        for k in range(len(ops)):
+            pk, opk = ops[k]
+            if opk is None or opk.kind == "wait":
+                continue
+            for j in range(k - 1, -1, -1):
+                pj, opj = ops[j]
+                if pj == pk or opj is None:
+                    continue
+                if ops_dependent(opj, opk):
+                    if j < len(stack):
+                        cp = stack[j]
+                        if pk in cp.enabled:
+                            cp.backtrack.add(pk)
+                        else:
+                            cp.backtrack.update(cp.enabled)
+                    break
+
+    # ---- random ---------------------------------------------------------
+
+    def _explore_random(self, res: ExploreResult) -> None:
+        cfg = self.cfg
+        rng = _random.Random(cfg.rng_seed)
+        seen: set[tuple] = set()
+        attempts = 0
+        while res.schedules < cfg.max_schedules and attempts < 4 * cfg.max_schedules:
+            attempts += 1
+            prefix = rng.getrandbits(64)
+            walk = _random.Random(prefix)
+
+            def choose(sched: Scheduler, runnable: list[Task]) -> Task:
+                return runnable[walk.randrange(len(runnable))]
+
+            out = self._run_one(choose)
+            key = tuple(out.choices)
+            if key in seen:
+                continue
+            seen.add(key)
+            self._record(res, out)
+            if len(res.violations) >= cfg.max_violations:
+                return
+        res.budget_exhausted = res.schedules >= cfg.max_schedules
+
+
+# ---------------------------------------------------------------------------
+# counterexample minimization
+
+def minimize(run_forced, choices: list[int], rule: str,
+             max_rounds: int = 6) -> list[int]:
+    """Greedy schedule minimization: repeatedly try to flatten context
+    switches (replace a switch-to-other with continue-previous) while the
+    violation (same rule) persists.  `run_forced(choices) -> Outcome`
+    replays a forced prefix.  Best-effort: candidates whose replay
+    diverges are skipped."""
+    best = list(choices)
+    for _ in range(max_rounds):
+        improved = False
+        i = 1
+        while i < len(best):
+            if best[i] != best[i - 1]:
+                cand = best[:i] + [best[i - 1]] + best[i + 1 :]
+                try:
+                    out = run_forced(cand)
+                except ReplayDivergence:
+                    out = None
+                if (
+                    out is not None
+                    and out.violation is not None
+                    and out.violation.rule == rule
+                ):
+                    best = list(out.choices)
+                    improved = True
+                    continue  # retry at the same position
+            i += 1
+        if not improved:
+            break
+    # drop everything after the violation fired (replay stops there anyway)
+    return best

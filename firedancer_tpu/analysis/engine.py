@@ -99,7 +99,16 @@ def run_repo(root: Path | str | None = None) -> Report:
         for p in sorted((root / d).glob("*.py")):
             ring_files.append(p.relative_to(root).as_posix())
             rep.findings.extend(ringlint.check_file(p, rel=root))
+    # tango/rings.py joins the scan for ring-mc-hook: every shared-memory
+    # native op must route through the fdtmc scheduler hook, and the
+    # guarded-function count is asserted coverage (a hook surface that
+    # silently shrank would let ring ops hide from the model checker)
+    rings_py = root / "firedancer_tpu" / "tango" / "rings.py"
+    ring_files.append(rings_py.relative_to(root).as_posix())
+    rings_findings, mc_hook_fns = ringlint.check_rings_file(rings_py, rel=root)
+    rep.findings.extend(rings_findings)
     rep.coverage["ring_files"] = ring_files
+    rep.coverage["mc_hook_fns"] = mc_hook_fns
 
     # -- purity: the whole package ---------------------------------------
     hot_fns = 0

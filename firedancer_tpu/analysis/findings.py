@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import asdict, dataclass
+from pathlib import Path
 
 
 @dataclass(frozen=True, order=True)
@@ -47,3 +48,85 @@ def apply_pragmas(findings: list[Finding], source_lines: list[str]) -> list[Find
         for f in findings
         if f.rule not in suppressed_rules(source_lines, f.line)
     ]
+
+
+# ---------------------------------------------------------------------------
+# baseline files: accepted-findings suppression without inline pragmas
+#
+# A baseline entry matches on (path, rule, msg) — NOT line, which drifts
+# under unrelated edits.  Generate with `scripts/fdtlint.py
+# --write-baseline FILE`, consume with `--baseline FILE`; any finding not
+# in the baseline still fails the run, and stale entries are reported so
+# a baseline cannot silently outlive its findings.
+
+#: repo root, for path normalization (engine.repo_root would be a
+#: circular import; same three-parents-up derivation)
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def _norm_path(p: str) -> str:
+    """Normalize a finding path for baseline matching: findings from a
+    full repo pass are repo-relative while targeted runs report the path
+    as typed (absolute or cwd-relative) — resolve everything and prefer
+    the repo-relative form so a baseline matches regardless of how the
+    lint was invoked."""
+    q = Path(p)
+    if not q.is_absolute():
+        candidates = [Path.cwd() / q, _REPO_ROOT / q]
+    else:
+        candidates = [q]
+    for c in candidates:
+        try:
+            r = c.resolve()
+        except OSError:  # pragma: no cover - unresolvable path
+            continue
+        if r.exists():
+            try:
+                return r.relative_to(_REPO_ROOT.resolve()).as_posix()
+            except ValueError:
+                return r.as_posix()
+    return q.as_posix()
+
+
+def baseline_key(f: Finding) -> tuple[str, str, str]:
+    return (_norm_path(f.path), f.rule, f.msg)
+
+
+def write_baseline(findings: list[Finding], path: str) -> None:
+    import json
+
+    doc = [
+        {"path": _norm_path(f.path), "rule": f.rule, "msg": f.msg}
+        for f in sorted(findings)
+    ]
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> set[tuple[str, str, str]]:
+    import json
+
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    out = set()
+    for e in doc:
+        try:
+            out.add((_norm_path(e["path"]), e["rule"], e["msg"]))
+        except (TypeError, KeyError):
+            raise ValueError(
+                f"baseline {path}: entries need path/rule/msg keys"
+            ) from None
+    return out
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: set[tuple[str, str, str]]
+) -> tuple[list[Finding], int, list[tuple[str, str, str]]]:
+    """Returns (kept findings, suppressed count, stale baseline entries)."""
+    kept = [f for f in findings if baseline_key(f) not in baseline]
+    hit = {baseline_key(f) for f in findings} & baseline
+    stale = sorted(baseline - hit)
+    return kept, len(findings) - len(kept), stale

@@ -1,0 +1,198 @@
+"""fdtmc safety/liveness invariants over the ring protocol.
+
+Monitors observe protocol events the instrumentation reports
+(sched.Scheduler.notify) plus end-of-execution summaries, and raise
+sched.McViolation with one of the rule slugs below.  Scenario harnesses
+(analysis/mcmodels.py) attach the monitors that apply to their link
+discipline (payload integrity only holds on reliable flow-controlled
+links; overrun accounting is the unreliable-link contract; etc.).
+
+Raw shared-state reads inside monitors go straight to the native layer
+(never through the hooks): monitors run on the scheduler's clock, not
+the protocol's, and must not perturb the schedule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from firedancer_tpu.tango import rings
+from firedancer_tpu.tango.rings import seq_diff, seq_u64
+
+from .findings import Finding
+from .sched import McViolation
+
+#: rule slug -> what a violation means (rendered in analysis/README.md
+#: and asserted complete by tests/test_fdtmc.py)
+RULES = {
+    "mc-torn-read": (
+        "a validated poll/drain returned frag metadata that mixes two "
+        "publishes (sig inconsistent with seq) — the invalidate/re-check "
+        "protocol failed"
+    ),
+    "mc-stale-read": (
+        "a consumer on a reliable flow-controlled link read dcache payload "
+        "bytes that do not match what the producer published for that frag "
+        "(payload not fully written before the frag became visible, or "
+        "the chunk was reused while still in flight)"
+    ),
+    "mc-reliable-overrun": (
+        "a reliable (credit-gated) consumer was lapped — the producer "
+        "published past the consumer's fseq + cr_max"
+    ),
+    "mc-credit-overflow": (
+        "the producer held more frags in flight than cr_max (credit "
+        "conservation broken: forged/leaked credits)"
+    ),
+    "mc-fseq-regress": (
+        "an fseq moved backwards beyond its declared rejoin-replay "
+        "allowance — a consumer's progress backchannel must be monotone"
+    ),
+    "mc-lost-frag": (
+        "a published frag was neither delivered nor counted as overrun "
+        "loss (the skipped-frag accounting is unsound)"
+    ),
+    "mc-reordered": (
+        "a consumer observed frags out of sequence order within one "
+        "incarnation"
+    ),
+    "mc-deadlock": (
+        "no task can make progress but the scenario has not completed "
+        "(producer starved of credits + consumer starved of frags)"
+    ),
+    "mc-livelock": (
+        "the execution exceeded its step budget without terminating"
+    ),
+}
+
+
+def finding_for(scenario: str, rule: str, msg: str, seed: str) -> Finding:
+    """fdtlint-style finding for a model-checking violation.  The path
+    pins the scenario harness (there is no single source line for an
+    interleaving bug); the seed in the message replays it:
+    `scripts/fdtmc.py --replay <seed>`."""
+    return Finding(
+        path=f"<fdtmc:{scenario}>",
+        line=0,
+        rule=rule,
+        msg=f"{msg} [replay: {seed}]",
+    )
+
+
+class Monitor:
+    def on_op(self, ev: dict) -> None: ...
+
+    def on_end(self, sched) -> None: ...
+
+
+def _raw_fseq(fs) -> int:
+    return rings._lib.fdt_fseq_query(rings._ptr(fs.mem))
+
+
+class FseqMonotonic(Monitor):
+    """fseq updates only move forward, except an explicitly declared
+    rejoin rewind of at most `rewind` frags (at-least-once replay)."""
+
+    def __init__(self, rewind: int = 0):
+        self.rewind = rewind
+
+    def on_op(self, ev: dict) -> None:
+        if ev.get("ev") != "fseq_update":
+            return
+        back = seq_diff(ev["old"], ev["new"])
+        if back > self.rewind:
+            raise McViolation(
+                "mc-fseq-regress",
+                f"{ev['fseq']} moved back {back} frags "
+                f"({ev['old']} -> {ev['new']}, allowance {self.rewind}) "
+                f"by {ev['task']}",
+            )
+
+
+class CreditBound(Monitor):
+    """At every publish, in-flight frags (seq_prod ahead of the slowest
+    reliable consumer) stay within cr_max (+ a declared rejoin-rewind
+    slack: a rewound fseq legitimately re-exposes consumed frags)."""
+
+    def __init__(self, mc_label: str, fseqs: list, cr_max: int, slack: int = 0):
+        self.mc_label = mc_label
+        self.fseqs = fseqs
+        self.cr_max = cr_max
+        self.slack = slack
+
+    def on_op(self, ev: dict) -> None:
+        if ev.get("ev") != "publish" or ev.get("mc") != self.mc_label:
+            return
+        lo = _raw_fseq(self.fseqs[0])
+        for fs in self.fseqs[1:]:
+            lo = rings.seq_min(lo, _raw_fseq(fs))
+        in_flight = seq_diff(seq_u64(ev["seq"] + 1), lo)
+        if in_flight > self.cr_max + self.slack:
+            raise McViolation(
+                "mc-credit-overflow",
+                f"{ev['task']} published seq {ev['seq']} with {in_flight} "
+                f"frags in flight on {self.mc_label} (cr_max {self.cr_max}, "
+                f"slack {self.slack})",
+            )
+
+
+class DrainResyncSound(Monitor):
+    """An overrun resync must land on the oldest potentially-live frag
+    (seq_prod - depth mod 2^64), or seq+1 when that is not ahead — never
+    BEYOND it.  Overshooting silently discards frags that were still
+    readable (counted, but lost needlessly): exactly what the pre-PR-3
+    clamp-to-zero formula did when seq_prod had wrapped past 2^64."""
+
+    def on_op(self, ev: dict) -> None:
+        if ev.get("ev") != "drain_overrun":
+            return
+        oldest = seq_u64(ev["seq_prod"] - ev["depth"])
+        want = oldest if seq_diff(oldest, ev["seq_old"]) > 0 else seq_u64(
+            ev["seq_old"] + 1
+        )
+        if ev["seq_new"] != want:
+            raise McViolation(
+                "mc-lost-frag",
+                f"overrun resync on {ev['mc']} jumped {ev['seq_old']} -> "
+                f"{ev['seq_new']} but the oldest live frag was {want} "
+                f"(seq_prod {ev['seq_prod']}, depth {ev['depth']}): "
+                f"live frags discarded",
+            )
+
+
+class EndCheck(Monitor):
+    """Scenario-closure end-of-execution invariant."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def on_end(self, sched) -> None:
+        self.fn(sched)
+
+
+# ---------------------------------------------------------------------------
+# inline checks scenario tasks call on data they consumed
+
+def check_frag_meta(frag, sig_of, scenario_note: str = "") -> None:
+    """A validated frag's sig must be the one published for its seq —
+    anything else is a torn metadata read that escaped the seq re-check."""
+    seq = int(frag["seq"])
+    sig = int(frag["sig"])
+    want = sig_of(seq)
+    if sig != want:
+        raise McViolation(
+            "mc-torn-read",
+            f"frag seq {seq} returned sig {sig:#x}, published {want:#x} "
+            f"{scenario_note}",
+        )
+
+
+def check_payload(data: np.ndarray, expect: np.ndarray, seq: int) -> None:
+    if not np.array_equal(data, expect):
+        bad = int(np.argmax(data != expect)) if len(data) == len(expect) else -1
+        raise McViolation(
+            "mc-stale-read",
+            f"payload for seq {seq} diverges from published bytes "
+            f"(first bad offset {bad}; reliable link must never expose "
+            f"torn/stale dcache reads)",
+        )

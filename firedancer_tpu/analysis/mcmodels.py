@@ -1,0 +1,718 @@
+"""fdtmc scenario harnesses: real ring topologies under the checker.
+
+Each scenario builds real tango objects (Workspace / MCache / DCache /
+FSeq — the same native-backed buffers production uses) and spawns
+producer/consumer/supervisor tasks written in the tile idiom (credit
+gate -> dcache write -> publish; drain -> gather -> fseq update).  The
+cooperative scheduler interleaves them at shared-memory micro-step
+granularity and the monitors (analysis/mcinvariants.py) check the
+protocol's contracts on every schedule.
+
+Scenarios:
+
+  1p1c              reliable flow-controlled producer/consumer with
+                    payloads: exactly-once, in-order, no torn/stale read
+  1p2c              one producer, two reliable consumers (min-fseq gate)
+  overrun_drain     unreliable consumer racing a lapping producer:
+                    every skipped frag counted, validated reads untorn
+  backpressure      cr_max=1: tightest credit loop, liveness (no
+                    deadlock/livelock) + credit conservation
+  restart_consumer  supervisor crashes the consumer mid-flight, rejoins
+                    via disco.supervisor.rejoin_links (the REAL restart
+                    path) with a replay window, re-incarnates it:
+                    at-least-once delivery, bounded fseq rewind
+  restart_producer  supervisor crashes the producer mid-publish_batch,
+                    producer_rejoin resumes the seq: exactly-once
+                    delivery at the consumer
+  wrap_1p1c / wrap_overrun / wrap_restart
+                    the same protocols started at seq0 = 2^64 - 2 so
+                    every seq comparison crosses the wrap (the PR 3
+                    rejoin/drain wrap fixes are pinned here)
+
+The `mutation` argument (tests/fixtures/mc_corpus/) flips a named
+protocol fault: hook-level ones live in sched.RingHook; scenario-level
+ones (publish-before-write, rejoin-no-wrap) are applied here because
+the fault is in the *discipline*, not the primitive.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from firedancer_tpu.disco.supervisor import rejoin_links
+from firedancer_tpu.tango import rings as R
+from firedancer_tpu.tango.rings import seq_diff, seq_u64
+
+from . import engine
+from .dpor import ExploreConfig, Explorer, ExploreResult
+from .mcinvariants import (
+    CreditBound,
+    DrainResyncSound,
+    EndCheck,
+    FseqMonotonic,
+    check_frag_meta,
+    check_payload,
+    finding_for,
+)
+from .sched import (
+    MUTATIONS,
+    McViolation,
+    Op,
+    RingHook,
+    Scheduler,
+    decode_seed,
+    forced_chooser,
+)
+
+U64 = seq_u64
+
+
+class Env:
+    """Scenario-facing facade over the scheduler + hook."""
+
+    def __init__(self, sched: Scheduler, hook: RingHook, mutation: str | None):
+        self.sched = sched
+        self.hook = hook
+        self.mutation = mutation
+        self.scratch: dict = {}
+
+    # task plumbing
+    def spawn(self, name: str, fn: Callable[[], None]):
+        return self.sched.spawn(name, fn)
+
+    def kill(self, task) -> None:
+        self.sched.kill(task)
+
+    def wait_for(self, pred, watch_objs=()) -> None:
+        watch = tuple(self.hook.label_of(o) for o in watch_objs)
+        self.sched.wait_for(pred, watch)
+
+    def crash_point(self, focus=None) -> None:
+        """A conflict-carrying yield: DPOR explores placing whatever
+        follows (a kill, a rejoin) across the schedule.  With `focus`
+        (a ring object), the crash races with that object's accesses
+        only — placements enumerate the dimension that matters (e.g. a
+        consumer crash relative to its fseq progression) instead of
+        every micro-step.  Without focus it conflicts with everything."""
+        if focus is None:
+            self.sched.yield_op(Op("crash", "*", ("crash",), True))
+        else:
+            label = self.hook.label_of(focus)
+            loc = ("seq",) if label.startswith("fs") else ("seq_prod",)
+            self.sched.yield_op(Op("crash", label, loc, True))
+
+    def violation(self, rule: str, msg: str) -> None:
+        raise McViolation(rule, msg)
+
+    # raw (unhooked) reads — scheduling hints for wait_for preds only
+    def raw_seq_prod(self, mc) -> int:
+        return R._lib.fdt_mcache_seq_query(R._ptr(mc.mem))
+
+    def raw_fseq(self, fs) -> int:
+        return R._lib.fdt_fseq_query(R._ptr(fs.mem))
+
+
+def _sig_of(seq0: int):
+    return lambda seq: 0xA000 + seq_diff(seq, seq0)
+
+
+def _pattern(sig: int, sz: int) -> np.ndarray:
+    return ((np.arange(sz, dtype=np.uint32) * 31 + (sig & 0xFFFF) * 7) & 0xFF).astype(
+        np.uint8
+    )
+
+
+# ---------------------------------------------------------------------------
+# task templates (the tile idiom, one frag at a time so every micro-step
+# is schedulable)
+
+def _producer(env: Env, mc, dc, fseqs, *, seq0: int, n: int, cr_max: int,
+              use_dcache: bool, psz: int = 24):
+    """Credit-gated producer; honors the publish-before-write mutation."""
+    sig_of = _sig_of(seq0)
+
+    def run():
+        seq = seq0
+        done = 0
+        while done < n:
+            lo = fseqs[0].query()
+            for fs in fseqs[1:]:
+                lo = R.seq_min(lo, fs.query())
+            cr = R.cr_avail(seq, lo, cr_max)
+            if cr == 0:
+                # scheduling hint only; credits are re-read through the
+                # hooked ops above once runnable (a leak-mutated cr_avail
+                # makes this pred always true, which is the fault)
+                env.wait_for(
+                    lambda: R.cr_avail(seq, min_raw(), cr_max) > 0,
+                    watch_objs=fseqs,
+                )
+                continue
+            sig = sig_of(seq)
+            if use_dcache:
+                payload = _pattern(sig, psz)
+                if env.mutation == "publish-before-write":
+                    chunk = dc.chunk  # the chunk write() will use
+                    mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
+                    dc.write(payload)
+                else:
+                    chunk = dc.write(payload)
+                    mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
+            else:
+                mc.publish(seq=seq, sig=sig)
+            seq = U64(seq + 1)
+            done += 1
+        env.scratch["prod_done"] = True
+
+    def min_raw():
+        lo = env.raw_fseq(fseqs[0])
+        for fs in fseqs[1:]:
+            lo = R.seq_min(lo, env.raw_fseq(fs))
+        return lo
+
+    return run
+
+
+def _consumer(env: Env, mc, dc, fs, *, seq0: int, n: int, name: str,
+              use_dcache: bool, budget: int = 3, use_poll: bool = False):
+    """Reliable consumer: drain (or poll), verify, publish progress."""
+    sig_of = _sig_of(seq0)
+    recv = env.scratch.setdefault(f"recv_{name}", [])
+
+    def run():
+        seq = seq0
+        while len(recv) < n:
+            if use_poll:
+                rc, frag, _now = mc.poll(seq)
+                if rc == 1:
+                    env.violation(
+                        "mc-reliable-overrun",
+                        f"{name}: poll at {seq} overrun on a reliable link",
+                    )
+                frags = [frag] if rc == 0 else []
+                if rc == 0:
+                    seq = U64(seq + 1)
+            else:
+                frags, seq, ovr = mc.drain(seq, budget)
+                if ovr:
+                    env.violation(
+                        "mc-reliable-overrun",
+                        f"{name}: drained with {ovr} frags lost on a "
+                        f"reliable link",
+                    )
+            for f in frags:
+                check_frag_meta(f, sig_of, f"({name})")
+                if use_dcache:
+                    data = dc.read(int(f["chunk"]), int(f["sz"]))
+                    check_payload(data, _pattern(int(f["sig"]), int(f["sz"])),
+                                  int(f["seq"]))
+                recv.append(int(f["seq"]))
+            fs.update(seq)
+            if len(recv) >= n:
+                break
+            if not len(frags):
+                env.wait_for(
+                    lambda: seq_diff(seq, env.raw_seq_prod(mc)) < 0,
+                    watch_objs=[mc],
+                )
+
+    return run
+
+
+def _order_check(env: Env, name: str, seq0: int, n: int):
+    def check(_sched):
+        recv = env.scratch.get(f"recv_{name}", [])
+        idx = [seq_diff(s, seq0) for s in recv]
+        if sorted(idx) != idx:
+            raise McViolation(
+                "mc-reordered", f"{name} observed seqs out of order: {idx}"
+            )
+        if set(idx) != set(range(n)):
+            missing = sorted(set(range(n)) - set(idx))
+            raise McViolation(
+                "mc-lost-frag",
+                f"{name} finished missing frag(s) {missing} of {n} "
+                f"(got {sorted(set(idx))})",
+            )
+
+    return check
+
+
+# ---------------------------------------------------------------------------
+# scenario builders
+
+def _build_1p1c(env: Env, mutation: str | None, *, seq0: int = 0):
+    depth, cr_max, n = 4, 2, 4
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    dc = R.DCache.create(w, "dc", mtu=32, depth=depth)
+    fs = R.FSeq.create(w, "fs", seq0=seq0)
+    env.sched.monitors += [
+        FseqMonotonic(),
+        CreditBound(env.hook.label_of(mc), [fs], cr_max),
+        EndCheck(_order_check(env, "c0", seq0, n)),
+    ]
+    env.spawn("prod", _producer(env, mc, dc, [fs], seq0=seq0, n=n,
+                                cr_max=cr_max, use_dcache=True))
+    env.spawn("cons", _consumer(env, mc, dc, fs, seq0=seq0, n=n, name="c0",
+                                use_dcache=True))
+
+
+def _build_1p2c(env: Env, mutation: str | None):
+    seq0, depth, cr_max, n = 0, 4, 2, 3
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    fs0 = R.FSeq.create(w, "fs0", seq0=seq0)
+    fs1 = R.FSeq.create(w, "fs1", seq0=seq0)
+    env.sched.monitors += [
+        FseqMonotonic(),
+        CreditBound(env.hook.label_of(mc), [fs0, fs1], cr_max),
+        EndCheck(_order_check(env, "c0", seq0, n)),
+        EndCheck(_order_check(env, "c1", seq0, n)),
+    ]
+    env.spawn("prod", _producer(env, mc, None, [fs0, fs1], seq0=seq0, n=n,
+                                cr_max=cr_max, use_dcache=False))
+    env.spawn("c0", _consumer(env, mc, None, fs0, seq0=seq0, n=n, name="c0",
+                              use_dcache=False))
+    env.spawn("c1", _consumer(env, mc, None, fs1, seq0=seq0, n=n, name="c1",
+                              use_dcache=False))
+
+
+def _build_overrun_drain(env: Env, mutation: str | None, *, seq0: int = 0,
+                         n: int = 10):
+    """Unreliable consumer vs a lapping producer: loss is legal, silent
+    loss is not."""
+    depth = 4
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    sig_of = _sig_of(seq0)
+    recv: list[int] = []
+    state = {"ovr": 0}
+    end_seq = U64(seq0 + n)
+
+    def producer():
+        seq = seq0
+        for _ in range(n):
+            mc.publish(seq=seq, sig=sig_of(seq))
+            seq = U64(seq + 1)
+        env.scratch["prod_done"] = True
+
+    def consumer():
+        seq = seq0
+        while seq_diff(seq, end_seq) < 0:
+            frags, seq, ovr = mc.drain(seq, 2)
+            state["ovr"] += ovr
+            for f in frags:
+                check_frag_meta(f, sig_of, "(unreliable)")
+                recv.append(int(f["seq"]))
+            if seq_diff(seq, end_seq) >= 0:
+                break
+            if not len(frags) and not ovr:
+                env.wait_for(
+                    lambda: env.scratch.get("prod_done")
+                    or seq_diff(seq, env.raw_seq_prod(mc)) < 0,
+                    watch_objs=[mc],
+                )
+                if env.scratch.get("prod_done") and seq_diff(
+                    seq, env.raw_seq_prod(mc)
+                ) >= 0:
+                    break
+
+    def end_check(_sched):
+        idx = [seq_diff(s, seq0) for s in recv]
+        if sorted(idx) != idx or len(set(idx)) != len(idx):
+            raise McViolation(
+                "mc-reordered", f"unreliable consumer saw seqs {idx}"
+            )
+        if len(recv) + state["ovr"] != n:
+            raise McViolation(
+                "mc-lost-frag",
+                f"accounting unsound: {len(recv)} delivered + "
+                f"{state['ovr']} counted-skipped != {n} published",
+            )
+
+    env.sched.monitors += [
+        FseqMonotonic(),
+        DrainResyncSound(),
+        EndCheck(end_check),
+    ]
+    env.spawn("prod", producer)
+    env.spawn("cons", consumer)
+
+
+def _build_backpressure(env: Env, mutation: str | None):
+    """cr_max=1 lockstep: the tightest credit loop must stay live."""
+    seq0, depth, cr_max, n = 0, 2, 1, 3
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    fs = R.FSeq.create(w, "fs", seq0=seq0)
+    env.sched.monitors += [
+        FseqMonotonic(),
+        CreditBound(env.hook.label_of(mc), [fs], cr_max),
+        EndCheck(_order_check(env, "c0", seq0, n)),
+    ]
+    env.spawn("prod", _producer(env, mc, None, [fs], seq0=seq0, n=n,
+                                cr_max=cr_max, use_dcache=False))
+    env.spawn("cons", _consumer(env, mc, None, fs, seq0=seq0, n=n, name="c0",
+                                use_dcache=False, use_poll=True))
+
+
+def _rejoin_no_wrap(il, replay: int) -> None:
+    """The pre-PR-3 consumer_rejoin arithmetic (plain-int min/max), kept
+    as a corpus mutant so the wrap-around fix can never silently regress:
+    fdtmc must always catch THIS version losing frags at 2^64."""
+    prod = il.mcache.seq_query()
+    last = il.fseq.query()
+    oldest = max(prod - il.mcache.depth, 0)
+    il.seq = max(min(last, prod) - replay, oldest, 0)
+    il.fseq.update(il.seq)
+
+
+def _build_restart_consumer(env: Env, mutation: str | None, *, seq0: int = 0):
+    """Supervisor crashes the consumer mid-flight and re-incarnates it
+    through the real disco rejoin path with a full replay window:
+    at-least-once delivery of every frag."""
+    from firedancer_tpu.disco.mux import InLink
+
+    depth, cr_max, n, replay = 4, 2, 3, 4
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    dc = R.DCache.create(w, "dc", mtu=32, depth=depth)
+    fs = R.FSeq.create(w, "fs", seq0=seq0)
+    sig_of = _sig_of(seq0)
+    seen: set[int] = set()
+    il = InLink("in", mc, dc, fs, reliable=True, seq=seq0)
+
+    def consumer_body():
+        seq = il.seq
+        while len(seen) < n:
+            # budget 1: the fseq walks through every value, so a crash can
+            # land at any consumer progress point (incl. just-before-wrap)
+            frags, seq, ovr = mc.drain(seq, 1)
+            if ovr:
+                env.violation(
+                    "mc-reliable-overrun",
+                    f"consumer drained with {ovr} lost on a reliable link",
+                )
+            for f in frags:
+                check_frag_meta(f, sig_of, "(restart)")
+                data = dc.read(int(f["chunk"]), int(f["sz"]))
+                check_payload(data, _pattern(int(f["sig"]), int(f["sz"])),
+                              int(f["seq"]))
+                seen.add(seq_diff(int(f["seq"]), seq0))
+            il.seq = seq
+            fs.update(seq)
+            if len(seen) >= n:
+                break
+            if not len(frags):
+                env.wait_for(
+                    lambda: seq_diff(il.seq, env.raw_seq_prod(mc)) < 0,
+                    watch_objs=[mc],
+                )
+                seq = il.seq
+
+    cons1 = env.spawn("cons", consumer_body)
+
+    def supervisor():
+        env.crash_point(focus=fs)
+        env.kill(cons1)
+        if mutation == "rejoin-no-wrap":
+            _rejoin_no_wrap(il, replay)
+        else:
+            rejoin_links([il], [], replay=replay)
+        env.spawn("cons2", consumer_body)
+
+    def end_check(_sched):
+        if seen != set(range(n)):
+            raise McViolation(
+                "mc-lost-frag",
+                f"restart lost frag(s) {sorted(set(range(n)) - seen)} "
+                f"despite a replay window of {replay}",
+            )
+
+    env.sched.monitors += [
+        FseqMonotonic(rewind=replay),
+        CreditBound(env.hook.label_of(mc), [fs], cr_max, slack=replay),
+        EndCheck(end_check),
+    ]
+    env.spawn("prod", _producer(env, mc, dc, [fs], seq0=seq0, n=n,
+                                cr_max=cr_max, use_dcache=True))
+    env.spawn("sup", supervisor)
+
+
+def _build_restart_producer(env: Env, mutation: str | None):
+    """Supervisor crashes the producer mid-publish_batch; the new
+    incarnation resumes from producer_rejoin's cursor: the consumer still
+    sees every frag exactly once, in order."""
+    seq0, depth, cr_max, n = 0, 4, 4, 4
+    w = R.Workspace(64 << 10)
+    mc = R.MCache.create(w, "mc", depth=depth, seq0=seq0)
+    fs = R.FSeq.create(w, "fs", seq0=seq0)
+    sig_of = _sig_of(seq0)
+
+    def producer1():
+        lo = fs.query()
+        cr = R.cr_avail(seq0, lo, cr_max)
+        take = min(cr, n)
+        sigs = np.array([sig_of(U64(seq0 + i)) for i in range(take)],
+                        dtype=np.uint64)
+        mc.publish_batch(seq0, sigs)
+        env.scratch["prod_done"] = True
+
+    prod1 = env.spawn("prod", producer1)
+
+    def producer2():
+        if mutation == "rejoin-blind-producer":
+            # pre-PR-3 rejoin: trust seq_query blindly and re-publish the
+            # interrupted line — fdtmc must keep catching the spurious
+            # reliable-consumer overrun this causes
+            seq = mc.seq_query()
+        else:
+            seq = R.producer_rejoin(mc)
+        while seq_diff(seq, U64(seq0 + n)) < 0:
+            lo = fs.query()
+            cr = R.cr_avail(seq, lo, cr_max)
+            if cr == 0:
+                env.wait_for(
+                    lambda: R.cr_avail(seq, env.raw_fseq(fs), cr_max) > 0,
+                    watch_objs=[fs],
+                )
+                continue
+            mc.publish(seq=seq, sig=sig_of(seq))
+            seq = U64(seq + 1)
+        env.scratch["prod_done"] = True
+
+    def supervisor():
+        env.crash_point()
+        env.kill(prod1)
+        env.spawn("prod2", producer2)
+
+    env.sched.monitors += [
+        FseqMonotonic(),
+        CreditBound(env.hook.label_of(mc), [fs], cr_max),
+        EndCheck(_order_check(env, "c0", seq0, n)),
+    ]
+    env.spawn("cons", _consumer(env, mc, None, fs, seq0=seq0, n=n, name="c0",
+                                use_dcache=False))
+    env.spawn("sup", supervisor)
+
+
+# a seq0 two frags shy of the wrap: every scenario's arithmetic crosses
+# 2^64 mid-run
+_WRAP_SEQ0 = U64((1 << 64) - 2)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    build: Callable[[Env, str | None], None]
+    max_steps: int = 1500
+    tier1_schedules: int = 300
+    slow_schedules: int = 1400
+    preemption_bound: int = 2
+    slow_preemption_bound: int = 3
+
+
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in [
+        Scenario("1p1c", _build_1p1c, tier1_schedules=350),
+        Scenario("1p2c", _build_1p2c, tier1_schedules=250),
+        Scenario("overrun_drain", _build_overrun_drain, tier1_schedules=300),
+        Scenario("backpressure", _build_backpressure, tier1_schedules=200),
+        Scenario("restart_consumer", _build_restart_consumer,
+                 tier1_schedules=300, max_steps=2000),
+        Scenario("restart_producer", _build_restart_producer,
+                 tier1_schedules=300, max_steps=2000),
+        Scenario("wrap_1p1c",
+                 lambda env, m: _build_1p1c(env, m, seq0=_WRAP_SEQ0),
+                 tier1_schedules=250),
+        # seq0/n chosen so the run ENDS with seq_prod numerically <= depth
+        # (just past the wrap): every overrun resync exercises the branch
+        # the pre-PR-3 clamp-to-zero formula got wrong
+        Scenario("wrap_overrun",
+                 lambda env, m: _build_overrun_drain(
+                     env, m, seq0=U64((1 << 64) - 4), n=6),
+                 tier1_schedules=250),
+        Scenario("wrap_restart",
+                 lambda env, m: _build_restart_consumer(env, m,
+                                                        seq0=_WRAP_SEQ0),
+                 tier1_schedules=250, max_steps=2000),
+    ]
+}
+
+
+# ---------------------------------------------------------------------------
+# execution factory / suite runner / replay
+
+def _make_execution(scn: Scenario, mutation: str | None):
+    def make():
+        assert R._MC is None, "fdtmc executions cannot nest"
+        sched = Scheduler(max_steps=scn.max_steps)
+        hook_muts = frozenset({mutation}) if mutation else frozenset()
+        hook = RingHook(sched, hook_muts)
+        env = Env(sched, hook, mutation)
+        R._MC = hook
+        try:
+            scn.build(env, mutation)
+        except BaseException:
+            R._MC = None
+            raise
+
+        def finalize():
+            R._MC = None
+
+        return sched, finalize
+
+    return make
+
+
+def explore_scenario(
+    name: str,
+    mutation: str | None = None,
+    mode: str = "dpor",
+    max_schedules: int | None = None,
+    preemption_bound: int | None = None,
+    max_steps: int | None = None,
+    rng_seed: int = 0,
+    max_violations: int = 4,
+) -> ExploreResult:
+    scn = SCENARIOS[name]
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r}")
+    cfg = ExploreConfig(
+        mode=mode,
+        max_schedules=max_schedules or scn.tier1_schedules,
+        max_steps=max_steps or scn.max_steps,
+        preemption_bound=(
+            scn.preemption_bound if preemption_bound is None else preemption_bound
+        ),
+        rng_seed=rng_seed,
+        max_violations=max_violations,
+    )
+    return Explorer(name, mutation, _make_execution(scn, mutation), cfg).explore()
+
+
+def replay(seed: str, max_steps: int | None = None):
+    """Deterministically re-run one captured schedule.  Returns
+    (scenario, mutation, Outcome)."""
+    name, mutation, choices = decode_seed(seed)
+    if name not in SCENARIOS:
+        raise ValueError(f"seed names unknown scenario {name!r}")
+    scn = SCENARIOS[name]
+    make = _make_execution(scn, mutation)
+    sched, finalize = make()
+    if max_steps:
+        sched.max_steps = max_steps
+    try:
+        out = sched.run(forced_chooser(choices))
+    finally:
+        finalize()
+    return name, mutation, out
+
+
+def minimize_seed(seed: str, rule: str, max_rounds: int = 2) -> str:
+    """Best-effort counterexample minimization: flatten context switches
+    while the violation persists (analysis/dpor.py minimize)."""
+    from .dpor import minimize
+    from .sched import encode_seed
+
+    name, mut, choices = decode_seed(seed)
+
+    def run_forced(ch):
+        _, _, out = replay(encode_seed(name, mut, ch))
+        return out
+
+    best = minimize(run_forced, choices, rule, max_rounds=max_rounds)
+    return encode_seed(name, mut, best)
+
+
+def run_suite(
+    tier: str = "tier1",
+    scenarios: list[str] | None = None,
+    mutation: str | None = None,
+    mode: str = "dpor",
+    rng_seed: int = 0,
+    max_schedules: int | None = None,
+    preemption_bound: int | None = None,
+    max_steps: int | None = None,
+) -> engine.Report:
+    """Explore scenarios at the given budget tier; aggregate violations
+    as fdtlint-style findings (engine.Report JSON shape).  Explicit
+    max_schedules/preemption_bound/max_steps override the tier's
+    per-scenario budgets (the CLI's --budget/--preemptions/--max-steps;
+    preemption_bound=0 is a valid CHESS bound, so None means unset)."""
+    rep = engine.Report()
+    names = scenarios or list(SCENARIOS)
+    total_scheds = 0
+    states = 0
+    per: dict[str, dict] = {}
+    for name in names:
+        scn = SCENARIOS[name]
+        slow = tier == "slow"
+        budget = max_schedules if max_schedules is not None else (
+            scn.slow_schedules if slow else scn.tier1_schedules
+        )
+        bound = preemption_bound if preemption_bound is not None else (
+            scn.slow_preemption_bound if slow else scn.preemption_bound
+        )
+        res = explore_scenario(
+            name,
+            mutation=mutation,
+            mode=mode,
+            max_schedules=budget,
+            preemption_bound=bound,
+            max_steps=max_steps,
+            rng_seed=rng_seed,
+        )
+        if slow and mode == "dpor":
+            # widen with seeded random walks: distinct schedules beyond
+            # the bounded-DPOR tree (counted separately, same invariants)
+            extra = explore_scenario(
+                name,
+                mutation=mutation,
+                mode="random",
+                max_schedules=max(budget // 2, 200),
+                preemption_bound=None,
+                max_steps=max_steps,
+                rng_seed=rng_seed + 1,
+            )
+            res.schedules += extra.schedules
+            res.states |= extra.states
+            res.violations += extra.violations
+        total_scheds += res.schedules
+        states += len(res.states)
+        per[name] = {
+            "schedules": res.schedules,
+            "pruned": res.pruned,
+            "distinct_states": len(res.states),
+            "violations": len(res.violations),
+        }
+        for v in res.violations[:4]:
+            try:
+                seed = minimize_seed(v.seed, v.rule)
+            except Exception:  # noqa: BLE001 - minimization is best-effort
+                seed = v.seed
+            rep.findings.append(finding_for(name, v.rule, v.msg, seed))
+    rep.coverage["fdtmc"] = {
+        "tier": tier,
+        "mode": mode,
+        "mutation": mutation,
+        "overrides": {
+            k: v
+            for k, v in [
+                ("max_schedules", max_schedules),
+                ("preemption_bound", preemption_bound),
+                ("max_steps", max_steps),
+            ]
+            if v is not None
+        },
+        "scenarios": per,
+        "schedules": total_scheds,
+        "distinct_states": states,
+    }
+    rep.findings.sort()
+    return rep

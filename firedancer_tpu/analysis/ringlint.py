@@ -20,6 +20,13 @@ over the tile layer (tiles/, disco/):
   ring-credit          direct mcache publishes must be gated on credits
                        (cr_avail / ctx.credits) so reliable consumers are
                        never lapped.
+  ring-mc-hook         every native shared-memory ring op (the
+                       fdt_{mcache,dcache,fseq,fctl} runtime surface)
+                       must sit under a `_MC is not None` model-checker
+                       guard, so no shared access can hide from fdtmc's
+                       scheduler (analysis/sched.py).  Applies to
+                       tango/rings.py (wired in engine.run_repo) and any
+                       file calling those natives directly.
 
 Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
 `*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
@@ -188,7 +195,87 @@ class _FunctionChecker:
         return self.findings
 
 
-def check_file(path: Path, rel: Path | None = None) -> list[Finding]:
+#: native entry points that touch shared ring memory at runtime — the
+#: surface fdtmc's scheduler must fully mediate.  Geometry/constructor
+#: calls (footprint/align/new/depth/seq0/compact_next/chunk_cnt) run
+#: before any concurrency and are exempt.
+MC_HOOKED_NATIVES = {
+    "fdt_mcache_seq_query",
+    "fdt_mcache_seq_advance",
+    "fdt_mcache_publish",
+    "fdt_mcache_publish_batch",
+    "fdt_mcache_poll",
+    "fdt_mcache_drain",
+    "fdt_dcache_scatter",
+    "fdt_dcache_gather",
+    "fdt_fseq_query",
+    "fdt_fseq_update",
+    "fdt_fseq_diag_query",
+    "fdt_fseq_diag_add",
+    "fdt_fctl_cr_avail",
+}
+
+
+def _is_mc_guard(node: ast.stmt) -> bool:
+    """Matches `if _MC is not None: ...` (the model-checker hook gate)."""
+    return (
+        isinstance(node, ast.If)
+        and isinstance(node.test, ast.Compare)
+        and isinstance(node.test.left, ast.Name)
+        and node.test.left.id == "_MC"
+        and len(node.test.ops) == 1
+        and isinstance(node.test.ops[0], ast.IsNot)
+    )
+
+
+def _check_mc_hooks(path: str, tree: ast.AST) -> tuple[list[Finding], int]:
+    """ring-mc-hook: every runtime ring native call must be preceded, in
+    the same function, by the `_MC is not None` guard.  Returns findings
+    + the number of correctly guarded functions (engine coverage)."""
+    findings: list[Finding] = []
+    guarded = 0
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        native_calls = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in MC_HOOKED_NATIVES
+        ]
+        if not native_calls:
+            continue
+        guard_lines = [s.lineno for s in ast.walk(fn) if _is_mc_guard(s)]
+        ok = True
+        for call in native_calls:
+            if not any(g < call.lineno for g in guard_lines):
+                ok = False
+                findings.append(
+                    Finding(
+                        path, call.lineno, "ring-mc-hook",
+                        f"native ring op {call.func.attr} reached without a "
+                        "preceding `_MC is not None` model-checker guard — "
+                        "this shared-memory access hides from fdtmc's "
+                        "scheduler (analysis/sched.py)",
+                    )
+                )
+        if ok:
+            guarded += 1
+    return findings, guarded
+
+
+def check_rings_file(path: Path, rel: Path | None = None) -> tuple[list[Finding], int]:
+    """check_file plus the guarded ring-op function count (engine's
+    mc-hook coverage metric), from a single parse."""
+    counter: list[int] = []
+    findings = check_file(path, rel, _mc_count_out=counter)
+    return findings, counter[0]
+
+
+def check_file(
+    path: Path, rel: Path | None = None, _mc_count_out: list | None = None
+) -> list[Finding]:
     text = path.read_text()
     tree = ast.parse(text, filename=str(path))
     disp = path.as_posix()
@@ -218,6 +305,9 @@ def check_file(path: Path, rel: Path | None = None) -> list[Finding]:
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr == "fdt_fseq_update"
+            # the rule bans raw calls OUTSIDE tango.rings; the canonical
+            # FSeq.update implementation is the one sanctioned call site
+            and not disp.endswith("tango/rings.py")
         ):
             findings.append(
                 Finding(
@@ -231,5 +321,11 @@ def check_file(path: Path, rel: Path | None = None) -> list[Finding]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             findings.extend(_FunctionChecker(disp, node).run())
+
+    # -- ring-mc-hook ----------------------------------------------------
+    mc_findings, mc_guarded = _check_mc_hooks(disp, tree)
+    findings.extend(mc_findings)
+    if _mc_count_out is not None:
+        _mc_count_out.append(mc_guarded)
 
     return apply_pragmas(sorted(set(findings)), text.splitlines())

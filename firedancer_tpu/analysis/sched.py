@@ -1,0 +1,873 @@
+"""fdtmc cooperative scheduler + ring-protocol instrumentation.
+
+The model checker runs the REAL tango ring protocol — the same numpy/
+shared-memory buffers, layouts, and algorithms the native layer uses —
+under a deterministic cooperative scheduler.  `tango.rings` routes every
+shared-memory operation through the `_MC` hook when one is installed;
+the hook here decomposes each operation into its C11-access micro-steps
+(fdt_tango.c is the spec: publish = invalidate line seq / write body /
+write line seq / advance seq_prod; poll = read seq / speculative copy /
+re-check seq) and parks the calling task at a yield point BEFORE each
+shared access.  Only one task thread ever runs at a time, so each
+micro-step is atomic and an execution is fully determined by the
+sequence of scheduling choices — which is what makes schedules
+capturable, enumerable (analysis/dpor.py) and replayable from a seed
+string (scripts/fdtmc.py --replay).
+
+Layout fidelity is asserted, not assumed: every shadow accessor
+cross-checks itself against the native getters at attach time, and
+tests/test_fdtmc.py runs a differential test (same op sequence native vs
+shadow → byte-identical buffers).
+
+Mutations: the known-bad corpus (tests/fixtures/mc_corpus/) activates
+named protocol faults here (skip the invalidate step, skip poll's
+re-check, leak credits, ...) to prove the checker actually catches the
+bug class each invariant encodes.  Shipped code never sets them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+from firedancer_tpu.tango import rings
+from firedancer_tpu.tango.rings import (
+    CHUNK_SZ,
+    FRAG_DTYPE,
+    seq_diff,
+    seq_u64,
+)
+
+# ---------------------------------------------------------------------------
+# protocol mutations the mc_corpus may activate
+
+MUTATIONS = frozenset(
+    {
+        # producer publishes frag meta before writing the payload bytes
+        # (scenario-level: the producer task flips its write/publish order)
+        "publish-before-write",
+        # publish skips the line-seq invalidation step (consumers can
+        # validate a torn copy against the OLD seq during an overrun)
+        "publish-no-invalidate",
+        # poll skips the post-copy seq re-check (torn reads validated)
+        "poll-no-recheck",
+        # cr_avail always reports full credit (producer overruns reliable
+        # consumers)
+        "credit-leak",
+        # every 3rd fseq.update publishes seq-2 (non-monotone backchannel)
+        "fseq-nonmonotone",
+        # drain's overrun resync does not count the skipped frags
+        "drain-uncounted",
+        # drain's overrun resync uses the pre-PR-3 clamp-to-zero formula
+        # (wrong at seq wrap-around)
+        "drain-resync-zero",
+        # consumer_rejoin uses the pre-PR-3 plain-int min/max arithmetic
+        # (wrong at seq wrap-around; scenario-level)
+        "rejoin-no-wrap",
+        # producer_rejoin returns seq_query blindly (pre-PR-3), re-publishing
+        # a line a crashed publish had already made live (scenario-level)
+        "rejoin-blind-producer",
+    }
+)
+
+
+class McViolation(Exception):
+    """An invariant violation (rule slug + message) found on a schedule."""
+
+    def __init__(self, rule: str, msg: str):
+        super().__init__(f"[{rule}] {msg}")
+        self.rule = rule
+        self.msg = msg
+
+
+class ReplayDivergence(Exception):
+    """A forced schedule choice named a task that cannot run — the seed
+    does not belong to this scenario/mutation/code revision."""
+
+
+class _Killed(BaseException):
+    """Unwinds a task thread on crash injection / teardown.  BaseException
+    so scenario-level `except Exception` cannot swallow it."""
+
+
+class SchedulerAbort(Exception):
+    """Raised by an exploration chooser to abandon a redundant execution
+    (sleep-set pruning): the run stops immediately and is not analyzed."""
+
+
+class Op(NamedTuple):
+    """One pending shared-memory access (the unit of interleaving)."""
+
+    kind: str  # e.g. "mc.pub.seq" — for traces
+    obj: str  # shared-object label ("mc0", "fs1", ...); "" = local-only
+    loc: tuple  # location within the object; ("chunk", start, cnt) is a range
+    write: bool
+
+    def __str__(self) -> str:
+        return f"{self.kind}@{self.obj}{self.loc}{'!' if self.write else ''}"
+
+
+def locs_overlap(a: tuple, b: tuple) -> bool:
+    if not a or not b or a[0] != b[0]:
+        return False
+    if a[0] == "chunk":
+        return a[1] < b[1] + b[2] and b[1] < a[1] + a[2]
+    return a == b
+
+
+def ops_dependent(a: Op | None, b: Op | None) -> bool:
+    """Conservative dependence: same object+location with a write involved.
+    A `wait` pseudo-op (blocked task) depends on every write to an object
+    it watches — wakes are scheduling-relevant."""
+    if a is None or b is None:
+        return False
+    if a.kind == "wait" or b.kind == "wait":
+        w, o = (a, b) if a.kind == "wait" else (b, a)
+        return o.write and o.obj in w.loc
+    if a.obj == "*" or b.obj == "*":
+        # wildcard ops (crash injection points) conflict with everything,
+        # so DPOR explores placing them at every position
+        return True
+    return a.obj == b.obj and (a.write or b.write) and locs_overlap(a.loc, b.loc)
+
+
+# ---------------------------------------------------------------------------
+# tasks
+
+NEW, RUNNABLE, BLOCKED, DONE, KILLED = "new", "runnable", "blocked", "done", "killed"
+
+
+def _handoff_lock() -> threading.Lock:
+    """A pre-acquired Lock used as a binary handoff semaphore: the
+    scheduler<->task protocol is strict ping-pong, and a raw Lock's
+    C-level acquire/release is ~10x cheaper than threading.Semaphore's
+    Condition machinery — the dominant cost of a schedule execution."""
+    lk = threading.Lock()
+    lk.acquire()
+    return lk
+
+
+class Task:
+    def __init__(self, index: int, name: str, fn: Callable[[], None]):
+        self.index = index
+        self.name = name
+        self.fn = fn
+        self.sem = _handoff_lock()
+        self.state = NEW
+        self.pending: Op | None = None  # op performed when next scheduled
+        self.block_pred: Callable[[], bool] | None = None
+        self.kill = False
+        self.error: BaseException | None = None
+        self.steps = 0
+        self.thread: threading.Thread | None = None
+
+
+@dataclass
+class Outcome:
+    """One execution's result."""
+
+    violation: McViolation | None = None
+    error: BaseException | None = None  # internal (non-violation) failure
+    choices: list = field(default_factory=list)  # executed task indices
+    steps: int = 0
+    trace: list = field(default_factory=list)  # (task_name, op_str) pairs
+    ops: list = field(default_factory=list)  # (task_index, Op|None) per step
+    state_hashes: list = field(default_factory=list)
+    deadlocked: bool = False
+    aborted: bool = False  # pruned by the explorer, not a real completion
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None and self.error is None
+
+
+class Scheduler:
+    """Deterministic cooperative scheduler: exactly one task thread runs
+    at any moment.  Scheduling decisions run INLINE on the active task
+    thread at every transition boundary (baton passing) — choosing the
+    same task again (the common case under the fewest-switches default)
+    costs zero OS context switches; only an actual task switch pays the
+    lock handoff.  The driver thread (run()) just starts the first
+    transition and sleeps until the execution ends."""
+
+    def __init__(self, max_steps: int = 4000, hash_states: bool = True):
+        self.max_steps = max_steps
+        self.hash_states = hash_states
+        self.tasks: list[Task] = []
+        self.current: Task | None = None
+        self.prev_choice: int | None = None
+        self._main_sem = _handoff_lock()
+        self._reap_sem = _handoff_lock()
+        self.outcome = Outcome()
+        self._hash_bufs: list[tuple[str, np.ndarray]] = []
+        self.monitors: list = []
+        self._choose: Callable | None = None
+        self._started = False
+        self._finished = False
+
+    # ---- task management ------------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], None]) -> Task:
+        t = Task(len(self.tasks), name, fn)
+        t.thread = threading.Thread(
+            target=self._thread_main, args=(t,), name=f"mc:{name}", daemon=True
+        )
+        t.state = RUNNABLE
+        self.tasks.append(t)
+        t.thread.start()
+        return t
+
+    def _thread_main(self, t: Task) -> None:
+        t.sem.acquire()  # first scheduling
+        err: BaseException | None = None
+        try:
+            if not t.kill:
+                t.fn()
+        except _Killed:
+            pass
+        except BaseException as e:  # noqa: BLE001 - routed to the outcome
+            err = e
+        t.pending = None
+        if t.kill:
+            t.state = KILLED
+            self._reap_sem.release()
+            return
+        t.state = DONE
+        if err is not None:
+            t.error = err
+            if isinstance(err, McViolation):
+                self._end(violation=err)
+            else:
+                self._end(error=err)
+            return
+        # completed normally: this thread makes the next scheduling move
+        nxt = self._advance()
+        if nxt is not None:
+            self.current = nxt
+            nxt.sem.release()
+
+    def kill(self, t: Task) -> None:
+        """Crash a PARKED task: its thread unwinds at the yield point it is
+        blocked on, without performing its pending op — shared memory is
+        left exactly as the dead incarnation's last completed micro-step
+        left it (the crash-mid-protocol model restarts must survive)."""
+        if t.state in (DONE, KILLED):
+            return
+        assert t is not self.current, "a task cannot kill itself"
+        t.kill = True
+        t.sem.release()
+        self._reap_sem.acquire()
+
+    # ---- transition boundary (runs on whichever thread is active) -------
+
+    def _end(self, violation=None, error=None, aborted=False) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        out = self.outcome
+        if violation is not None and out.violation is None:
+            out.violation = violation
+        if error is not None:
+            out.error = error
+        out.aborted = aborted
+        self._main_sem.release()  # wake the driver
+
+    def _advance(self) -> Task | None:
+        """Close the just-finished transition, pick and account the next
+        one.  Returns the task to run next, or None when the execution is
+        over (the caller must then park or exit)."""
+        out = self.outcome
+        if self._finished:
+            return None
+        if self._started:
+            out.steps += 1
+            if self.hash_states:
+                out.state_hashes.append(self.state_hash())
+        for t in self.tasks:
+            if t.state == BLOCKED and t.block_pred():
+                t.state = RUNNABLE
+        live = [t for t in self.tasks if t.state not in (DONE, KILLED)]
+        if not live:
+            self._end()
+            return None
+        runnable = [t for t in live if t.state == RUNNABLE]
+        if not runnable:
+            out.deadlocked = True
+            self._end(
+                violation=McViolation(
+                    "mc-deadlock",
+                    "no runnable task but "
+                    + ", ".join(f"{t.name} blocked" for t in live)
+                    + f" after {out.steps} steps",
+                )
+            )
+            return None
+        if out.steps >= self.max_steps:
+            self._end(
+                violation=McViolation(
+                    "mc-livelock",
+                    f"execution exceeded {self.max_steps} steps without "
+                    f"terminating (tasks: "
+                    + ", ".join(f"{t.name}={t.state}" for t in live)
+                    + ")",
+                )
+            )
+            return None
+        try:
+            nxt = self._choose(self, runnable)
+        except SchedulerAbort:
+            self._end(aborted=True)
+            return None
+        except ReplayDivergence as e:
+            self._end(error=e)
+            return None
+        out.choices.append(nxt.index)
+        out.ops.append((nxt.index, nxt.pending))
+        out.trace.append(
+            (nxt.name, str(nxt.pending) if nxt.pending is not None else "<run>")
+        )
+        self.prev_choice = nxt.index
+        nxt.steps += 1
+        self._started = True
+        return nxt
+
+    # ---- yield protocol (called on task threads) ------------------------
+
+    def yield_op(self, op: Op) -> None:
+        """Transition boundary before a shared-memory access: the calling
+        task performs `op` atomically after this returns."""
+        t = self.current
+        assert t is not None, "yield outside a scheduled task"
+        t.pending = op
+        nxt = self._advance()
+        if nxt is t:
+            t.pending = None
+            return  # continue on this thread: no context switch
+        if nxt is not None:
+            self.current = nxt
+            nxt.sem.release()
+        t.sem.acquire()  # parked until scheduled again (or teardown-killed)
+        if t.kill:
+            raise _Killed()
+        t.pending = None
+
+    def wait_for(self, pred: Callable[[], bool], watch: tuple[str, ...]) -> None:
+        """Block the calling task until pred() holds.  pred reads shared
+        state RAW (no hooks) and must be a pure scheduling hint — the task
+        must re-read anything it acts on through hooked ops."""
+        t = self.current
+        assert t is not None
+        while not pred():
+            t.block_pred = pred
+            t.state = BLOCKED
+            t.pending = Op("wait", "", watch, False)
+            nxt = self._advance()
+            if nxt is not None:
+                self.current = nxt
+                nxt.sem.release()
+            t.sem.acquire()
+            if t.kill:
+                raise _Killed()
+        t.block_pred = None
+        t.pending = None
+
+    def notify(self, ev: dict) -> None:
+        """Report a completed protocol event to the invariant monitors
+        (runs on the task thread, inside the transition)."""
+        ev["task"] = self.current.name if self.current else "<setup>"
+        for m in self.monitors:
+            m.on_op(ev)
+
+    # ---- state hashing --------------------------------------------------
+
+    def register_buffer(self, label: str, mem: np.ndarray) -> None:
+        self._hash_bufs.append((label, mem))
+
+    def state_hash(self) -> bytes:
+        h = hashlib.blake2b(digest_size=12)
+        for label, mem in self._hash_bufs:
+            h.update(label.encode())
+            h.update(mem.tobytes())
+        for t in self.tasks:
+            h.update(f"{t.name}:{t.state}:{t.steps}".encode())
+        return h.digest()
+
+    # ---- driver ----------------------------------------------------------
+
+    def run(self, choose: Callable[["Scheduler", list[Task]], Task]) -> Outcome:
+        self._choose = choose
+        out = self.outcome
+        nxt = self._advance()
+        if nxt is not None:
+            self.current = nxt
+            nxt.sem.release()
+            self._main_sem.acquire()  # until _end fires
+        self._teardown()
+        if isinstance(out.error, ReplayDivergence):
+            raise out.error
+        if out.ok and not out.aborted:
+            # end-of-execution invariants only hold for completed runs
+            for m in self.monitors:
+                try:
+                    m.on_end(self)
+                except McViolation as v:
+                    out.violation = v
+                    break
+        return out
+
+    def _teardown(self) -> None:
+        for t in self.tasks:
+            if t.state not in (DONE, KILLED):
+                t.kill = True
+                t.sem.release()
+                self._reap_sem.acquire()
+
+
+# ---------------------------------------------------------------------------
+# shadow accessors: the native object layouts, viewed from Python
+#
+# Offsets mirror fdt_tango.c's structs; every attach cross-checks itself
+# against the native getters so a C-side layout change fails loudly here.
+
+_MC_HDR = 128  # sizeof(fdt_mcache_hdr_t)
+_MC_SEQ_PROD_OFF = 64
+_MC_SEQ0_OFF = 16
+_FS_SEQ_OFF = 0
+_FS_DIAG_OFF = 64
+
+
+class _McShadow:
+    def __init__(self, mc, label: str):
+        self.label = label
+        self.depth = mc.depth
+        self.mem = mc.mem
+        self.seq_prod = mc.mem[_MC_SEQ_PROD_OFF : _MC_SEQ_PROD_OFF + 8].view("<u8")
+        self.lines = mc.mem[_MC_HDR : _MC_HDR + mc.depth * 32].view(FRAG_DTYPE)
+        seq0_v = int(mc.mem[_MC_SEQ0_OFF : _MC_SEQ0_OFF + 8].view("<u8")[0])
+        assert seq0_v == mc.seq0_query(), "mcache shadow layout drift (seq0)"
+        assert int(self.seq_prod[0]) == rings._lib.fdt_mcache_seq_query(
+            rings._ptr(mc.mem)
+        ), "mcache shadow layout drift (seq_prod)"
+
+
+class _FsShadow:
+    def __init__(self, fs, label: str):
+        self.label = label
+        self.mem = fs.mem
+        self.seq = fs.mem[_FS_SEQ_OFF : _FS_SEQ_OFF + 8].view("<u8")
+        self.diag = fs.mem[_FS_DIAG_OFF : _FS_DIAG_OFF + 64].view("<u8")
+        self.update_cnt = 0  # drives the fseq-nonmonotone mutation
+        assert int(self.seq[0]) == rings._lib.fdt_fseq_query(
+            rings._ptr(fs.mem)
+        ), "fseq shadow layout drift"
+
+
+class _DcShadow:
+    def __init__(self, dc, label: str):
+        self.label = label
+        self.mem = dc.mem
+
+
+# ---------------------------------------------------------------------------
+# the rings._MC hook
+
+class RingHook:
+    """Intercepts tango.rings shared-memory ops, decomposing each into its
+    micro-steps under the scheduler.  Ops invoked outside any scheduled
+    task (scenario setup on the main thread) pass through to native."""
+
+    def __init__(self, sched: Scheduler, mutations: frozenset[str] = frozenset()):
+        unknown = set(mutations) - MUTATIONS
+        if unknown:
+            raise ValueError(f"unknown mutations: {sorted(unknown)}")
+        self.sched = sched
+        self.mutations = frozenset(mutations)
+        self._mc_shadows: dict[int, _McShadow] = {}
+        self._fs_shadows: dict[int, _FsShadow] = {}
+        self._dc_shadows: dict[int, _DcShadow] = {}
+
+    # ---- object registry ------------------------------------------------
+
+    def _mc(self, mc) -> _McShadow:
+        sh = self._mc_shadows.get(id(mc))
+        if sh is None:
+            sh = _McShadow(mc, f"mc{len(self._mc_shadows)}")
+            self._mc_shadows[id(mc)] = sh
+            self.sched.register_buffer(sh.label, mc.mem)
+        return sh
+
+    def _fs(self, fs) -> _FsShadow:
+        sh = self._fs_shadows.get(id(fs))
+        if sh is None:
+            sh = _FsShadow(fs, f"fs{len(self._fs_shadows)}")
+            self._fs_shadows[id(fs)] = sh
+            self.sched.register_buffer(sh.label, fs.mem)
+        return sh
+
+    def _dc(self, dc) -> _DcShadow:
+        sh = self._dc_shadows.get(id(dc))
+        if sh is None:
+            sh = _DcShadow(dc, f"dc{len(self._dc_shadows)}")
+            self._dc_shadows[id(dc)] = sh
+            self.sched.register_buffer(sh.label, dc.mem)
+        return sh
+
+    def label_of(self, obj) -> str:
+        """Stable trace label for a ring object (attaches it if new)."""
+        import firedancer_tpu.tango.rings as R
+
+        if isinstance(obj, R.MCache):
+            return self._mc(obj).label
+        if isinstance(obj, R.FSeq):
+            return self._fs(obj).label
+        if isinstance(obj, R.DCache):
+            return self._dc(obj).label
+        raise TypeError(type(obj))
+
+    # ---- plumbing -------------------------------------------------------
+
+    def _native(self, fn, *args, **kw):
+        prev, rings._MC = rings._MC, None
+        try:
+            return fn(*args, **kw)
+        finally:
+            rings._MC = prev
+
+    def _scheduled(self) -> bool:
+        return self.sched.current is not None
+
+    def _y(self, kind: str, obj: str, loc: tuple, write: bool) -> None:
+        self.sched.yield_op(Op(kind, obj, loc, write))
+
+    # ---- mcache ---------------------------------------------------------
+
+    def mcache_seq_query(self, mc) -> int:
+        if not self._scheduled():
+            return self._native(mc.seq_query)
+        sh = self._mc(mc)
+        self._y("mc.seq_query", sh.label, ("seq_prod",), False)
+        return int(sh.seq_prod[0])
+
+    def mcache_seq_advance(self, mc, seq) -> None:
+        if not self._scheduled():
+            return self._native(mc.seq_advance, seq)
+        sh = self._mc(mc)
+        self._y("mc.seq_advance", sh.label, ("seq_prod",), True)
+        sh.seq_prod[0] = seq_u64(seq)
+        self.sched.notify(
+            {"ev": "seq_advance", "mc": sh.label, "seq": seq_u64(seq)}
+        )
+
+    def mcache_publish(self, mc, seq, sig, chunk, sz, ctl, tsorig, tspub) -> None:
+        if not self._scheduled():
+            return self._native(mc.publish, seq, sig, chunk, sz, ctl, tsorig, tspub)
+        sh = self._mc(mc)
+        seq = seq_u64(seq)
+        i = seq & (sh.depth - 1)
+        line = sh.lines[i : i + 1]
+        if "publish-no-invalidate" not in self.mutations:
+            self._y("mc.pub.invalidate", sh.label, ("line", i), True)
+            line["seq"] = seq_u64(seq - 1)
+        self._y("mc.pub.body1", sh.label, ("line", i), True)
+        line["sig"] = sig
+        line["chunk"] = chunk
+        self._y("mc.pub.body2", sh.label, ("line", i), True)
+        line["sz"] = sz
+        line["ctl"] = ctl
+        line["tsorig"] = tsorig
+        line["tspub"] = tspub
+        self._y("mc.pub.seq", sh.label, ("line", i), True)
+        line["seq"] = seq
+        self._y("mc.pub.seq_prod", sh.label, ("seq_prod",), True)
+        sh.seq_prod[0] = seq_u64(seq + 1)
+        self.sched.notify({"ev": "publish", "mc": sh.label, "seq": seq, "sig": sig})
+
+    def mcache_publish_batch(self, mc, seq0, sigs, chunks, szs, ctls, tspub, tsorigs):
+        if not self._scheduled():
+            return self._native(
+                mc.publish_batch, seq0, sigs, chunks, szs, ctls, tspub, tsorigs
+            )
+        n = len(sigs)
+        for k in range(n):
+            self.mcache_publish(
+                mc,
+                seq_u64(seq0 + k),
+                int(sigs[k]),
+                int(chunks[k]) if chunks is not None else 0,
+                int(szs[k]) if szs is not None else 0,
+                int(ctls[k]) if ctls is not None else rings.CTL_SOM | rings.CTL_EOM,
+                int(tsorigs[k]) if tsorigs is not None else tspub,
+                tspub,
+            )
+        return seq_u64(seq0 + n)
+
+    def mcache_poll(self, mc, seq_expect):
+        if not self._scheduled():
+            return self._native(mc.poll, seq_expect)
+        sh = self._mc(mc)
+        seq_expect = seq_u64(seq_expect)
+        i = seq_expect & (sh.depth - 1)
+        line = sh.lines[i]
+        self._y("mc.poll.seq1", sh.label, ("line", i), False)
+        seq_found = int(line["seq"])
+        if seq_found != seq_expect:
+            rc = -1 if seq_diff(seq_found, seq_expect) < 0 else 1
+            self.sched.notify(
+                {"ev": "poll_miss", "mc": sh.label, "seq": seq_expect, "rc": rc}
+            )
+            return rc, None, seq_found
+        out = np.zeros(1, dtype=FRAG_DTYPE)
+        self._y("mc.poll.body1", sh.label, ("line", i), False)
+        out["sig"] = line["sig"]
+        out["chunk"] = line["chunk"]
+        self._y("mc.poll.body2", sh.label, ("line", i), False)
+        out["sz"] = line["sz"]
+        out["ctl"] = line["ctl"]
+        out["tsorig"] = line["tsorig"]
+        out["tspub"] = line["tspub"]
+        if "poll-no-recheck" not in self.mutations:
+            self._y("mc.poll.seq2", sh.label, ("line", i), False)
+            seq_check = int(line["seq"])
+            if seq_check != seq_expect:
+                self.sched.notify(
+                    {"ev": "poll_torn", "mc": sh.label, "seq": seq_expect}
+                )
+                return 1, None, seq_check
+        out["seq"] = seq_expect
+        self.sched.notify(
+            {
+                "ev": "poll_ok",
+                "mc": sh.label,
+                "seq": seq_expect,
+                "sig": int(out["sig"][0]),
+            }
+        )
+        # native wrapper leaves seq_now at 0 on success — match it
+        return 0, out[0], 0
+
+    def mcache_drain(self, mc, seq, max_frags):
+        if not self._scheduled():
+            return self._native(mc.drain, seq, max_frags)
+        sh = self._mc(mc)
+        out = np.zeros(max_frags, dtype=FRAG_DTYPE)
+        seq = seq_u64(seq)
+        n = 0
+        ovr = 0
+        while n < max_frags:
+            rc, frag, _seq_now = self.mcache_poll(mc, seq)
+            if rc == 0:
+                out[n] = frag
+                n += 1
+                seq = seq_u64(seq + 1)
+                continue
+            if rc < 0:
+                break
+            # overrun resync (mirrors the fixed fdt_mcache_drain loop)
+            self._y("mc.drain.seq_prod", sh.label, ("seq_prod",), False)
+            seq_prod = int(sh.seq_prod[0])
+            if "drain-resync-zero" in self.mutations:
+                seq_new = seq_prod - sh.depth if seq_prod > sh.depth else 0
+            else:
+                seq_new = seq_u64(seq_prod - sh.depth)
+            if seq_diff(seq_new, seq) <= 0:
+                seq_new = seq_u64(seq + 1)
+            skipped = seq_u64(seq_new - seq)
+            if "drain-uncounted" not in self.mutations:
+                ovr += skipped
+            self.sched.notify(
+                {
+                    "ev": "drain_overrun",
+                    "mc": sh.label,
+                    "skipped": skipped,
+                    "seq_old": seq,
+                    "seq_new": seq_new,
+                    "seq_prod": seq_prod,
+                    "depth": sh.depth,
+                }
+            )
+            seq = seq_new
+        return out[:n], seq, ovr
+
+    # ---- dcache ---------------------------------------------------------
+
+    def dcache_write(self, dc, payload) -> int:
+        if not self._scheduled():
+            return self._native(dc.write, payload)
+        sh = self._dc(dc)
+        sz = len(payload)
+        c = dc.chunk
+        cnt = (sz + CHUNK_SZ - 1) // CHUNK_SZ
+        off = c * CHUNK_SZ
+        half = max(sz // 2, 1) if sz else 0
+        self._y("dc.write1", sh.label, ("chunk", c, cnt), True)
+        dc.mem[off : off + half] = payload[:half]
+        self._y("dc.write2", sh.label, ("chunk", c, cnt), True)
+        dc.mem[off + half : off + sz] = payload[half:sz]
+        # cursor advance is producer-local state, not a shared access
+        dc.chunk = rings._lib.fdt_dcache_compact_next(
+            c, sz, dc.mtu, dc.wmark_chunks
+        )
+        self.sched.notify({"ev": "dcache_write", "dc": sh.label, "chunk": c, "sz": sz})
+        return c
+
+    def dcache_read(self, dc, chunk, sz):
+        if not self._scheduled():
+            return self._native(dc.read, chunk, sz)
+        sh = self._dc(dc)
+        cnt = (sz + CHUNK_SZ - 1) // CHUNK_SZ
+        off = chunk * CHUNK_SZ
+        out = np.empty(sz, dtype=np.uint8)
+        half = max(sz // 2, 1) if sz else 0
+        self._y("dc.read1", sh.label, ("chunk", chunk, cnt), False)
+        out[:half] = dc.mem[off : off + half]
+        self._y("dc.read2", sh.label, ("chunk", chunk, cnt), False)
+        out[half:sz] = dc.mem[off + half : off + sz]
+        return out
+
+    def dcache_write_batch(self, dc, rows, szs):
+        if not self._scheduled():
+            return self._native(dc.write_batch, rows, szs)
+        n, width = rows.shape
+        if len(szs) and int(szs.max()) > min(dc.mtu, width):
+            raise ValueError(
+                f"payload sz {int(szs.max())} exceeds "
+                f"min(dcache mtu {dc.mtu}, row width {width})"
+            )
+        out = np.empty(n, dtype=np.uint32)
+        for k in range(n):
+            out[k] = self.dcache_write(dc, rows[k, : int(szs[k])])
+        return out
+
+    def dcache_read_batch(self, dc, chunks, szs, width):
+        if not self._scheduled():
+            return self._native(dc.read_batch, chunks, szs, width)
+        n = len(chunks)
+        out = np.zeros((n, width), dtype=np.uint8)
+        for k in range(n):
+            sz = min(int(szs[k]), width)
+            out[k, :sz] = self.dcache_read(dc, int(chunks[k]), sz)
+        return out
+
+    # ---- fseq / fctl ----------------------------------------------------
+
+    def fseq_query(self, fs) -> int:
+        if not self._scheduled():
+            return self._native(fs.query)
+        sh = self._fs(fs)
+        self._y("fseq.query", sh.label, ("seq",), False)
+        return int(sh.seq[0])
+
+    def fseq_update(self, fs, seq) -> None:
+        if not self._scheduled():
+            return self._native(fs.update, seq)
+        sh = self._fs(fs)
+        val = seq_u64(seq)
+        sh.update_cnt += 1
+        if "fseq-nonmonotone" in self.mutations and sh.update_cnt % 3 == 0:
+            val = seq_u64(val - 2)
+        self._y("fseq.update", sh.label, ("seq",), True)
+        old = int(sh.seq[0])
+        sh.seq[0] = val
+        self.sched.notify(
+            {"ev": "fseq_update", "fseq": sh.label, "old": old, "new": val}
+        )
+
+    def fseq_diag(self, fs, idx) -> int:
+        if not self._scheduled():
+            return self._native(fs.diag, idx)
+        sh = self._fs(fs)
+        i = idx & 7
+        self._y("fseq.diag", sh.label, ("diag", i), False)
+        return int(sh.diag[i * 8 : i * 8 + 8].view("<u8")[0])
+
+    def fseq_diag_add(self, fs, idx, delta) -> None:
+        if not self._scheduled():
+            return self._native(fs.diag_add, idx, delta)
+        sh = self._fs(fs)
+        i = idx & 7
+        self._y("fseq.diag_add", sh.label, ("diag", i), True)
+        v = sh.diag[i * 8 : i * 8 + 8].view("<u8")
+        v[0] = seq_u64(int(v[0]) + delta)
+        self.sched.notify(
+            {"ev": "diag_add", "fseq": sh.label, "idx": i, "delta": delta}
+        )
+
+    def cr_avail(self, seq_prod, seq_cons_min, cr_max) -> int:
+        # pure function — no shared access, so no yield point; still traced
+        # (and faultable) because credit decisions gate the whole protocol
+        if "credit-leak" in self.mutations:
+            val = cr_max
+        else:
+            val = self._native(rings.cr_avail, seq_prod, seq_cons_min, cr_max)
+        if self._scheduled():
+            self.sched.notify(
+                {
+                    "ev": "cr_avail",
+                    "seq_prod": seq_u64(seq_prod),
+                    "cons_min": seq_u64(seq_cons_min),
+                    "cr": val,
+                }
+            )
+        return val
+
+
+@contextmanager
+def installed(hook: RingHook):
+    """Route tango.rings shared-memory ops through `hook` for the scope."""
+    assert rings._MC is None, "fdtmc hook already installed (no nesting)"
+    rings._MC = hook
+    try:
+        yield hook
+    finally:
+        rings._MC = None
+
+
+# ---------------------------------------------------------------------------
+# schedule seeds: deterministic capture/replay
+
+_SEED_PREFIX = "fdtmc1"
+
+
+def encode_seed(scenario: str, mutation: str | None, choices: list[int]) -> str:
+    assert all(0 <= c < 16 for c in choices), "task index exceeds seed alphabet"
+    body = "".join(f"{c:x}" for c in choices) or "-"
+    return f"{_SEED_PREFIX}.{scenario}.{mutation or 'none'}.{body}"
+
+
+def decode_seed(seed: str) -> tuple[str, str | None, list[int]]:
+    parts = seed.strip().split(".")
+    if len(parts) != 4 or parts[0] != _SEED_PREFIX:
+        raise ValueError(f"not an fdtmc seed: {seed!r}")
+    _, scenario, mutation, body = parts
+    if mutation != "none" and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation in seed: {mutation!r}")
+    choices = [] if body == "-" else [int(ch, 16) for ch in body]
+    return scenario, (None if mutation == "none" else mutation), choices
+
+
+def forced_chooser(choices: list[int]):
+    """Chooser that replays `choices` exactly, then continues with the
+    fewest-switches default policy (prefer the previously-run task)."""
+    it = iter(choices)
+
+    def choose(sched: Scheduler, runnable: list[Task]) -> Task:
+        idx = next(it, None)
+        if idx is None:
+            for t in runnable:
+                if t.index == sched.prev_choice:
+                    return t
+            return runnable[0]
+        for t in runnable:
+            if t.index == idx:
+                return t
+        raise ReplayDivergence(
+            f"seed names task {idx} at step {sched.outcome.steps} but runnable "
+            f"tasks are {[t.index for t in runnable]} — stale seed?"
+        )
+
+    return choose

@@ -69,6 +69,28 @@ class RestartPolicy:
     replay: int | dict = 0
 
 
+def rejoin_links(ins, outs, replay: int = 0, on_skip=None) -> None:
+    """Resync a restarted tile's ring endpoints: consumer seqs from the
+    published fseqs (rewound up to `replay` frags on reliable links),
+    producer cursors from the mcaches.  Shared by the supervisor's
+    crash-restart path and fdtmc's restart scenarios (analysis/
+    mcmodels.py), so the model checker exercises the exact code the
+    supervisor runs.
+
+    `ins` items need .mcache/.fseq/.reliable/.seq, `outs` items
+    .mcache/.seq (disco.mux.InLink/OutLink shaped).  `on_skip(link,
+    skipped)` observes unreliable-link jump gaps for loss accounting."""
+    for il in ins:
+        il.seq, skipped = R.consumer_rejoin(
+            il.mcache, il.fseq, reliable=il.reliable, replay=replay
+        )
+        if skipped and on_skip is not None:
+            on_skip(il, skipped)
+        il.fseq.update(il.seq)
+    for o in outs:
+        o.seq = R.producer_rejoin(o.mcache)
+
+
 class _TileState:
     def __init__(self) -> None:
         self.fail_times: collections.deque = collections.deque()
@@ -243,16 +265,12 @@ class Supervisor:
         replay = p.replay
         if isinstance(replay, dict):
             replay = replay.get(name, 0)
-        for il in ctx.ins:
-            il.seq, skipped = R.consumer_rejoin(
-                il.mcache, il.fseq, reliable=il.reliable, replay=replay
-            )
-            if skipped:
-                metrics.inc("overrun_frags", skipped)
-                il.fseq.diag_add(0, skipped)
-            il.fseq.update(il.seq)
-        for o in ctx.outs:
-            o.seq = R.producer_rejoin(o.mcache)
+
+        def _account_skip(il, skipped):
+            metrics.inc("overrun_frags", skipped)
+            il.fseq.diag_add(0, skipped)
+
+        rejoin_links(ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip)
         ts.tile.on_crash(ctx)
         ctx.interrupt.clear()
         ctx.booted = False

@@ -24,4 +24,10 @@ from firedancer_tpu.tango.rings import (  # noqa: F401
     TCache,
     Workspace,
     cr_avail,
+    seq_diff,
+    seq_le,
+    seq_lt,
+    seq_max,
+    seq_min,
+    seq_u64,
 )

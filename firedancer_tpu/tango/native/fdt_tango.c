@@ -62,10 +62,25 @@ uint64_t fdt_mcache_depth( void const * mcache ) {
   return ( (fdt_mcache_hdr_t const *)mcache )->depth;
 }
 
+uint64_t fdt_mcache_seq0( void const * mcache ) {
+  return ( (fdt_mcache_hdr_t const *)mcache )->seq0;
+}
+
 uint64_t fdt_mcache_seq_query( void const * mcache ) {
   fdt_mcache_hdr_t const * h = (fdt_mcache_hdr_t const *)mcache;
   return atomic_load_explicit( (_Atomic uint64_t *)&h->seq_prod,
                                memory_order_acquire );
+}
+
+void fdt_mcache_seq_advance( void * mcache, uint64_t seq ) {
+  /* Producer-side cursor repair (fdt_producer_rejoin): completes a
+     publish that crashed between its line-seq store and the seq_prod
+     advance.  The line already carries its final seq (consumers may have
+     consumed it), so the ONLY safe recovery is advancing the cursor past
+     it — re-publishing would invalidate a live line under a concurrent
+     consumer's speculative copy (spurious overrun on a reliable link). */
+  fdt_mcache_hdr_t * h = (fdt_mcache_hdr_t *)mcache;
+  atomic_store_explicit( &h->seq_prod, seq, memory_order_release );
 }
 
 void fdt_mcache_publish( void * mcache, uint64_t seq, uint64_t sig,
@@ -135,10 +150,15 @@ uint64_t fdt_mcache_drain( void const * mcache, uint64_t * seq_io,
     if( rc == 0 ) { n++; seq++; continue; }
     if( rc < 0 ) break; /* caught up */
     /* Overrun: resynchronize to the producer's current horizon minus the
-       ring depth (oldest frag still guaranteed live-ish), counting losses. */
+       ring depth (oldest frag still guaranteed live-ish), counting losses.
+       All seq arithmetic is mod 2^64 with signed-distance comparisons: the
+       old `seq_prod > depth ? seq_prod - depth : 0` clamp mis-resynced to
+       seq 0 when seq_prod had just wrapped past 2^64 (seq_prod numerically
+       tiny but the live window is [seq_prod - depth, seq_prod)), skipping
+       frags that were still readable. */
     uint64_t depth = fdt_mcache_depth( mcache );
     uint64_t seq_prod = fdt_mcache_seq_query( mcache );
-    uint64_t seq_new = seq_prod > depth ? seq_prod - depth : 0UL;
+    uint64_t seq_new = seq_prod - depth; /* mod-2^64 */
     if( (int64_t)( seq_new - seq ) <= 0L ) seq_new = seq + 1UL;
     if( overrun_cnt ) *overrun_cnt += seq_new - seq;
     seq = seq_new;

@@ -71,8 +71,16 @@ uint64_t fdt_mcache_footprint( uint64_t depth );
    FDT_SEQ_NULL-marked (seq = seq0 - depth, so they read as "ancient"). */
 int      fdt_mcache_new( void * mem, uint64_t depth, uint64_t seq0 );
 uint64_t fdt_mcache_depth( void const * mcache );
+/* The seq the ring was initialized at (rejoin helpers clamp to it: seqs
+   before seq0 alias the "ancient"-marked init lines and must never be
+   polled as live). */
+uint64_t fdt_mcache_seq0( void const * mcache );
 /* Producer's next-to-publish seq (monotone published watermark + 1). */
 uint64_t fdt_mcache_seq_query( void const * mcache );
+/* Restart-only cursor repair: advance seq_prod past a line a crashed
+   incarnation published without advancing the cursor.  Never rewrites
+   the line (it may be under a consumer's speculative copy). */
+void fdt_mcache_seq_advance( void * mcache, uint64_t seq );
 /* Publish one frag at seq (must be the producer's current seq; caller
    advances seq themselves).  Release-ordered. */
 void fdt_mcache_publish( void * mcache, uint64_t seq, uint64_t sig,

@@ -73,6 +73,8 @@ def _load() -> ct.CDLL:
         "fdt_mcache_footprint": (u64, [u64]),
         "fdt_mcache_new": (i32, [vp, u64, u64]),
         "fdt_mcache_depth": (u64, [vp]),
+        "fdt_mcache_seq0": (u64, [vp]),
+        "fdt_mcache_seq_advance": (None, [vp, u64]),
         "fdt_mcache_seq_query": (u64, [vp]),
         "fdt_mcache_publish": (None, [vp, u64, u64, u32, u16, u16, u32, u32]),
         "fdt_mcache_poll": (i32, [vp, u64, vp, vp]),
@@ -191,6 +193,58 @@ _lib = _load()
 
 CHUNK_SZ = 64
 CTL_SOM, CTL_EOM, CTL_ERR = 1, 2, 4
+
+# ---------------------------------------------------------------------------
+# model-checker hook
+#
+# fdtmc (analysis/sched.py) installs an interceptor here to run the ring
+# protocol under a deterministic cooperative scheduler: every method that
+# touches shared ring memory routes through `_MC` when it is set, so the
+# checker can decompose the op into its C11-access micro-steps and explore
+# interleavings.  In production `_MC` is None and the guard is a single
+# global load — a no-op on the hot path.  The ring-mc-hook lint rule
+# (analysis/ringlint.py) asserts no shared-memory native call in this file
+# hides from the scheduler by skipping the guard.
+
+_MC = None
+
+
+# ---------------------------------------------------------------------------
+# wrap-safe sequence arithmetic
+#
+# Native seqs are u64 and wrap mod 2^64; Python ints do not.  Every
+# comparison/distance on seqs host-side must go through these helpers
+# (mirroring the reference's fd_seq_lt/fd_seq_diff, fd_tango_base.h), or
+# rejoin/overrun logic silently breaks when a ring crosses 2^64.
+
+_U64_MASK = (1 << 64) - 1
+
+
+def seq_u64(x: int) -> int:
+    """Reduce to the u64 domain (mod 2^64)."""
+    return x & _U64_MASK
+
+
+def seq_diff(a: int, b: int) -> int:
+    """Signed distance a - b mod 2^64 (positive: a is after b)."""
+    d = (a - b) & _U64_MASK
+    return d - (1 << 64) if d >= (1 << 63) else d
+
+
+def seq_lt(a: int, b: int) -> bool:
+    return seq_diff(a, b) < 0
+
+
+def seq_le(a: int, b: int) -> bool:
+    return seq_diff(a, b) <= 0
+
+
+def seq_min(a: int, b: int) -> int:
+    return a if seq_le(a, b) else b
+
+
+def seq_max(a: int, b: int) -> int:
+    return a if seq_le(b, a) else b
 
 FRAG_DTYPE = np.dtype(
     {
@@ -421,8 +475,19 @@ class MCache:
     def create(cls, wksp: Workspace, name: str, depth: int, seq0: int = 0) -> "MCache":
         return cls(wksp.alloc(name, cls.footprint(depth)), depth, seq0)
 
+    def seq0_query(self) -> int:
+        return _lib.fdt_mcache_seq0(_ptr(self.mem))
+
     def seq_query(self) -> int:
+        if _MC is not None:
+            return _MC.mcache_seq_query(self)
         return _lib.fdt_mcache_seq_query(_ptr(self.mem))
+
+    def seq_advance(self, seq: int) -> None:
+        """Restart-only cursor repair — see producer_rejoin."""
+        if _MC is not None:
+            return _MC.mcache_seq_advance(self, seq)
+        _lib.fdt_mcache_seq_advance(_ptr(self.mem), seq)
 
     def publish(
         self,
@@ -434,10 +499,14 @@ class MCache:
         tsorig: int = 0,
         tspub: int = 0,
     ) -> None:
+        if _MC is not None:
+            return _MC.mcache_publish(self, seq, sig, chunk, sz, ctl, tsorig, tspub)
         _lib.fdt_mcache_publish(_ptr(self.mem), seq, sig, chunk, sz, ctl, tsorig, tspub)
 
     def poll(self, seq_expect: int):
         """Returns (rc, frag, seq_now): rc 0=ok, -1=empty, 1=overrun."""
+        if _MC is not None:
+            return _MC.mcache_poll(self, seq_expect)
         out = np.zeros(1, dtype=FRAG_DTYPE)
         seq_now = ct.c_uint64(0)
         rc = _lib.fdt_mcache_poll(
@@ -447,6 +516,8 @@ class MCache:
 
     def drain(self, seq: int, max_frags: int):
         """Batch-consume. Returns (frags ndarray, new_seq, n_overrun)."""
+        if _MC is not None:
+            return _MC.mcache_drain(self, seq, max_frags)
         out = np.zeros(max_frags, dtype=FRAG_DTYPE)
         seq_io = ct.c_uint64(seq)
         ovr = ct.c_uint64(0)
@@ -479,6 +550,10 @@ class MCache:
             None if tsorigs is None
             else np.ascontiguousarray(tsorigs, np.uint32)
         )
+        if _MC is not None:
+            return _MC.mcache_publish_batch(
+                self, seq0, sigs, chunks, szs, ctls, tspub, tsorigs
+            )
         return _lib.fdt_mcache_publish_batch(
             _ptr(self.mem),
             seq0,
@@ -518,6 +593,8 @@ class DCache:
         """Producer: copy payload in at the cursor, return its chunk idx."""
         sz = len(payload)
         assert sz <= self.mtu
+        if _MC is not None:
+            return _MC.dcache_write(self, payload)
         off = self.chunk * CHUNK_SZ
         self.mem[off : off + sz] = payload
         chunk = self.chunk
@@ -527,12 +604,16 @@ class DCache:
         return chunk
 
     def read(self, chunk: int, sz: int) -> np.ndarray:
+        if _MC is not None:
+            return _MC.dcache_read(self, chunk, sz)
         off = chunk * CHUNK_SZ
         return self.mem[off : off + sz]
 
     def read_batch(self, chunks: np.ndarray, szs: np.ndarray, width: int) -> np.ndarray:
         """Gather payloads into a dense (n, width) u8 matrix (zero-padded) —
         the shape the JAX bridge ships to the device.  One native call."""
+        if _MC is not None:
+            return _MC.dcache_read_batch(self, chunks, szs, width)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint32)
         szs = np.ascontiguousarray(szs, dtype=np.uint16)
         n = len(chunks)
@@ -554,6 +635,8 @@ class DCache:
         One native call."""
         rows = np.ascontiguousarray(rows, dtype=np.uint8)
         szs = np.ascontiguousarray(szs, dtype=np.uint16)
+        if _MC is not None:
+            return _MC.dcache_write_batch(self, rows, szs)
         n, width = rows.shape
         if len(szs) and int(szs.max()) > min(self.mtu, width):
             # a sz beyond the row width would publish a frag whose tail the
@@ -598,19 +681,32 @@ class FSeq:
         return cls(wksp.alloc(name, cls.footprint(), align=64), seq0)
 
     def query(self) -> int:
+        if _MC is not None:
+            return _MC.fseq_query(self)
         return _lib.fdt_fseq_query(_ptr(self.mem))
 
     def update(self, seq: int) -> None:
+        if _MC is not None:
+            return _MC.fseq_update(self, seq)
         _lib.fdt_fseq_update(_ptr(self.mem), seq)
 
     def diag(self, idx: int) -> int:
+        if _MC is not None:
+            return _MC.fseq_diag(self, idx)
         return _lib.fdt_fseq_diag_query(_ptr(self.mem), idx)
 
     def diag_add(self, idx: int, delta: int) -> None:
+        if _MC is not None:
+            return _MC.fseq_diag_add(self, idx, delta)
         _lib.fdt_fseq_diag_add(_ptr(self.mem), idx, delta)
 
 
 def cr_avail(seq_prod: int, seq_cons_min: int, cr_max: int) -> int:
+    # pure function of its arguments (no shared-memory access), but routed
+    # through the hook so the checker can trace credit decisions and the
+    # mutant corpus can fault them (credit-leak)
+    if _MC is not None:
+        return _MC.cr_avail(seq_prod, seq_cons_min, cr_max)
     return _lib.fdt_fctl_cr_avail(seq_prod, seq_cons_min, cr_max)
 
 
@@ -631,13 +727,20 @@ def consumer_rejoin(
 
     Unreliable links jump to the producer's head; the gap is returned as
     `skipped` for the caller to account as overrun_frags (the same
-    book-keeping an overrun during normal operation gets)."""
+    book-keeping an overrun during normal operation gets).
+
+    All arithmetic is wrap-safe mod 2^64 (fdtmc finding, PR 3): the old
+    plain-int min/max resumed a reliable consumer at the producer's
+    wrapped-to-tiny head instead of the consumer's own fseq when the ring
+    crossed 2^64 (silent frag loss on a reliable link), and the replay
+    rewind could land before the ring's seq0 where the init lines'
+    "ancient" seq marks alias real seqs and poll would validate garbage."""
     prod = mcache.seq_query()
     last = fseq.query()
     if not reliable:
-        return prod, max(prod - last, 0)
-    oldest = max(prod - mcache.depth, 0)
-    seq = max(min(last, prod) - max(replay, 0), oldest, 0)
+        return prod, max(seq_diff(prod, last), 0)
+    oldest = seq_max(seq_u64(prod - mcache.depth), mcache.seq0_query())
+    seq = seq_max(seq_u64(seq_min(last, prod) - max(replay, 0)), oldest)
     return seq, 0
 
 
@@ -645,8 +748,22 @@ def producer_rejoin(mcache: "MCache") -> int:
     """Resync point for a producer rejoining its ring after a crash: the
     mcache's own published cursor (fdt_mcache_seq_query reads the seq the
     last publish advanced to), so the new incarnation continues the
-    sequence instead of overwriting live frags from seq 0."""
-    return mcache.seq_query()
+    sequence instead of overwriting live frags from seq 0.
+
+    A crash can land BETWEEN a publish's line-seq store and its seq_prod
+    advance (fdtmc finding, PR 3: seed-replayable as a spurious reliable-
+    consumer overrun).  The line for seq_prod then already carries its
+    final seq and consumers may have consumed it — re-publishing it would
+    invalidate a live line under a concurrent consumer's speculative
+    copy.  Recovery completes the interrupted publish instead: advance
+    the cursor past every already-published line."""
+    seq = mcache.seq_query()
+    while True:
+        rc, _frag, _now = mcache.poll(seq)
+        if rc != 0:
+            return seq
+        seq = seq_u64(seq + 1)
+        mcache.seq_advance(seq)
 
 
 CNC_BOOT, CNC_RUN, CNC_HALT, CNC_FAIL = 0, 1, 2, 3

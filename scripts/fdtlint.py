@@ -7,8 +7,13 @@ Usage:
     scripts/fdtlint.py --json          # machine-readable report
     scripts/fdtlint.py PATH [PATH...]  # targeted: .py files or fixture dirs
     scripts/fdtlint.py --root DIR      # lint a repo checkout other than ./
+    scripts/fdtlint.py --baseline F    # suppress findings recorded in F
+    scripts/fdtlint.py --write-baseline F  # record current findings to F
 
-Exit status: 0 clean, 1 findings, 2 usage/internal error.
+Exit status: 0 clean, 1 findings, 2 usage/internal error.  A baseline
+file suppresses ACCEPTED findings (matched on path+rule+msg, not line)
+without touching the source; stale entries are reported on stderr so a
+baseline cannot outlive its findings.
 
 Stdlib-only on purpose: runs without jax/numpy or a native toolchain, so
 it is safe as a pre-commit / CI gate anywhere.
@@ -22,7 +27,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from firedancer_tpu.analysis import engine  # noqa: E402
+from firedancer_tpu.analysis import engine, findings  # noqa: E402
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -33,6 +38,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("paths", nargs="*", help=".py files or directories; empty = full repo pass")
     ap.add_argument("--json", action="store_true", help="emit a JSON report")
     ap.add_argument("--root", default=None, help="repo root for the full pass")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppress findings recorded in FILE (path+rule+msg)")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="record the current findings to FILE and exit 0")
     args = ap.parse_args(argv)
 
     try:
@@ -40,6 +49,30 @@ def main(argv: list[str] | None = None) -> int:
             report = engine.run_paths(args.paths)
         else:
             report = engine.run_repo(args.root)
+        if args.write_baseline:
+            findings.write_baseline(report.findings, args.write_baseline)
+            print(
+                f"fdtlint: wrote {len(report.findings)} finding(s) to "
+                f"{args.write_baseline}"
+            )
+            return 0
+        if args.baseline:
+            base = findings.load_baseline(args.baseline)
+            kept, suppressed, stale = findings.apply_baseline(
+                report.findings, base
+            )
+            report.findings = kept
+            report.coverage["baseline"] = {
+                "file": args.baseline,
+                "suppressed": suppressed,
+                "stale": len(stale),
+            }
+            for key in stale:
+                print(
+                    f"fdtlint: stale baseline entry (no longer found): "
+                    f"{key[0]} [{key[1]}] {key[2]}",
+                    file=sys.stderr,
+                )
     except (FileNotFoundError, ValueError, SyntaxError) as e:
         print(f"fdtlint: error: {e}", file=sys.stderr)
         return 2

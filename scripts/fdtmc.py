@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""fdtmc — exhaustive interleaving model checker for the tango ring
+protocol (mcache/dcache/fseq/fctl), with DPOR and replayable
+counterexamples.
+
+Usage:
+    scripts/fdtmc.py                       # bounded suite, all scenarios
+    scripts/fdtmc.py --exhaustive          # slow-tier budgets (+ random walks)
+    scripts/fdtmc.py --scenario 1p1c       # one scenario
+    scripts/fdtmc.py --mode dfs            # oracle mode (no DPOR reduction)
+    scripts/fdtmc.py --mutation credit-leak  # corpus fault injection
+    scripts/fdtmc.py --replay SEED         # deterministically re-run one
+                                           # captured schedule, print trace
+    scripts/fdtmc.py --json                # machine-readable report
+    scripts/fdtmc.py --list                # scenarios, mutations, rules
+
+Exit status (matches fdtlint): 0 clean, 1 findings, 2 usage/internal
+error.  Every finding's message carries its replay seed.
+
+Unlike fdtlint this needs numpy + the native tango build (the checker
+runs the real rings, not a model of them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdtmc", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--scenario", default=None, help="run one scenario (default: all)")
+    ap.add_argument("--mutation", default=None, help="activate a corpus protocol fault")
+    ap.add_argument("--mode", default="dpor", choices=["dpor", "dfs", "random"])
+    ap.add_argument("--budget", type=int, default=None,
+                    help="max schedules per scenario (default: tier budgets)")
+    ap.add_argument("--max-steps", type=int, default=None,
+                    help="per-execution step bound (livelock guard)")
+    ap.add_argument("--preemptions", type=int, default=None,
+                    help="preemption bound (default: per-scenario)")
+    ap.add_argument("--rng-seed", type=int, default=0, help="random-mode seed")
+    ap.add_argument("--exhaustive", action="store_true",
+                    help="slow-tier budgets + random widening")
+    ap.add_argument("--replay", default=None, metavar="SEED",
+                    help="re-run one captured schedule deterministically")
+    ap.add_argument("--json", action="store_true", help="emit a JSON report")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios, mutations, and rules")
+    args = ap.parse_args(argv)
+
+    try:
+        from firedancer_tpu.analysis import mcinvariants, mcmodels
+        from firedancer_tpu.analysis.sched import MUTATIONS, ReplayDivergence
+    except Exception as e:  # noqa: BLE001 - import-time build failures
+        print(f"fdtmc: error: cannot load checker ({e})", file=sys.stderr)
+        return 2
+
+    if args.list:
+        print("scenarios:")
+        for name, s in mcmodels.SCENARIOS.items():
+            print(f"  {name:18s} tier1={s.tier1_schedules} slow={s.slow_schedules}")
+        print("mutations:", ", ".join(sorted(MUTATIONS)))
+        print("rules:")
+        for rule, doc in mcinvariants.RULES.items():
+            print(f"  {rule:22s} {doc}")
+        return 0
+
+    if args.replay:
+        try:
+            name, mutation, out = mcmodels.replay(
+                args.replay, max_steps=args.max_steps
+            )
+        except (ValueError, ReplayDivergence) as e:
+            print(f"fdtmc: replay error: {e}", file=sys.stderr)
+            return 2
+        if out.error is not None:
+            print(f"fdtmc: internal error during replay: {out.error}",
+                  file=sys.stderr)
+            return 2
+        header = f"replay {args.replay}: scenario={name} mutation={mutation}"
+        if args.json:
+            import json
+
+            print(json.dumps({
+                "seed": args.replay,
+                "scenario": name,
+                "mutation": mutation,
+                "steps": out.steps,
+                "violation": (
+                    {"rule": out.violation.rule, "msg": out.violation.msg}
+                    if out.violation else None
+                ),
+                "trace": [f"{t}: {o}" for t, o in out.trace],
+            }, indent=2))
+        else:
+            print(header)
+            for t, o in out.trace:
+                print(f"  {t:8s} {o}")
+            if out.violation:
+                print(f"VIOLATION [{out.violation.rule}] {out.violation.msg}")
+            else:
+                print(f"clean ({out.steps} steps)")
+        return 1 if out.violation else 0
+
+    try:
+        if args.scenario and args.scenario not in mcmodels.SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {args.scenario!r} "
+                f"(have: {', '.join(mcmodels.SCENARIOS)})"
+            )
+        rep = mcmodels.run_suite(
+            tier="slow" if args.exhaustive else "tier1",
+            scenarios=[args.scenario] if args.scenario else None,
+            mutation=args.mutation,
+            mode=args.mode,
+            rng_seed=args.rng_seed,
+            max_schedules=args.budget,
+            preemption_bound=args.preemptions,
+            max_steps=args.max_steps,
+        )
+    except (ValueError, KeyError) as e:
+        print(f"fdtmc: error: {e}", file=sys.stderr)
+        return 2
+    except RuntimeError as e:
+        print(f"fdtmc: internal error: {e} ({e.__cause__})", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(rep.to_json())
+    else:
+        cov = rep.coverage["fdtmc"]
+        if rep.ok:
+            print(
+                f"fdtmc: clean — {cov['schedules']} schedules, "
+                f"{cov['distinct_states']} distinct states across "
+                f"{len(cov['scenarios'])} scenario(s) [{cov['mode']}]"
+            )
+        else:
+            for f in rep.findings:
+                print(f)
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

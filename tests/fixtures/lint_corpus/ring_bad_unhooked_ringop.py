@@ -1,0 +1,21 @@
+"""known-bad: a runtime ring native op reached without the `_MC is not
+None` model-checker guard — this shared-memory access would hide from
+fdtmc's cooperative scheduler.  Must trip ring-mc-hook."""
+
+_MC = None
+
+
+class SneakyRing:
+    def __init__(self, lib, mem):
+        self._lib = lib
+        self.mem = mem
+
+    def publish_unhooked(self, seq, sig):
+        # BAD: no `if _MC is not None:` gate before the native call
+        self._lib.fdt_mcache_publish(self.mem, seq, sig, 0, 0, 3, 0, 0)
+
+    def query_hooked_ok(self):
+        # control: this one is guarded and must NOT trip the rule
+        if _MC is not None:
+            return _MC.mcache_seq_query(self)
+        return self._lib.fdt_mcache_seq_query(self.mem)

@@ -1,0 +1,8 @@
+"""cr_avail always reports full credit: the producer overruns reliable
+consumers (forged flow control)."""
+
+MUTATION = "credit-leak"
+SCENARIO = "1p1c"
+MODE = "dpor"
+BUDGET = 60
+EXPECT_RULES = {"mc-credit-overflow", "mc-reliable-overrun", "mc-stale-read"}

@@ -1,0 +1,9 @@
+"""The pre-PR-3 native drain resync (clamp to 0 instead of
+seq_prod - depth mod 2^64): discards live frags when the ring has just
+wrapped past 2^64.  Pins the fdt_mcache_drain fix."""
+
+MUTATION = "drain-resync-zero"
+SCENARIO = "wrap_overrun"
+MODE = "dpor"
+BUDGET = 60
+EXPECT_RULES = {"mc-lost-frag", "mc-deadlock", "mc-livelock"}

@@ -1,0 +1,8 @@
+"""Drain's overrun resync skips frags without counting them: silent
+frag loss (the accounting the overrun contract requires is gone)."""
+
+MUTATION = "drain-uncounted"
+SCENARIO = "overrun_drain"
+MODE = "dpor"
+BUDGET = 60
+EXPECT_RULES = {"mc-lost-frag"}

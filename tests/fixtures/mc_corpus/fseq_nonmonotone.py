@@ -1,0 +1,8 @@
+"""Every third fseq.update publishes seq-2: the consumer's progress
+backchannel regresses, forging credit history."""
+
+MUTATION = "fseq-nonmonotone"
+SCENARIO = "1p1c"
+MODE = "dpor"
+BUDGET = 60
+EXPECT_RULES = {"mc-fseq-regress"}

@@ -1,0 +1,8 @@
+"""Poll skips the post-copy seq re-check: a frag overwritten mid-copy is
+returned as valid (torn metadata)."""
+
+MUTATION = "poll-no-recheck"
+SCENARIO = "overrun_drain"
+MODE = "dpor"
+BUDGET = 250
+EXPECT_RULES = {"mc-torn-read"}

@@ -1,0 +1,8 @@
+"""Producer publishes frag metadata BEFORE writing the payload bytes:
+a consumer that sees the seq may read stale dcache contents."""
+
+MUTATION = "publish-before-write"
+SCENARIO = "1p1c"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-stale-read"}

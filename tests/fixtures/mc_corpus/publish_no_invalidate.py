@@ -1,0 +1,8 @@
+"""Publish skips the line-seq invalidation step: during an overrun a
+consumer can validate a torn copy against the OLD seq."""
+
+MUTATION = "publish-no-invalidate"
+SCENARIO = "overrun_drain"
+MODE = "dpor"
+BUDGET = 100
+EXPECT_RULES = {"mc-torn-read"}

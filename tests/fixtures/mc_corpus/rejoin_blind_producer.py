@@ -1,0 +1,11 @@
+"""The pre-PR-3 producer_rejoin (trust seq_query blindly): a crash
+between a publish's line-seq store and its seq_prod advance makes the
+restarted producer RE-publish a line consumers may have consumed — the
+invalidation step fails a concurrent reliable consumer's poll re-check
+as a spurious overrun.  Pins the producer_rejoin repair loop."""
+
+MUTATION = "rejoin-blind-producer"
+SCENARIO = "restart_producer"
+MODE = "dpor"
+BUDGET = 350
+EXPECT_RULES = {"mc-reliable-overrun"}

@@ -1,0 +1,11 @@
+"""The pre-PR-3 consumer_rejoin arithmetic (plain-int min/max): at seq
+wrap-around a reliable consumer resumes at the producer's numerically
+tiny head instead of its own fseq, silently skipping frags.  Pins the
+consumer_rejoin fix."""
+
+MUTATION = "rejoin-no-wrap"
+SCENARIO = "wrap_restart"
+MODE = "random"
+BUDGET = 80
+EXPECT_RULES = {"mc-reliable-overrun", "mc-lost-frag", "mc-deadlock",
+                "mc-livelock", "mc-stale-read"}

@@ -1,0 +1,185 @@
+"""Direct unit coverage for tango.tempo (housekeeping-interval math) and
+tango.lru (intrusive LRU) — previously only exercised indirectly through
+the mux loop and the QUIC server."""
+
+from __future__ import annotations
+
+import pytest
+
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.tango.lru import Lru
+
+# ---------------------------------------------------------------------------
+# tempo.lazy_default: cr_max/2 frags at ~10ns each, clamped to [100us, 100ms]
+
+
+def test_lazy_default_midrange_formula():
+    # 100_000 credits * 10ns / 2 = 500_000 ns: inside the clamp window
+    assert tempo.lazy_default(100_000) == 500_000
+
+
+def test_lazy_default_clamps():
+    assert tempo.lazy_default(1) == 100_000  # floor: 100us
+    assert tempo.lazy_default(0) == 100_000
+    assert tempo.lazy_default(1 << 40) == 100_000_000  # ceiling: 100ms
+
+
+def test_lazy_default_monotone_in_cr_max():
+    vals = [tempo.lazy_default(c) for c in (1, 64, 4096, 1 << 20, 1 << 30)]
+    assert vals == sorted(vals)
+
+
+# ---------------------------------------------------------------------------
+# tempo.async_reload: uniform in [lazy/2, 3*lazy/2)
+
+
+def test_async_reload_deterministic_with_explicit_rng():
+    lazy = 1_000_000
+    assert tempo.async_reload(lazy, rng_u32=0) == lazy // 2
+    assert tempo.async_reload(lazy, rng_u32=7) == lazy // 2 + 7
+    # rng reduced mod span: lazy/2 + (rng % lazy)
+    assert tempo.async_reload(lazy, rng_u32=lazy + 3) == lazy // 2 + 3
+
+
+def test_async_reload_distribution_bounds():
+    lazy = 10_000
+    lo, hi = lazy // 2, lazy // 2 + lazy  # [lazy/2, 3*lazy/2)
+    seen = set()
+    for rng in range(0, 3 * lazy, 97):
+        v = tempo.async_reload(lazy, rng_u32=rng)
+        assert lo <= v < hi, v
+        seen.add(v)
+    assert len(seen) > 50  # actually spreads over the window
+
+
+def test_async_reload_entropy_path_in_bounds():
+    lazy = 50_000
+    for _ in range(64):  # os.urandom path
+        v = tempo.async_reload(lazy)
+        assert lazy // 2 <= v < lazy // 2 + lazy
+
+
+def test_async_reload_degenerate_lazy():
+    # span clamps to >= 2 so a zero interval cannot divide by zero
+    for rng in range(8):
+        assert tempo.async_reload(0, rng_u32=rng) in (1, 2)
+
+
+def test_tick_per_ns_close_to_unity():
+    # the tick source IS the ns clock on this substrate
+    assert 0.5 < tempo.tick_per_ns(observe_s=0.001) < 2.0
+
+
+# ---------------------------------------------------------------------------
+# Lru: eviction order, touch refresh, remove/free-list reuse
+
+
+def test_lru_evicts_least_recently_used_in_order():
+    lru = Lru(3)
+    for k in "abc":
+        lru.acquire(k)
+    assert lru.lru_key() == "a"
+    s, evicted = lru.acquire("d")
+    assert evicted == "a"
+    assert "a" not in lru and "b" in lru
+    _, evicted = lru.acquire("e")
+    assert evicted == "b"
+    assert list(lru.iter_lru()) == ["c", "d", "e"]
+
+
+def test_lru_touch_refreshes_recency():
+    lru = Lru(3)
+    for k in "abc":
+        lru.acquire(k)
+    assert lru.touch("a")  # a becomes most recent
+    _, evicted = lru.acquire("d")
+    assert evicted == "b"  # b was the LRU after the touch
+    assert "a" in lru
+    assert not lru.touch("zz")  # unknown key: no-op, reported
+
+
+def test_lru_acquire_existing_touches_not_duplicates():
+    lru = Lru(2)
+    s0, _ = lru.acquire("x")
+    lru.acquire("y")
+    s1, evicted = lru.acquire("x")  # re-acquire refreshes, same slot
+    assert s1 == s0 and evicted is None and len(lru) == 2
+    _, evicted = lru.acquire("z")
+    assert evicted == "y"  # x was refreshed above
+
+
+def test_lru_remove_frees_slot_for_reuse():
+    lru = Lru(2)
+    s_a, _ = lru.acquire("a")
+    lru.acquire("b")
+    assert lru.remove("a")
+    assert not lru.remove("a")  # second remove is a no-op
+    assert len(lru) == 1
+    s_c, evicted = lru.acquire("c")
+    assert evicted is None  # free slot reused, no eviction
+    assert s_c == s_a
+    assert list(lru.iter_lru()) == ["b", "c"]
+
+
+def test_lru_iter_order_full_cycle():
+    lru = Lru(4)
+    for k in "abcd":
+        lru.acquire(k)
+    lru.touch("b")
+    lru.touch("a")
+    # least..most recent: c, d, b, a
+    assert list(lru.iter_lru()) == ["c", "d", "b", "a"]
+    assert lru.lru_key() == "c"
+
+
+def test_lru_capacity_one():
+    lru = Lru(1)
+    lru.acquire("a")
+    _, evicted = lru.acquire("b")
+    assert evicted == "a" and lru.lru_key() == "b"
+    assert list(lru.iter_lru()) == ["b"]
+
+
+def test_lru_empty_states():
+    lru = Lru(2)
+    assert lru.lru_key() is None
+    assert list(lru.iter_lru()) == []
+    assert len(lru) == 0
+
+
+def test_lru_randomized_vs_model():
+    """Differential test against an ordered-dict model."""
+    import random
+
+    rng = random.Random(7)
+    cap = 5
+    lru = Lru(cap)
+    model: dict[int, None] = {}  # insertion = recency order (oldest first)
+    for _ in range(2000):
+        k = rng.randrange(12)
+        op = rng.random()
+        if op < 0.6:
+            _, evicted = lru.acquire(k)
+            want_evicted = None
+            if k in model:
+                model.pop(k)
+            elif len(model) == cap:
+                want_evicted = next(iter(model))
+                model.pop(want_evicted)
+            model[k] = None
+            assert evicted == want_evicted
+        elif op < 0.8:
+            assert lru.touch(k) == (k in model)
+            if k in model:
+                model.pop(k)
+                model[k] = None
+        else:
+            assert lru.remove(k) == (k in model)
+            model.pop(k, None)
+        assert list(lru.iter_lru()) == list(model)
+        assert len(lru) == len(model)
+
+
+def test_lru_rejects_zero_capacity():
+    with pytest.raises(AssertionError):
+        Lru(0)
