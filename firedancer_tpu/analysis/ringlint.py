@@ -27,6 +27,17 @@ over the tile layer (tiles/, disco/):
                        scheduler (analysis/sched.py).  Applies to
                        tango/rings.py (wired in engine.run_repo) and any
                        file calling those natives directly.
+  device-dispatch      tile mux-loop hook bodies (on_frags/after_credit)
+                       must not talk to a device directly — no
+                       jax.device_put, no jax.* call, no device
+                       executable (`device_fn`/compiled `_fns`) call, no
+                       block_until_ready.  Device interaction belongs to
+                       the worker classes (tiles/verify.py
+                       _DeviceWorker/_DevicePool behind a
+                       FallbackPolicy/DevicePolicy): a device call on
+                       the mux thread blocks heartbeats behind D2H
+                       latency and bypasses the per-device fault
+                       domains (quarantine/backoff/host fallback).
 
 Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
 `*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
@@ -265,6 +276,69 @@ def _check_mc_hooks(path: str, tree: ast.AST) -> tuple[list[Finding], int]:
     return findings, guarded
 
 
+#: mux-loop tile hooks that must stay host-side — they run on the loop
+#: thread between heartbeats, so a device call here stalls supervision
+#: and dodges the pool's fault domains
+DEVICE_DISPATCH_HOOKS = {"on_frags", "after_credit"}
+
+#: attribute callees that mean "talks to a device right here"
+_DEVICE_CALL_ATTRS = {"device_put", "block_until_ready"}
+
+#: classes that OWN device interaction (tiles/verify.py's worker layer);
+#: a hook-named method inside one is their private protocol, not a tile
+_DEVICE_OWNER_RE = ("Worker", "Pool", "Policy")
+
+
+def _device_call_reason(call: ast.Call) -> str | None:
+    callee = _src(call.func)
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in _DEVICE_CALL_ATTRS:
+            return f"{call.func.attr}()"
+        if callee.startswith("jax."):
+            return f"{callee}()"
+    elif isinstance(call.func, ast.Name) and call.func.id == "device_put":
+        return "device_put()"
+    if "device_fn" in callee or "_fns[" in callee or callee.endswith("_fns"):
+        return f"device executable call {callee}()"
+    return None
+
+
+def _check_device_dispatch(path: str, tree: ast.AST) -> list[Finding]:
+    """device-dispatch: no direct jax/executable calls from tile
+    on_frags/after_credit bodies — only the worker classes drive
+    devices (they run on their own threads, under a policy that owns
+    failure/quarantine/fallback)."""
+    findings: list[Finding] = []
+    exempt: set[int] = set()
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef) and any(
+            tag in cls.name for tag in _DEVICE_OWNER_RE
+        ):
+            exempt.update(id(n) for n in ast.walk(cls))
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in DEVICE_DISPATCH_HOOKS or id(fn) in exempt:
+            continue
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            reason = _device_call_reason(call)
+            if reason is not None:
+                findings.append(
+                    Finding(
+                        path, call.lineno, "device-dispatch",
+                        f"direct {reason} in tile hook {fn.name} — device "
+                        "interaction must go through the device worker "
+                        "pool (policy dispatch/land on a worker thread), "
+                        "not the mux loop body: a device call here blocks "
+                        "heartbeats on D2H latency and bypasses the "
+                        "per-device fault domains",
+                    )
+                )
+    return findings
+
+
 def check_rings_file(path: Path, rel: Path | None = None) -> tuple[list[Finding], int]:
     """check_file plus the guarded ring-op function count (engine's
     mc-hook coverage metric), from a single parse."""
@@ -327,5 +401,8 @@ def check_file(
     findings.extend(mc_findings)
     if _mc_count_out is not None:
         _mc_count_out.append(mc_guarded)
+
+    # -- device-dispatch -------------------------------------------------
+    findings.extend(_check_device_dispatch(disp, tree))
 
     return apply_pragmas(sorted(set(findings)), text.splitlines())

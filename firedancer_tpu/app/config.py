@@ -15,6 +15,8 @@ Config shape (all keys optional; defaults below):
     count = 1                        # horizontal seq-sharded replicas
     max_lanes = 4096
     msg_width = 1232
+    devices = 1                      # device pool: "auto" | N | [ordinals]
+    stall_patience_s = 120.0         # per-device tunnel-stall patience
     [tiles.dedup]
     signature_cache_size = 4194302   # default.toml:760
     [links]
@@ -42,6 +44,11 @@ class Config:
     verify_count: int = 1
     verify_max_lanes: int = 4096
     verify_msg_width: int = 1232
+    #: device pool width per replica: 1 (single stream), int N, explicit
+    #: ordinal list, or "auto" (every local accelerator, split disjointly
+    #: across the verify replicas by disco.topo.device_assignments)
+    verify_devices: object = 1
+    verify_stall_patience_s: float = 120.0
     dedup_depth: int = 4_194_302
     link_depth: int = 1024
     bank_count: int = 2
@@ -70,6 +77,8 @@ def parse(text: str) -> Config:
         verify_count=v.get("count", 1),
         verify_max_lanes=v.get("max_lanes", 4096),
         verify_msg_width=v.get("msg_width", 1232),
+        verify_devices=v.get("devices", 1),
+        verify_stall_patience_s=v.get("stall_patience_s", 120.0),
         dedup_depth=d.get("signature_cache_size", 4_194_302),
         link_depth=doc.get("links", {}).get("depth", 1024),
         bank_count=t.get("bank", {}).get("count", 2),
@@ -114,10 +123,13 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     from firedancer_tpu.tiles.store import StoreTile
     from firedancer_tpu.ballet import shred as SH
 
+    from firedancer_tpu.disco.topo import device_assignments
+
     mb_mtu = 65_535
     depth = cfg.link_depth
     n = cfg.verify_count
     n_banks = cfg.bank_count
+    verify_devs = device_assignments(cfg.verify_devices, n)
     topo = Topology(name=cfg.name)
 
     net = NetTile(
@@ -142,6 +154,8 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
                 # (bucket shapes would each pay a multi-minute cold
                 # compile on CPU hosts)
                 pad_full=True,
+                devices=verify_devs[i],
+                stall_patience_s=cfg.verify_stall_patience_s,
                 name=f"verify{i}",
             ),
             ins=[("quic_verify", True)],
@@ -225,6 +239,8 @@ def build_ingress_topology(
 ) -> tuple[Topology, QuicIngressTile]:
     """The production ingress shape: quic -> N seq-sharded verify ->
     dedup -> sink (reference connection map, config.c:681-712)."""
+    from firedancer_tpu.disco.topo import device_assignments
+
     topo = Topology(name=cfg.name)
     qt = QuicIngressTile(
         identity_secret,
@@ -235,12 +251,15 @@ def build_ingress_topology(
     topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
     topo.tile(qt, outs=["quic_verify"])
     n = cfg.verify_count
+    verify_devs = device_assignments(cfg.verify_devices, n)
     for i in range(n):
         topo.link(f"verify{i}_dedup", depth=depth, mtu=wire.LINK_MTU)
         vt = VerifyTile(
             msg_width=cfg.verify_msg_width,
             max_lanes=cfg.verify_max_lanes,
             shard=(i, n) if n > 1 else None,
+            devices=verify_devs[i],
+            stall_patience_s=cfg.verify_stall_patience_s,
             name=f"verify{i}",
         )
         topo.tile(

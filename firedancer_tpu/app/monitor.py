@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 
-from firedancer_tpu.disco.metrics import Metrics, MetricsSchema
+from firedancer_tpu.disco.metrics import Metrics, MetricsSchema, device_rows
 from firedancer_tpu.tango import rings as R
 
 _SIGNAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
@@ -113,6 +113,16 @@ class Monitor:
                     f"NOTE {name}: {c['fallback_batches']} batches on the "
                     f"host fallback path"
                 )
+            # per-device fault domains (the verify pool): a quarantined /
+            # stalled / dead device alarms as `verify0_dev3_degraded`
+            # style lines — one device degrading is NOT tile degradation
+            for i, row in sorted(device_rows(c).items()):
+                if row.get("degraded"):
+                    out.append(
+                        f"ALARM {name}_dev{i}_degraded: device quarantined "
+                        f"(landed {row.get('landed', 0)}, failed "
+                        f"{row.get('failed', 0)})"
+                    )
         return out
 
     def render(self, prev: dict | None, cur: dict, dt: float) -> str:
@@ -140,6 +150,20 @@ class Monitor:
                 f"{name:>10} {row['signal']:>5} {rin:12,.0f} {rout:12,.0f} "
                 f"{c['in_frags']:12,} {c['out_frags']:12,}{flag}"
             )
+            # device-pool health sub-rows (tiles exporting dev{i}_*
+            # counters — the multi-device verify scale-out)
+            devs = device_rows(c)
+            if len(devs) > 1 or any(
+                r.get("degraded") for r in devs.values()
+            ):
+                for i, r in sorted(devs.items()):
+                    dflag = " DEGRADED" if r.get("degraded") else ""
+                    lines.append(
+                        f"{'':>10}   dev{i}: depth={r.get('depth', 0)} "
+                        f"inflight={r.get('inflight', 0)} "
+                        f"landed={r.get('landed', 0):,} "
+                        f"failed={r.get('failed', 0)}{dflag}"
+                    )
         for lname, ls in cur.get("_links", {}).items():
             for tile, s in ls["consumers"].items():
                 if s["lag"]:

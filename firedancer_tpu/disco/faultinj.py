@@ -65,6 +65,12 @@ class Fault:
            drop/corrupt (seeded hash, batch-boundary independent).
     duration_s: stall length (heartbeat starvation time).
     link:  restrict drop/corrupt to one in-link name (None = all).
+    device: restrict device_error to one device-pool domain (None = the
+           tile's merged batch stream).  A targeted fault's `at` indexes
+           THAT device's own batch sequence, which stays deterministic
+           under the pool's timing-dependent scheduler; an untargeted
+           fault on a multi-device tile indexes the merged stream, whose
+           order depends on scheduling — use at=0 windows there.
     """
 
     tile: str
@@ -75,6 +81,7 @@ class Fault:
     frac: float = 1.0
     duration_s: float = 0.0
     link: str | None = None
+    device: int | None = None
     fired: bool = field(default=False, compare=False)
 
 
@@ -171,6 +178,14 @@ class TileFaults:
         self.frags_seen = 0  # across all in-links (on="frag" triggers)
         self._link_idx: dict[str, int] = {}  # per-link cumulative index
         self.dev_batches = 0
+        #: per-device batch indices (device-pool workers each call
+        #: device_error with their domain index)
+        self.dev_batches_by: dict[int, int] = {}
+        #: device_error is called from every pool worker thread; the
+        #: merged dev_batches read-modify-write must not lose updates
+        #: (a lost increment shifts an untargeted fault window and
+        #: breaks the injector's determinism contract)
+        self._dev_lock = threading.Lock()
         self._squeeze = 0
         self._tick_faults = [
             (i, f)
@@ -280,12 +295,28 @@ class TileFaults:
 
     # -- device batches (FallbackPolicy hook) -----------------------------
 
-    def device_error(self) -> None:
-        b = self.dev_batches
-        self.dev_batches = b + 1
+    def device_error(self, device: int | None = None) -> None:
+        """Fired once per device-batch attempt.  Single-device policies
+        call it bare (the merged stream); pool domains pass their index
+        so a fault can target ONE device — the quarantine/redistribute
+        chaos tests key on that."""
+        with self._dev_lock:
+            b = self.dev_batches
+            self.dev_batches = b + 1
+            bd = None
+            if device is not None:
+                bd = self.dev_batches_by.get(device, 0)
+                self.dev_batches_by[device] = bd + 1
         for _, f in self._dev_faults:
-            if f.at <= b < f.at + f.count:
-                self.inj.log(self.tile, "device_error", b)
+            if f.device is not None:
+                if device is None or f.device != device:
+                    continue
+                ref = bd
+            else:
+                ref = b
+            if f.at <= ref < f.at + f.count:
+                self.inj.log(self.tile, "device_error", ref, device)
                 raise DeviceFault(
-                    f"{self.tile}: scripted device failure at batch {b}"
+                    f"{self.tile}: scripted device failure at batch {ref}"
+                    + (f" on dev{device}" if device is not None else "")
                 )

@@ -21,6 +21,48 @@ import numpy as np
 HIST_BUCKETS = 16
 _HIST_WORDS = HIST_BUCKETS + 2  # buckets + sum + count
 
+#: the per-device health/throughput row exported by device-pool tiles
+#: (tiles/verify.py): queue depth, batches in flight, batches landed,
+#: batches failed (errors + stalls), and a 0/1 degraded gauge
+#: (quarantined / stalled / dead worker)
+DEVICE_METRICS = ("depth", "inflight", "landed", "failed", "degraded")
+
+
+def device_counters(
+    n_devices: int, names: tuple[str, ...] = DEVICE_METRICS
+) -> tuple[str, ...]:
+    """Schema counters for an n-device pool: dev0_depth, dev0_inflight,
+    ... dev{n-1}_degraded.  Kept here (not in the tile) so readers —
+    app/monitor.py's health rows, tests — parse the same naming."""
+    return tuple(
+        f"dev{i}_{m}" for i in range(n_devices) for m in names
+    )
+
+
+def parse_device_counter(name: str) -> tuple[int, str] | None:
+    """"dev3_landed" -> (3, "landed"); None for non-device counters."""
+    if not name.startswith("dev"):
+        return None
+    head, _, metric = name.partition("_")
+    if not metric or metric not in DEVICE_METRICS:
+        return None
+    try:
+        return int(head[3:]), metric
+    except ValueError:
+        return None
+
+
+def device_rows(counters: dict) -> dict[int, dict]:
+    """Group a tile's counter snapshot into per-device health rows:
+    {dev_idx: {metric: value}} for every dev{i}_* counter present."""
+    out: dict[int, dict] = {}
+    for name, v in counters.items():
+        parsed = parse_device_counter(name)
+        if parsed is not None:
+            idx, metric = parsed
+            out.setdefault(idx, {})[metric] = v
+    return out
+
 
 @dataclass(frozen=True)
 class MetricsSchema:

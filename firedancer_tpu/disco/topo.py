@@ -26,6 +26,36 @@ from .metrics import Metrics
 from .mux import InLink, MuxCtx, OutLink, Tile, run_loop
 
 
+def device_assignments(spec, n_tiles: int) -> list[list[int]]:
+    """Partition a `verify_devices` spec (auto | N | [ordinals]) across
+    n_tiles seq-sharded verify replicas.
+
+    Each replica gets a DISJOINT device-ordinal list so two workers
+    never contend for one accelerator (the reference pins each
+    wiredancer lane to one FPGA slot for the same reason).  With fewer
+    devices than replicas the devices are shared round-robin — valid,
+    just contended.  "auto" probes the jax local-device inventory AT
+    BUILD TIME (the partition needs the count), which initializes and
+    freezes the backend — a caller that must control the platform
+    (the forced virtual CPU mesh) calls ensure_cpu_devices() first;
+    host-only topologies should pass an explicit spec, not "auto".
+    """
+    assert n_tiles >= 1
+    if spec in (None, 1, "off"):
+        return [[0] for _ in range(n_tiles)]
+    if spec == "auto":
+        from firedancer_tpu.utils.hostdev import local_device_count
+
+        indices = list(range(local_device_count()))
+    elif isinstance(spec, int):
+        indices = list(range(max(spec, 1)))
+    else:
+        indices = [int(d) for d in spec] or [0]
+    if len(indices) < n_tiles:
+        return [[indices[i % len(indices)]] for i in range(n_tiles)]
+    return [indices[i::n_tiles] for i in range(n_tiles)]
+
+
 @dataclass
 class LinkSpec:
     name: str

@@ -326,3 +326,27 @@ def verify_batch_digest(digests, sigs, pubs):
     sigs = jnp.asarray(sigs, jnp.uint8)
     pubs = jnp.asarray(pubs, jnp.uint8)
     return _verify_digest_impl(digests, sigs, pubs, use_pallas=_use_pallas())
+
+
+def verify_batch_digest_on(device):
+    """verify_batch_digest pinned to one local device: a per-domain
+    executable for the verify tile's device pool (tiles/verify.py).
+
+    Inputs are committed to `device` with an explicit device_put and the
+    jitted kernel follows their placement, so each pool domain compiles
+    and runs on its own accelerator.  The explicit put is also what buys
+    the pool its transfer/compute overlap: a put onto one device
+    progresses while another device (or this one's previous batch)
+    executes — the round-3 measurement the scale-out design rests on.
+    jax.jit caches per placement, and the persistent compilation cache
+    makes devices 1..n-1 near-free after device 0."""
+    use_pallas = _use_pallas()
+
+    def fn(digests, sigs, pubs):
+        d = jax.device_put(jnp.asarray(digests, jnp.uint8), device)
+        s = jax.device_put(jnp.asarray(sigs, jnp.uint8), device)
+        p = jax.device_put(jnp.asarray(pubs, jnp.uint8), device)
+        return _verify_digest_impl(d, s, p, use_pallas=use_pallas)
+
+    fn.device = device
+    return fn

@@ -15,6 +15,81 @@ def _mesh_axes(n: int):
     return n // mp, mp
 
 
+def run_verify_pool(n_devices: int, lanes: int = 16) -> None:
+    """Dry-run the verify tile's DEVICE POOL across the mesh devices:
+    one pinned executable per device (ops.ed25519.verify_batch_digest_on),
+    a `_DevicePool` of per-device `DevicePolicy` fault domains, 2x
+    batches submitted through the least-in-flight scheduler, and the
+    in-order landing asserted.  This is the production multi-device
+    scale-out path (tiles/verify.py) compiled and executed without real
+    chips — the sharded-mesh dryrun above validates collectives; this
+    validates the per-device-queue pool the verify tile actually runs."""
+    import hashlib
+    import time
+
+    import jax
+
+    from firedancer_tpu.ops.ed25519 import hostpath
+    from firedancer_tpu.ops.ed25519 import verify as fver
+    from firedancer_tpu.tiles.verify import DevicePolicy, _DevicePool
+
+    devs = jax.local_devices()[:n_devices]
+    rng = np.random.default_rng(2)
+    sk = rng.integers(0, 256, 32, np.uint8).tobytes()
+    pk = hostpath.public_from_secret(sk)
+    digests = np.zeros((lanes, 64), np.uint8)
+    sigs = np.zeros((lanes, 64), np.uint8)
+    pubs = np.tile(np.frombuffer(pk, np.uint8), (lanes, 1))
+    for i in range(lanes):
+        msg = rng.integers(0, 256, 32, np.uint8).tobytes()
+        sig = hostpath.sign(sk, msg)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        digests[i] = np.frombuffer(
+            hashlib.sha512(sig[:32] + pk + msg).digest(), np.uint8
+        )
+    fns = [fver.verify_batch_digest_on(d) for d in devs]
+    for fn in fns:
+        # warm each device's compile BEFORE the pool boots, exactly as
+        # the verify tile does (_make_device_fns): a cold compile
+        # (~95 s here, concurrent on one core) inside a worker's first
+        # dispatch would outlast the 120 s per-device stall patience —
+        # the watchdog would quarantine every "stalled" device and pile
+        # all batches on whichever recovers first
+        np.asarray(fn(digests, sigs, pubs))
+    policies = [
+        DevicePolicy(fn, hostpath.verify_batch_digest_host, index=i)
+        for i, fn in enumerate(fns)
+    ]
+    pool = _DevicePool(policies, depth=2, name="dryrun")
+    try:
+        n_batches = 2 * len(devs)
+        submitted = 0
+        landed = []
+        deadline = time.monotonic() + 600.0
+        while len(landed) < n_batches and time.monotonic() < deadline:
+            while submitted < n_batches and pool.submit(
+                {"lanes": lanes, "i": submitted}, (digests, sigs, pubs)
+            ):
+                submitted += 1
+            pool.poll()
+            while pool.ready:
+                meta, ok = pool.ready.popleft()
+                assert ok[:lanes].all(), "pool verify rejected valid sigs"
+                landed.append(meta)
+            time.sleep(0.001)
+        assert [m["i"] for m in landed] == list(range(n_batches)), (
+            "pool landing out of order or incomplete"
+        )
+        used = sum(1 for w in pool.workers if w.landed_n > 0)
+        assert used >= min(2, len(devs)), "pool did not spread work"
+        print(
+            f"dryrun_verify_pool ok: {n_batches} batches in order over "
+            f"{used}/{len(devs)} devices"
+        )
+    finally:
+        pool.stop(timeout_s=30.0)
+
+
 def run(n_devices: int) -> None:
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -52,6 +127,16 @@ def run(n_devices: int) -> None:
             # multi-step sustained run: aging-bloom rotation boundaries,
             # per-step metrics consistency, uneven final dp batch
             pipeline.dryrun_sustained(mesh)
+        if os.environ.get("FDT_DRYRUN_POOL", "1") != "0":
+            # the verify tile's per-device worker pool on the same
+            # devices.  Each device placement is its own kernel compile
+            # (~95 s cold, ~12 s cached on this host), so the default
+            # validates the real pinned-pool path on 2 devices;
+            # FDT_DRYRUN_POOL_DEVICES=8 opts into the full width
+            pool_n = int(
+                os.environ.get("FDT_DRYRUN_POOL_DEVICES", "2")
+            )
+            run_verify_pool(min(max(pool_n, 1), n_devices))
         print(f"dryrun_multichip ok: full pipeline on mesh dp={dp} mp={mp}")
         return
 

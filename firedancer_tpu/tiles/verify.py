@@ -15,6 +15,20 @@ when the request queue is full the tile stops draining its in-ring and
 the ring's credit model takes over — exactly the reference's flow-control
 discipline, with the device behind the same tile/link boundary.
 
+Round-6 scale-out: the single worker became a DEVICE POOL (`_DevicePool`)
+— one worker thread (with its own in-flight pipeline, i.e. the double
+buffer) per local accelerator, a least-in-flight scheduler with
+round-robin tie-break, an in-flight cap per device, and an in-order
+landing buffer so results still publish in arrival-seq order across
+devices.  Each device is its own FAULT DOMAIN (`DevicePolicy`): a device
+that errors or stalls past its patience is quarantined with capped
+backoff and its in-flight batches are resubmitted to healthy devices;
+the strict host path (ops/ed25519/hostpath.py) remains the last resort
+when every device is out.  This is the layer that converts the ALU-bound
+per-chip ceiling (PROFILE.md round 5: ~390K verifies/s/chip) into a
+linear-in-devices aggregate — the same conclusion that drove the
+reference to scale sig-verify across tiles and wiredancer FPGA lanes.
+
 Batch discipline: lane counts are padded up to power-of-two buckets so
 XLA compiles a handful of static shapes, then reuses them forever.  All
 per-frag work is vectorized numpy; the Python loop body is O(1) per batch.
@@ -25,10 +39,11 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 
 import numpy as np
 
-from firedancer_tpu.disco.metrics import MetricsSchema
+from firedancer_tpu.disco.metrics import MetricsSchema, device_counters
 from firedancer_tpu.disco.mux import MuxCtx, Tile
 from firedancer_tpu.tango import rings as R
 
@@ -61,6 +76,11 @@ class FallbackPolicy:
     degradation state live.
     """
 
+    #: set by the pool's stall watchdog while a device call is wedged
+    #: past its patience (DevicePolicy only; the classic single-device
+    #: policy never stalls — its worker's host fallback is in-line)
+    stalled = False
+
     def __init__(
         self,
         device_fn,
@@ -83,6 +103,11 @@ class FallbackPolicy:
         self.device_errors = 0
         self.device_trips = 0
         self.host_reprobes = 0
+
+    def healthy(self, now: float | None = None) -> bool:
+        """Schedulable by the pool.  The classic policy always is — it
+        degrades to the host path internally, per batch."""
+        return True
 
     def _try_device(self) -> bool:
         if self.device_fn is None:
@@ -142,53 +167,251 @@ class FallbackPolicy:
         return self.host_fn(*args, lanes=lanes)
 
 
+class DevicePolicy(FallbackPolicy):
+    """One device's FAULT DOMAIN inside a multi-device pool.
+
+    Differs from the classic FallbackPolicy in who owns recovery: the
+    classic policy reroutes a failed batch to the host path itself; a
+    pool domain hands the batch BACK (dispatch/land return a failure)
+    so the scheduler can resubmit it to a HEALTHY device first and only
+    fall to the host when every device is out.  The breaker is
+    time-based: `trip_after` consecutive failures quarantine the device
+    for a capped-exponential backoff (`backoff_base_s`..`backoff_max_s`),
+    after which the next scheduled batch re-probes it.
+
+    `stall_patience_s` is the round-5 "120 s tunnel stall" patience,
+    moved from the global pipeline into this per-device breaker: a
+    device call wedged past the patience degrades only ITS device (the
+    pool marks `stalled`, quarantines, and redistributes its in-flight
+    batches); the other devices keep verifying.
+    """
+
+    def __init__(
+        self,
+        device_fn,
+        host_fn,
+        *,
+        index: int = 0,
+        trip_after: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        stall_patience_s: float = 120.0,
+        fault_hook=None,
+    ):
+        super().__init__(
+            device_fn, host_fn, trip_after=trip_after, fault_hook=fault_hook
+        )
+        self.index = index
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.stall_patience_s = stall_patience_s
+        self.backoff_s = 0.0
+        self.quarantined_until = 0.0
+        self.stalled = False
+        self.device_stalls = 0
+
+    def healthy(self, now: float | None = None) -> bool:
+        if self.stalled or self.device_fn is None:
+            return False
+        if not self.tripped:
+            return True
+        if now is None:
+            now = time.monotonic()
+        return now >= self.quarantined_until  # backoff expired: re-probe
+
+    def _try_device(self) -> bool:
+        if self.device_fn is None or self.stalled:
+            return False
+        if not self.tripped:
+            return True
+        if time.monotonic() >= self.quarantined_until:
+            self.host_reprobes += 1  # (re-)probe of a quarantined device
+            return True
+        return False
+
+    def _quarantine(self) -> None:
+        """Trip the breaker with capped exponential backoff: each failed
+        (re-)probe doubles the backoff, a success (in land) resets it."""
+        if not self.tripped:
+            self.device_trips += 1
+        self.tripped = True
+        self.backoff_s = (
+            self.backoff_base_s
+            if not self.backoff_s
+            else min(self.backoff_s * 2.0, self.backoff_max_s)
+        )
+        self.quarantined_until = time.monotonic() + self.backoff_s
+
+    def _device_failed(self) -> None:
+        self.device_errors += 1
+        self.consec_failures += 1
+        if self.consec_failures >= self.trip_after:
+            self._quarantine()
+
+    def mark_stalled(self) -> None:
+        """Pool stall watchdog: the device call is wedged past patience.
+        Quarantine so the scheduler routes around it; the flag clears
+        when the wedged call finally returns (the worker owns that)."""
+        self.stalled = True
+        self.device_stalls += 1
+        self._quarantine()
+
+    def dispatch(self, args):
+        if self._try_device():
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(self.index)
+                return ("dev", self.device_fn(*args))
+            except Exception:
+                self._device_failed()
+                return ("fail", None)
+        return ("fail", None)  # quarantined: the pool redistributes
+
+    def land(self, fut, args, lanes: int | None = None):
+        kind, val = fut
+        if kind == "dev":
+            try:
+                out = np.asarray(val)
+                self.consec_failures = 0
+                self.tripped = False
+                self.backoff_s = 0.0
+                return out
+            except Exception:
+                self._device_failed()
+                return None  # the pool resubmits elsewhere
+        if kind == "host":
+            if self.device_fn is not None:
+                self.fallback_batches += 1
+            return self.host_fn(*args, lanes=lanes)
+        return None  # "fail": never dispatched (quarantine raced)
+
+
 class _DeviceWorker:
     """Push-request/push-result engine (the wd_f1.c interface shape).
 
-    One dedicated thread owns all device interaction.  `depth` batches
-    ride in flight: the thread dispatches every queued request before it
-    blocks on the oldest result's D2H copy, so transfer and compute of
-    batch N+1 overlap the sync of batch N.  All dispatch/land calls go
-    through the FallbackPolicy, so a device failure degrades to the host
-    path instead of killing this thread.
+    One dedicated thread owns all interaction with ONE device.  `depth`
+    batches ride in flight: the thread dispatches every queued request
+    before it blocks on the oldest result's D2H copy, so transfer and
+    compute of batch N+1 overlap the sync of batch N (the double
+    buffer).  All dispatch/land calls go through the policy, so a device
+    failure degrades (classic) or surfaces to the pool (DevicePolicy)
+    instead of killing this thread.
+
+    Accounting contract: every submitted batch is exactly one of
+    landed (a results entry), still queued/in flight (visible in
+    `reqq`/`pending`), or drained back by `abort()` — never silently
+    dropped.  `pending` entries are appended BEFORE dispatch and popped
+    only AFTER their land completes, so a wedge inside a device call
+    keeps that batch recoverable.
     """
 
-    def __init__(self, policy: FallbackPolicy, depth: int = 3):
+    def __init__(self, policy: FallbackPolicy, depth: int = 3,
+                 name: str = "verify-dev"):
         self.policy = policy
         self.depth = depth
         self.reqq: queue.Queue = queue.Queue(maxsize=depth)
         self.results: collections.deque = collections.deque()
+        self.pending: collections.deque = collections.deque()
         self.error: BaseException | None = None
         self.aborted = False
+        #: single-writer counters: submitted_n by the submitting (mux)
+        #: thread, completed_n by this worker thread; the difference is
+        #: the in-flight load the scheduler balances on
+        self.submitted_n = 0
+        self.completed_n = 0
+        #: landed batches accepted by the pool (pool/mux thread only)
+        self.landed_n = 0
+        #: monotonic timestamp while inside a device call — dispatch
+        #: (the H2D put can wedge in the tunnel) or land (the D2H sync)
+        #: — read by the pool's stall watchdog; 0.0 = not in a call
+        self.land_t0 = 0.0
         self.thread = threading.Thread(
-            target=self._main, name="verify-dev", daemon=True
+            target=self._main, name=name, daemon=True
         )
         self.thread.start()
 
-    def submit(self, meta, args) -> None:
-        self.reqq.put((meta, args))
+    def inflight(self) -> int:
+        return self.submitted_n - self.completed_n
 
-    def stop(self) -> None:
+    def alive(self) -> bool:
+        return self.error is None and self.thread.is_alive()
+
+    def submit(self, meta, args, mode: str = "auto") -> None:
+        """Single-submitter (mux thread); the caller checks reqq.full()
+        first, so this never blocks."""
+        self.reqq.put_nowait((meta, args, mode))
+        self.submitted_n += 1
+
+    def stop(self, timeout_s: float | None = None) -> None:
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
         while self.thread.is_alive():
             try:
                 self.reqq.put(_STOP, timeout=0.1)
                 break
             except queue.Full:
-                continue  # a dead worker never drains: is_alive re-checks
-        self.thread.join()
+                # a dead worker never drains: is_alive re-checks.  A
+                # WEDGED worker never drains either — the deadline must
+                # bound this loop too, or a stop under a full queue
+                # spins forever and the halt path never returns
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+        self.thread.join(
+            None if deadline is None
+            else max(deadline - time.monotonic(), 0.0)
+        )
 
-    def abort(self, timeout_s: float = 10.0) -> None:
-        """Crash-recovery teardown: drop queued and in-flight work (the
-        supervisor's ring replay re-delivers it) and stop the thread."""
+    def abort(self, timeout_s: float = 10.0) -> list[tuple]:
+        """Teardown that cannot orphan work: stop (or abandon, if
+        wedged) the thread, then drain every batch it never landed —
+        queued submissions AND the in-flight `pending` entries (a land
+        wedged inside a device call keeps its batch there) — back to
+        the caller for resubmission or deliberate discard."""
         self.aborted = True
         try:
             self.reqq.put_nowait(_STOP)
         except queue.Full:
             pass
         self.thread.join(timeout=timeout_s)
+        drained: list[tuple] = []
+        while True:
+            try:
+                item = self.reqq.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                drained.append(item)
+        # liveness BEFORE the pending snapshot: a slow-but-not-wedged
+        # worker can finish its in-flight land right after the join
+        # timeout — snapshotting first would count that batch in both
+        # completed_n and drained and fire the assert spuriously.  Once
+        # dead here, counters and pending are final.  A still-alive
+        # thread (wedged, or merely slower than the join timeout) can
+        # popleft/append concurrently, so the snapshot retries on the
+        # deque's mutated-during-iteration error rather than letting it
+        # escape into the crash-recovery path.
+        alive = self.thread.is_alive()
+        while True:
+            try:
+                snap = [(m, a, md) for m, a, md, _ in self.pending]
+                break
+            except RuntimeError:
+                continue
+        drained.extend(snap)
+        if not alive:
+            # the thread exited: counters are final — prove no batch
+            # was silently dropped (the pre-fix abort lost queued metas
+            # when a land wedged)
+            assert self.submitted_n == self.completed_n + len(drained), (
+                f"device worker dropped batches: submitted "
+                f"{self.submitted_n} != landed {self.completed_n} + "
+                f"drained {len(drained)}"
+            )
+        return drained
 
     def _main(self) -> None:
-        pending: collections.deque = collections.deque()
+        pending = self.pending
         stopped = False
         try:
             while not (stopped and not pending):
@@ -204,38 +427,270 @@ class _DeviceWorker:
                     if item is _STOP:
                         stopped = True
                         break
-                    meta, args = item
-                    # async dispatch: returns immediately
-                    pending.append(
-                        (meta, args, self.policy.dispatch(args))
-                    )
+                    meta, args, mode = item
+                    # enter the accounting BEFORE dispatch: a dispatch
+                    # that wedges must leave the batch recoverable
+                    slot = [meta, args, mode, None]
+                    pending.append(slot)
+                    if mode == "host":
+                        slot[3] = ("host", None)
+                    else:
+                        # async dispatch: returns immediately — but the
+                        # H2D put inside it can wedge (tunnel stall), so
+                        # the watchdog window covers it too
+                        self.land_t0 = time.monotonic()
+                        slot[3] = self.policy.dispatch(args)
+                        self.land_t0 = 0.0
                 if pending:
-                    meta, args, fut = pending.popleft()
+                    meta, args, mode, fut = pending[0]
+                    if fut is None:  # pragma: no cover - abort raced
+                        fut = ("fail", None)
                     # D2H copy is the only reliable sync on this platform
-                    self.results.append(
-                        (meta, self.policy.land(fut, args, meta["lanes"]))
-                    )
+                    self.land_t0 = time.monotonic()
+                    ok = self.policy.land(fut, args, meta["lanes"])
+                    self.land_t0 = 0.0
+                    self.policy.stalled = False  # the call returned
+                    self.completed_n += 1
+                    pending.popleft()
+                    self.results.append((meta, ok))
         except BaseException as e:  # noqa: BLE001 — surfaced by the tile
             self.error = e
 
 
-class VerifyTile(Tile):
-    schema = MetricsSchema(
-        counters=(
-            "verify_fail_txns",
-            "dedup_drop_txns",
-            "verified_sigs",
-            "device_batches",
-            # FallbackPolicy state, mirrored each loop so monitors see
-            # degradation live
-            "fallback_batches",
-            "device_errors",
-            "device_trips",
-            "host_reprobes",
-        ),
-        hists=("lane_batch",),
-    )
+class _DevicePool:
+    """N per-device workers behind one submit/land facade.
 
+    Scheduler: least-in-flight across healthy domains, ties broken
+    round-robin; per-device in-flight cap = the worker queue depth.
+    When no device is healthy, batches go out in `mode="host"` — the
+    strict host path as last resort — on any responsive worker.
+
+    Landing is IN ORDER: every batch gets a monotonically increasing
+    `pool_seq` at first submit; completed batches park in a reorder
+    buffer and `ready` hands them out strictly by seq, so downstream
+    publish order is identical to a single serialized stream no matter
+    how devices interleave.
+
+    Fault handling: a failed batch (device error) or a quarantined/
+    stalled/dead domain's in-flight work is resubmitted — same seq —
+    to another domain.  Late results from a domain a batch was moved
+    away from are dropped by an assignment check, which is what makes
+    "zero lost, zero duplicated" hold through stall recovery races.
+
+    Thread model: submit/poll/abort run on the owning tile's mux
+    thread only; workers touch only their own queues/results.
+    """
+
+    def __init__(self, policies: list, depth: int = 3, name: str = "verify"):
+        self.policies = policies
+        self.workers = [
+            _DeviceWorker(p, depth, name=f"{name}-dev{i}")
+            for i, p in enumerate(policies)
+        ]
+        self.aborted = False
+        self.next_seq = 0
+        self.landed_seq = 0
+        self.reorder: dict[int, tuple] = {}
+        #: seq -> [meta, args, mode, domain_idx]; the live assignment
+        self.outstanding: dict[int, list] = {}
+        #: evicted batches waiting for a domain with room
+        self.retryq: collections.deque = collections.deque()
+        #: in-order completed batches, consumed by the tile
+        self.ready: collections.deque = collections.deque()
+        self.rr = 0
+        self.resubmits = 0
+        self.late_results = 0
+        self._evicted: set[int] = set()
+        self._stopping = False
+
+    # ---- scheduling -----------------------------------------------------
+
+    def _domain_ok(self, i: int) -> bool:
+        w = self.workers[i]
+        return w.alive() and not self.policies[i].stalled
+
+    def _pick(self, peek: bool = False) -> tuple[int | None, str]:
+        now = time.monotonic()
+        n = len(self.workers)
+        cand = [
+            i for i in range(n)
+            if self._domain_ok(i) and self.policies[i].healthy(now)
+        ]
+        mode = "auto"
+        if not cand:
+            # every device quarantined/stalled/dead: strict host path on
+            # any still-responsive worker is the last resort
+            mode = "host"
+            cand = [i for i in range(n) if self._domain_ok(i)]
+        open_ = [i for i in cand if not self.workers[i].reqq.full()]
+        if not open_:
+            return None, mode
+        best, best_load = None, None
+        for j in range(len(open_)):
+            i = open_[(self.rr + j) % len(open_)]
+            load = self.workers[i].inflight()
+            if best is None or load < best_load:
+                best, best_load = i, load
+        if not peek:
+            self.rr = (self.rr + 1) % max(n, 1)
+        return best, mode
+
+    def can_accept(self) -> bool:
+        """Room for NEW work: evicted batches retry first (publishing is
+        seq-ordered, so head-of-line seqs must not starve)."""
+        if self.retryq:
+            return False
+        return self._pick(peek=True)[0] is not None
+
+    def submit(self, meta, args) -> bool:
+        """Schedule one new batch; False = no capacity (caller holds it
+        staged and retries — ring backpressure does the rest)."""
+        self.pump()
+        if self.retryq:
+            return False
+        tgt, mode = self._pick()
+        if tgt is None:
+            return False
+        seq = self.next_seq
+        self.next_seq += 1
+        meta["pool_seq"] = seq
+        self.outstanding[seq] = [meta, args, mode, tgt]
+        self.workers[tgt].submit(meta, args, mode)
+        return True
+
+    def pump(self) -> None:
+        """Re-place evicted batches as capacity frees up."""
+        while self.retryq:
+            tgt, mode = self._pick()
+            if tgt is None:
+                return
+            seq = self.retryq.popleft()
+            ent = self.outstanding.get(seq)
+            if ent is None:  # pragma: no cover - landed while queued
+                continue
+            ent[2], ent[3] = mode, tgt
+            self.workers[tgt].submit(ent[0], ent[1], mode)
+
+    def _resubmit(self, seq: int) -> None:
+        ent = self.outstanding[seq]
+        self.resubmits += 1
+        tgt, mode = self._pick()
+        if tgt is None:
+            ent[3] = -1  # unassigned: parked until capacity frees
+            self.retryq.append(seq)
+            return
+        ent[2], ent[3] = mode, tgt
+        self.workers[tgt].submit(ent[0], ent[1], mode)
+
+    def _evict(self, i: int) -> None:
+        """Move every batch assigned to domain i elsewhere (quarantine /
+        dead worker).  Late results from i are dropped by the
+        assignment check in poll()."""
+        for seq, ent in list(self.outstanding.items()):
+            if ent[3] == i:
+                self._resubmit(seq)
+
+    # ---- landing --------------------------------------------------------
+
+    def _drain_results(self, i: int, w: _DeviceWorker) -> None:
+        while w.results:
+            meta, ok = w.results.popleft()
+            seq = meta["pool_seq"]
+            ent = self.outstanding.get(seq)
+            if ent is None or ent[3] != i:
+                # a batch this domain lost to resubmission landed
+                # anyway (stall recovered): first landing won
+                self.late_results += 1
+                continue
+            if ok is None:
+                self._resubmit(seq)  # device failed it: try elsewhere
+                continue
+            del self.outstanding[seq]
+            w.landed_n += 1
+            self.reorder[seq] = (meta, ok)
+
+    def poll(self) -> None:
+        """Drain worker results into the in-order ready queue; watchdog
+        stalled/dead domains; resubmit failed batches.  Mux-thread only."""
+        now = time.monotonic()
+        for i, w in enumerate(self.workers):
+            p = self.policies[i]
+            # drain completed results BEFORE any eviction below: a
+            # worker that landed S1..Sk and then wedged/died on S(k+1)
+            # must not have its finished batches reassigned and re-run
+            # (eviction-first turned them into dropped late results)
+            self._drain_results(i, w)
+            patience = getattr(p, "stall_patience_s", 0.0)
+            t0 = w.land_t0
+            if (
+                patience
+                and t0
+                and now - t0 > patience
+                and not p.stalled
+            ):
+                # round-5's global tunnel-stall patience, now per device:
+                # only THIS device degrades; its batches move on
+                p.mark_stalled()
+                self._evict(i)
+            if (
+                p.stalled
+                and not w.land_t0
+                and not w.pending
+                and w.reqq.empty()
+            ):
+                # watchdog/return race: the wedged call came back (the
+                # worker cleared the flag) and THEN a stale mark_stalled
+                # re-set it.  Nothing is in flight on this worker, so no
+                # land will ever clear it again — clear it here or the
+                # domain is out of the pool forever.  The quarantine
+                # backoff from the mark still gates the re-probe.
+                p.stalled = False
+            if (
+                not self._stopping
+                and i not in self._evicted
+                and (w.error is not None or not w.thread.is_alive())
+            ):
+                self._evicted.add(i)
+                self._evict(i)
+        self.pump()
+        while self.landed_seq in self.reorder:
+            self.ready.append(self.reorder.pop(self.landed_seq))
+            self.landed_seq += 1
+
+    def idle(self) -> bool:
+        return not self.outstanding and not self.ready
+
+    def check_fatal(self) -> None:
+        """Every domain dead -> surface the first error (the supervisor
+        restarts the tile).  A partial failure is handled by eviction."""
+        errs = [w.error for w in self.workers]
+        if errs and all(e is not None for e in errs):
+            raise errs[0]
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def stop(self, timeout_s: float | None = 30.0) -> None:
+        self._stopping = True
+        for w in self.workers:
+            w.stop(timeout_s)
+
+    def abort(self, timeout_s: float = 10.0) -> tuple[list[int], int]:
+        """Crash teardown: abort every worker, drain their unlanded
+        batches (the caller deliberately discards them — the
+        supervisor's ring replay re-delivers), and report which domains
+        are wedged zombies (their policies must be detached)."""
+        self.aborted = True
+        self._stopping = True
+        zombies: list[int] = []
+        dropped = 0
+        for i, w in enumerate(self.workers):
+            dropped += len(w.abort(timeout_s))
+            if w.thread.is_alive():
+                zombies.append(i)
+        return zombies, dropped
+
+
+class VerifyTile(Tile):
     def __init__(
         self,
         *,
@@ -247,8 +702,12 @@ class VerifyTile(Tile):
         async_depth: int = 3,
         device: str = "auto",
         device_fn=None,
+        devices: int | str | list | None = 1,
         fallback_trip: int = 3,
         fallback_reprobe: int = 64,
+        dev_backoff_base_s: float = 0.5,
+        dev_backoff_max_s: float = 30.0,
+        stall_patience_s: float = 120.0,
         name: str = "verify",
     ):
         """pad_full: always pad sub-batches to max_lanes (one compiled
@@ -261,14 +720,22 @@ class VerifyTile(Tile):
         across verify tiles, fd_verify.c:46); the others are skipped
         without gathering payloads.
 
-        async_depth: device batches in flight (the wiredancer request
-        pipe depth); 1 degenerates to synchronous dispatch.
+        async_depth: device batches in flight PER DEVICE (the wiredancer
+        request pipe depth); 1 degenerates to synchronous dispatch.
 
         device: "auto" jits the batched kernel; "off" never touches JAX
         and verifies every batch on the strict host path (CPU-only tests,
         chaos harnesses, degraded deploys).  device_fn overrides the
         jitted kernel outright (fault-injection stubs).  fallback_trip /
-        fallback_reprobe parameterize the FallbackPolicy."""
+        fallback_reprobe parameterize the FallbackPolicy.
+
+        devices: the pool width — 1 (default: today's single serialized
+        stream, bit-identical), an int N (domains 0..N-1), an explicit
+        list of local device ordinals, or "auto" (every jax local
+        device; resolves to 1 off-device).  With N > 1 each domain is
+        its own fault domain: dev_backoff_base_s/dev_backoff_max_s cap
+        the quarantine backoff and stall_patience_s is the per-device
+        stall patience (round 5's global 120 s, now per device)."""
         assert max_lanes & (max_lanes - 1) == 0, (
             "max_lanes must be a power of two (pad buckets + warm compiles "
             "assume it)"
@@ -282,13 +749,40 @@ class VerifyTile(Tile):
         self.async_depth = max(async_depth, 1)
         self.device = device
         self._device_fn_override = device_fn
+        self.device_indices = _resolve_devices(devices, device, device_fn)
+        self.n_devices = len(self.device_indices)
         self.fallback_trip = fallback_trip
         self.fallback_reprobe = fallback_reprobe
+        self.dev_backoff_base_s = dev_backoff_base_s
+        self.dev_backoff_max_s = dev_backoff_max_s
+        self.stall_patience_s = stall_patience_s
+        # per-instance schema: the per-device health/throughput rows are
+        # sized by the pool width at declaration time (the topology
+        # allocates the metrics region before boot)
+        self.schema = MetricsSchema(
+            counters=(
+                "verify_fail_txns",
+                "dedup_drop_txns",
+                "verified_sigs",
+                "device_batches",
+                # FallbackPolicy state, mirrored each loop so monitors
+                # see degradation live (sums across the pool's domains)
+                "fallback_batches",
+                "device_errors",
+                "device_trips",
+                "host_reprobes",
+                "pool_resubmits",
+                "pool_late_results",
+            )
+            + device_counters(self.n_devices),
+            hists=("lane_batch",),
+        )
         self._tc: R.TCache | None = None
-        self._fn = None
-        self._policy: FallbackPolicy | None = None
-        self._worker: _DeviceWorker | None = None
+        self._fns: list | None = None
+        self._policies: list[FallbackPolicy] | None = None
+        self._pool: _DevicePool | None = None
         self._interrupt = None  # ctx.interrupt, bound at boot
+        self._mirror_tick = 0
         #: staged host-prepared lanes not yet submitted (list of dicts)
         self._staged: collections.deque = collections.deque()
         self._staged_lanes = 0
@@ -296,12 +790,71 @@ class VerifyTile(Tile):
         self._outq: collections.deque = collections.deque()
         self._outq_txns = 0
 
+    @property
+    def _policy(self) -> FallbackPolicy | None:
+        """Compat view for single-device callers/tests."""
+        return self._policies[0] if self._policies else None
+
     def wksp_footprint(self) -> int:
         if not self.pre_dedup:
             return 0
         return R.TCache.footprint(
             PRE_DEDUP_DEPTH, R.TCache.map_cnt_for(PRE_DEDUP_DEPTH)
         )
+
+    def _make_device_fns(self) -> list:
+        """One verify executable per pool domain.  With real devices
+        (device="auto", n>1) each is pinned to its own accelerator —
+        inputs commit there, so one domain's H2D put overlaps the other
+        domains' compute (round-3 measurement: a device_put progresses
+        while an execution runs)."""
+        n = self.n_devices
+        if self._device_fn_override is not None:
+            return [self._device_fn_override] * n
+        if self.device != "auto":
+            return [None] * n
+        if self._fns is None:
+            import jax
+
+            from firedancer_tpu.ops.ed25519 import verify as fver
+
+            # digest-input variant: host hashes SHA512(R||A||M) during
+            # lane expansion, so each lane ships 160 device bytes
+            # (digest+sig+pub) instead of msg_width+100 — the pipeline is
+            # host->device bandwidth bound, not compute bound (PROFILE.md)
+            if self.device_indices == [0]:
+                # the default single-stream tile: plain jit on the
+                # default device — bit-identical to the pre-pool path
+                self._fns = [jax.jit(fver.verify_batch_digest)]
+            else:
+                local = jax.local_devices()
+                bad = [d for d in self.device_indices if d >= len(local)]
+                if bad:
+                    # aliasing d % len(local) would silently pin two
+                    # pool domains to one chip and report N healthy
+                    # independent devices — surface the misconfig instead
+                    raise ValueError(
+                        f"{self.name}: devices {bad} out of range — host "
+                        f"has {len(local)} local device(s)"
+                    )
+                self._fns = [
+                    fver.verify_batch_digest_on(local[d])
+                    for d in self.device_indices
+                ]
+            # warm the full-batch shape (per device) so the steady state
+            # never compiles; smaller pow2 buckets (trickle traffic)
+            # compile on first use — warming every bucket cost minutes
+            # of boot on CPU hosts.  The persistent compilation cache
+            # makes devices 1..n-1 near-free after device 0.
+            for f in self._fns:
+                np.asarray(
+                    f(
+                        np.zeros((self.max_lanes, 64), dtype=np.uint8),
+                        np.zeros((self.max_lanes, 64), np.uint8),
+                        np.zeros((self.max_lanes, 32), np.uint8),
+                    )
+                )
+        return self._fns
 
     def on_boot(self, ctx: MuxCtx) -> None:
         from firedancer_tpu.ops.ed25519 import hostpath
@@ -316,45 +869,38 @@ class VerifyTile(Tile):
             # must NOT be swallowed by a stale pre-dedup entry — the
             # real dedup tile downstream keeps the durable history
             self._tc = R.TCache(ctx.alloc("tcache", fp), depth, map_cnt)
-        dev = self._device_fn_override
-        if dev is None and self.device == "auto" and self._fn is None:
-            import jax
-
-            from firedancer_tpu.ops.ed25519 import verify as fver
-
-            # digest-input variant: host hashes SHA512(R||A||M) during
-            # lane expansion, so each lane ships 160 device bytes
-            # (digest+sig+pub) instead of msg_width+100 — the pipeline is
-            # host->device bandwidth bound, not compute bound (PROFILE.md)
-            self._fn = jax.jit(fver.verify_batch_digest)
-            # warm the full-batch shape so the steady state never
-            # compiles; smaller pow2 buckets (trickle traffic) compile on
-            # first use — warming every bucket cost minutes of boot on
-            # CPU hosts
-            np.asarray(
-                self._fn(
-                    np.zeros((self.max_lanes, 64), dtype=np.uint8),
-                    np.zeros((self.max_lanes, 64), np.uint8),
-                    np.zeros((self.max_lanes, 32), np.uint8),
-                )
-            )
-        if dev is None and self.device == "auto":
-            dev = self._fn
-        if self._policy is None:
-            # policy (and its degradation counters) persists across
-            # supervisor restarts; only the worker thread is per-life
-            self._policy = FallbackPolicy(
-                dev,
-                hostpath.verify_batch_digest_host,
-                trip_after=self.fallback_trip,
-                reprobe_every=self.fallback_reprobe,
-                fault_hook=(
-                    ctx.faults.device_error
-                    if ctx.faults is not None
-                    else None
-                ),
-            )
-        self._worker = _DeviceWorker(self._policy, self.async_depth)
+        fns = self._make_device_fns()
+        if self._policies is None:
+            # policies (and their degradation counters) persist across
+            # supervisor restarts; only the worker threads are per-life
+            hook = ctx.faults.device_error if ctx.faults is not None else None
+            if self.n_devices == 1:
+                self._policies = [
+                    FallbackPolicy(
+                        fns[0],
+                        hostpath.verify_batch_digest_host,
+                        trip_after=self.fallback_trip,
+                        reprobe_every=self.fallback_reprobe,
+                        fault_hook=hook,
+                    )
+                ]
+            else:
+                self._policies = [
+                    DevicePolicy(
+                        fns[i],
+                        hostpath.verify_batch_digest_host,
+                        index=i,
+                        trip_after=self.fallback_trip,
+                        backoff_base_s=self.dev_backoff_base_s,
+                        backoff_max_s=self.dev_backoff_max_s,
+                        stall_patience_s=self.stall_patience_s,
+                        fault_hook=hook,
+                    )
+                    for i in range(self.n_devices)
+                ]
+        self._pool = _DevicePool(
+            self._policies, self.async_depth, name=self.name
+        )
 
     # ---- ingress: host prep + staging -----------------------------------
 
@@ -382,22 +928,22 @@ class VerifyTile(Tile):
         b["tsorigs"] = frags["tsorig"].copy()
         self._staged.append(b)
         self._staged_lanes += lanes
-        # submit only while the request pipe has room: a full pipe means
-        # the device/host worker is behind, and the right response is to
-        # hold frags in the RING (in_budget -> credit backpressure), not
-        # to block this thread past its heartbeat deadline
+        # submit only while the pool has room: a full pool means every
+        # device pipe is behind, and the right response is to hold frags
+        # in the RING (in_budget -> credit backpressure), not to block
+        # this thread past its heartbeat deadline
         while (
             self._staged_lanes >= self.max_lanes
-            and not self._worker.reqq.full()
+            and self._pool.can_accept()
         ):
             self._submit_front(self.max_lanes)
 
     def in_budget(self, ctx: MuxCtx) -> int | None:
-        # stop draining the ring when the device pipe is full or results
+        # stop draining the ring when the device pool is full or results
         # are waiting on downstream credits — backpressure flows upstream
         # through the ring's credit model, not an unbounded host buffer
-        w = self._worker
-        if w is not None and w.reqq.full():
+        p = self._pool
+        if p is not None and not p.can_accept():
             return 0
         if self._staged_lanes >= 2 * self.max_lanes:
             return 0
@@ -409,7 +955,7 @@ class VerifyTile(Tile):
 
     def _submit_front(self, lanes_cap: int) -> None:
         """Concatenate staged chunks into one device batch of <= lanes_cap
-        lanes (whole txns only) and push it to the worker."""
+        lanes (whole txns only) and push it to the pool."""
         take, lanes = [], 0
         while self._staged:
             chunk = self._staged[0]
@@ -466,34 +1012,34 @@ class VerifyTile(Tile):
         )
 
     def _submit(self, meta, args) -> None:
-        """Interruptible submit: a full request pipe behind a slow host
-        path must not turn into an unbounded blocking put — the
-        supervisor's interrupt (stall recovery) and a dead worker both
-        have to be able to unwedge the loop thread."""
-        w = self._worker
+        """Interruptible submit: a full pool behind a slow host path
+        must not turn into an unbounded blocking put — the supervisor's
+        interrupt (stall recovery) and dead workers both have to be
+        able to unwedge the loop thread."""
+        pool = self._pool
         while True:
-            if w.error is not None:
-                raise w.error
-            if w.aborted:
+            pool.check_fatal()
+            if pool.aborted:
                 return  # crash teardown: ring replay re-delivers
             if self._interrupt is not None and self._interrupt.is_set():
                 from firedancer_tpu.disco.mux import TileInterrupted
 
                 raise TileInterrupted(f"{self.name}: submit abandoned")
-            try:
-                w.reqq.put((meta, args), timeout=0.05)
+            if pool.submit(meta, args):
                 return
-            except queue.Full:
-                continue
+            # no capacity anywhere: poll (stall watchdog + retry pump
+            # may free a lane) and wait for a worker to make progress
+            pool.poll()
+            time.sleep(1e-3)
 
     # ---- egress: results -> publish --------------------------------------
 
     def _land_results(self, ctx: MuxCtx) -> None:
-        w = self._worker
-        if w.error is not None:
-            raise w.error
-        while w.results:
-            meta, ok = w.results.popleft()
+        pool = self._pool
+        pool.check_fatal()
+        pool.poll()
+        while pool.ready:
+            meta, ok = pool.ready.popleft()
             lanes = meta["lanes"]
             ok = ok[:lanes]
             ctx.metrics.inc("verified_sigs", lanes)
@@ -547,73 +1093,153 @@ class VerifyTile(Tile):
     def after_credit(self, ctx: MuxCtx) -> None:
         self._land_results(ctx)
         self._publish_ready(ctx)
-        # keep the device fed: push a partial batch when the request pipe
-        # has room and nothing fuller is coming (trickle traffic)
-        if self._staged_lanes and not self._worker.reqq.full():
+        # keep the devices fed: push a partial batch when the pool has
+        # room and nothing fuller is coming (trickle traffic)
+        if self._staged_lanes and self._pool.can_accept():
             self._submit_front(self.max_lanes)
         self._mirror_policy_metrics(ctx)
 
     def _mirror_policy_metrics(self, ctx: MuxCtx) -> None:
-        """Expose the FallbackPolicy degradation state in the shared
-        metrics region (monitors read it live)."""
-        p = self._policy
+        """Expose the pool's degradation state in the shared metrics
+        region (monitors read it live).  Aggregates every iteration;
+        per-device rows every 16th (they are O(devices) set calls)."""
+        pool = self._pool
+        ps = self._policies
         m = ctx.metrics
-        m.set("fallback_batches", p.fallback_batches)
-        m.set("device_errors", p.device_errors)
-        m.set("device_trips", p.device_trips)
-        m.set("host_reprobes", p.host_reprobes)
+        m.set("fallback_batches", sum(p.fallback_batches for p in ps))
+        m.set("device_errors", sum(p.device_errors for p in ps))
+        m.set("device_trips", sum(p.device_trips for p in ps))
+        m.set("host_reprobes", sum(p.host_reprobes for p in ps))
+        m.set("pool_resubmits", pool.resubmits)
+        m.set("pool_late_results", pool.late_results)
+        self._mirror_tick += 1
+        if (self._mirror_tick & 0xF) != 1:
+            return
+        now = time.monotonic()
+        for i, w in enumerate(pool.workers):
+            p = ps[i]
+            m.set(f"dev{i}_depth", w.reqq.qsize())
+            m.set(f"dev{i}_inflight", max(w.inflight(), 0))
+            m.set(f"dev{i}_landed", w.landed_n)
+            m.set(f"dev{i}_failed", p.device_errors + getattr(
+                p, "device_stalls", 0))
+            degraded = (
+                # a cleanly stopped worker (halt) is not a fault; a
+                # dead/errored one mid-run is
+                (not w.alive() and not pool._stopping)
+                or w.error is not None
+                or p.stalled
+                or (p.tripped and not p.healthy(now))
+            )
+            m.set(f"dev{i}_degraded", int(degraded))
 
     def on_crash(self, ctx: MuxCtx) -> None:
         # drop in-flight host state: the supervisor's ring replay
         # re-delivers anything the dead incarnation consumed but never
         # forwarded, and the downstream dedup collapses re-delivery of
-        # what it DID forward.  The policy object (device fn + trip
-        # state) survives into the next incarnation.
-        if self._worker is not None:
-            self._worker.abort()
-            if self._worker.thread.is_alive() and self._policy is not None:
-                # the zombie worker (stuck mid host-verify; threads are
-                # unkillable) still holds the old policy — detach a
+        # what it DID forward.  The policy objects (device fns + trip
+        # state) survive into the next incarnation.
+        if self._pool is not None:
+            zombies, _dropped = self._pool.abort()
+            for i in zombies:
+                # the zombie worker (stuck mid device/host call; threads
+                # are unkillable) still holds its old policy — detach a
                 # fresh copy so its late dispatch/land calls can't
                 # corrupt the live incarnation's degradation state
-                old = self._policy
-                p = FallbackPolicy(
-                    old.device_fn, old.host_fn,
+                self._policies[i] = _clone_policy(
+                    self._policies[i],
                     trip_after=self.fallback_trip,
                     reprobe_every=self.fallback_reprobe,
-                    fault_hook=old.fault_hook,
                 )
-                for attr in (
-                    "consec_failures", "tripped", "fallback_batches",
-                    "device_errors", "device_trips", "host_reprobes",
-                ):
-                    setattr(p, attr, getattr(old, attr))
-                self._policy = p
-            self._worker = None
+            self._pool = None
         self._staged.clear()
         self._staged_lanes = 0
         self._outq.clear()
         self._outq_txns = 0
 
     def on_halt(self, ctx: MuxCtx) -> None:
-        # drain everything: staged -> device -> results -> downstream.
+        # drain everything: staged -> devices -> results -> downstream.
         # consumers are still running (topology halts upstream-first,
         # disco/topo.py halt order), so credits keep freeing.
         while self._staged_lanes:
             self._submit_front(self.max_lanes)
-        self._worker.stop()
+        pool = self._pool
+        deadline = time.monotonic() + 60.0
+        while not pool.idle() and time.monotonic() < deadline:
+            self._land_results(ctx)
+            if pool.outstanding:
+                time.sleep(1e-3)
+        pool.stop()
         self._land_results(ctx)
-        import time as _t
-
-        deadline = _t.monotonic() + 30.0
-        while self._outq and _t.monotonic() < deadline:
+        deadline = time.monotonic() + 30.0
+        while self._outq and time.monotonic() < deadline:
             cr = min(o.cr_avail() for o in ctx.outs) if ctx.outs else 0
             if cr <= 0:
-                _t.sleep(100e-6)
+                time.sleep(100e-6)
                 continue
             ctx.credits = cr
             self._publish_ready(ctx)
+        self._mirror_tick = 0  # force the per-device rows one last time
         self._mirror_policy_metrics(ctx)
+
+
+def _resolve_devices(devices, device: str, device_fn) -> list[int]:
+    """`devices` spec -> local device ordinals (pool domains).
+
+    "auto" probes jax ONLY for a real device="auto" kernel (a host-only
+    or stubbed tile must never pull the backend in); int N = ordinals
+    0..N-1 (logical domains when stubbed); an explicit list is taken
+    verbatim (disjoint ordinal sets across seq-sharded replicas — see
+    disco.topo.device_assignments)."""
+    if devices in (None, 1, "off"):
+        return [0]  # "off" mirrors disco.topo.device_assignments
+    if devices == "auto":
+        if device == "auto" and device_fn is None:
+            from firedancer_tpu.utils.hostdev import local_device_count
+
+            return list(range(local_device_count()))
+        return [0]
+    if isinstance(devices, int):
+        return list(range(max(devices, 1)))
+    out = [int(d) for d in devices]
+    return out or [0]
+
+
+def _clone_policy(
+    old: FallbackPolicy, *, trip_after: int, reprobe_every: int
+) -> FallbackPolicy:
+    """Fresh policy object carrying over the old one's degradation
+    state (a wedged zombie thread keeps a dead reference instead)."""
+    if isinstance(old, DevicePolicy):
+        p: FallbackPolicy = DevicePolicy(
+            old.device_fn, old.host_fn,
+            index=old.index,
+            trip_after=old.trip_after,
+            backoff_base_s=old.backoff_base_s,
+            backoff_max_s=old.backoff_max_s,
+            stall_patience_s=old.stall_patience_s,
+            fault_hook=old.fault_hook,
+        )
+        for attr in ("backoff_s", "quarantined_until", "device_stalls"):
+            setattr(p, attr, getattr(old, attr))
+        # NOT `stalled`: only the wedged call's return clears that flag,
+        # and the zombie holds the OLD object — a copied flag would
+        # quarantine the clone forever.  The carried-over backoff still
+        # delays the re-probe, and a still-wedged device just re-trips
+        # the patience watchdog.
+    else:
+        p = FallbackPolicy(
+            old.device_fn, old.host_fn,
+            trip_after=trip_after,
+            reprobe_every=reprobe_every,
+            fault_hook=old.fault_hook,
+        )
+    for attr in (
+        "consec_failures", "tripped", "fallback_batches",
+        "device_errors", "device_trips", "host_reprobes",
+    ):
+        setattr(p, attr, getattr(old, attr))
+    return p
 
 
 def _split_chunk(chunk: dict, k_txns: int, k_lanes: int) -> tuple[dict, dict]:

@@ -41,6 +41,23 @@ def ensure_cpu_devices(n: int) -> None:
     enable_compilation_cache()
 
 
+def local_device_count(default: int = 1) -> int:
+    """Local accelerator inventory for "auto" device specs (the verify
+    pool, disco.topo.device_assignments, bench.py's multichip mode).
+
+    Initializes the jax backend if it is not already up — callers that
+    must control the platform (virtual CPU meshes) call
+    ensure_cpu_devices() FIRST; afterwards the count is frozen.  Returns
+    `default` when jax is unavailable so host-only configs never fail on
+    a missing accelerator stack."""
+    try:
+        import jax
+
+        return max(len(jax.local_devices()), 1)
+    except Exception:
+        return default
+
+
 def enable_compilation_cache(
     path: str | None = None, min_secs: float = 1.0
 ) -> None:

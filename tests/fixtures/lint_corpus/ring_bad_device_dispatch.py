@@ -1,0 +1,58 @@
+"""known-bad: a tile driving the device straight from its mux-loop hook
+bodies — device_put / jax calls / device-executable calls inside
+on_frags/after_credit block heartbeats behind D2H latency and bypass the
+device pool's per-device fault domains.  Must trip device-dispatch."""
+
+import jax
+import numpy as np
+
+
+class EagerVerifyTile:
+    def __init__(self, device_fn):
+        self.device_fn = device_fn
+        self._fns = [device_fn]
+        self._outq = []
+
+    def on_frags(self, ctx, in_idx, frags):
+        # BAD: H2D transfer on the mux thread
+        staged = jax.device_put(frags["payload"])
+        # BAD: device executable invoked in the hook body
+        ok = self.device_fn(staged)
+        self._outq.append(np.asarray(ok))
+
+    def after_credit(self, ctx):
+        if self._outq:
+            # BAD: synchronous device wait in the credit hook
+            jax.block_until_ready(self._outq[0])
+            # BAD: compiled-executable table call in the hook body
+            self._fns[0](self._outq.pop())
+
+
+class PooledVerifyTile:
+    """control: staging + pool submit/poll in the hooks is the sanctioned
+    shape and must NOT trip the rule."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._staged = []
+
+    def on_frags(self, ctx, in_idx, frags):
+        self._staged.append(frags)
+        while self._staged and self._pool.can_accept():
+            self._pool.submit({"lanes": 1}, self._staged.pop())
+
+    def after_credit(self, ctx):
+        self._pool.poll()
+        while self._pool.ready:
+            ctx.publish(self._pool.ready.popleft())
+
+
+class _StubDeviceWorkerPool:
+    """control: a Worker/Pool class owns device calls — even a
+    hook-named method here is its private protocol, not a tile hook."""
+
+    def __init__(self, device_fn):
+        self.device_fn = device_fn
+
+    def on_frags(self, ctx, in_idx, frags):
+        return self.device_fn(jax.device_put(frags))

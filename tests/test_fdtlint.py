@@ -46,6 +46,7 @@ BAD_FIXTURES = {
     "ring_bad_write_after_publish.py": "ring-publish-order",
     "ring_bad_publish_no_credit.py": "ring-credit",
     "ring_bad_unhooked_ringop.py": "ring-mc-hook",
+    "ring_bad_device_dispatch.py": "device-dispatch",
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",
     "purity_bad_branch.py": "purity-untraced-branch",
@@ -114,6 +115,16 @@ def test_mc_hook_coverage(repo_report):
     cov = repo_report.coverage
     assert "firedancer_tpu/tango/rings.py" in set(cov["ring_files"])
     assert cov["mc_hook_fns"] >= 13, cov["mc_hook_fns"]
+
+
+def test_device_dispatch_fixture_controls_are_clean():
+    """The rule flags every direct device call in the eager tile's hook
+    bodies and NONE in the two controls (pool-routed hooks; a Worker/
+    Pool-owned method, even hook-named)."""
+    rep = engine.run_paths([CORPUS / "ring_bad_device_dispatch.py"])
+    hits = [f for f in rep.findings if f.rule == "device-dispatch"]
+    assert len(hits) == 4, hits  # the four BAD lines in EagerVerifyTile
+    assert all(f.line < 30 for f in hits), hits  # controls stay clean
 
 
 def test_unhooked_fixture_guarded_control_is_clean():
