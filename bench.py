@@ -176,11 +176,11 @@ def _bench_sha512_fallback() -> dict:
     }
 
 
-def _bench_pipeline_tps() -> float:
-    """Sustained pipeline TPS: replayed pcap corpus → verify(TPU) → dedup
-    → sink over real rings (reference analog: fddev bench topology,
-    src/app/fddev/bench.c:62-90, with the replay tile as the load source).
-    """
+def _bench_pipeline_tps():
+    """Sustained pipeline TPS + tail-latency keys: replayed pcap corpus
+    → verify(TPU) → dedup → sink over real rings (reference analog:
+    fddev bench topology, src/app/fddev/bench.c:62-90, with the replay
+    tile as the load source).  Returns (tps, {latency keys})."""
     import os
     import tempfile
 
@@ -213,8 +213,9 @@ def _bench_pipeline_tps() -> float:
             os.unlink(path)
 
 
-def _run_pipeline_tps(path, rows, szs, pool_n, total) -> float:
+def _run_pipeline_tps(path, rows, szs, pool_n, total):
     from firedancer_tpu.disco import Topology
+    from firedancer_tpu.disco import metrics as M
     from firedancer_tpu.tiles import wire
     from firedancer_tpu.tiles.dedup import DedupTile
     from firedancer_tpu.tiles.replay import ReplayTile
@@ -256,7 +257,20 @@ def _run_pipeline_tps(path, rows, szs, pool_n, total) -> float:
         dt = time.perf_counter() - t0
         done = md.counter("in_frags")
         topo.halt()
-        return done / dt
+        # tail-latency keys alongside the throughput number, from the
+        # per-link latency hists the run loop records (disco/mux.py):
+        # e2e at the sink's in-link = replay tsorig -> pipeline exit;
+        # verify hop = the verify tile's per-batch service time
+        lat = {}
+        ms = topo.metrics("sink")
+        he = ms.hist("e2e_us_dedup_sink")
+        if he["count"]:
+            lat["e2e_p50_us"] = round(M.hist_percentile(he, 50), 1)
+            lat["e2e_p99_us"] = round(M.hist_percentile(he, 99), 1)
+        hv = topo.metrics("verify").hist("svc_us_replay_verify")
+        if hv["count"]:
+            lat["verify_hop_p99_us"] = round(M.hist_percentile(hv, 99), 1)
+        return done / dt, lat
     finally:
         topo.close()
 
@@ -433,7 +447,13 @@ def main() -> None:
     try:
         if "verify_path" not in skip:
             # verify-path rate (replay -> verify(TPU) -> dedup over rings)
-            result["verify_path_tps"] = round(_bench_pipeline_tps(), 1)
+            # + tail-latency keys (e2e_p50_us/e2e_p99_us from the sink's
+            # end-to-end hist, verify_hop_p99_us from verify's service
+            # hist) so the BENCH trajectory tracks tail latency, not
+            # just throughput
+            tps, lat = _bench_pipeline_tps()
+            result["verify_path_tps"] = round(tps, 1)
+            result.update(lat)
     except Exception:
         pass  # the headline metric line must never break
     try:

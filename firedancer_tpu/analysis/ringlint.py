@@ -38,6 +38,17 @@ over the tile layer (tiles/, disco/):
                        the mux thread blocks heartbeats behind D2H
                        latency and bypasses the per-device fault
                        domains (quarantine/backoff/host fallback).
+  hot-path-clock       tile hook bodies (on_frags/after_credit) must not
+                       read the clock through bare time.* calls
+                       (time.monotonic_ns / time.time / ...) — clock
+                       reads go through the sanctioned helpers:
+                       disco.mux.now_ts() (the compressed frag-timestamp
+                       domain, wrap-handled by ts_diff) or
+                       tango.tempo.tickcount() (the calibrated tick
+                       source).  A bare call silently forks the tile
+                       off the loop's phase-sampling discipline and the
+                       u32 wrap handling the latency attribution
+                       depends on.
 
 Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
 `*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
@@ -303,12 +314,12 @@ def _device_call_reason(call: ast.Call) -> str | None:
     return None
 
 
-def _check_device_dispatch(path: str, tree: ast.AST) -> list[Finding]:
-    """device-dispatch: no direct jax/executable calls from tile
-    on_frags/after_credit bodies — only the worker classes drive
-    devices (they run on their own threads, under a policy that owns
-    failure/quarantine/fallback)."""
-    findings: list[Finding] = []
+def _iter_tile_hooks(tree: ast.AST):
+    """Yield the tile-owned hook functions (on_frags/after_credit) in a
+    module — the mux-loop bodies the hot-path rules police.  Hook-named
+    methods inside Worker/Pool/Policy classes are private protocol
+    (they run on their own threads) and are skipped; both the
+    device-dispatch and hot-path-clock rules share this carve-out."""
     exempt: set[int] = set()
     for cls in ast.walk(tree):
         if isinstance(cls, ast.ClassDef) and any(
@@ -318,8 +329,17 @@ def _check_device_dispatch(path: str, tree: ast.AST) -> list[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        if fn.name not in DEVICE_DISPATCH_HOOKS or id(fn) in exempt:
-            continue
+        if fn.name in DEVICE_DISPATCH_HOOKS and id(fn) not in exempt:
+            yield fn
+
+
+def _check_device_dispatch(path: str, tree: ast.AST) -> list[Finding]:
+    """device-dispatch: no direct jax/executable calls from tile
+    on_frags/after_credit bodies — only the worker classes drive
+    devices (they run on their own threads, under a policy that owns
+    failure/quarantine/fallback)."""
+    findings: list[Finding] = []
+    for fn in _iter_tile_hooks(tree):
         for call in ast.walk(fn):
             if not isinstance(call, ast.Call):
                 continue
@@ -336,6 +356,47 @@ def _check_device_dispatch(path: str, tree: ast.AST) -> list[Finding]:
                         "per-device fault domains",
                     )
                 )
+    return findings
+
+
+#: bare clock reads banned from tile hook bodies — the sanctioned
+#: routes are disco.mux.now_ts() / tango.tempo.tickcount()
+_CLOCK_ATTRS = {
+    "time",
+    "time_ns",
+    "monotonic",
+    "monotonic_ns",
+    "perf_counter",
+    "perf_counter_ns",
+}
+
+
+def _check_hot_clock(path: str, tree: ast.AST) -> list[Finding]:
+    """hot-path-clock: no bare time.* clock reads in tile
+    on_frags/after_credit bodies (the Worker/Pool/Policy carve-out is
+    _iter_tile_hooks', shared with device-dispatch)."""
+    findings: list[Finding] = []
+    for fn in _iter_tile_hooks(tree):
+        for call in ast.walk(fn):
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _CLOCK_ATTRS
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "time"
+            ):
+                continue
+            findings.append(
+                Finding(
+                    path, call.lineno, "hot-path-clock",
+                    f"bare clock read time.{call.func.attr}() in tile "
+                    f"hook {fn.name} — go through mux.now_ts() (the "
+                    "compressed frag-timestamp domain, wrap-safe via "
+                    "ts_diff) or tango.tempo.tickcount(): a direct "
+                    "call forks the tile off the loop's phase-sampling "
+                    "and u32-wrap discipline",
+                )
+            )
     return findings
 
 
@@ -404,5 +465,8 @@ def check_file(
 
     # -- device-dispatch -------------------------------------------------
     findings.extend(_check_device_dispatch(disp, tree))
+
+    # -- hot-path-clock ----------------------------------------------------
+    findings.extend(_check_hot_clock(disp, tree))
 
     return apply_pragmas(sorted(set(findings)), text.splitlines())

@@ -12,10 +12,35 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 
-from firedancer_tpu.disco.metrics import Metrics, MetricsSchema, device_rows
+from firedancer_tpu.disco.metrics import (
+    Metrics,
+    MetricsSchema,
+    device_rows,
+    hist_percentile,
+)
 from firedancer_tpu.tango import rings as R
 
+#: the per-in-link latency-attribution hist prefixes the run loop
+#: records (disco.mux.LINK_HIST_KINDS) — the monitor renders these as
+#: per-hop percentile rows
+_LAT_PREFIXES = ("qwait_us_", "svc_us_", "e2e_us_")
+
 _SIGNAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
+
+
+def _hist_delta(cur: dict, prev: dict | None) -> dict:
+    """Windowed hist: cur - prev per bucket (both are cumulative
+    monotone snapshots of the same region).  No prev -> cumulative."""
+    if not prev or not prev.get("count"):
+        return cur
+    return {
+        "count": cur.get("count", 0) - prev.get("count", 0),
+        "sum": cur.get("sum", 0) - prev.get("sum", 0),
+        "buckets": [
+            a - b
+            for a, b in zip(cur.get("buckets", []), prev.get("buckets", []))
+        ],
+    }
 
 
 @dataclass
@@ -64,6 +89,13 @@ class Monitor:
                 "counters": {
                     c: tv.metrics.counter(c)
                     for c in tv.metrics.schema.counters
+                },
+                # per-hop latency attribution hists (queue-wait /
+                # service / end-to-end per in-link)
+                "lat_hists": {
+                    h: tv.metrics.hist(h)
+                    for h in tv.metrics.schema.hists
+                    if h.startswith(_LAT_PREFIXES)
                 },
             }
         for lname, ls in self.links.items():
@@ -126,10 +158,11 @@ class Monitor:
         return out
 
     def render(self, prev: dict | None, cur: dict, dt: float) -> str:
-        """Tile table with in/out rates (frags/s) since the last snapshot."""
+        """Tile table with in/out rates (frags/s), %backpressure, and
+        per-hop latency percentiles since the last snapshot."""
         lines = [
             f"{'tile':>10} {'state':>5} {'in/s':>12} {'out/s':>12} "
-            f"{'in_frags':>12} {'out_frags':>12}"
+            f"{'in_frags':>12} {'out_frags':>12} {'bp%':>6}"
         ]
         for name, row in cur.items():
             if name == "_links":
@@ -139,8 +172,19 @@ class Monitor:
                 p = prev[name]["counters"]
                 rin = (c["in_frags"] - p["in_frags"]) / dt
                 rout = (c["out_frags"] - p["out_frags"]) / dt
+                d_bp = c.get("backpressure_iters", 0) - p.get(
+                    "backpressure_iters", 0
+                )
+                d_loop = c.get("loop_iters", 0) - p.get("loop_iters", 0)
             else:
                 rin = rout = 0.0
+                d_bp = c.get("backpressure_iters", 0)
+                d_loop = c.get("loop_iters", 0)
+            # %backpressure: share of loop iterations spent with zero
+            # credits (stalled behind a slow reliable consumer) in the
+            # window — every backpressure iteration also counts in
+            # loop_iters, so the ratio is direct
+            bp_pct = 100.0 * d_bp / max(d_loop, 1)
             flag = " STALE" if row.get("stale") else ""
             if c.get("degraded"):
                 flag += " DEGRADED"
@@ -148,8 +192,45 @@ class Monitor:
                 flag += f" restarts={c['restarts']}"
             lines.append(
                 f"{name:>10} {row['signal']:>5} {rin:12,.0f} {rout:12,.0f} "
-                f"{c['in_frags']:12,} {c['out_frags']:12,}{flag}"
+                f"{c['in_frags']:12,} {c['out_frags']:12,} {bp_pct:5.1f}%"
+                f"{flag}"
             )
+            # per-hop latency sub-rows: queue-wait / end-to-end
+            # percentiles per in-link (the qwait/svc/e2e hists the run
+            # loop records in the compressed-µs domain), windowed
+            # against the previous snapshot like bp% — a regression
+            # hours into a run must move the displayed p99 within one
+            # refresh, not be pinned by cumulative history
+            links = sorted(
+                {
+                    h[len("qwait_us_"):]
+                    for h in row.get("lat_hists", {})
+                    if h.startswith("qwait_us_")
+                }
+            )
+            p_hists = (
+                prev[name].get("lat_hists", {})
+                if prev is not None and name in prev
+                else {}
+            )
+            for ln in links:
+                hq = _hist_delta(
+                    row["lat_hists"].get(f"qwait_us_{ln}", {}),
+                    p_hists.get(f"qwait_us_{ln}"),
+                )
+                he = _hist_delta(
+                    row["lat_hists"].get(f"e2e_us_{ln}", {}),
+                    p_hists.get(f"e2e_us_{ln}"),
+                )
+                if not hq.get("count") and not he.get("count"):
+                    continue
+                lines.append(
+                    f"{'':>10}   lat {ln}: "
+                    f"qwait p50={hist_percentile(hq, 50):,.0f}us "
+                    f"p99={hist_percentile(hq, 99):,.0f}us | "
+                    f"e2e p50={hist_percentile(he, 50):,.0f}us "
+                    f"p99={hist_percentile(he, 99):,.0f}us"
+                )
             # device-pool health sub-rows (tiles exporting dev{i}_*
             # counters — the multi-device verify scale-out)
             devs = device_rows(c)

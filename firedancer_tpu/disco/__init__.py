@@ -10,7 +10,16 @@ code or on the TPU, never in the Python loop body.
 """
 
 from .faultinj import Fault, FaultInjector  # noqa: F401
-from .metrics import Metrics, MetricsSchema  # noqa: F401
-from .mux import InLink, MuxCtx, OutLink, Tile, run_loop  # noqa: F401
+from .metrics import Metrics, MetricsSchema, hist_percentile  # noqa: F401
+from .mux import (  # noqa: F401
+    InLink,
+    MuxCtx,
+    OutLink,
+    Tile,
+    run_loop,
+    ts_diff,
+    ts_diff_arr,
+)
 from .supervisor import RestartPolicy, Supervisor  # noqa: F401
 from .topo import Topology  # noqa: F401
+from .trace import SpanRing, TraceConfig, Tracer  # noqa: F401

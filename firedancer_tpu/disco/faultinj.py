@@ -174,6 +174,10 @@ class TileFaults:
     def __init__(self, inj: FaultInjector, tile: str, faults: list):
         self.inj = inj
         self.tile = tile
+        #: span tracer (disco/trace.py), bound by the run loop at boot
+        #: so injected faults annotate themselves into the tile's trace
+        #: (only ever written from the tile's own loop thread)
+        self.tracer = None
         self.ticks = 0
         self.frags_seen = 0  # across all in-links (on="frag" triggers)
         self._link_idx: dict[str, int] = {}  # per-link cumulative index
@@ -212,9 +216,15 @@ class TileFaults:
             f.fired = True
             if f.kind == "kill":
                 self.inj.log(self.tile, "kill", f.at)
+                if self.tracer is not None:
+                    self.tracer.fault("kill", seq=f.at)
                 raise FaultKill(f"{self.tile}: scripted kill at {f.at}")
             if f.kind == "stall":
                 self.inj.log(self.tile, "stall", f.at, f.duration_s)
+                if self.tracer is not None:
+                    self.tracer.fault(
+                        "stall", seq=f.at, aux64=int(f.duration_s * 1e6)
+                    )
                 self._stall(ctx, f.duration_s)
             elif f.kind == "backpressure":
                 self.inj.log(self.tile, "backpressure", f.at, f.count)
@@ -269,6 +279,8 @@ class TileFaults:
             if not sel.any():
                 continue
             hit = np.flatnonzero(sel)
+            if self.tracer is not None:
+                self.tracer.fault(f.kind, seq=f.at, aux64=len(hit))
             if f.kind == "drop":
                 keep[hit] = False
                 self.inj.log(

@@ -183,3 +183,36 @@ class Metrics:
         out = {c: self.counter(c) for c in self.schema.counters}
         out.update({h: self.hist(h) for h in self.schema.hists})
         return out
+
+
+# ---------------------------------------------------------------------------
+# percentile estimation over the 16-bucket log2 histograms
+#
+# Bucket b holds samples v with floor(log2(max(v, 1))) == b, i.e. bucket 0
+# covers [0, 2) and bucket b covers [2^b, 2^(b+1)), with the top bucket
+# clamped open-ended.  A percentile is estimated by walking the cumulative
+# counts to the containing bucket and interpolating linearly inside it —
+# the error is bounded by the bucket's 2x span, which is the resolution
+# the storage format buys (the reference converts the same fd_histf
+# buckets to approximate percentiles in fd_top).
+
+
+def hist_percentile(h: dict, q: float) -> float:
+    """Estimate the q-th percentile (q in [0, 100]) of a Metrics.hist()
+    snapshot by log-bucket linear interpolation.  0.0 on an empty hist."""
+    buckets = h.get("buckets") or []
+    count = h.get("count", 0)
+    if count <= 0:
+        return 0.0
+    rank = (min(max(q, 0.0), 100.0) / 100.0) * count
+    cum = 0
+    for b, n in enumerate(buckets):
+        if n and cum + n >= rank:
+            lo = 0.0 if b == 0 else float(1 << b)
+            # the top bucket is open-ended; assume the same 2x
+            # geometric span as the others (documented estimator bias
+            # for distributions with mass beyond 2^HIST_BUCKETS)
+            hi = float(1 << (b + 1))
+            return lo + (hi - lo) * ((rank - cum) / n)
+        cum += n
+    return float(1 << HIST_BUCKETS)

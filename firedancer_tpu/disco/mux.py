@@ -25,6 +25,8 @@ import numpy as np
 from firedancer_tpu.tango import rings as R
 
 from .metrics import Metrics, MetricsSchema
+from .trace import BP as _SPAN_BP
+from .trace import HK as _SPAN_HK
 
 
 class TileInterrupted(RuntimeError):
@@ -41,6 +43,47 @@ def now_ts() -> int:
     return (time.monotonic_ns() // 1000) & 0xFFFFFFFF
 
 
+# -- wrap-safe compressed-timestamp arithmetic ------------------------------
+#
+# now_ts() values live on a u32 ring (2^32 µs ~ 71 min); a plain Python
+# subtraction goes negative-garbage the first time the ring wraps mid-run.
+# Every latency delta on frag timestamps must go through these helpers —
+# the u32 analog of tango.rings.seq_diff (the PR 3 discipline), matching
+# the reference's compressed-timestamp decompression (fd_frag_meta_ts_comp
+# sign-extends the low bits against a reference clock, fd_tango_base.h).
+
+_TS_MASK = 0xFFFFFFFF
+_TS_HALF = 1 << 31
+
+
+def ts_diff(a: int, b: int) -> int:
+    """Signed µs distance a - b mod 2^32 (positive: a is after b).
+    Valid while |true distance| < ~35.8 min (2^31 µs)."""
+    d = (int(a) - int(b)) & _TS_MASK
+    return d - (1 << 32) if d >= _TS_HALF else d
+
+
+def ts_diff_arr(a, b) -> np.ndarray:
+    """Vector ts_diff: i64 signed distances for u32 timestamp arrays."""
+    with np.errstate(over="ignore"):
+        d = np.asarray(a, np.uint32) - np.asarray(b, np.uint32)
+    return d.astype(np.int64) - (
+        (d >= np.uint32(_TS_HALF)).astype(np.int64) << 32
+    )
+
+
+#: per-in-link latency attribution hists, appended to every tile's
+#: schema by the topology at build time (disco/topo.py): queue-wait =
+#: consume-ts - upstream tspub, service = post-callback ts - consume-ts,
+#: end-to-end = consume-ts - origin tsorig.  All in the compressed-µs
+#: domain, all wrap-safe via ts_diff.
+LINK_HIST_KINDS = ("qwait_us", "svc_us", "e2e_us")
+
+
+def link_hist_names(link: str) -> tuple[str, ...]:
+    return tuple(f"{k}_{link}" for k in LINK_HIST_KINDS)
+
+
 @dataclass
 class InLink:
     """This tile's consumer endpoint of one link."""
@@ -51,6 +94,14 @@ class InLink:
     fseq: R.FSeq  # this consumer's progress backchannel
     reliable: bool = True
     seq: int = 0
+    #: observability wiring (set by the topology at build time): the
+    #: link's small-int id for span events, and this endpoint's per-link
+    #: latency hist names — None when the ctx's metrics schema lacks
+    #: them (hand-built tiles in unit tests), which disables recording
+    link_id: int = 0
+    h_qwait: str | None = None
+    h_svc: str | None = None
+    h_e2e: str | None = None
 
     def gather(self, frags: np.ndarray, width: int | None = None) -> np.ndarray:
         """Dense (n, width) u8 payload matrix for a drained frag batch."""
@@ -68,6 +119,9 @@ class OutLink:
     dcache: R.DCache | None
     consumer_fseqs: list[R.FSeq] = field(default_factory=list)  # reliable only
     seq: int = 0
+    #: span-event wiring (topology build time); tracer None = tracing off
+    link_id: int = 0
+    tracer: object | None = None
 
     @property
     def depth(self) -> int:
@@ -104,12 +158,15 @@ class OutLink:
             chunks = self.dcache.write_batch(rows, szs)
         if tspub == 0:
             tspub = now_ts()
+        seq0 = self.seq
         # run_loop gates every callback round on cr_avail() across outs;
         # OutLink.publish is the one sanctioned wrapper under that gate
         # (manual-credit tiles re-check per ring).  fdtlint: allow[ring-credit]
         self.seq = self.mcache.publish_batch(
-            self.seq, sigs, chunks, szs, ctls, tspub, tsorigs
+            seq0, sigs, chunks, szs, ctls, tspub, tsorigs
         )
+        if self.tracer is not None:
+            self.tracer.publish(self.link_id, seq0, sigs, tspub, tsorigs)
         return n
 
 
@@ -142,6 +199,10 @@ class MuxCtx:
         #: workspace state that must survive a crash (dedup's tcache)
         self.interrupt = threading.Event()
         self.faults = None
+        #: span-event writer (disco/trace.py Tracer), installed by the
+        #: topology when tracing is enabled; None keeps every trace
+        #: point a single attribute check
+        self.tracer = None
         self.incarnation = 0
         #: True once the current incarnation's on_boot completed — lets
         #: the topology distinguish "died during boot" (raise at start)
@@ -264,6 +325,11 @@ def run_loop(
     m = ctx.metrics
     cnc = ctx.cnc
     faults = ctx.faults
+    tracer = ctx.tracer
+    if faults is not None:
+        # injected faults annotate themselves into the trace (the
+        # kill -> restart gap must be visible in the timeline)
+        faults.tracer = tracer
     try:
         tile.on_boot(ctx)
     except Exception:
@@ -308,7 +374,10 @@ def run_loop(
                     break
                 tile.during_housekeeping(ctx)
                 if sample:
-                    m.hist_sample("hk_ns", time.monotonic_ns() - now)
+                    hk_ns = time.monotonic_ns() - now
+                    m.hist_sample("hk_ns", hk_ns)
+                    if tracer is not None:
+                        tracer.point(_SPAN_HK, aux64=hk_ns)
             m.inc("loop_iters")
 
             if tile.manual_credits:
@@ -322,6 +391,10 @@ def run_loop(
                     cr = 0
                 if ctx.outs and cr == 0:
                     m.inc("backpressure_iters")
+                    if tracer is not None and idle == 0:
+                        # one BP span per streak start (per-iteration
+                        # events would flood the ring with no new info)
+                        tracer.point(_SPAN_BP)
                     idle += 1
                     if idle >= idle_before_sleep:
                         time.sleep(idle_sleep_s)
@@ -365,7 +438,36 @@ def run_loop(
                     m.inc("in_frags", len(frags))
                     m.inc("in_bytes", int(frags["sz"].sum()))
                     m.hist_sample("batch_sz", len(frags))
+                    # per-hop latency attribution on the compressed-µs
+                    # clock, per drained batch (two vector subtracts on
+                    # arrays already in hand — negligible next to the
+                    # batch's gather/publish work): queue-wait behind
+                    # the upstream publish, end-to-end from the origin
+                    # stamp, and batch service time after the callback
+                    t_cons = 0
+                    if il.h_qwait is not None:
+                        t_cons = now_ts()
+                        m.hist_sample_many(
+                            il.h_qwait,
+                            np.maximum(
+                                ts_diff_arr(t_cons, frags["tspub"]), 0
+                            ),
+                        )
+                        m.hist_sample_many(
+                            il.h_e2e,
+                            np.maximum(
+                                ts_diff_arr(t_cons, frags["tsorig"]), 0
+                            ),
+                        )
+                    if tracer is not None:
+                        tracer.ingest(
+                            il.link_id, frags, t_cons or now_ts()
+                        )
                     tile.on_frags(ctx, i, frags)
+                    if il.h_svc is not None:
+                        m.hist_sample(
+                            il.h_svc, max(ts_diff(now_ts(), t_cons), 0)
+                        )
             ctx.credits = cr - got
             if sample:
                 t_credit0 = time.monotonic_ns()

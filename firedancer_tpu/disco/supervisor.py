@@ -271,6 +271,15 @@ class Supervisor:
             il.fseq.diag_add(0, skipped)
 
         rejoin_links(ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip)
+        if ctx.tracer is not None:
+            # the dead incarnation's thread is joined above and the new
+            # one has not spawned, so this is the ring's only writer —
+            # the restart annotation makes the kill -> rejoin gap
+            # visible (and assertable) in the assembled trace
+            ctx.tracer.fault(
+                "restart", seq=ctx.incarnation + 1,
+                aux64=st.restarts + 1,
+            )
         ts.tile.on_crash(ctx)
         ctx.interrupt.clear()
         ctx.booted = False

@@ -22,8 +22,9 @@ from dataclasses import dataclass, field
 
 from firedancer_tpu.tango import rings as R
 
-from .metrics import Metrics
-from .mux import InLink, MuxCtx, OutLink, Tile, run_loop
+from .metrics import Metrics, MetricsSchema
+from .mux import InLink, MuxCtx, OutLink, Tile, link_hist_names, run_loop
+from .trace import SpanRing, TraceConfig, Tracer
 
 
 def device_assignments(spec, n_tiles: int) -> list[list[int]]:
@@ -86,16 +87,33 @@ class Topology:
         topo.start(); ...; topo.halt()
     """
 
-    def __init__(self, name: str | None = None):
+    def __init__(
+        self, name: str | None = None, trace: TraceConfig | None = None
+    ):
         self.name = name
         self.links: dict[str, LinkSpec] = {}
         self.tiles: dict[str, TileSpec] = {}
         self.wksp: R.Workspace | None = None
+        # sample <= 0 means OFF (TraceConfig contract) — normalize here
+        # so build() installs no tracer regardless of which entry point
+        # (constructor arg or enable_trace) carried the config in
+        self.trace = trace if trace is not None and trace.sample > 0 else None
         self._mcaches: dict[str, R.MCache] = {}
         self._dcaches: dict[str, R.DCache] = {}
         self._fseqs: dict[tuple[str, str], R.FSeq] = {}
         self._cncs: dict[str, R.CNC] = {}
         self._metrics: dict[str, Metrics] = {}
+        self._schemas: dict[str, MetricsSchema] = {}
+        self._tracers: dict[str, Tracer] = {}
+
+    def enable_trace(self, sample: int = 64, depth: int = 1 << 14) -> None:
+        """Turn on fdttrace span rings for every tile (must run before
+        build()).  sample <= 0 disables — no tracer is installed and
+        the hot path pays only the per-phase None checks."""
+        assert self.wksp is None, "enable_trace before build()"
+        self.trace = (
+            TraceConfig(sample=sample, depth=depth) if sample > 0 else None
+        )
 
     # ---- declaration ----------------------------------------------------
 
@@ -123,6 +141,18 @@ class Topology:
 
     # ---- build ----------------------------------------------------------
 
+    def _tile_schema(self, ts: TileSpec) -> MetricsSchema:
+        """The tile's own schema + base + the per-in-link latency
+        attribution hists (qwait/svc/e2e per consumed link) the run
+        loop records.  Everything that reads a tile's metrics region —
+        build, manifest export, monitor, metric tile — must agree on
+        this one layout."""
+        base = ts.tile.schema.with_base()
+        link_hists = tuple(
+            h for ln, _rel in ts.ins for h in link_hist_names(ln)
+        )
+        return MetricsSchema(base.counters, base.hists + link_hists)
+
     def _footprint(self) -> int:
         total = 4096
         for ls in self.links.values():
@@ -132,8 +162,10 @@ class Topology:
             total += (R.FSeq.footprint() + 128) * max(len(ls.consumers), 1)
         for ts in self.tiles.values():
             total += R.CNC.footprint() + 128
-            total += Metrics.footprint(ts.tile.schema.with_base()) + 256
+            total += Metrics.footprint(self._tile_schema(ts)) + 256
             total += ts.tile.wksp_footprint() + 256
+            if self.trace is not None:
+                total += SpanRing.footprint(self.trace.depth) + 256
         return total
 
     def build(self) -> None:
@@ -151,12 +183,29 @@ class Topology:
                 self._fseqs[(ls.name, cons)] = R.FSeq.create(
                     self.wksp, f"fs_{ls.name}_{cons}"
                 )
+        # link ids: declaration-order small ints, shared with the span
+        # events (u8 field) and the manifest's id -> name table
+        link_ids = {ln: i for i, ln in enumerate(self.links)}
+        assert len(link_ids) <= 256, "span events carry a u8 link id"
         for name, ts in self.tiles.items():
             self._cncs[name] = R.CNC.create(self.wksp, f"cnc_{name}")
-            schema = ts.tile.schema.with_base()
+            schema = self._tile_schema(ts)
+            self._schemas[name] = schema
             mem = self.wksp.alloc(f"metrics_{name}", Metrics.footprint(schema))
             self._metrics[name] = Metrics(mem, schema)
+            if self.trace is not None:
+                ring = SpanRing(
+                    self.wksp.alloc(
+                        f"trace_{name}", SpanRing.footprint(self.trace.depth)
+                    ),
+                    self.trace.depth,
+                    self.trace.sample,
+                )
+                self._tracers[name] = Tracer(
+                    ring, self.trace.sample, name=name
+                )
         for name, ts in self.tiles.items():
+            tracer = self._tracers.get(name)
             ins = [
                 InLink(
                     ln,
@@ -164,6 +213,10 @@ class Topology:
                     self._dcaches.get(ln),
                     self._fseqs[(ln, name)],
                     reliable,
+                    link_id=link_ids[ln],
+                    h_qwait=f"qwait_us_{ln}",
+                    h_svc=f"svc_us_{ln}",
+                    h_e2e=f"e2e_us_{ln}",
                 )
                 for ln, reliable in ts.ins
             ]
@@ -177,6 +230,8 @@ class Topology:
                         for cons, rel in self.links[ln].consumers
                         if rel
                     ],
+                    link_id=link_ids[ln],
+                    tracer=tracer,
                 )
                 for ln in ts.outs
             ]
@@ -184,6 +239,7 @@ class Topology:
                 name, self._cncs[name], ins, outs, self._metrics[name],
                 wksp=self.wksp,
             )
+            ts.ctx.tracer = tracer
 
     def export_manifest(self) -> None:
         """Publish the workspace directory + a monitor manifest (tile
@@ -194,12 +250,14 @@ class Topology:
             return
         tiles = {}
         for name, ts in self.tiles.items():
-            schema = ts.tile.schema.with_base()
+            schema = self._schemas.get(name) or self._tile_schema(ts)
             tiles[name] = {
                 "metrics": f"metrics_{name}",
                 "cnc": f"cnc_{name}",
                 "counters": list(schema.counters),
                 "hists": list(schema.hists),
+                "ins": [ln for ln, _rel in ts.ins],
+                "outs": list(ts.outs),
             }
         links = {
             ls.name: {
@@ -212,7 +270,17 @@ class Topology:
             }
             for ls in self.links.values()
         }
-        self.wksp.publish_directory({"tiles": tiles, "links": links})
+        extra = {"tiles": tiles, "links": links}
+        if self.trace is not None:
+            # fdttrace attach surface: per-tile span ring alloc names +
+            # the link id -> name table the u8 link field indexes
+            extra["trace"] = {
+                "sample": self.trace.sample,
+                "depth": self.trace.depth,
+                "links": list(self.links),
+                "tiles": {name: f"trace_{name}" for name in self.tiles},
+            }
+        self.wksp.publish_directory(extra)
 
     # ---- run ------------------------------------------------------------
 

@@ -25,14 +25,13 @@ Microblock wire format (one frag per microblock on the pack_bank link):
 
 from __future__ import annotations
 
-import time
-
 import numpy as np
 
 from firedancer_tpu.ballet import pack as P
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
 from firedancer_tpu.tango import rings as R
+from firedancer_tpu.tango import tempo
 
 from . import wire
 
@@ -169,7 +168,9 @@ class PackTile(Tile):
                 ctx.metrics.inc("completions")
 
     def after_credit(self, ctx: MuxCtx) -> None:
-        now = time.monotonic_ns()
+        # hot-path-clock discipline: loop-body clock reads go through
+        # the sanctioned tempo tick source, never bare time.* calls
+        now = tempo.tickcount()
         if self._block_started_ns == 0:
             self._block_started_ns = now
         elif now - self._block_started_ns >= self.slot_ns:

@@ -27,12 +27,13 @@ class SinkTile(Tile):
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         ctx.metrics.inc("sunk_frags", len(frags))
         # end-to-end latency: origin tsorig (stamped at ingress, carried
-        # through every relay) to arrival here; u32 modular delta
-        from firedancer_tpu.disco.mux import now_ts
+        # through every relay) to arrival here; sign-extended wrap-safe
+        # delta (ts_diff) so a 2^32 µs wrap mid-run cannot turn a small
+        # latency into a ~71-minute garbage sample
+        from firedancer_tpu.disco.mux import now_ts, ts_diff_arr
 
-        now = np.uint32(now_ts())
-        lat = (now - frags["tsorig"].astype(np.uint32)) & np.uint32(0xFFFFFFFF)
-        ctx.metrics.hist_sample_many("latency_us", lat.astype(np.int64))
+        lat = np.maximum(ts_diff_arr(now_ts(), frags["tsorig"]), 0)
+        ctx.metrics.hist_sample_many("latency_us", lat)
         if self.record:
             rows = ctx.ins[in_idx].gather(frags)
             with self.lock:

@@ -43,8 +43,9 @@ import time
 
 import numpy as np
 
+from firedancer_tpu.disco import trace as SPAN
 from firedancer_tpu.disco.metrics import MetricsSchema, device_counters
-from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.disco.mux import MuxCtx, Tile, now_ts
 from firedancer_tpu.tango import rings as R
 
 from . import wire
@@ -432,6 +433,12 @@ class _DeviceWorker:
                     # that wedges must leave the batch recoverable
                     slot = [meta, args, mode, None]
                     pending.append(slot)
+                    # span timestamps ride the meta dict (plain writes on
+                    # this worker thread); the MUX thread turns them into
+                    # DISPATCH/LAND span events when the batch lands —
+                    # the span ring itself stays single-writer
+                    meta["t_disp"] = now_ts()
+                    meta["t_dev"] = getattr(self.policy, "index", 0)
                     if mode == "host":
                         slot[3] = ("host", None)
                     else:
@@ -449,6 +456,7 @@ class _DeviceWorker:
                     self.land_t0 = time.monotonic()
                     ok = self.policy.land(fut, args, meta["lanes"])
                     self.land_t0 = 0.0
+                    meta["t_land"] = now_ts()
                     self.policy.stalled = False  # the call returned
                     self.completed_n += 1
                     pending.popleft()
@@ -782,6 +790,9 @@ class VerifyTile(Tile):
         self._policies: list[FallbackPolicy] | None = None
         self._pool: _DevicePool | None = None
         self._interrupt = None  # ctx.interrupt, bound at boot
+        self._tracer = None  # ctx.tracer, bound at boot
+        self._prev_fallback = 0  # FALLBACK span edge detector
+        self._prev_degraded: dict[int, int] = {}  # QUARANTINE edges
         self._mirror_tick = 0
         #: staged host-prepared lanes not yet submitted (list of dicts)
         self._staged: collections.deque = collections.deque()
@@ -860,6 +871,7 @@ class VerifyTile(Tile):
         from firedancer_tpu.ops.ed25519 import hostpath
 
         self._interrupt = ctx.interrupt
+        self._tracer = ctx.tracer
         if self.pre_dedup:
             depth = PRE_DEDUP_DEPTH
             map_cnt = R.TCache.map_cnt_for(depth)
@@ -1026,6 +1038,12 @@ class VerifyTile(Tile):
 
                 raise TileInterrupted(f"{self.name}: submit abandoned")
             if pool.submit(meta, args):
+                if self._tracer is not None:
+                    self._tracer.point(
+                        SPAN.ENQUEUE,
+                        seq=meta["pool_seq"],
+                        aux16=min(meta["lanes"], 0xFFFF),
+                    )
                 return
             # no capacity anywhere: poll (stall watchdog + retry pump
             # may free a lane) and wait for a worker to make progress
@@ -1042,6 +1060,21 @@ class VerifyTile(Tile):
             meta, ok = pool.ready.popleft()
             lanes = meta["lanes"]
             ok = ok[:lanes]
+            if self._tracer is not None:
+                # dispatch/land timestamps were stamped into the meta by
+                # the worker thread; emitted here so the span ring keeps
+                # its single writer (this mux thread)
+                dev = int(meta.get("t_dev", 0)) & 0xFF
+                seq = meta.get("pool_seq", 0)
+                if "t_disp" in meta:
+                    self._tracer.point(
+                        SPAN.DISPATCH, ts=meta["t_disp"], seq=seq,
+                        aux16=dev,
+                    )
+                self._tracer.point(
+                    SPAN.LAND, ts=meta.get("t_land"), seq=seq, aux16=dev,
+                    aux64=lanes,
+                )
             ctx.metrics.inc("verified_sigs", lanes)
             ctx.metrics.inc("device_batches")
             ctx.metrics.hist_sample("lane_batch", lanes)
@@ -1106,7 +1139,13 @@ class VerifyTile(Tile):
         pool = self._pool
         ps = self._policies
         m = ctx.metrics
-        m.set("fallback_batches", sum(p.fallback_batches for p in ps))
+        fb = sum(p.fallback_batches for p in ps)
+        if self._tracer is not None and fb > self._prev_fallback:
+            self._tracer.point(
+                SPAN.FALLBACK, aux64=fb - self._prev_fallback
+            )
+        self._prev_fallback = fb
+        m.set("fallback_batches", fb)
         m.set("device_errors", sum(p.device_errors for p in ps))
         m.set("device_trips", sum(p.device_trips for p in ps))
         m.set("host_reprobes", sum(p.host_reprobes for p in ps))
@@ -1131,6 +1170,13 @@ class VerifyTile(Tile):
                 or p.stalled
                 or (p.tripped and not p.healthy(now))
             )
+            if (
+                self._tracer is not None
+                and degraded
+                and not self._prev_degraded.get(i)
+            ):
+                self._tracer.point(SPAN.QUARANTINE, aux16=i)
+            self._prev_degraded[i] = int(degraded)
             m.set(f"dev{i}_degraded", int(degraded))
 
     def on_crash(self, ctx: MuxCtx) -> None:

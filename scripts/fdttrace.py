@@ -1,0 +1,488 @@
+#!/usr/bin/env python3
+"""fdttrace — drain a live topology's span rings, assemble per-frag
+timelines, and export latency attribution.
+
+Usage:
+    scripts/fdttrace.py WKSP --summary           # per-hop percentile table
+    scripts/fdttrace.py WKSP --out trace.json    # Chrome trace-event JSON
+    scripts/fdttrace.py WKSP --follow [-i 2.0]   # live summary loop
+    scripts/fdttrace.py WKSP --seconds 2 --out t.json   # longer capture
+
+WKSP is the topology's workspace name (Topology(name=...) with
+enable_trace(); the manifest published at start() carries the span-ring
+directory).  `--summary` needs only the always-on per-link latency
+histograms; the trace export needs span rings (enable_trace) and emits
+Chrome trace-event JSON loadable in Perfetto / chrome://tracing: "X"
+(complete) events only, one track per tile facet (frags / device pool /
+loop / faults), timestamps unwrapped from the compressed u32 µs domain
+and strictly sorted per track.
+
+Frag spans correlate across tiles by the sig field (the dedup tag is
+carried hop to hop), which is also the sampling key — the same 1-in-N
+frags are traced at every hop, so a sampled frag's whole
+quic -> verify -> dedup -> pack timeline is assemblable.  Injected
+faults (disco/faultinj.py) and supervisor restarts appear on each
+tile's fault track, so a kill -> restart gap is visible in the trace
+and assertable from `classify()` (a timeline is whole, or it is lost
+with its furthest-reached hop named).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from firedancer_tpu.disco import trace as T  # noqa: E402
+from firedancer_tpu.disco.metrics import (  # noqa: E402
+    Metrics,
+    MetricsSchema,
+    hist_percentile,
+)
+from firedancer_tpu.disco.mux import LINK_HIST_KINDS, ts_diff  # noqa: E402
+from firedancer_tpu.tango import rings as R  # noqa: E402
+
+#: per-tile sub-tracks in the Chrome trace (tid = tile_index * 4 + facet)
+_FACET_FRAGS, _FACET_DEVICE, _FACET_LOOP, _FACET_FAULTS = 0, 1, 2, 3
+
+
+class TraceSession:
+    """Attached (or in-process) view of a topology's span rings +
+    metrics regions, with incremental drain cursors."""
+
+    def __init__(
+        self,
+        rings: dict[str, "T.SpanRing"],
+        link_names: list[str],
+        metrics: dict[str, Metrics] | None = None,
+        tile_links: dict[str, dict] | None = None,
+    ):
+        self.rings = rings
+        self.link_names = list(link_names)
+        self.metrics = metrics or {}
+        #: {tile: {"ins": [...], "outs": [...]}} for the summary table
+        self.tile_links = tile_links or {}
+        self.cursors = {t: 0 for t in rings}
+        self.dropped = {t: 0 for t in rings}
+        self.events: dict[str, list[dict]] = {t: [] for t in rings}
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def attach(cls, wksp_name: str) -> "TraceSession":
+        """Attach to a live named workspace via its published manifest."""
+        wksp, extra = R.Workspace.attach(wksp_name)
+        tiles = extra.get("tiles", {})
+        metrics = {}
+        tile_links = {}
+        for name, t in tiles.items():
+            schema = MetricsSchema(
+                counters=tuple(t["counters"]), hists=tuple(t["hists"])
+            )
+            metrics[name] = Metrics(wksp.view(t["metrics"]), schema)
+            tile_links[name] = {
+                "ins": t.get("ins", []),
+                "outs": t.get("outs", []),
+            }
+        tr = extra.get("trace")
+        rings = {}
+        link_names = list(extra.get("links", {}))
+        if tr is not None:
+            link_names = tr["links"]
+            for name, alloc in tr["tiles"].items():
+                rings[name] = T.SpanRing(wksp.view(alloc), join=True)
+        s = cls(rings, link_names, metrics, tile_links)
+        s.wksp = wksp  # keep the mapping alive
+        return s
+
+    @classmethod
+    def from_topology(cls, topo) -> "TraceSession":
+        """In-process session over a (possibly anonymous) Topology with
+        tracing enabled — the test-suite entry point."""
+        rings = {name: tr.ring for name, tr in topo._tracers.items()}
+        tile_links = {
+            name: {"ins": [ln for ln, _ in ts.ins], "outs": list(ts.outs)}
+            for name, ts in topo.tiles.items()
+        }
+        return cls(
+            rings, list(topo.links), dict(topo._metrics), tile_links
+        )
+
+    # -- span drain -------------------------------------------------------
+
+    def drain(self) -> int:
+        """Pull new events from every ring; returns how many arrived."""
+        got = 0
+        for tile, ring in self.rings.items():
+            ev, cur, dropped = ring.read(self.cursors[tile])
+            self.cursors[tile] = cur
+            self.dropped[tile] += dropped
+            decoded = T.decode(ev)
+            self.events[tile].extend(decoded)
+            got += len(decoded)
+        return got
+
+    def link_name(self, link_id: int) -> str:
+        if 0 <= link_id < len(self.link_names):
+            return self.link_names[link_id]
+        return f"link{link_id}"
+
+
+# ---------------------------------------------------------------------------
+# timeline assembly + completeness classification
+
+
+def assemble(session: TraceSession) -> dict[int, list[dict]]:
+    """Per-frag timelines: {sig: [frag events across tiles, ts-order]}.
+    Only INGEST/PUBLISH events carry a frag identity."""
+    timelines: dict[int, list[dict]] = {}
+    for tile, evs in session.events.items():
+        for e in evs:
+            if e["kind"] not in (T.INGEST, T.PUBLISH):
+                continue
+            timelines.setdefault(e["sig"], []).append(
+                {
+                    "tile": tile,
+                    "kind": T.KIND_NAMES[e["kind"]],
+                    "link": session.link_name(e["link"]),
+                    "ts": e["ts"],
+                    "seq": e["seq"],
+                }
+            )
+    anchor = _anchor(session)
+    for evs in timelines.values():
+        evs.sort(key=lambda e: ts_diff(e["ts"], anchor))
+    return timelines
+
+
+def classify(
+    timelines: dict[int, list[dict]], path: list[str]
+) -> tuple[set, dict]:
+    """Completeness over an ordered link path (e.g. [quic_verify,
+    verify_dedup, dedup_pack]).  A timeline is WHOLE when it was
+    published on every path link; otherwise it is LOST at the furthest
+    link it did reach (None = touched the path but was never published
+    on it).  Sigs whose timeline never touches a path link at all —
+    foreign traffic like microblock handles on the bank rings — are
+    outside the classification.  Kill -> restart chaos runs assert on
+    exactly this: every admitted frag whole, every lost frag explained
+    by a declared injection."""
+    path_set = set(path)
+    whole: set = set()
+    lost: dict = {}
+    for sig, evs in timelines.items():
+        if not any(e["link"] in path_set for e in evs):
+            continue
+        published = {e["link"] for e in evs if e["kind"] == "publish"}
+        progress = None
+        ok = True
+        for ln in path:
+            if ln in published:
+                progress = ln
+            else:
+                ok = False
+        if ok:
+            whole.add(sig)
+        else:
+            lost[sig] = progress
+    return whole, lost
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+
+
+def _anchor(session: TraceSession) -> int:
+    for evs in session.events.values():
+        for e in evs:
+            return e["ts"]
+    return 0
+
+
+def chrome_trace(session: TraceSession) -> list[dict]:
+    """Span events -> Chrome trace-event JSON (list of "X" events,
+    strictly sorted per (pid, tid) track)."""
+    anchor = _anchor(session)
+    rel0 = min(
+        (
+            ts_diff(e["ts"], anchor)
+            for evs in session.events.values()
+            for e in evs
+        ),
+        default=0,
+    )
+
+    def us(ts: int) -> int:
+        return ts_diff(ts, anchor) - rel0
+
+    out: list[dict] = []
+    tiles = sorted(session.events)
+    for t_idx, tile in enumerate(tiles):
+        evs = session.events[tile]
+        tid = t_idx * 4
+        # frag track: INGEST paired with the tile's next PUBLISH of the
+        # same sig = the frag's service span at this tile
+        pubs: dict[int, list[int]] = {}
+        for e in evs:
+            if e["kind"] == T.PUBLISH:
+                pubs.setdefault(e["sig"], []).append(e["ts"])
+        for sig in pubs:
+            pubs[sig].sort(key=us)
+        ingest_sigs = set()
+        for e in evs:
+            k = e["kind"]
+            if k == T.INGEST:
+                ingest_sigs.add(e["sig"])
+                t_in = us(e["ts"])
+                dur = 1
+                for p in pubs.get(e["sig"], ()):
+                    if us(p) >= t_in:
+                        dur = max(us(p) - t_in, 1)
+                        break
+                tsorig = int(e["aux64"]) >> 32
+                tspub = int(e["aux64"]) & 0xFFFFFFFF
+                out.append(
+                    {
+                        "name": f"{tile} {session.link_name(e['link'])}",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_FRAGS,
+                        "ts": t_in,
+                        "dur": dur,
+                        "args": {
+                            "sig": f"{e['sig']:#018x}",
+                            "seq": int(e["seq"]),
+                            "qwait_us": max(ts_diff(e["ts"], tspub), 0),
+                            "e2e_us": max(ts_diff(e["ts"], tsorig), 0),
+                        },
+                    }
+                )
+            elif k == T.PUBLISH and e["sig"] not in ingest_sigs:
+                # origin tiles (quic/synth/replay) publish frags they
+                # never ingested from a ring
+                out.append(
+                    {
+                        "name": f"{tile} publish "
+                        f"{session.link_name(e['link'])}",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_FRAGS,
+                        "ts": us(e["ts"]),
+                        "dur": 1,
+                        "args": {
+                            "sig": f"{e['sig']:#018x}",
+                            "seq": int(e["seq"]),
+                        },
+                    }
+                )
+        # device-pool track: ENQUEUE -> DISPATCH wait + DISPATCH -> LAND
+        # service, matched by pool seq
+        enq = {e["seq"]: e for e in evs if e["kind"] == T.ENQUEUE}
+        disp = {e["seq"]: e for e in evs if e["kind"] == T.DISPATCH}
+        for e in evs:
+            if e["kind"] != T.LAND:
+                continue
+            seq = e["seq"]
+            d, q = disp.get(seq), enq.get(seq)
+            t_end = us(e["ts"])
+            if d is not None:
+                out.append(
+                    {
+                        "name": f"{tile} dev{e['aux16']} batch",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_DEVICE,
+                        "ts": us(d["ts"]),
+                        "dur": max(t_end - us(d["ts"]), 1),
+                        "args": {
+                            "pool_seq": int(seq),
+                            "lanes": int(e["aux64"]),
+                            "queue_us": 0
+                            if q is None
+                            else max(us(d["ts"]) - us(q["ts"]), 0),
+                        },
+                    }
+                )
+        # loop track (housekeeping + backpressure streak markers) and
+        # fault annotations (injected faults, supervisor restarts)
+        for e in evs:
+            if e["kind"] == T.HK:
+                out.append(
+                    {
+                        "name": f"{tile} hk",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_LOOP,
+                        "ts": us(e["ts"]),
+                        "dur": max(int(e["aux64"]) // 1000, 1),
+                        "args": {},
+                    }
+                )
+            elif e["kind"] in (T.BP, T.FALLBACK, T.QUARANTINE):
+                out.append(
+                    {
+                        "name": f"{tile} {T.KIND_NAMES[e['kind']]}",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_LOOP,
+                        "ts": us(e["ts"]),
+                        "dur": 1,
+                        "args": {"aux": int(e["aux64"])},
+                    }
+                )
+            elif e["kind"] == T.FAULT:
+                code = T.FAULT_NAMES.get(e["aux16"], "?")
+                dur = 1
+                if code == "stall":
+                    dur = max(int(e["aux64"]), 1)  # stall length, µs
+                out.append(
+                    {
+                        "name": f"{tile} fault:{code}",
+                        "ph": "X",
+                        "pid": 1,
+                        "tid": tid + _FACET_FAULTS,
+                        "ts": us(e["ts"]),
+                        "dur": dur,
+                        "args": {"detail": int(e["aux64"])},
+                    }
+                )
+    # strict per-track time order (Perfetto requires monotone begins)
+    out.sort(key=lambda e: (e["pid"], e["tid"], e["ts"], -e["dur"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# summary: per-hop percentile table from the always-on latency hists
+
+
+def summary_rows(session: TraceSession) -> list[dict]:
+    """One row per (tile, in-link) hop: p50/p99/p99.9 for queue-wait /
+    service / end-to-end, plus the tile's %backpressure."""
+    rows = []
+    for tile in sorted(session.metrics):
+        m = session.metrics[tile]
+        c = {k: m.counter(k) for k in ("backpressure_iters", "loop_iters")}
+        bp_pct = 100.0 * c["backpressure_iters"] / max(c["loop_iters"], 1)
+        for ln in session.tile_links.get(tile, {}).get("ins", []):
+            row = {"tile": tile, "link": ln, "bp_pct": round(bp_pct, 2)}
+            have = False
+            for kind in LINK_HIST_KINDS:
+                name = f"{kind}_{ln}"
+                if name not in m.schema.hists:
+                    continue
+                h = m.hist(name)
+                have = True
+                row[kind] = {
+                    "count": h["count"],
+                    "p50": round(hist_percentile(h, 50), 1),
+                    "p99": round(hist_percentile(h, 99), 1),
+                    "p99.9": round(hist_percentile(h, 99.9), 1),
+                }
+            if have:
+                rows.append(row)
+    return rows
+
+
+def render_summary(rows: list[dict]) -> str:
+    lines = [
+        f"{'hop (tile < link)':<34} {'n':>9} "
+        f"{'qwait p50/p99':>17} {'svc p50/p99':>17} "
+        f"{'e2e p50/p99/p99.9':>26} {'bp%':>6}"
+    ]
+    for r in rows:
+        q, s, e = r.get("qwait_us"), r.get("svc_us"), r.get("e2e_us")
+
+        def pair(d):
+            if d is None or not d["count"]:
+                return "-"
+            return f"{d['p50']:,.0f}/{d['p99']:,.0f}"
+
+        e2e = "-"
+        if e is not None and e["count"]:
+            e2e = f"{e['p50']:,.0f}/{e['p99']:,.0f}/{e['p99.9']:,.0f}"
+        lines.append(
+            f"{r['tile'] + ' < ' + r['link']:<34} "
+            f"{(q or {'count': 0})['count']:>9,} "
+            f"{pair(q):>17} {pair(s):>17} {e2e:>26} {r['bp_pct']:>5.1f}%"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdttrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("wksp", help="topology workspace name (Topology(name=...))")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the per-hop percentile table and exit")
+    ap.add_argument("--follow", action="store_true",
+                    help="re-print the summary every --interval seconds")
+    ap.add_argument("--interval", "-i", type=float, default=2.0)
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="stop --follow after N prints (default: forever)")
+    ap.add_argument("--seconds", type=float, default=1.0,
+                    help="span capture window for the trace export")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write Chrome trace-event JSON here (default stdout)")
+    args = ap.parse_args(argv)
+
+    try:
+        session = TraceSession.attach(args.wksp)
+    except FileNotFoundError:
+        print(
+            f"fdttrace: no workspace {args.wksp!r} (is the topology "
+            "running with a name, and was start() reached?)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.follow:
+        i = 0
+        while args.iterations is None or i < args.iterations:
+            print(render_summary(summary_rows(session)))
+            print()
+            i += 1
+            if args.iterations is None or i < args.iterations:
+                time.sleep(args.interval)
+        return 0
+    if args.summary:
+        print(render_summary(summary_rows(session)))
+        return 0
+
+    if not session.rings:
+        print(
+            "fdttrace: workspace has no span rings — run the topology "
+            "with enable_trace() (sampling > 0) for trace export",
+            file=sys.stderr,
+        )
+        return 2
+    session.drain()
+    end = time.monotonic() + args.seconds
+    while time.monotonic() < end:
+        time.sleep(min(0.05, args.seconds))
+        session.drain()
+    events = chrome_trace(session)
+    doc = json.dumps(events)
+    if args.out:
+        Path(args.out).write_text(doc)
+        n_drop = sum(session.dropped.values())
+        print(
+            f"fdttrace: wrote {len(events)} events to {args.out}"
+            + (f" ({n_drop} spans lost to ring laps)" if n_drop else "")
+        )
+    else:
+        print(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""known-bad: a tile reading the clock through bare time.* calls inside
+its mux-loop hook bodies.  Direct clock reads fork the tile off the run
+loop's phase-sampling discipline and the compressed-timestamp (u32 µs)
+wrap handling — latency math built on them goes negative-garbage at the
+2^32 wrap.  Must trip hot-path-clock; the sanctioned helpers
+(mux.now_ts / tempo.tickcount) and the Worker/Pool carve-out must not."""
+
+import time
+
+from firedancer_tpu.disco.mux import now_ts
+from firedancer_tpu.tango import tempo
+
+
+class ImpatientTile:
+    def __init__(self):
+        self._deadline_ns = 0
+        self._t0 = 0.0
+
+    def on_frags(self, ctx, in_idx, frags):
+        # BAD: raw ns clock in the frag hook
+        t0 = time.monotonic_ns()
+        ctx.publish(frags["sig"])
+        # BAD: wall clock (not even monotonic) for a latency delta
+        ctx.metrics.hist_sample("svc_s", time.time() - self._t0)
+        self._t0 = t0
+
+    def after_credit(self, ctx):
+        # BAD: perf_counter cadence gate in the credit hook
+        if time.perf_counter() < self._deadline_ns:
+            return
+        self._deadline_ns = time.perf_counter() + 0.002
+
+
+class DisciplinedTile:
+    """control: the sanctioned clock helpers must NOT trip the rule."""
+
+    def __init__(self):
+        self._ready_at = 0
+
+    def on_frags(self, ctx, in_idx, frags):
+        ctx.metrics.hist_sample("e2e_us", now_ts())
+
+    def after_credit(self, ctx):
+        now = tempo.tickcount()
+        if now >= self._ready_at:
+            self._ready_at = now + 2_000_000
+
+
+class _StubDeviceWorkerPool:
+    """control: Worker/Pool classes own their own timing (stall
+    watchdogs) — a hook-named method here is private protocol."""
+
+    def after_credit(self, ctx):
+        return time.monotonic()

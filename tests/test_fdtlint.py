@@ -47,6 +47,7 @@ BAD_FIXTURES = {
     "ring_bad_publish_no_credit.py": "ring-credit",
     "ring_bad_unhooked_ringop.py": "ring-mc-hook",
     "ring_bad_device_dispatch.py": "device-dispatch",
+    "ring_bad_hot_clock.py": "hot-path-clock",
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",
     "purity_bad_branch.py": "purity-untraced-branch",
@@ -125,6 +126,16 @@ def test_device_dispatch_fixture_controls_are_clean():
     hits = [f for f in rep.findings if f.rule == "device-dispatch"]
     assert len(hits) == 4, hits  # the four BAD lines in EagerVerifyTile
     assert all(f.line < 30 for f in hits), hits  # controls stay clean
+
+
+def test_hot_clock_fixture_controls_are_clean():
+    """The rule flags every bare time.* clock read in the impatient
+    tile's hook bodies and NONE in the controls (sanctioned now_ts /
+    tempo.tickcount helpers; a Worker/Pool-owned hook-named method)."""
+    rep = engine.run_paths([CORPUS / "ring_bad_hot_clock.py"])
+    hits = [f for f in rep.findings if f.rule == "hot-path-clock"]
+    assert len(hits) == 4, hits  # the four BAD reads in ImpatientTile
+    assert all(f.line < 32 for f in hits), hits  # controls stay clean
 
 
 def test_unhooked_fixture_guarded_control_is_clean():

@@ -88,6 +88,26 @@ def test_metric_tile_prometheus_scrape():
             if ln and not ln.startswith("#") and " " in ln
         }
         assert int(got["fdt_sink_in_frags"]) >= 512
+        # cross-check the scraped series against Metrics.hist contents:
+        # traffic has drained (sink saw all 512 frags), so the hists are
+        # quiescent and the exposition must agree exactly — cumulative
+        # le=2^(k+1)-1 buckets, +Inf == _count, and _sum
+        from firedancer_tpu.disco.metrics import HIST_BUCKETS
+
+        for tile, hname in (("sink", "batch_sz"), ("sink", "latency_us"),
+                            ("sink", "qwait_us_synth_sink")):
+            h = topo.metrics(tile).hist(hname)
+            assert h["count"] > 0, (tile, hname)
+            cum = 0
+            for b in range(HIST_BUCKETS):
+                cum += h["buckets"][b]
+                le = (1 << (b + 1)) - 1
+                key = f'fdt_{tile}_{hname}_bucket{{le="{le}"}}'
+                assert int(got[key]) == cum, (key, got[key], cum)
+            inf = f'fdt_{tile}_{hname}_bucket{{le="+Inf"}}'
+            assert int(got[inf]) == h["count"]
+            assert int(got[f"fdt_{tile}_{hname}_count"]) == h["count"]
+            assert int(got[f"fdt_{tile}_{hname}_sum"]) == h["sum"]
         status, _ = H.get(metric.addr, "/nothing")
         assert status == 404
         topo.halt()

@@ -394,6 +394,10 @@ def test_supervisor_chaos_kill_and_stall_full_topology():
     mbsink = SinkTile(record=True, name="mbsink")  # admitted microblocks
 
     topo = Topology()
+    # full-rate span tracing rides along: the trace-completeness
+    # assertion below requires every frag's timeline, whole or
+    # explicitly classified lost, across the kill -> restart
+    topo.enable_trace(sample=1, depth=1 << 15)
     topo.link("quic_verify", depth=256, mtu=wire.LINK_MTU)
     topo.link("verify_dedup", depth=256, mtu=wire.LINK_MTU)
     topo.link("dedup_pack", depth=256, mtu=wire.LINK_MTU)
@@ -525,6 +529,45 @@ def test_supervisor_chaos_kill_and_stall_full_topology():
         assert sorted(g for e in corrupt_ev for g in e[3]) == [50, 51, 52]
         drop_ev = [e for e in inj.events if e[1] == "drop"]
         assert sorted(g for e in drop_ev for g in e[3]) == [60, 61]
+
+        # ---- trace completeness across the kill -> restart ----
+        # every frag admitted at pack has a WHOLE span timeline (it was
+        # published on every hop of quic -> verify -> dedup -> pack);
+        # every incomplete timeline is explicitly classified lost at
+        # the hop it reached, and the loss population is bounded by the
+        # declared injections (corruptions rejected at verify, plus the
+        # bloom/overrun budget) — the replay-healed drops must NOT be
+        # lost (their re-delivery completes the timeline)
+        from scripts import fdttrace
+
+        session = fdttrace.TraceSession.from_topology(topo)
+        session.drain()
+        assert sum(session.dropped.values()) == 0, session.dropped
+        timelines = fdttrace.assemble(session)
+        whole, lost_frags = fdttrace.classify(
+            timelines, ["quic_verify", "verify_dedup", "dedup_pack"]
+        )
+        assert set(sunk) <= whole
+        # the kill and the restart are annotated on verify's timeline
+        verify_faults = [
+            (e["aux16"], e["ts"])
+            for e in session.events["verify"]
+            if e["kind"] == 10  # trace.FAULT
+        ]
+        from firedancer_tpu.disco import trace as _tr
+
+        codes = [_tr.FAULT_NAMES.get(c) for c, _ in verify_faults]
+        assert "kill" in codes and "restart" in codes
+        # every lost timeline stalled before dedup's output: nothing
+        # that reached dedup_pack is in the lost set by construction,
+        # and the count is bounded by the declared loss budget
+        assert all(
+            last in (None, "quic_verify", "verify_dedup")
+            for last in lost_frags.values()
+        )
+        assert len(lost_frags) <= (
+            inj.corrupted_frags() + overruns + BLOOM_FP_BUDGET
+        )
     finally:
         topo.close()
 
