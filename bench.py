@@ -275,12 +275,18 @@ def _run_pipeline_tps(path, rows, szs, pool_n, total):
         topo.close()
 
 
-def _bench_landed_tps() -> float:
+def _bench_landed_tps() -> tuple[float, dict]:
     """Landed TPS through the FULL validator: a benchg/benchs load
     (distinct device-signed transfers blasted at the legacy UDP txn
     port) through net -> quic -> verify(TPU) -> dedup -> pack -> bank
     (funk execution) -> poh -> shred -> store, gated on RPC
-    getTransactionCount (reference: src/app/fddev/bench.c:62-90)."""
+    getTransactionCount (reference: src/app/fddev/bench.c:62-90).
+
+    Returns (tps, profile keys): the run-loop profiler
+    (disco/profile.py) rides the same topology, so the JSON line
+    carries the measured GIL-wait fraction and scheduler-lag p99 of
+    the 17-tile single-interpreter runtime — the quantified "before"
+    of the ROADMAP item-1 multi-process refactor."""
     import tempfile
 
     from firedancer_tpu.app import config as C
@@ -331,6 +337,11 @@ def _bench_landed_tps() -> float:
         topo, handles = C.build_validator_topology(
             cfg, identity, tmp + "/bs", funk=funk
         )
+        # per-tile run-loop profiling: ~two clock reads per 16th loop
+        # iteration — measured invisible next to the device/bank work
+        # (PROFILE.md round 8), and the gil_wait_frac / sched_lag keys
+        # are this bench's contract
+        topo.enable_profile()
         topo.build()
         topo.start(batch_max=16384, boot_timeout_s=1200.0)
         blaster = None
@@ -388,9 +399,16 @@ def _bench_landed_tps() -> float:
                 ):
                     break  # drained: no progress for 3 s after send end
                 time.sleep(0.1)
+            from firedancer_tpu.disco.profile import aggregate
+
+            agg = aggregate(topo.profile_metrics())
+            prof = {
+                "gil_wait_frac": agg["gil_wait_frac"],
+                "sched_lag_p99_us": agg["sched_lag_p99_us"],
+            }
             if t_first is None or t_last is None or t_last <= t_first:
-                return 0.0
-            return (last_cnt - first_cnt) / (t_last - t_first)
+                return 0.0, prof
+            return (last_cnt - first_cnt) / (t_last - t_first), prof
         finally:
             if blaster is not None:
                 blaster.stop()
@@ -459,8 +477,12 @@ def main() -> None:
     try:
         if "landed" not in skip:
             # full-validator landed rate (net->quic->verify->...->bank,
-            # RPC-observed) — the number `fddev bench` reports
-            result["pipeline_tps"] = round(_bench_landed_tps(), 1)
+            # RPC-observed) — the number `fddev bench` reports — plus
+            # the run-loop profiler's GIL-wait / scheduler-lag keys
+            # (the item-1 refactor's measured "before")
+            tps, prof = _bench_landed_tps()
+            result["pipeline_tps"] = round(tps, 1)
+            result.update(prof)
     except Exception:
         pass
     print(json.dumps(result), flush=True)

@@ -38,6 +38,21 @@ over the tile layer (tiles/, disco/):
                        the mux thread blocks heartbeats behind D2H
                        latency and bypasses the per-device fault
                        domains (quarantine/backoff/host fallback).
+  metrics-schema       every counter/hist name a tile writes via
+                       ctx.metrics.inc/set/hist_sample[_many] must be
+                       declared in that tile's MetricsSchema (its own
+                       literals, the base schema, or the per-link /
+                       per-device dynamic families).  Metrics.inc on an
+                       undeclared name raises KeyError at runtime ONLY
+                       on the first hit of that code path — a typo'd
+                       name on a rare branch (an error path, a
+                       restart-only branch) ships silently and then
+                       kills the tile in production; and schema drift
+                       (renamed metric, stale writer) is invisible
+                       until that branch runs.  Classes whose schema is
+                       not a statically-literal class attribute are
+                       skipped (instance-built schemas like VerifyTile
+                       size theirs at runtime).
   hot-path-clock       tile hook bodies (on_frags/after_credit) must not
                        read the clock through bare time.* calls
                        (time.monotonic_ns / time.time / ...) — clock
@@ -59,6 +74,7 @@ deliberate must carry a `# fdtlint: allow[rule]` pragma with a reason.
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 
 from .findings import Finding, apply_pragmas
@@ -400,6 +416,161 @@ def _check_hot_clock(path: str, tree: ast.AST) -> list[Finding]:
     return findings
 
 
+#: metric-write methods -> the schema domain the name must be declared in
+_METRIC_WRITE_ATTRS = {
+    "inc": "counters",
+    "set": "counters",
+    "hist_sample": "hists",
+    "hist_sample_many": "hists",
+}
+
+#: dynamic name families every tile schema grows at build time: the
+#: per-in-link latency hists (disco.mux.link_hist_names, appended by
+#: the topology), plus the per-device pool rows (exact dev{i}_{metric}
+#: shape below — a bare "dev" prefix would exempt typos like
+#: "devcie0_landed" from the rule)
+_DYNAMIC_METRIC_PREFIXES = ("qwait_us_", "svc_us_", "e2e_us_")
+
+#: the device-pool row family (mirror of disco.metrics.DEVICE_METRICS,
+#: pinned against drift by tests/test_fdtlint.py like the base schema)
+DEVICE_METRIC_NAMES = ("depth", "inflight", "landed", "failed", "degraded")
+_DEVICE_METRIC_RE = re.compile(
+    r"^dev\d+_(" + "|".join(DEVICE_METRIC_NAMES) + r")$"
+)
+
+
+def _is_dynamic_metric(name: str) -> bool:
+    return name.startswith(_DYNAMIC_METRIC_PREFIXES) or bool(
+        _DEVICE_METRIC_RE.match(name)
+    )
+
+#: the base schema every tile gets (disco.metrics.MetricsSchema
+#: BASE_COUNTERS/BASE_HISTS).  Mirrored literally — NOT imported —
+#: because fdtlint is stdlib-only by contract (disco.metrics pulls in
+#: numpy); tests/test_fdtlint.py asserts this mirror cannot drift.
+BASE_SCHEMA_COUNTERS = (
+    "in_frags",
+    "in_bytes",
+    "out_frags",
+    "out_bytes",
+    "overrun_frags",
+    "backpressure_iters",
+    "housekeep_iters",
+    "loop_iters",
+    "restarts",
+    "hb_misses",
+    "degraded",
+)
+BASE_SCHEMA_HISTS = ("batch_sz", "loop_ns", "hk_ns", "frag_ns", "credit_ns")
+
+
+def _literal_strs(node: ast.AST) -> tuple[str, ...] | None:
+    """A tuple/list of string constants, or None when any element (or
+    the node itself) is dynamic."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return tuple(out)
+
+
+def _declared_schema(cls: ast.ClassDef) -> tuple[set[str], set[str]] | None:
+    """(counters, hists) from a class-level `schema = MetricsSchema(...)`
+    with fully-literal arguments; None when absent or dynamic."""
+    for stmt in cls.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "schema"
+            and isinstance(stmt.value, ast.Call)
+            and (
+                (isinstance(stmt.value.func, ast.Name)
+                 and stmt.value.func.id == "MetricsSchema")
+                or (isinstance(stmt.value.func, ast.Attribute)
+                    and stmt.value.func.attr == "MetricsSchema")
+            )
+        ):
+            continue
+        call = stmt.value
+        counters: set[str] = set()
+        hists: set[str] = set()
+        ok = True
+        for i, arg in enumerate(call.args):
+            lit = _literal_strs(arg)
+            if lit is None:
+                ok = False
+                break
+            (counters if i == 0 else hists).update(lit)
+        for kw in call.keywords:
+            lit = _literal_strs(kw.value)
+            if lit is None:
+                ok = False
+                break
+            if kw.arg == "counters":
+                counters.update(lit)
+            elif kw.arg == "hists":
+                hists.update(lit)
+            else:
+                ok = False
+                break
+        if not ok:
+            return None
+        return counters, hists
+    return None
+
+
+def _check_metrics_schema(path: str, tree: ast.AST) -> list[Finding]:
+    """metrics-schema: literal metric names written inside a tile class
+    must be declared in its (literal, class-level) schema."""
+    findings: list[Finding] = []
+    base_counters = set(BASE_SCHEMA_COUNTERS)
+    base_hists = set(BASE_SCHEMA_HISTS)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        decl = _declared_schema(cls)
+        if decl is None:
+            continue
+        counters = decl[0] | base_counters
+        hists = decl[1] | base_hists
+        domains = {"counters": counters, "hists": hists}
+        for call in ast.walk(cls):
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _METRIC_WRITE_ATTRS
+                and "metrics" in _receiver(call)
+                and call.args
+            ):
+                continue
+            arg = call.args[0]
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                continue  # dynamic names are out of the rule's reach
+            name = arg.value
+            domain = _METRIC_WRITE_ATTRS[call.func.attr]
+            if name in domains[domain]:
+                continue
+            if _is_dynamic_metric(name):
+                continue
+            findings.append(
+                Finding(
+                    path, call.lineno, "metrics-schema",
+                    f"metric {name!r} written via metrics."
+                    f"{call.func.attr}() is not declared in "
+                    f"{cls.name}'s schema {domain} — a typo'd name "
+                    "raises KeyError on the first hit of this code "
+                    "path (declare it, or fix the name)",
+                )
+            )
+    return findings
+
+
 def check_rings_file(path: Path, rel: Path | None = None) -> tuple[list[Finding], int]:
     """check_file plus the guarded ring-op function count (engine's
     mc-hook coverage metric), from a single parse."""
@@ -468,5 +639,8 @@ def check_file(
 
     # -- hot-path-clock ----------------------------------------------------
     findings.extend(_check_hot_clock(disp, tree))
+
+    # -- metrics-schema ----------------------------------------------------
+    findings.extend(_check_metrics_schema(disp, tree))
 
     return apply_pragmas(sorted(set(findings)), text.splitlines())

@@ -21,14 +21,24 @@ Config shape (all keys optional; defaults below):
     signature_cache_size = 4194302   # default.toml:760
     [links]
     depth = 1024
+    [slo]                            # asserted SLOs (disco/slo.py)
+    e2e_p99_us = 50000               # omit a key = not asserted
+    verify_hop_p99_us = 20000
+    landed_tps_min = 5000
+    drop_rate_max = 0.001
+    fast_window_s = 5.0
+    slow_window_s = 60.0
 """
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python 3.10: tomllib landed in 3.11
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
-from firedancer_tpu.disco import Topology
+from firedancer_tpu.disco import SloConfig, Topology
 from firedancer_tpu.tiles import wire
 from firedancer_tpu.tiles.dedup import DedupTile
 from firedancer_tpu.tiles.quic import QuicIngressTile
@@ -61,6 +71,8 @@ class Config:
     shred_version: int = 1
     metrics_port: int = 0
     rpc_port: int = 0
+    #: asserted SLOs from the `[slo]` section; None = none asserted
+    slo: SloConfig | None = None
     raw: dict = field(default_factory=dict)
 
 
@@ -97,6 +109,7 @@ def parse(text: str) -> Config:
         shred_version=t.get("shred", {}).get("version", 1),
         metrics_port=t.get("metric", {}).get("port", 0),
         rpc_port=t.get("rpc", {}).get("port", 0),
+        slo=SloConfig.from_dict(doc["slo"]) if "slo" in doc else None,
         raw=doc,
     )
 
@@ -131,6 +144,10 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     n_banks = cfg.bank_count
     verify_devs = device_assignments(cfg.verify_devices, n)
     topo = Topology(name=cfg.name)
+    # asserted SLOs ride the topology: build() allocates the shared slo
+    # gauge region and the manifest carries the config to attached
+    # monitors (disco/slo.py, disco/flight.py)
+    topo.slo = cfg.slo
 
     net = NetTile(
         quic_addr=("0.0.0.0", cfg.quic_port),
@@ -242,6 +259,7 @@ def build_ingress_topology(
     from firedancer_tpu.disco.topo import device_assignments
 
     topo = Topology(name=cfg.name)
+    topo.slo = cfg.slo
     qt = QuicIngressTile(
         identity_secret,
         quic_addr=("0.0.0.0", cfg.quic_port),

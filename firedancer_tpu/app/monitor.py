@@ -16,8 +16,10 @@ from firedancer_tpu.disco.metrics import (
     Metrics,
     MetricsSchema,
     device_rows,
+    hist_delta as _hist_delta,
     hist_percentile,
 )
+from firedancer_tpu.disco.slo import SloConfig, SloEngine
 from firedancer_tpu.tango import rings as R
 
 #: the per-in-link latency-attribution hist prefixes the run loop
@@ -26,21 +28,6 @@ from firedancer_tpu.tango import rings as R
 _LAT_PREFIXES = ("qwait_us_", "svc_us_", "e2e_us_")
 
 _SIGNAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
-
-
-def _hist_delta(cur: dict, prev: dict | None) -> dict:
-    """Windowed hist: cur - prev per bucket (both are cumulative
-    monotone snapshots of the same region).  No prev -> cumulative."""
-    if not prev or not prev.get("count"):
-        return cur
-    return {
-        "count": cur.get("count", 0) - prev.get("count", 0),
-        "sum": cur.get("sum", 0) - prev.get("sum", 0),
-        "buckets": [
-            a - b
-            for a, b in zip(cur.get("buckets", []), prev.get("buckets", []))
-        ],
-    }
 
 
 @dataclass
@@ -53,9 +40,18 @@ class TileView:
 class Monitor:
     """Attach-and-read view of a named topology workspace."""
 
+    #: class-level defaults keep alarms()/render() pure over a snapshot
+    #: dict even on a Monitor built without __init__ (tests construct
+    #: bare instances via object.__new__ to drive them offline).  None,
+    #: not {}: a shared class-level dict would leak profiler regions
+    #: between bare instances.
+    slo: SloEngine | None = None
+    profiles: dict[str, Metrics] | None = None
+
     def __init__(self, wksp_name: str):
         self.wksp, extra = R.Workspace.attach(wksp_name)
         self.tiles: dict[str, TileView] = {}
+        self._tile_links: dict[str, dict] = {}
         for name, t in extra.get("tiles", {}).items():
             schema = MetricsSchema(
                 counters=tuple(t["counters"]), hists=tuple(t["hists"])
@@ -65,7 +61,31 @@ class Monitor:
             self.tiles[name] = TileView(
                 name, m, R.CNC(self.wksp.view(t["cnc"]), join=True)
             )
+            self._tile_links[name] = {
+                "ins": t.get("ins", []), "outs": t.get("outs", [])
+            }
         self.links = extra.get("links", {})
+        # per-tile run-loop profiler regions (disco/profile.py), when
+        # the topology was built with enable_profile()
+        self.profiles: dict[str, Metrics] = {}
+        prof = extra.get("profile")
+        if prof is not None:
+            from firedancer_tpu.disco.profile import PROFILE_SCHEMA
+
+            for name, alloc in prof.get("tiles", {}).items():
+                self.profiles[name] = Metrics(
+                    self.wksp.view(alloc), PROFILE_SCHEMA
+                )
+        # asserted SLOs: the monitor runs its OWN burn-rate engine over
+        # its snapshots (same objectives + same shared hists as the
+        # in-process flight recorder), so `alarms` carries SLO rows
+        self.slo: SloEngine | None = None
+        slo = extra.get("slo")
+        if slo is not None:
+            self.slo = SloEngine(
+                SloConfig.from_dict(slo.get("config", {})),
+                self._tile_links,
+            )
 
     #: heartbeat older than this is flagged as stale (reference monitor
     #: renders heartbeat diffs; a stuck tile stops beating long before
@@ -120,6 +140,17 @@ class Monitor:
                 "produced": prod_seq,
                 "consumers": seqs,
             }
+        # profiler summaries ride the snapshot (disco/profile.py)
+        if self.profiles:
+            from firedancer_tpu.disco.profile import profile_row
+
+            for name, pm in self.profiles.items():
+                if name in out:
+                    out[name]["profile"] = profile_row(pm)
+        # each snapshot feeds the SLO engine's windows; alarms() then
+        # evaluates the multi-window burn rates over them
+        if self.slo is not None:
+            self.slo.observe(out)
         return out
 
     def alarms(self, snap: dict) -> list[str]:
@@ -155,6 +186,11 @@ class Monitor:
                         f"(landed {row.get('landed', 0)}, failed "
                         f"{row.get('failed', 0)})"
                     )
+        # asserted-SLO burn-rate rows (disco/slo.py): breached SLOs
+        # alarm, fast-burning-but-unconfirmed ones are noted
+        if self.slo is not None:
+            self.slo.evaluate()
+            out.extend(self.slo.alarm_rows())
         return out
 
     def render(self, prev: dict | None, cur: dict, dt: float) -> str:
@@ -231,6 +267,19 @@ class Monitor:
                     f"e2e p50={hist_percentile(he, 50):,.0f}us "
                     f"p99={hist_percentile(he, 99):,.0f}us"
                 )
+            # run-loop profile sub-row (enable_profile topologies):
+            # GIL-wait share, phase split, scheduler-lag p99
+            prof = row.get("profile")
+            if prof and prof.get("samples"):
+                lines.append(
+                    f"{'':>10}   prof: gil_wait "
+                    f"{prof['gil_wait_frac'] * 100:.1f}% | frag "
+                    f"{prof['frag_frac'] * 100:.0f}% hk "
+                    f"{prof['hk_frac'] * 100:.0f}% credit "
+                    f"{prof['credit_frac'] * 100:.0f}% bp "
+                    f"{prof.get('bp_frac', 0) * 100:.0f}% | sched_lag "
+                    f"p99={prof['sched_lag_p99_us']:,.0f}us"
+                )
             # device-pool health sub-rows (tiles exporting dev{i}_*
             # counters — the multi-device verify scale-out)
             devs = device_rows(c)
@@ -266,3 +315,76 @@ class Monitor:
             i += 1
             if iterations is None or i < iterations:
                 time.sleep(interval_s)
+
+    def once(self) -> dict:
+        """One machine-readable snapshot document: full tile rows,
+        link state, alarms, SLO status, and profiler summaries — the
+        `--once --json` surface CI and fdtincident scrape without a
+        TTY.  Counters are cumulative (no rates: rates need a second
+        refresh; consumers diff two documents)."""
+        snap = self.snapshot()
+        doc = {
+            "tiles": {k: v for k, v in snap.items() if k != "_links"},
+            "links": snap.get("_links", {}),
+            "alarms": self.alarms(snap),
+        }
+        if self.slo is not None:
+            doc["slo"] = self.slo.to_dict()
+        return doc
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: `python -m firedancer_tpu.app.monitor WKSP [--once]
+    [--json] [-i SECONDS] [--iterations N]`."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="monitor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("wksp", help="topology workspace name")
+    ap.add_argument("--once", action="store_true",
+                    help="single refresh, then exit (CI / scripting)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON (implies no TTY UI)")
+    ap.add_argument("--interval", "-i", type=float, default=1.0)
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="stop the live loop after N refreshes")
+    args = ap.parse_args(argv)
+    try:
+        mon = Monitor(args.wksp)
+    except FileNotFoundError:
+        print(
+            f"monitor: no workspace {args.wksp!r} (is the topology "
+            "running with a name, and was start() reached?)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.once:
+        doc = mon.once()
+        if args.json:
+            print(json.dumps(doc, sort_keys=True, default=int))
+        else:
+            snap = {**doc["tiles"], "_links": doc["links"]}
+            print(mon.render(None, snap, args.interval))
+        return 0
+    if args.json:
+        # line-delimited JSON stream, one document per refresh
+        i = 0
+        while args.iterations is None or i < args.iterations:
+            print(json.dumps(mon.once(), sort_keys=True, default=int),
+                  flush=True)
+            i += 1
+            if args.iterations is None or i < args.iterations:
+                time.sleep(args.interval)
+        return 0
+    mon.run(interval_s=args.interval, iterations=args.iterations)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

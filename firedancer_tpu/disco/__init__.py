@@ -10,7 +10,14 @@ code or on the TPU, never in the Python loop body.
 """
 
 from .faultinj import Fault, FaultInjector  # noqa: F401
-from .metrics import Metrics, MetricsSchema, hist_percentile  # noqa: F401
+from .flight import FlightConfig, FlightRecorder  # noqa: F401
+from .metrics import (  # noqa: F401
+    Metrics,
+    MetricsSchema,
+    hist_frac_above,
+    hist_percentile,
+)
+from .slo import SloConfig, SloEngine  # noqa: F401
 from .mux import (  # noqa: F401
     InLink,
     MuxCtx,

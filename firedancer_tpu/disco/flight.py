@@ -1,0 +1,530 @@
+"""fdtflight: black-box flight recorder and incident bundles.
+
+PR 5's fdttrace answers "where does a frag spend its time"; this layer
+answers "what exactly was the system doing when it broke".  Three parts:
+
+  * A per-tile BLACK BOX: a small ring of periodic state records in
+    workspace shared memory (BlackBox) — metric deltas, ring cursors
+    (mcache seq / fseq / credit view) and supervision state, sampled by
+    the recorder's watcher thread.  Like the span rings it is a
+    single-writer, torn-read-tolerant u64 region: the data survives the
+    death of any tile (and, after the item-1 process-runtime refactor,
+    of any tile process) because it lives in the workspace, not in the
+    tile.
+
+  * A trigger engine: supervisor crash/stall restarts, circuit-breaker
+    trips and wedges (via Supervisor.add_listener), device quarantines
+    (dev{i}_degraded gauge edges), SLO breaches (disco/slo.py burn-rate
+    edges) and explicit signals (FlightRecorder.trigger / SIGUSR1) each
+    freeze the black boxes and dump an INCIDENT BUNDLE.
+
+  * The bundle itself: one self-contained JSON document — trigger,
+    topology manifest, faultinj seed + canonical fired record, SLO
+    state, per-tile state (cnc signal, counters, ring cursors, recent
+    black-box records) and the last-N span events per tile — enough to
+    classify, render, and diff the incident offline with NO access to
+    the live system (`scripts/fdtincident.py`).
+
+Determinism note: two runs of the same seeded fault schedule produce
+bundles whose canonical fields (trigger kind/tile, classification,
+faultinj seed + fired record) are equal; wall-clock fields and counter
+values are declared noisy and compared only informationally by
+`fdtincident diff`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import trace as T
+from .metrics import device_rows
+
+_SIGNAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
+
+#: counters every black-box record carries (beyond ts + ring cursors)
+BOX_COUNTERS = (
+    "in_frags",
+    "out_frags",
+    "overrun_frags",
+    "backpressure_iters",
+    "loop_iters",
+    "restarts",
+    "degraded",
+)
+
+_BOX_HDR_WORDS = 8
+
+
+class BlackBox:
+    """Per-tile snapshot ring in a u64 workspace region.
+
+    Header: word0 = record cursor (total records ever written),
+    word1 = depth, word2 = rec_words.  Records live at slot
+    (i % depth); same single-writer torn-read-tolerant contract as the
+    metrics regions.  The single writer is the flight recorder's
+    watcher thread (for every box — one writer thread, many boxes)."""
+
+    def __init__(
+        self, mem_u8: np.ndarray, depth: int = 0, rec_words: int = 0,
+        join: bool = False,
+    ):
+        self.words = mem_u8[: (len(mem_u8) // 8) * 8].view(np.uint64)
+        if join:
+            self.depth = int(self.words[1])
+            self.rec_words = int(self.words[2])
+        else:
+            assert depth > 0 and rec_words > 0
+            self.depth = depth
+            self.rec_words = rec_words
+            self.words[0] = 0
+            self.words[1] = depth
+            self.words[2] = rec_words
+        self.recs = self.words[
+            _BOX_HDR_WORDS : _BOX_HDR_WORDS + self.depth * self.rec_words
+        ].reshape(self.depth, self.rec_words)
+
+    @staticmethod
+    def footprint(depth: int, rec_words: int) -> int:
+        return (_BOX_HDR_WORDS + depth * rec_words) * 8
+
+    def write(self, rec) -> None:
+        c = int(self.words[0])
+        row = np.zeros(self.rec_words, np.uint64)
+        n = min(len(rec), self.rec_words)
+        row[:n] = np.asarray(rec[:n], np.uint64)
+        self.recs[c % self.depth] = row
+        self.words[0] = np.uint64(c + 1)
+
+    def read_all(self) -> list[list[int]]:
+        """Last min(cursor, depth) records, oldest first."""
+        c = int(self.words[0])
+        lo = max(c - self.depth, 0)
+        idx = (lo + np.arange(c - lo)) % self.depth
+        return self.recs[idx].tolist()
+
+
+@dataclass(frozen=True)
+class FlightConfig:
+    """Topology-level flight-recorder knobs (Topology.enable_flight)."""
+
+    #: black-box records retained per tile
+    depth: int = 64
+    #: span events included per tile in a bundle's timeline
+    timeline_n: int = 256
+
+
+def box_rec_words(n_ins: int, n_outs: int) -> int:
+    """Record layout: ts_us, BOX_COUNTERS, then (produced, consumed)
+    per in-link and (produced, min_consumer_seq) per out-link."""
+    return 1 + len(BOX_COUNTERS) + 2 * n_ins + 2 * n_outs
+
+
+def decode_box_record(rec: list[int], ins: list[str], outs: list[str]) -> dict:
+    out = {"ts_us": rec[0]}
+    base = 1
+    for i, c in enumerate(BOX_COUNTERS):
+        out[c] = rec[base + i]
+    base += len(BOX_COUNTERS)
+    out["ins"] = {}
+    for i, ln in enumerate(ins):
+        out["ins"][ln] = {
+            "produced": rec[base + 2 * i],
+            "consumed": rec[base + 2 * i + 1],
+        }
+    base += 2 * len(ins)
+    out["outs"] = {}
+    for i, ln in enumerate(outs):
+        out["outs"][ln] = {
+            "produced": rec[base + 2 * i],
+            "slowest_consumer": rec[base + 2 * i + 1],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-process topology snapshots (monitor-shaped, shared with the SLO
+# engine and the bundles; app/monitor.py produces the same shape from an
+# attached workspace)
+
+
+_LAT_PREFIXES = ("qwait_us_", "svc_us_", "e2e_us_")
+
+
+def snapshot_topology(topo) -> dict:
+    """One monitor-shaped snapshot of a built in-process Topology."""
+    out: dict = {}
+    for name in topo.tiles:
+        m = topo._metrics[name]
+        cnc = topo._cncs[name]
+        sig = cnc.signal_query()
+        out[name] = {
+            "signal": _SIGNAMES.get(sig, str(sig)),
+            "heartbeat": cnc.heartbeat_query(),
+            "counters": {
+                c: m.counter(c) for c in m.schema.counters
+            },
+            "lat_hists": {
+                h: m.hist(h)
+                for h in m.schema.hists
+                if h.startswith(_LAT_PREFIXES)
+            },
+        }
+    links: dict = {}
+    for lname, ls in topo.links.items():
+        mc = topo._mcaches.get(lname)
+        prod = mc.seq_query() if mc is not None else None
+        seqs = {}
+        for cons, _rel in ls.consumers:
+            fs = topo._fseqs.get((lname, cons))
+            if fs is None:
+                continue
+            cseq = fs.query()
+            seqs[cons] = {
+                "seq": cseq,
+                "lag": None if prod is None else max(prod - cseq, 0),
+            }
+        links[lname] = {"produced": prod, "consumers": seqs}
+    out["_links"] = links
+    return out
+
+
+def tile_links(topo) -> dict[str, dict]:
+    return {
+        name: {"ins": [ln for ln, _ in ts.ins], "outs": list(ts.outs)}
+        for name, ts in topo.tiles.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+
+
+class FlightRecorder:
+    """Watch a built (and usually supervised) in-process Topology;
+    record black boxes; dump incident bundles on triggers.
+
+    Usage:
+        topo.enable_flight(); topo.enable_trace(...)   # before build
+        sup = Supervisor(topo, ..., faults=inj)
+        rec = FlightRecorder(topo, out_dir, slo=SloEngine(...),
+                             faults=inj)
+        rec.attach_supervisor(sup)
+        sup.start(); rec.start()
+        ...
+        rec.stop(); sup.halt()
+    """
+
+    def __init__(
+        self,
+        topo,
+        out_dir: str,
+        slo=None,
+        faults=None,
+        poll_s: float = 0.05,
+        name: str | None = None,
+    ):
+        assert topo.wksp is not None, "FlightRecorder needs a built topology"
+        self.topo = topo
+        self.out_dir = out_dir
+        self.slo = slo
+        self.faults = faults
+        self.poll_s = poll_s
+        self.name = name or topo.name or "fdt"
+        self.incidents: list[str] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sup = None
+        #: supervisor events are queued here and dumped by the WATCHER
+        #: thread (next poll, <= poll_s later): bundle construction is
+        #: snapshot + span decode + JSON I/O, far too slow for the
+        #: supervisor watchdog's "callbacks must be fast" contract — a
+        #: restart storm must not serialize restarts behind file writes
+        self._pending: list[tuple[str, str | None, dict]] = []
+        #: edge detectors
+        self._dev_degraded: dict[tuple[str, int], int] = {}
+        self._tile_degraded: dict[str, int] = {}
+        self._slo_breached: dict[str, bool] = {}
+        os.makedirs(out_dir, exist_ok=True)
+
+    # -- trigger wiring ---------------------------------------------------
+
+    def attach_supervisor(self, sup) -> None:
+        """Subscribe to the supervisor's failure events (restart /
+        breaker / wedged become incident triggers)."""
+        self._sup = sup
+        sup.add_listener(self._on_supervisor_event)
+
+    def _on_supervisor_event(self, tile: str, kind: str, detail: dict) -> None:
+        # enqueue only — the watcher thread builds the bundle.  The
+        # black boxes and span rings hold the state leading up to the
+        # failure, so a <= poll_s dump delay loses nothing.
+        with self._lock:
+            self._pending.append((kind, tile, dict(detail)))
+
+    def install_signal(self, signum=None) -> None:
+        """Explicit-signal trigger: SIGUSR1 (or `signum`) dumps a
+        bundle.  Must be called from the main thread."""
+        import signal as _signal
+
+        signum = _signal.SIGUSR1 if signum is None else signum
+        _signal.signal(
+            signum,
+            lambda sn, frame: self.trigger("signal", detail={"signum": sn}),
+        )
+
+    def trigger(self, kind: str = "manual", tile: str | None = None,
+                detail: dict | None = None) -> str:
+        """Explicit incident dump; returns the bundle path."""
+        return self._incident(kind, tile, detail or {})
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch, name="flight", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        self._drain_pending()  # events that raced the shutdown
+
+    # -- watcher ----------------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — diagnosis must not kill the host
+                from firedancer_tpu.utils import log
+
+                import traceback
+
+                log.err("flight watcher error:\n%s", traceback.format_exc())
+
+    def poll_once(self) -> None:
+        """One watcher pass: queued supervisor events, box records,
+        trigger edge detection.  Exposed for deterministic tests (no
+        thread needed)."""
+        self._drain_pending()
+        snap = snapshot_topology(self.topo)
+        self._write_boxes(snap)
+        self._detect_quarantine(snap)
+        if self._sup is None:
+            self._detect_degraded(snap)
+        if self.slo is not None:
+            self.slo.observe(snap)
+            self.slo.evaluate()
+            self._export_slo_gauges()
+            for name, breached in self.slo.breached_now.items():
+                was = self._slo_breached.get(name, False)
+                if breached and not was:
+                    st = next(
+                        s for s in self.slo._last if s.name == name
+                    )
+                    # cumulative breach count, incremented on the EDGE
+                    # (the live per-SLO gauges clear when the windows
+                    # quieten; this records that it happened)
+                    m = self.topo._metrics.get("slo")
+                    if m is not None:
+                        m.inc("slo_breaches")
+                    self._incident(
+                        "slo", None,
+                        {"slo": name, **st.to_dict()},
+                    )
+                self._slo_breached[name] = breached
+
+    def _drain_pending(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                kind, tile, detail = self._pending.pop(0)
+            self._incident(kind, tile, detail)
+
+    def _write_boxes(self, snap: dict) -> None:
+        boxes = getattr(self.topo, "_flightboxes", {})
+        if not boxes:
+            return
+        ts_us = time.monotonic_ns() // 1000
+        links = snap.get("_links", {})
+        for name, box in boxes.items():
+            row = snap.get(name)
+            if row is None:
+                continue
+            c = row["counters"]
+            rec = [ts_us] + [c.get(k, 0) for k in BOX_COUNTERS]
+            ts = self.topo.tiles[name]
+            for ln, _rel in ts.ins:
+                li = links.get(ln, {})
+                prod = li.get("produced") or 0
+                cons = li.get("consumers", {}).get(name, {}).get("seq", 0)
+                rec += [prod, cons]
+            for ln in ts.outs:
+                li = links.get(ln, {})
+                prod = li.get("produced") or 0
+                consumers = li.get("consumers", {})
+                slowest = min(
+                    (v["seq"] for v in consumers.values()), default=prod
+                )
+                rec += [prod, slowest]
+            box.write(rec)
+
+    def _detect_quarantine(self, snap: dict) -> None:
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            for i, dev in device_rows(row["counters"]).items():
+                cur = int(bool(dev.get("degraded")))
+                was = self._dev_degraded.get((name, i), 0)
+                if cur and not was:
+                    self._incident(
+                        "quarantine", name,
+                        {"device": i, "landed": dev.get("landed", 0),
+                         "failed": dev.get("failed", 0)},
+                    )
+                self._dev_degraded[(name, i)] = cur
+
+    def _detect_degraded(self, snap: dict) -> None:
+        """Fallback breaker detection via the shared degraded gauge,
+        for unsupervised/attached runs with no listener hook."""
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            cur = int(bool(row["counters"].get("degraded")))
+            was = self._tile_degraded.get(name, 0)
+            if cur and not was:
+                self._incident(
+                    "breaker", name,
+                    {"restarts": row["counters"].get("restarts", 0)},
+                )
+            self._tile_degraded[name] = cur
+
+    def _export_slo_gauges(self) -> None:
+        m = self.topo._metrics.get("slo")
+        if m is None:
+            return
+        gauges = self.slo.gauges()
+        known = set(m.schema.counters)
+        for k, v in gauges.items():
+            if k in known:
+                m.set(k, v)
+        m.inc("slo_evaluations")
+
+    # -- bundles ----------------------------------------------------------
+
+    def _incident(self, kind: str, tile: str | None, detail: dict) -> str:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            bundle = self._build_bundle(kind, tile, detail, seq)
+            path = os.path.join(
+                self.out_dir, f"incident_{seq:04d}_{kind}.json"
+            )
+            # write-then-rename: bundle files appear atomically, so a
+            # concurrent `fdtincident` scan never reads a partial doc
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, indent=1, sort_keys=True, default=int)
+            os.replace(tmp, path)
+            self.incidents.append(path)
+        from firedancer_tpu.utils import log
+
+        log.info(
+            "flight: incident %s (%s%s) -> %s",
+            seq, kind, f" tile={tile}" if tile else "", path,
+        )
+        return path
+
+    def _build_bundle(
+        self, kind: str, tile: str | None, detail: dict, seq: int
+    ) -> dict:
+        topo = self.topo
+        snap = snapshot_topology(topo)
+        tlinks = tile_links(topo)
+        bundle: dict = {
+            "version": 1,
+            "id": f"{self.name}-{seq:04d}-{kind}",
+            "seq": seq,
+            "trigger": {
+                "kind": kind,
+                "tile": tile,
+                "detail": detail,
+                "ts_mono_us": time.monotonic_ns() // 1000,
+                "wall_time": time.time(),
+            },
+            "topology": {
+                "name": topo.name,
+                "tiles": tlinks,
+                "links": {
+                    ln: {"depth": ls.depth, "mtu": ls.mtu,
+                         "producer": ls.producer}
+                    for ln, ls in topo.links.items()
+                },
+            },
+        }
+        if self.faults is not None:
+            bundle["faultinj"] = {
+                "seed": self.faults.seed,
+                "fired": [list(e) for e in self.faults.fired()],
+            }
+        if self.slo is not None:
+            bundle["slo"] = self.slo.to_dict()
+        tiles: dict = {}
+        boxes = getattr(topo, "_flightboxes", {})
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            entry: dict = {
+                "signal": row["signal"],
+                "counters": row["counters"],
+            }
+            box = boxes.get(name)
+            if box is not None:
+                ins = tlinks[name]["ins"]
+                outs = tlinks[name]["outs"]
+                entry["flight"] = [
+                    decode_box_record(r, ins, outs)
+                    for r in box.read_all()
+                ]
+            tiles[name] = entry
+        bundle["tiles"] = tiles
+        bundle["rings"] = snap.get("_links", {})
+        bundle["timeline"] = self._timeline()
+        return bundle
+
+    def _timeline(self) -> dict:
+        """Last-N decoded span events per tile (needs enable_trace)."""
+        cfg = getattr(self.topo, "flight", None) or FlightConfig()
+        out: dict = {}
+        for name, tracer in getattr(self.topo, "_tracers", {}).items():
+            ring = tracer.ring
+            c = ring.cursor()
+            evs, _, _ = ring.read(max(c - cfg.timeline_n, 0))
+            decoded = []
+            for e in T.decode(evs):
+                d = {
+                    "kind": T.KIND_NAMES.get(e["kind"], str(e["kind"])),
+                    "link": e["link"],
+                    "ts": e["ts"],
+                    "seq": e["seq"],
+                    "sig": e["sig"],
+                    "aux16": e["aux16"],
+                    "aux64": e["aux64"],
+                }
+                if e["kind"] == T.FAULT:
+                    d["fault"] = T.FAULT_NAMES.get(e["aux16"], "?")
+                decoded.append(d)
+            out[name] = decoded
+        return out

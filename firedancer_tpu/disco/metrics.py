@@ -199,20 +199,101 @@ class Metrics:
 
 def hist_percentile(h: dict, q: float) -> float:
     """Estimate the q-th percentile (q in [0, 100]) of a Metrics.hist()
-    snapshot by log-bucket linear interpolation.  0.0 on an empty hist."""
+    snapshot by log-bucket linear interpolation.  0.0 on an empty hist.
+
+    Boundary contract (pinned in tests/test_fdttrace.py):
+      * empty hist / count <= 0 / no occupied bucket -> 0.0;
+      * q is clamped into [0, 100]; q=0 returns the lower edge of the
+        first occupied bucket (the min estimate), q=100 the upper edge
+        of the last occupied one (the max estimate);
+      * all mass in the overflow bucket interpolates inside
+        [2^(HIST_BUCKETS-1), 2^HIST_BUCKETS] — a finite estimate with
+        the documented 2x-span bias for values beyond the top bucket;
+      * torn snapshots (the regions are read lock-free, and windowed
+        deltas of torn reads can even go negative per bucket) never
+        push the walk past the occupied mass: negative bucket counts
+        are treated as empty and the rank is clamped to the occupied
+        total, so the estimate stays inside the last occupied bucket
+        instead of jumping to the 2^HIST_BUCKETS sentinel."""
     buckets = h.get("buckets") or []
     count = h.get("count", 0)
     if count <= 0:
         return 0.0
-    rank = (min(max(q, 0.0), 100.0) / 100.0) * count
+    occupied = [(b, n) for b, n in enumerate(buckets) if n > 0]
+    if not occupied:
+        # count incremented before its bucket landed (torn read)
+        return 0.0
+    mass = sum(n for _, n in occupied)
+    rank = (min(max(q, 0.0), 100.0) / 100.0) * min(count, mass)
     cum = 0
-    for b, n in enumerate(buckets):
-        if n and cum + n >= rank:
+    for b, n in occupied:
+        if cum + n >= rank:
             lo = 0.0 if b == 0 else float(1 << b)
             # the top bucket is open-ended; assume the same 2x
             # geometric span as the others (documented estimator bias
             # for distributions with mass beyond 2^HIST_BUCKETS)
             hi = float(1 << (b + 1))
-            return lo + (hi - lo) * ((rank - cum) / n)
+            return lo + (hi - lo) * (max(rank - cum, 0.0) / n)
         cum += n
-    return float(1 << HIST_BUCKETS)
+    # unreachable while rank <= mass; keep the clamp for safety
+    b, n = occupied[-1]
+    return float(1 << (b + 1))
+
+
+def merge_hists(hs: list[dict]) -> dict:
+    """Sum Metrics.hist() snapshots bucket-wise (counts, sums, and a
+    buckets vector as long as the longest input) — the primitive behind
+    cross-tile SLO windows (disco/slo.py) and profile aggregation
+    (disco/profile.py)."""
+    out = {"count": 0, "sum": 0, "buckets": []}
+    for h in hs:
+        out["count"] += h.get("count", 0)
+        out["sum"] += h.get("sum", 0)
+        bk = h.get("buckets", [])
+        if len(bk) > len(out["buckets"]):
+            out["buckets"] += [0] * (len(bk) - len(out["buckets"]))
+        for i, n in enumerate(bk):
+            out["buckets"][i] += n
+    return out
+
+
+def hist_delta(cur: dict, prev: dict | None) -> dict:
+    """Windowed hist: cur - prev per bucket (both cumulative monotone
+    snapshots of the same region).  No/empty prev -> cur unchanged
+    (cumulative view).  Buckets are padded to the longer vector so a
+    schema-extended snapshot diffs cleanly against an older one."""
+    if not prev or not prev.get("count"):
+        return cur
+    cb, pb = cur.get("buckets", []), prev.get("buckets", [])
+    n = max(len(cb), len(pb))
+    return {
+        "count": cur.get("count", 0) - prev.get("count", 0),
+        "sum": cur.get("sum", 0) - prev.get("sum", 0),
+        "buckets": [
+            (cb[i] if i < len(cb) else 0) - (pb[i] if i < len(pb) else 0)
+            for i in range(n)
+        ],
+    }
+
+
+def hist_frac_above(h: dict, x: float) -> float:
+    """Estimated fraction of a Metrics.hist() snapshot's samples that
+    exceed `x`, by the same log-bucket linear interpolation as
+    hist_percentile (and the same torn-read tolerance).  This is the
+    SLO engine's primitive: for a latency SLO "p99 <= X", the bad
+    fraction of a window is hist_frac_above(window_delta, X)."""
+    buckets = h.get("buckets") or []
+    mass = sum(n for n in buckets if n > 0)
+    if mass <= 0:
+        return 0.0
+    above = 0.0
+    for b, n in enumerate(buckets):
+        if n <= 0:
+            continue
+        lo = 0.0 if b == 0 else float(1 << b)
+        hi = float(1 << (b + 1))
+        if x < lo:
+            above += n
+        elif x < hi:
+            above += n * ((hi - x) / (hi - lo))
+    return min(above / mass, 1.0)

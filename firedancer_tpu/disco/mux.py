@@ -203,6 +203,10 @@ class MuxCtx:
         #: topology when tracing is enabled; None keeps every trace
         #: point a single attribute check
         self.tracer = None
+        #: run-loop profiler (disco/profile.py TileProfiler), installed
+        #: by the topology when profiling is enabled; None keeps every
+        #: profile point a single attribute check
+        self.profiler = None
         self.incarnation = 0
         #: True once the current incarnation's on_boot completed — lets
         #: the topology distinguish "died during boot" (raise at start)
@@ -326,6 +330,11 @@ def run_loop(
     cnc = ctx.cnc
     faults = ctx.faults
     tracer = ctx.tracer
+    # run-loop profiler (disco/profile.py): wall/CPU phase attribution
+    # and scheduler-lag on the SAME 1-in-16 sampled iterations as the
+    # phase hists; None costs one attribute check per hook point
+    prof = ctx.profiler
+    idle_sleep_ns = int(idle_sleep_s * 1e9)
     if faults is not None:
         # injected faults annotate themselves into the trace (the
         # kill -> restart gap must be visible in the timeline)
@@ -363,8 +372,18 @@ def run_loop(
             # 1/16 sample keeps the Python-side cost negligible while
             # preserving the distribution)
             sample = (iters & 0xF) == 0
+            p_cpu0 = (
+                time.thread_time_ns()
+                if prof is not None and sample
+                else 0
+            )
+            p_sleep = 0  # voluntary sleep inside this iteration (ns)
             iters += 1
             if now >= next_hk:
+                # scheduler lag: how far past the INTENDED firing point
+                # the loop actually got here (GIL/scheduler contention
+                # seen from the time-based cadence's side)
+                hk_lag_ns = now - next_hk if next_hk else 0
                 next_hk = now + tempo.async_reload(lazy_ns)
                 cnc.heartbeat(now)
                 for il in ctx.ins:
@@ -373,6 +392,15 @@ def run_loop(
                 if cnc.signal_query() == R.CNC_HALT:
                     break
                 tile.during_housekeeping(ctx)
+                if prof is not None:
+                    if hk_lag_ns:
+                        prof.sched_lag(hk_lag_ns)
+                    if sample:
+                        prof.add_phase(
+                            "hk",
+                            time.monotonic_ns() - now,
+                            time.thread_time_ns() - p_cpu0,
+                        )
                 if sample:
                     hk_ns = time.monotonic_ns() - now
                     m.hist_sample("hk_ns", hk_ns)
@@ -397,13 +425,32 @@ def run_loop(
                         tracer.point(_SPAN_BP)
                     idle += 1
                     if idle >= idle_before_sleep:
-                        time.sleep(idle_sleep_s)
+                        if prof is None:
+                            time.sleep(idle_sleep_s)
+                        else:
+                            t0s = time.monotonic_ns()
+                            time.sleep(idle_sleep_s)
+                            p_sleep = time.monotonic_ns() - t0s
+                            prof.add_sleep(p_sleep, idle_sleep_ns)
+                    if prof is not None and sample:
+                        end = time.monotonic_ns()
+                        prof.add_bp(max(end - now - p_sleep, 0))
+                        prof.add_iter(
+                            end - now,
+                            time.thread_time_ns() - p_cpu0,
+                            p_sleep,
+                        )
                     continue
             ctx.credits = cr
 
             out_seq0 = [o.seq for o in ctx.outs]
             got = 0
             t_frag0 = time.monotonic_ns() if sample else 0
+            p_cpu_frag0 = (
+                time.thread_time_ns()
+                if prof is not None and sample
+                else 0
+            )
             absorb = tile.in_budget(ctx)
             # rotate the drain order so a saturated in-link cannot starve
             # the others of the shared credit budget (e.g. pack's txn
@@ -471,13 +518,27 @@ def run_loop(
             ctx.credits = cr - got
             if sample:
                 t_credit0 = time.monotonic_ns()
+                p_cpu_credit0 = (
+                    time.thread_time_ns() if prof is not None else 0
+                )
                 if got:
                     m.hist_sample("frag_ns", t_credit0 - t_frag0)
+                    if prof is not None:
+                        prof.add_phase(
+                            "frag",
+                            t_credit0 - t_frag0,
+                            p_cpu_credit0 - p_cpu_frag0,
+                        )
                 tile.after_credit(ctx)
-                m.hist_sample(
-                    "credit_ns", time.monotonic_ns() - t_credit0
-                )
-                m.hist_sample("loop_ns", time.monotonic_ns() - now)
+                t_end = time.monotonic_ns()
+                m.hist_sample("credit_ns", t_end - t_credit0)
+                m.hist_sample("loop_ns", t_end - now)
+                if prof is not None:
+                    prof.add_phase(
+                        "credit",
+                        t_end - t_credit0,
+                        time.thread_time_ns() - p_cpu_credit0,
+                    )
             else:
                 tile.after_credit(ctx)
 
@@ -485,9 +546,23 @@ def run_loop(
             if got == 0 and not produced:
                 idle += 1
                 if idle >= idle_before_sleep:
-                    time.sleep(idle_sleep_s)
+                    if prof is None:
+                        time.sleep(idle_sleep_s)
+                    else:
+                        t0s = time.monotonic_ns()
+                        time.sleep(idle_sleep_s)
+                        p_sleep += time.monotonic_ns() - t0s
+                        prof.add_sleep(
+                            time.monotonic_ns() - t0s, idle_sleep_ns
+                        )
             else:
                 idle = 0
+            if prof is not None and sample:
+                prof.add_iter(
+                    time.monotonic_ns() - now,
+                    time.thread_time_ns() - p_cpu0,
+                    p_sleep,
+                )
     except Exception:
         cnc.signal(R.CNC_FAIL)
         raise

@@ -1,0 +1,395 @@
+"""SLO engine: asserted service-level objectives with multi-window
+burn-rate evaluation over the fdttrace measurement substrate.
+
+The framing is "The Tail at Scale" (Dean & Barroso, CACM 2013) applied
+to the quic→verify→dedup→pack path: the SLOs are TAIL objectives
+(e2e p99, per-hop p99) plus a throughput floor and a drop ceiling, and
+the alerting is the multi-window burn-rate scheme (a breach must burn
+the error budget fast over a short window AND be sustained over a long
+window before it alarms — a single slow batch is noise, a sustained
+regression is an incident).
+
+Inputs are monitor-shaped snapshots ({tile: {"counters": ..,
+"lat_hists": ..}}, app/monitor.py Monitor.snapshot or
+flight.snapshot_topology) — the engine is a pure library over them, so
+the in-process flight recorder and an attached cross-process monitor
+evaluate the SAME objectives from the same shared-memory histograms.
+
+SLO semantics (all optional; None = not asserted):
+  e2e_p99_us        end-to-end p99 ceiling, measured on the merged
+                    e2e_us_* hists of the path's EXIT tiles (tiles with
+                    no out links: sink/store).  Budget: at most
+                    `budget` (default 1%) of samples may exceed it.
+                    NOTE: latency ceilings must sit inside the 16-bucket
+                    log2 hist domain — values clamp into the top bucket
+                    at 2^15 µs and the domain ends at 2^16 µs (~65 ms),
+                    so a ceiling >= 65536 µs can never be observed as
+                    violated by this storage format.
+  verify_hop_p99_us verify service-time p99 ceiling (svc_us_* hists of
+                    verify* tiles), same budget semantics.
+  landed_tps_min    throughput floor: windowed in_frags rate at the
+                    exit tiles must stay >= this.
+  drop_rate_max     ceiling on the per-window drop fraction,
+                    dropped / (landed + dropped), where dropped sums
+                    the declared-loss counters (overruns + verify
+                    rejects) across every tile and landed is the
+                    exit-tile frag count.  (Landed, not a sum of every
+                    hop's in_frags — that would count each frag once
+                    per hop and understate the fraction by the
+                    pipeline depth.)
+
+Burn rate for the latency SLOs = bad_fraction / budget; for the floor,
+shortfall = floor / measured_rate; for the drop ceiling, observed_rate /
+ceiling.  A breach fires when BOTH windows exceed their thresholds
+(burn_fast over the fast window and burn_slow over the slow window),
+following the SRE-workbook multiwindow scheme.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .metrics import (
+    hist_delta,
+    hist_frac_above,
+    hist_percentile,
+    merge_hists,
+)
+
+#: counters summed into the window's "dropped" numerator — declared
+#: frag loss only (injected drops are declared by faultinj, not here)
+DROP_COUNTERS = ("overrun_frags", "verify_fail_txns", "dup_txns")
+#: dup_txns is exactly-once collapse, not loss — excluded by default
+DEFAULT_DROP_COUNTERS = ("overrun_frags", "verify_fail_txns")
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """The `[slo]` config section (app/config.py).  Window/threshold
+    defaults suit a live deployment; tests shrink the windows."""
+
+    e2e_p99_us: float | None = None
+    verify_hop_p99_us: float | None = None
+    landed_tps_min: float | None = None
+    drop_rate_max: float | None = None
+    #: error budget for the latency SLOs: tolerated fraction of samples
+    #: above the ceiling (p99 objective = 1% budget)
+    budget: float = 0.01
+    fast_window_s: float = 5.0
+    slow_window_s: float = 60.0
+    #: burn-rate thresholds per window (SRE-workbook style: fast burn
+    #: must be large, slow burn sustained)
+    burn_fast: float = 10.0
+    burn_slow: float = 2.0
+
+    def asserted(self) -> list[str]:
+        return [
+            k
+            for k in (
+                "e2e_p99_us",
+                "verify_hop_p99_us",
+                "landed_tps_min",
+                "drop_rate_max",
+            )
+            if getattr(self, k) is not None
+        ]
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "SloConfig":
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+@dataclass
+class SloStatus:
+    """One SLO's evaluation at a point in time."""
+
+    name: str
+    threshold: float
+    #: the fast/slow-window burn rates (>= 1.0 means the window is
+    #: violating the objective at budget-exhausting rate)
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+    breached: bool = False
+    #: measured headline value over the fast window (p99 / rate / frac)
+    measured: float = 0.0
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+
+@dataclass
+class _Digest:
+    """One observation: the cumulative state the windows difference."""
+
+    ts: float
+    e2e: dict = field(default_factory=dict)
+    verify_hop: dict = field(default_factory=dict)
+    landed_frags: int = 0
+    dropped_frags: int = 0
+
+
+class SloEngine:
+    """Windowed burn-rate evaluation.  Feed monitor-shaped snapshots
+    via observe(); read evaluate()/alarm_rows()/gauges().
+
+    `tile_links` ({tile: {"ins": [...], "outs": [...]}}) tells the
+    engine which tiles are path exits (no outs) — the topology manifest
+    and flight.snapshot_topology both carry it."""
+
+    def __init__(
+        self,
+        cfg: SloConfig,
+        tile_links: dict[str, dict] | None = None,
+        clock=time.monotonic,
+    ):
+        self.cfg = cfg
+        self.tile_links = tile_links or {}
+        self.clock = clock
+        self._digests: list[_Digest] = []
+        self._last: list[SloStatus] = []
+        #: breach edges: name -> currently-breached (for incident
+        #: debounce — the flight recorder fires one bundle per edge)
+        self.breached_now: dict[str, bool] = {}
+
+    # -- snapshot digestion ----------------------------------------------
+
+    def _exit_tiles(self, snap: dict) -> list[str]:
+        names = [n for n in snap if n != "_links"]
+        if self.tile_links:
+            exits = [
+                n
+                for n in names
+                if not self.tile_links.get(n, {}).get("outs")
+                # observer tiles (metric/rpc) have no ins either
+                and self.tile_links.get(n, {}).get("ins")
+            ]
+            if exits:
+                return exits
+        return names
+
+    def observe(self, snap: dict, now: float | None = None) -> None:
+        """Digest one snapshot.  Keeps ~2x slow_window of history."""
+        now = self.clock() if now is None else now
+        d = _Digest(ts=now)
+        exits = set(self._exit_tiles(snap))
+        e2e, vhop = [], []
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            c = row.get("counters", {})
+            hists = row.get("lat_hists", {})
+            if name in exits:
+                d.landed_frags += c.get("in_frags", 0)
+                e2e.extend(
+                    h for k, h in hists.items() if k.startswith("e2e_us_")
+                )
+            if name.startswith("verify"):
+                vhop.extend(
+                    h for k, h in hists.items() if k.startswith("svc_us_")
+                )
+            d.dropped_frags += sum(
+                c.get(k, 0) for k in DEFAULT_DROP_COUNTERS
+            )
+        d.e2e = merge_hists(e2e)
+        d.verify_hop = merge_hists(vhop)
+        self._digests.append(d)
+        horizon = now - 2.0 * self.cfg.slow_window_s - 1.0
+        while len(self._digests) > 2 and self._digests[1].ts <= horizon:
+            self._digests.pop(0)
+
+    def _window(self, now: float, span_s: float) -> tuple[_Digest, _Digest] | None:
+        """(oldest digest inside [now-span, now], newest digest), or
+        None when the window has no baseline yet.  When the sampling
+        interval exceeds the span (a monitor polling slower than the
+        fast window), fall back to the NEWEST prior digest — a window
+        one sampling interval wide, the closest available approximation
+        — never to the oldest history, which would silently dilute a
+        fast burn into the slow-window average."""
+        if len(self._digests) < 2:
+            return None
+        cur = self._digests[-1]
+        base = None
+        for d in self._digests[:-1]:
+            if d.ts >= now - span_s:
+                base = d
+                break
+        if base is None:
+            base = self._digests[-2]
+        if cur.ts <= base.ts:
+            return None
+        return base, cur
+
+    # -- evaluation -------------------------------------------------------
+
+    def _latency_burn(
+        self, now: float, span_s: float, which: str, ceiling: float
+    ) -> tuple[float, float]:
+        """(burn, measured p99) for a latency SLO over one window."""
+        w = self._window(now, span_s)
+        if w is None:
+            return 0.0, 0.0
+        base, cur = w
+        dh = hist_delta(getattr(cur, which), getattr(base, which))
+        if dh.get("count", 0) <= 0:
+            return 0.0, 0.0
+        bad = hist_frac_above(dh, ceiling)
+        return bad / max(self.cfg.budget, 1e-9), hist_percentile(dh, 99.0)
+
+    def _rate(self, now: float, span_s: float, attr: str) -> float | None:
+        w = self._window(now, span_s)
+        if w is None:
+            return None
+        base, cur = w
+        return (getattr(cur, attr) - getattr(base, attr)) / (
+            cur.ts - base.ts
+        )
+
+    def evaluate(self, now: float | None = None) -> list[SloStatus]:
+        now = self.clock() if now is None else now
+        cfg = self.cfg
+        out: list[SloStatus] = []
+
+        for name, which in (
+            ("e2e_p99_us", "e2e"),
+            ("verify_hop_p99_us", "verify_hop"),
+        ):
+            ceiling = getattr(cfg, name)
+            if ceiling is None:
+                continue
+            bf, p99f = self._latency_burn(
+                now, cfg.fast_window_s, which, ceiling
+            )
+            bs, _ = self._latency_burn(now, cfg.slow_window_s, which, ceiling)
+            st = SloStatus(
+                name, ceiling, round(bf, 3), round(bs, 3),
+                breached=bf >= cfg.burn_fast and bs >= cfg.burn_slow,
+                measured=round(p99f, 1),
+                detail=f"p99={p99f:,.0f}us ceiling={ceiling:,.0f}us",
+            )
+            out.append(st)
+
+        if cfg.landed_tps_min is not None:
+            rf = self._rate(now, cfg.fast_window_s, "landed_frags")
+            rs = self._rate(now, cfg.slow_window_s, "landed_frags")
+            bf = 0.0 if rf is None else cfg.landed_tps_min / max(rf, 1e-9)
+            bs = 0.0 if rs is None else cfg.landed_tps_min / max(rs, 1e-9)
+            out.append(
+                SloStatus(
+                    "landed_tps_min", cfg.landed_tps_min,
+                    round(min(bf, 1e6), 3), round(min(bs, 1e6), 3),
+                    # the floor's "burn" is shortfall; both windows must
+                    # be under the floor (shortfall > 1) to breach
+                    breached=bf > 1.0 and bs > 1.0,
+                    measured=0.0 if rf is None else round(rf, 1),
+                    detail=(
+                        f"rate={0.0 if rf is None else rf:,.0f}/s "
+                        f"floor={cfg.landed_tps_min:,.0f}/s"
+                    ),
+                )
+            )
+
+        if cfg.drop_rate_max is not None:
+            st = self._drop_status(now)
+            out.append(st)
+
+        self._last = out
+        self.breached_now = {s.name: s.breached for s in out}
+        return out
+
+    def _drop_status(self, now: float) -> SloStatus:
+        cfg = self.cfg
+
+        def frac(span_s: float) -> float | None:
+            w = self._window(now, span_s)
+            if w is None:
+                return None
+            base, cur = w
+            ddrop = max(cur.dropped_frags - base.dropped_frags, 0)
+            dland = max(cur.landed_frags - base.landed_frags, 0)
+            if ddrop + dland <= 0:
+                return None
+            return ddrop / (ddrop + dland)
+
+        ff, fs = frac(cfg.fast_window_s), frac(cfg.slow_window_s)
+        bf = 0.0 if ff is None else ff / max(cfg.drop_rate_max, 1e-9)
+        bs = 0.0 if fs is None else fs / max(cfg.drop_rate_max, 1e-9)
+        return SloStatus(
+            "drop_rate_max", cfg.drop_rate_max,
+            round(bf, 3), round(bs, 3),
+            breached=bf > 1.0 and bs > 1.0,
+            measured=0.0 if ff is None else round(ff, 6),
+            detail=(
+                f"drop_frac={0.0 if ff is None else ff:.4f} "
+                f"ceiling={cfg.drop_rate_max:.4f}"
+            ),
+        )
+
+    # -- surfacing --------------------------------------------------------
+
+    def alarm_rows(self) -> list[str]:
+        """Monitor alarm lines for the last evaluation (breaches as
+        ALARM, elevated-but-unconfirmed fast burns as NOTE)."""
+        out = []
+        for s in self._last:
+            if s.breached:
+                out.append(
+                    f"ALARM slo {s.name}: breached ({s.detail}; burn "
+                    f"fast={s.burn_fast} slow={s.burn_slow})"
+                )
+            elif s.burn_fast >= 1.0:
+                out.append(
+                    f"NOTE slo {s.name}: burning budget ({s.detail}; "
+                    f"burn fast={s.burn_fast} slow={s.burn_slow})"
+                )
+        return out
+
+    def gauges(self) -> dict[str, int]:
+        """Fixed-point (x1000) gauges for the shared `slo` metrics
+        region / Prometheus export: per-SLO fast/slow burn and breach."""
+        out: dict[str, int] = {}
+        for s in self._last:
+            key = s.name
+            out[f"{key}_burn_fast_x1000"] = int(
+                min(max(s.burn_fast, 0.0), 1e6) * 1000
+            )
+            out[f"{key}_burn_slow_x1000"] = int(
+                min(max(s.burn_slow, 0.0), 1e6) * 1000
+            )
+            out[f"{key}_breached"] = int(s.breached)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "config": self.cfg.to_dict(),
+            "status": [s.to_dict() for s in self._last],
+        }
+
+
+def slo_metrics_schema(cfg: SloConfig):
+    """Schema for the shared `slo` gauge region (one counter slot per
+    gauge the engine exports), so monitors/Prometheus scrape burn rates
+    from shared memory like any tile's metrics."""
+    from .metrics import MetricsSchema
+
+    counters: list[str] = []
+    for name in cfg.asserted():
+        counters += [
+            f"{name}_burn_fast_x1000",
+            f"{name}_burn_slow_x1000",
+            f"{name}_breached",
+        ]
+    counters.append("slo_evaluations")
+    counters.append("slo_breaches")
+    return MetricsSchema(counters=tuple(counters))

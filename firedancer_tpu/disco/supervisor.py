@@ -121,11 +121,32 @@ class Supervisor:
         self.topo = topo
         self.policy = policy or RestartPolicy()
         self.faults = faults
+        #: failure observers: cb(tile_name, kind, detail_dict) invoked
+        #: on the watchdog thread for kind in {"restart", "breaker",
+        #: "wedged"} AFTER the supervisor has recorded the event in the
+        #: shared metrics region.  The flight recorder (disco/flight.py)
+        #: hooks here so every supervision action can freeze an incident
+        #: bundle; callbacks must be fast and must not raise (exceptions
+        #: are swallowed so a broken observer cannot wedge supervision).
+        self._listeners: list = []
         self._state: dict[str, _TileState] = {}
         self._loop_kw: dict = {}
         self._halting = False
         self._watchdog: threading.Thread | None = None
         self._stop = threading.Event()
+
+    def add_listener(self, cb) -> None:
+        """Register a failure observer: cb(tile, kind, detail)."""
+        self._listeners.append(cb)
+
+    def _emit(self, tile: str, kind: str, detail: dict) -> None:
+        for cb in self._listeners:
+            try:
+                cb(tile, kind, detail)
+            except Exception:  # noqa: BLE001 — observers cannot wedge us
+                from firedancer_tpu.utils import log
+
+                log.err("supervisor listener failed on %s/%s", tile, kind)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -237,6 +258,7 @@ class Supervisor:
             st.degraded = "wedged"
             metrics.set("degraded", 1)
             log.err("tile %s wedged (interrupt ignored); degraded", name)
+            self._emit(name, "wedged", {"reason": reason})
             return
         now = time.monotonic()
         # circuit breaker over a sliding failure window
@@ -249,6 +271,11 @@ class Supervisor:
             log.err(
                 "tile %s: %d failures in %.0fs; circuit breaker open",
                 name, len(st.fail_times), p.breaker_window_s,
+            )
+            self._emit(
+                name, "breaker",
+                {"reason": reason, "failures": len(st.fail_times),
+                 "window_s": p.breaker_window_s},
             )
             return
         # capped exponential backoff, reset after a healthy uptime
@@ -291,6 +318,11 @@ class Supervisor:
         log.info(
             "tile %s restarting (%s, incarnation %d, backoff %.0fms)",
             name, reason, ctx.incarnation, st.backoff_s * 1e3,
+        )
+        self._emit(
+            name, "restart",
+            {"reason": reason, "incarnation": ctx.incarnation,
+             "restarts": st.restarts, "backoff_s": st.backoff_s},
         )
 
     # ---- introspection --------------------------------------------------

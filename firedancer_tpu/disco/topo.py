@@ -98,6 +98,17 @@ class Topology:
         # so build() installs no tracer regardless of which entry point
         # (constructor arg or enable_trace) carried the config in
         self.trace = trace if trace is not None and trace.sample > 0 else None
+        #: run-loop profiling (disco/profile.py): None = off; set via
+        #: enable_profile() before build()
+        self.profile = None
+        #: flight recorder black boxes (disco/flight.py): None = off;
+        #: set via enable_flight() before build()
+        self.flight = None
+        #: asserted SLOs (disco/slo.py SloConfig): None = none asserted.
+        #: When set before build(), a shared `slo` gauge region is
+        #: allocated (metrics_registry()["slo"]) and the config rides
+        #: the manifest so attached monitors evaluate the same SLOs.
+        self.slo = None
         self._mcaches: dict[str, R.MCache] = {}
         self._dcaches: dict[str, R.DCache] = {}
         self._fseqs: dict[tuple[str, str], R.FSeq] = {}
@@ -105,6 +116,8 @@ class Topology:
         self._metrics: dict[str, Metrics] = {}
         self._schemas: dict[str, MetricsSchema] = {}
         self._tracers: dict[str, Tracer] = {}
+        self._profilers: dict = {}
+        self._flightboxes: dict = {}
 
     def enable_trace(self, sample: int = 64, depth: int = 1 << 14) -> None:
         """Turn on fdttrace span rings for every tile (must run before
@@ -114,6 +127,25 @@ class Topology:
         self.trace = (
             TraceConfig(sample=sample, depth=depth) if sample > 0 else None
         )
+
+    def enable_profile(self, on: bool = True) -> None:
+        """Turn on the per-tile run-loop profiler (disco/profile.py):
+        sampled wall/CPU phase attribution, GIL-wait fraction, and the
+        scheduler-lag histogram, in per-tile workspace regions.  Must
+        run before build(); off = one None check per loop hook."""
+        assert self.wksp is None, "enable_profile before build()"
+        self.profile = True if on else None
+
+    def enable_flight(self, depth: int = 64, timeline_n: int = 256) -> None:
+        """Allocate per-tile flight-recorder black boxes
+        (disco/flight.py BlackBox) in the workspace.  Must run before
+        build().  The boxes are written by a FlightRecorder's watcher
+        thread, not by the tiles — enabling this costs the hot path
+        nothing."""
+        assert self.wksp is None, "enable_flight before build()"
+        from .flight import FlightConfig
+
+        self.flight = FlightConfig(depth=depth, timeline_n=timeline_n)
 
     # ---- declaration ----------------------------------------------------
 
@@ -166,6 +198,21 @@ class Topology:
             total += ts.tile.wksp_footprint() + 256
             if self.trace is not None:
                 total += SpanRing.footprint(self.trace.depth) + 256
+            if self.profile is not None:
+                from .profile import PROFILE_SCHEMA
+
+                total += Metrics.footprint(PROFILE_SCHEMA) + 256
+            if self.flight is not None:
+                from .flight import BlackBox, box_rec_words
+
+                total += BlackBox.footprint(
+                    self.flight.depth,
+                    box_rec_words(len(ts.ins), len(ts.outs)),
+                ) + 256
+        if self.slo is not None:
+            from .slo import slo_metrics_schema
+
+            total += Metrics.footprint(slo_metrics_schema(self.slo)) + 256
         return total
 
     def build(self) -> None:
@@ -204,6 +251,37 @@ class Topology:
                 self._tracers[name] = Tracer(
                     ring, self.trace.sample, name=name
                 )
+            if self.profile is not None:
+                from .profile import PROFILE_SCHEMA, TileProfiler
+
+                pmem = self.wksp.alloc(
+                    f"profile_{name}", Metrics.footprint(PROFILE_SCHEMA)
+                )
+                self._profilers[name] = TileProfiler(
+                    Metrics(pmem, PROFILE_SCHEMA)
+                )
+            if self.flight is not None:
+                from .flight import BlackBox, box_rec_words
+
+                rw = box_rec_words(len(ts.ins), len(ts.outs))
+                bmem = self.wksp.alloc(
+                    f"flight_{name}",
+                    BlackBox.footprint(self.flight.depth, rw),
+                )
+                self._flightboxes[name] = BlackBox(
+                    bmem, self.flight.depth, rw
+                )
+        if self.slo is not None:
+            from .slo import slo_metrics_schema
+
+            sschema = slo_metrics_schema(self.slo)
+            smem = self.wksp.alloc(
+                "metrics_slo", Metrics.footprint(sschema)
+            )
+            # a pseudo-tile entry: the Prometheus metric tile renders it
+            # as fdt_slo_* gauges; the flight recorder's watcher is the
+            # single writer
+            self._metrics["slo"] = Metrics(smem, sschema)
         for name, ts in self.tiles.items():
             tracer = self._tracers.get(name)
             ins = [
@@ -240,6 +318,7 @@ class Topology:
                 wksp=self.wksp,
             )
             ts.ctx.tracer = tracer
+            ts.ctx.profiler = self._profilers.get(name)
 
     def export_manifest(self) -> None:
         """Publish the workspace directory + a monitor manifest (tile
@@ -279,6 +358,23 @@ class Topology:
                 "depth": self.trace.depth,
                 "links": list(self.links),
                 "tiles": {name: f"trace_{name}" for name in self.tiles},
+            }
+        if self.profile is not None:
+            # fdtflight attach surface: per-tile profiler regions
+            extra["profile"] = {
+                "tiles": {name: f"profile_{name}" for name in self.tiles},
+            }
+        if self.flight is not None:
+            extra["flight"] = {
+                "depth": self.flight.depth,
+                "tiles": {name: f"flight_{name}" for name in self.tiles},
+            }
+        if self.slo is not None:
+            # attached monitors evaluate the SAME objectives from the
+            # same shared histograms (disco/slo.py SloEngine)
+            extra["slo"] = {
+                "config": self.slo.to_dict(),
+                "metrics": "metrics_slo",
             }
         self.wksp.publish_directory(extra)
 
@@ -388,6 +484,11 @@ class Topology:
     def metrics_registry(self) -> dict[str, Metrics]:
         """Snapshot of every tile's Metrics (the metric tile's source)."""
         return dict(self._metrics)
+
+    def profile_metrics(self) -> dict[str, Metrics]:
+        """Per-tile profiler regions (disco/profile.py readers), empty
+        when profiling is off."""
+        return {name: p.m for name, p in self._profilers.items()}
 
     def close(self) -> None:
         if self.wksp is not None:

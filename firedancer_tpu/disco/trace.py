@@ -161,7 +161,12 @@ class SpanRing:
         idx = (lo + np.arange(c - lo)) % self.depth
         out = self.ev[idx].copy()
         r2 = int(self.words[3])  # writer reservations during the copy
-        safe_lo = max(lo, r2 - self.depth)
+        # clamp to c: a writer that laps the WHOLE window mid-copy can
+        # push r2 - depth beyond the committed cursor we are reporting —
+        # without the clamp the dropped count would cover events beyond
+        # [since, c), and the next read (starting at c) would count
+        # those same losses AGAIN, double-reporting drops
+        safe_lo = min(max(lo, r2 - self.depth), c)
         if safe_lo > lo:
             out = out[safe_lo - lo :]
         return out, c, safe_lo - since

@@ -4,13 +4,18 @@
 Generates a random fault schedule (kills, heartbeat-starving stalls,
 frag drops, payload corruption, credit squeezes, device-verify failures)
 from a seed, drives a synth -> verify -> dedup -> sink topology through
-it under the supervisor, and checks the survival invariants:
+it under the supervisor WITH the flight recorder attached, and checks
+the survival invariants:
 
   * no duplicate transaction is ever admitted past dedup,
   * every missing survivor is accounted for (injected drops/corruptions,
     declared overruns, or the documented u64-tag collision budget),
   * every scripted kill/stall was repaired by a restart and no tile
-    ended degraded.
+    ended degraded,
+  * every scripted kill/stall yields EXACTLY ONE incident bundle,
+    correctly classified (injected-kill / injected-stall), every bundle
+    is explained, and a fault-free soak yields ZERO bundles
+    (scripts/fdtincident.py classification).
 
 The seed is printed up front and again on failure — re-running with
 --seed replays the identical fault sequence (disco/faultinj.py hashes
@@ -38,6 +43,7 @@ import numpy as np  # noqa: E402
 from firedancer_tpu.disco import (  # noqa: E402
     Fault,
     FaultInjector,
+    FlightRecorder,
     RestartPolicy,
     Supervisor,
     Topology,
@@ -119,6 +125,7 @@ def run_soak(
     dedup = DedupTile(depth=1 << 12)
     sink = SinkTile(record=True)
     topo = Topology()
+    topo.enable_flight(depth=32)
     topo.link("synth_verify", depth=RING_DEPTH, mtu=wire.LINK_MTU)
     topo.link("verify_dedup", depth=RING_DEPTH, mtu=wire.LINK_MTU)
     topo.link("dedup_sink", depth=RING_DEPTH, mtu=wire.LINK_MTU)
@@ -137,6 +144,16 @@ def run_soak(
         faults=inj,
     )
     report: dict = {"ok": False, "seed": seed}
+    # flight recorder: every supervision event must freeze exactly one
+    # classifiable incident bundle (and a clean soak exactly zero)
+    import shutil
+    import tempfile
+
+    inc_dir = tempfile.mkdtemp(prefix="fdt_incidents_")
+    topo.build()
+    flight = FlightRecorder(topo, inc_dir, faults=inj, poll_s=0.05)
+    flight.attach_supervisor(sup)
+    flight.start()
     sup.start(batch_max=32)
     try:
         end = time.monotonic() + deadline_s
@@ -146,6 +163,7 @@ def run_soak(
                 break
             time.sleep(0.1)
     finally:
+        flight.stop()
         sup.halt()
     try:
         sunk = sink.all_sigs().tolist()
@@ -169,6 +187,20 @@ def run_soak(
             degraded=degraded,
             fired=inj.fired(),
         )
+        # incident bundles: 1:1 against the canonical fired record
+        from scripts.fdtincident import classify_dir
+
+        inc_rows = classify_dir(inc_dir)
+        by_class: dict[str, int] = {}
+        for r in inc_rows:
+            by_class[r["class"]] = by_class.get(r["class"], 0) + 1
+        n_kill, n_stall = inj.count("kill"), inj.count("stall")
+        report.update(
+            incidents=[
+                {"class": r["class"], "tile": r["tile"]} for r in inc_rows
+            ],
+            incident_dir=inc_dir,
+        )
         checks = {
             "no_duplicates": len(uniq) == len(sunk),
             "only_known_tags": uniq <= set(synth.tags.tolist()),
@@ -177,8 +209,19 @@ def run_soak(
                 <= injected + overruns + BLOOM_FP_BUDGET
             ),
             "faults_repaired": sum(restarts.values())
-            >= inj.count("kill") + inj.count("stall"),
+            >= n_kill + n_stall,
             "nothing_degraded": not degraded,
+            # fdtflight: one correctly-classified bundle per scripted
+            # kill/stall, everything explained, zero when clean
+            "incident_kill_1to1": by_class.get("injected-kill", 0)
+            == n_kill,
+            "incident_stall_1to1": by_class.get("injected-stall", 0)
+            == n_stall,
+            "incidents_all_explained": all(
+                r["explained"] for r in inc_rows
+            ),
+            "incidents_zero_when_clean": bool(inj.events)
+            or not inc_rows,
         }
         report["checks"] = checks
         report["ok"] = all(checks.values())
@@ -188,6 +231,9 @@ def run_soak(
                 print(f"  {k}: {v}")
         if not report["ok"]:
             print(f"chaos_soak FAILED — replay with --seed {seed}")
+            print(f"  incident bundles kept at {inc_dir}")
+        else:
+            shutil.rmtree(inc_dir, ignore_errors=True)
         return report
     finally:
         topo.close()

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""checkall — the one-shot local gate: fdtlint + bounded fdtmc + the
+tier-1 pytest suite, aggregated into one exit code.
+
+Usage:
+    scripts/checkall.py                 # all three stages
+    scripts/checkall.py --json          # machine-readable summary
+    scripts/checkall.py --skip mc       # skip stages (lint,mc,pytest)
+    scripts/checkall.py --mc-budget 200 # bound the model checker
+    scripts/checkall.py --pytest-timeout 1200
+
+Exit status follows the fdtlint convention: 0 every stage clean,
+1 any stage found problems (lint findings, mc violations, test
+failures), 2 usage/internal error (a stage crashed rather than
+reporting).  Stages keep running after a failure so one run reports
+everything.
+
+This is what a pre-push hook or a CI job runs; the individual tools
+remain available for targeted work (scripts/fdtlint.py,
+scripts/fdtmc.py, pytest -m 'not slow').
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _stage_lint() -> dict:
+    """In-process fdtlint full-repo pass (stdlib-only, fast)."""
+    from firedancer_tpu.analysis import engine
+
+    t0 = time.perf_counter()
+    try:
+        rep = engine.run_repo(REPO)
+    except Exception as e:  # noqa: BLE001 — report, don't crash the gate
+        return {"rc": 2, "error": repr(e), "seconds": 0.0}
+    return {
+        "rc": 0 if rep.ok else 1,
+        "findings": len(rep.findings),
+        "detail": [str(f) for f in rep.findings[:20]],
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
+def _run(cmd: list[str], timeout_s: float, env=None) -> tuple[int, str]:
+    try:
+        r = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout_s, env=env,
+        )
+        return r.returncode, (r.stdout + r.stderr)[-8000:]
+    except subprocess.TimeoutExpired:
+        return 2, f"timeout after {timeout_s}s"
+
+
+def _stage_mc(budget: int, timeout_s: float) -> dict:
+    t0 = time.perf_counter()
+    cmd = [sys.executable, str(REPO / "scripts" / "fdtmc.py"), "--json"]
+    if budget:
+        cmd += ["--budget", str(budget)]
+    rc, out = _run(cmd, timeout_s)
+    stage = {"rc": rc, "seconds": round(time.perf_counter() - t0, 2)}
+    try:
+        doc = json.loads(out.strip())
+        mc = doc.get("coverage", {}).get("fdtmc", {})
+        stage["scenarios"] = len(mc.get("scenarios", {}))
+        stage["schedules"] = mc.get("schedules", 0)
+        stage["findings"] = len(doc.get("findings", []))
+    except Exception:  # noqa: BLE001 — non-JSON tail is fine on rc != 0
+        stage["tail"] = out[-2000:]
+    return stage
+
+
+def _stage_pytest(timeout_s: float, extra: list[str]) -> dict:
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+        "--continue-on-collection-errors", "-p", "no:cacheprovider",
+    ] + extra
+    rc, out = _run(cmd, timeout_s, env=env)
+    stage = {"rc": rc, "seconds": round(time.perf_counter() - t0, 2)}
+    for line in reversed(out.splitlines()):
+        if ("passed" in line or "failed" in line or "error" in line) and (
+            "==" in line or "," in line
+        ):
+            stage["summary"] = line.strip().strip("= ")
+            break
+    if rc not in (0, 1):
+        stage["tail"] = out[-2000:]
+    return stage
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="checkall", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregated summary as JSON")
+    ap.add_argument("--skip", default="",
+                    help="comma list of stages to skip: lint,mc,pytest")
+    ap.add_argument("--mc-budget", type=int, default=64,
+                    help="fdtmc schedules per scenario (0 = tier default)")
+    ap.add_argument("--mc-timeout", type=float, default=600.0)
+    ap.add_argument("--pytest-timeout", type=float, default=1800.0)
+    ap.add_argument("--pytest-args", default="",
+                    help="extra args appended to the pytest command")
+    args = ap.parse_args(argv)
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+    bad = skip - {"lint", "mc", "pytest"}
+    if bad:
+        print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
+        return 2
+
+    stages: dict[str, dict] = {}
+    if "lint" not in skip:
+        stages["lint"] = _stage_lint()
+        if not args.json:
+            print(f"checkall lint: rc={stages['lint']['rc']} "
+                  f"({stages['lint'].get('findings', '?')} findings, "
+                  f"{stages['lint']['seconds']}s)", flush=True)
+    if "mc" not in skip:
+        stages["mc"] = _stage_mc(args.mc_budget, args.mc_timeout)
+        if not args.json:
+            print(f"checkall mc: rc={stages['mc']['rc']} "
+                  f"({stages['mc']['seconds']}s)", flush=True)
+    if "pytest" not in skip:
+        stages["pytest"] = _stage_pytest(
+            args.pytest_timeout, args.pytest_args.split()
+        )
+        if not args.json:
+            print(f"checkall pytest: rc={stages['pytest']['rc']} "
+                  f"({stages['pytest'].get('summary', '')}, "
+                  f"{stages['pytest']['seconds']}s)", flush=True)
+
+    rcs = [s["rc"] for s in stages.values()]
+    rc = 2 if any(r not in (0, 1) for r in rcs) else (1 if any(rcs) else 0)
+    doc = {"ok": rc == 0, "rc": rc, "stages": stages}
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(f"checkall: {'clean' if rc == 0 else 'PROBLEMS'} (rc={rc})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

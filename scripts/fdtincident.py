@@ -1,0 +1,351 @@
+#!/usr/bin/env python3
+"""fdtincident — list, classify, render and diff fdtflight incident
+bundles (disco/flight.py FlightRecorder output).
+
+Usage:
+    scripts/fdtincident.py list DIR [--json]
+    scripts/fdtincident.py classify DIR [--json] [--strict]
+    scripts/fdtincident.py render BUNDLE [--json]
+    scripts/fdtincident.py diff A B [--json]
+    scripts/fdtincident.py --assert-clean DIR
+
+Exit status follows the fdtlint convention: 0 clean, 1 findings,
+2 usage/internal error.
+
+  * `--assert-clean DIR` exits 0 when DIR holds no bundles and 1 when
+    it holds any (each listed on stderr) — the chaos suite's "a clean
+    soak yields zero incidents" gate.
+  * `classify` maps every bundle to a class by correlating its trigger
+    with the embedded faultinj fired record (the canonical replayable
+    artifact) and the trace FAULT annotations: a crash restart backed
+    by a scripted kill is `injected-kill`, a heartbeat restart backed
+    by a scripted stall is `injected-stall`, a quarantine backed by
+    scripted device errors is `injected-device-error`, an SLO trigger
+    is `slo-breach:<name>`; anything else is `unexplained-*`.
+    `--strict` exits 1 when any bundle is unexplained — the chaos
+    suite's "every injected fault yields exactly one CORRECTLY
+    classified bundle" gate.
+  * `diff` compares the CANONICAL fields of two bundles (trigger
+    kind/tile, classification, faultinj seed + fired record): replays
+    of the same seeded schedule must diff clean (exit 0); wall-clock
+    and counter fields are reported informationally only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+# ---------------------------------------------------------------------------
+# bundle IO
+
+
+def load_bundle(path: str | Path) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "trigger" not in doc:
+        raise ValueError(f"{path}: not an incident bundle")
+    return doc
+
+
+def bundle_paths(dir_path: str | Path) -> list[Path]:
+    d = Path(dir_path)
+    if not d.is_dir():
+        raise FileNotFoundError(f"{d}: not a directory")
+    return sorted(d.glob("incident_*.json"))
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def _fired_kinds(bundle: dict, tile: str | None) -> set[str]:
+    fired = bundle.get("faultinj", {}).get("fired", [])
+    return {
+        e[1] for e in fired if tile is None or e[0] == tile
+    }
+
+
+def _timeline_faults(bundle: dict, tile: str | None) -> set[str]:
+    out: set[str] = set()
+    for t, evs in bundle.get("timeline", {}).items():
+        if tile is not None and t != tile:
+            continue
+        out |= {
+            e.get("fault") for e in evs if e.get("kind") == "fault"
+        } - {None}
+    return out
+
+
+def classify_bundle(bundle: dict) -> dict:
+    """One bundle -> {id, kind, tile, class, explained}."""
+    trig = bundle.get("trigger", {})
+    kind = trig.get("kind")
+    tile = trig.get("tile")
+    detail = trig.get("detail", {}) or {}
+    fired = _fired_kinds(bundle, tile)
+    annotated = _timeline_faults(bundle, tile)
+    cls, explained = f"unexplained-{kind}", False
+    if kind == "restart":
+        reason = detail.get("reason")
+        if reason == "crash" and ("kill" in fired or "kill" in annotated):
+            cls, explained = "injected-kill", True
+        elif reason == "heartbeat" and (
+            "stall" in fired or "stall" in annotated
+        ):
+            cls, explained = "injected-stall", True
+        else:
+            cls = f"unexplained-restart-{reason}"
+    elif kind == "quarantine":
+        if "device_error" in fired:
+            cls, explained = "injected-device-error", True
+        elif fired & {"kill", "stall"}:
+            # restart churn can transiently degrade a pool domain (the
+            # dead incarnation's workers die with it) — collateral of a
+            # declared fault, not an unexplained device failure
+            cls, explained = "restart-collateral-quarantine", True
+        else:
+            cls = "unexplained-quarantine"
+    elif kind in ("breaker", "wedged"):
+        # a breaker/wedge backed by ANY scripted fault on the tile is an
+        # expected chaos outcome; otherwise it demands investigation
+        explained = bool(fired & {"kill", "stall", "device_error"})
+        cls = f"{kind}" if explained else f"unexplained-{kind}"
+    elif kind == "slo":
+        cls, explained = f"slo-breach:{detail.get('slo')}", True
+    elif kind in ("manual", "signal"):
+        cls, explained = kind, True
+    return {
+        "id": bundle.get("id"),
+        "kind": kind,
+        "tile": tile,
+        "class": cls,
+        "explained": explained,
+    }
+
+
+def classify_dir(dir_path: str | Path) -> list[dict]:
+    out = []
+    for p in bundle_paths(dir_path):
+        row = classify_bundle(load_bundle(p))
+        row["path"] = str(p)
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# render
+
+
+def render_bundle(bundle: dict) -> str:
+    trig = bundle.get("trigger", {})
+    row = classify_bundle(bundle)
+    lines = [
+        f"incident {bundle.get('id')} — {row['class']}",
+        f"  trigger: {trig.get('kind')}"
+        + (f" tile={trig.get('tile')}" if trig.get("tile") else "")
+        + f" detail={json.dumps(trig.get('detail', {}), sort_keys=True)}",
+    ]
+    fi = bundle.get("faultinj")
+    if fi:
+        lines.append(
+            f"  faultinj: seed={fi.get('seed')} "
+            f"fired={len(fi.get('fired', []))} event(s)"
+        )
+        for e in fi.get("fired", [])[:10]:
+            lines.append(f"    {e}")
+    slo = bundle.get("slo")
+    if slo:
+        for s in slo.get("status", []):
+            flag = "BREACHED" if s.get("breached") else "ok"
+            lines.append(
+                f"  slo {s['name']}: {flag} burn fast={s['burn_fast']} "
+                f"slow={s['burn_slow']} ({s.get('detail', '')})"
+            )
+    lines.append(f"{'tile':>10} {'signal':>6} {'in':>10} {'out':>10} "
+                 f"{'restarts':>8} {'degraded':>8}")
+    for name, t in sorted(bundle.get("tiles", {}).items()):
+        c = t.get("counters", {})
+        lines.append(
+            f"{name:>10} {t.get('signal', '?'):>6} "
+            f"{c.get('in_frags', 0):>10,} {c.get('out_frags', 0):>10,} "
+            f"{c.get('restarts', 0):>8} {c.get('degraded', 0):>8}"
+        )
+        flight = t.get("flight") or []
+        if flight:
+            a, b = flight[0], flight[-1]
+            span_us = max(b["ts_us"] - a["ts_us"], 0)
+            lines.append(
+                f"{'':>10}   black box: {len(flight)} records over "
+                f"{span_us / 1e6:.2f}s, in_frags "
+                f"{a['in_frags']:,} -> {b['in_frags']:,}"
+            )
+    tl = bundle.get("timeline", {})
+    n_ev = sum(len(v) for v in tl.values())
+    if n_ev:
+        lines.append(f"  timeline: {n_ev} span event(s) across "
+                     f"{len(tl)} tile(s); faults:")
+        for t, evs in sorted(tl.items()):
+            for e in evs:
+                if e.get("kind") == "fault":
+                    lines.append(
+                        f"    {t}: fault:{e.get('fault')} ts={e['ts']} "
+                        f"aux={e.get('aux64')}"
+                    )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# diff
+
+
+#: fields equal across replays of the same seeded schedule
+def canonical(bundle: dict) -> dict:
+    trig = bundle.get("trigger", {})
+    return {
+        "kind": trig.get("kind"),
+        "tile": trig.get("tile"),
+        "class": classify_bundle(bundle)["class"],
+        "seed": bundle.get("faultinj", {}).get("seed"),
+        "fired": bundle.get("faultinj", {}).get("fired", []),
+        "slo": sorted(
+            s["name"]
+            for s in bundle.get("slo", {}).get("status", [])
+            if s.get("breached")
+        ),
+    }
+
+
+def diff_bundles(a: dict, b: dict) -> dict:
+    ca, cb = canonical(a), canonical(b)
+    fields = sorted(set(ca) | set(cb))
+    mism = {
+        f: {"a": ca.get(f), "b": cb.get(f)}
+        for f in fields
+        if ca.get(f) != cb.get(f)
+    }
+    info = {}
+    for name in set(a.get("tiles", {})) & set(b.get("tiles", {})):
+        csa = a["tiles"][name].get("counters", {})
+        csb = b["tiles"][name].get("counters", {})
+        deltas = {
+            k: csb.get(k, 0) - csa.get(k, 0)
+            for k in csa
+            if csb.get(k, 0) != csa.get(k, 0)
+        }
+        if deltas:
+            info[name] = deltas
+    return {
+        "canonical_equal": not mism,
+        "canonical_mismatches": mism,
+        "counter_deltas": info,  # informational (declared noisy)
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdtincident", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--assert-clean", metavar="DIR", default=None,
+                    help="exit 0 iff DIR holds no incident bundles")
+    sub = ap.add_subparsers(dest="cmd")
+    p = sub.add_parser("list", help="one line per bundle in DIR")
+    p.add_argument("dir")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("classify", help="classify every bundle in DIR")
+    p.add_argument("dir")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 when any bundle is unexplained")
+    p = sub.add_parser("render", help="pretty-print one bundle")
+    p.add_argument("bundle")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("diff", help="canonical diff of two bundles")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.assert_clean is not None:
+            paths = bundle_paths(args.assert_clean)
+            if not paths:
+                print(f"fdtincident: clean ({args.assert_clean}: no bundles)")
+                return 0
+            for pth in paths:
+                row = classify_bundle(load_bundle(pth))
+                print(f"{pth}: {row['class']}", file=sys.stderr)
+            print(f"fdtincident: {len(paths)} incident bundle(s)")
+            return 1
+        if args.cmd == "list":
+            rows = []
+            for pth in bundle_paths(args.dir):
+                doc = load_bundle(pth)
+                trig = doc.get("trigger", {})
+                rows.append({
+                    "path": str(pth),
+                    "id": doc.get("id"),
+                    "kind": trig.get("kind"),
+                    "tile": trig.get("tile"),
+                    "wall_time": trig.get("wall_time"),
+                })
+            if args.json:
+                print(json.dumps(rows, indent=1, sort_keys=True))
+            else:
+                for r in rows:
+                    print(
+                        f"{r['id']:<28} {r['kind']:<12} "
+                        f"{r['tile'] or '-':<10} {r['path']}"
+                    )
+            return 0
+        if args.cmd == "classify":
+            rows = classify_dir(args.dir)
+            if args.json:
+                print(json.dumps(rows, indent=1, sort_keys=True))
+            else:
+                for r in rows:
+                    flag = "" if r["explained"] else "  <-- UNEXPLAINED"
+                    print(f"{r['id']:<28} {r['class']}{flag}")
+            if args.strict and any(not r["explained"] for r in rows):
+                return 1
+            return 0
+        if args.cmd == "render":
+            doc = load_bundle(args.bundle)
+            if args.json:
+                print(json.dumps(doc, indent=1, sort_keys=True))
+            else:
+                print(render_bundle(doc))
+            return 0
+        if args.cmd == "diff":
+            d = diff_bundles(load_bundle(args.a), load_bundle(args.b))
+            if args.json:
+                print(json.dumps(d, indent=1, sort_keys=True))
+            else:
+                if d["canonical_equal"]:
+                    print("fdtincident: canonical fields equal")
+                else:
+                    for f, v in d["canonical_mismatches"].items():
+                        print(f"canonical mismatch {f}: {v['a']!r} != "
+                              f"{v['b']!r}")
+                for t, deltas in sorted(d["counter_deltas"].items()):
+                    print(f"  (noisy) {t}: {deltas}")
+            return 0 if d["canonical_equal"] else 1
+        ap.print_help()
+        return 2
+    except (FileNotFoundError, ValueError, json.JSONDecodeError) as e:
+        print(f"fdtincident: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""metrics-schema corpus: literal metric writes not declared in the
+tile's schema.
+
+BAD: `typo_txns` / `latency_su` are not in the schema (typo'd names
+mint a KeyError on the first hit of their code path); `gauge_typo` via
+set() likewise.  CONTROLS that must stay clean: declared names, base
+schema names, the dynamic per-link/per-device families, non-literal
+names, and a class whose schema is built dynamically (out of reach)."""
+
+from firedancer_tpu.disco.metrics import MetricsSchema, device_counters
+from firedancer_tpu.disco.mux import MuxCtx, Tile
+
+
+class TypoTile(Tile):
+    name = "typo"
+    schema = MetricsSchema(
+        counters=("good_txns",), hists=("latency_us",)
+    )
+
+    def on_frags(self, ctx: MuxCtx, in_idx: int, frags) -> None:
+        ctx.metrics.inc("good_txns", len(frags))        # declared: clean
+        ctx.metrics.inc("in_frags", len(frags))         # base: clean
+        ctx.metrics.inc("typo_txns")                    # BAD: undeclared
+        ctx.metrics.set("gauge_typo", 1)                # BAD: undeclared
+        ctx.metrics.hist_sample("latency_us", 5)        # declared: clean
+        ctx.metrics.hist_sample("latency_su", 5)        # BAD: typo'd hist
+        ctx.metrics.hist_sample_many("qwait_us_a_b", frags)  # dynamic: clean
+        ctx.metrics.set("dev0_degraded", 1)             # dynamic: clean
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        which = "good_txns"
+        ctx.metrics.inc(which)  # non-literal name: out of reach, clean
+
+
+class DynamicSchemaTile(Tile):
+    """Control: instance-built schema — the rule must skip the class."""
+
+    name = "dyn"
+
+    def __init__(self, n: int):
+        self.schema = MetricsSchema(
+            counters=("landed",) + device_counters(n)
+        )
+
+    def after_credit(self, ctx: MuxCtx) -> None:
+        ctx.metrics.inc("landed")
+        ctx.metrics.inc("whatever_runtime_sized")  # skipped: dynamic schema

@@ -1,0 +1,566 @@
+"""fdtflight tier-1 surface (ISSUE 6): the SLO burn-rate engine, the
+black-box flight recorder, incident bundles + the fdtincident CLI, and
+the per-tile run-loop profiler.
+
+Acceptance criteria under test:
+  - a 1:1 mapping from injected faults (kill, stall) to correctly
+    classified incident bundles, and ZERO incidents in a clean run;
+  - an SLO breach deliberately induced via faultinj backpressure
+    produces a burn-rate alarm and a bundle naming the violated SLO;
+  - with profiling enabled, the bench aggregation carries populated
+    `gil_wait_frac` / `sched_lag_p99_us` keys; with flight/profiling
+    disabled the loop installs nothing (hot path pays None checks).
+
+Everything runs on the strict host verify path (device="off"), JAX-free.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import (
+    Fault,
+    FaultInjector,
+    FlightRecorder,
+    Metrics,
+    MetricsSchema,
+    RestartPolicy,
+    SloConfig,
+    SloEngine,
+    Supervisor,
+    Topology,
+)
+from firedancer_tpu.disco import flight as F
+from firedancer_tpu.tiles import wire
+from firedancer_tpu.tiles.dedup import DedupTile
+from firedancer_tpu.tiles.sink import SinkTile
+from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+from firedancer_tpu.tiles.verify import VerifyTile
+
+from scripts import fdtincident
+
+
+# ---------------------------------------------------------------------------
+# SLO engine over synthetic snapshots (pure library, no topology)
+
+
+def _hist_of(values) -> dict:
+    schema = MetricsSchema(hists=("h",))
+    m = Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema)
+    m.hist_sample_many("h", np.asarray(values, np.int64))
+    return m.hist("h")
+
+
+def _snap(e2e=None, in_frags=0, overruns=0) -> dict:
+    return {
+        "sink": {
+            "signal": "RUN",
+            "counters": {
+                "in_frags": in_frags,
+                "overrun_frags": overruns,
+            },
+            "lat_hists": {"e2e_us_d_s": e2e or {}},
+        }
+    }
+
+
+_TL = {"sink": {"ins": ["d_s"], "outs": []}}
+
+
+def test_slo_latency_burn_and_breach_edges():
+    cfg = SloConfig(
+        e2e_p99_us=1000.0, budget=0.01,
+        fast_window_s=1.0, slow_window_s=3.0,
+        burn_fast=10.0, burn_slow=2.0,
+    )
+    eng = SloEngine(cfg, _TL, clock=lambda: 0.0)
+    good = np.full(100, 100.0)  # well under the 1 ms ceiling
+    eng.observe(_snap(_hist_of([])), now=0.0)
+    eng.observe(_snap(_hist_of(good)), now=1.0)
+    (st,) = eng.evaluate(now=1.0)
+    assert st.name == "e2e_p99_us" and not st.breached
+    assert st.burn_fast == 0.0
+    # a flood of 8 ms samples: bad fraction ~1.0 -> burn ~100x in the
+    # fast window, ~50x in the slow -> breach fires on the edge
+    bad = np.concatenate([good, np.full(100, 8000.0)])
+    eng.observe(_snap(_hist_of(bad)), now=2.0)
+    (st,) = eng.evaluate(now=2.0)
+    assert st.breached and st.burn_fast >= 10.0 and st.burn_slow >= 2.0
+    assert eng.breached_now == {"e2e_p99_us": True}
+    rows = eng.alarm_rows()
+    assert any("ALARM slo e2e_p99_us" in r for r in rows)
+    g = eng.gauges()
+    assert g["e2e_p99_us_breached"] == 1
+    assert g["e2e_p99_us_burn_fast_x1000"] >= 10_000
+
+
+def test_slo_tps_floor_and_drop_ceiling():
+    cfg = SloConfig(
+        landed_tps_min=50.0, drop_rate_max=0.01,
+        fast_window_s=1.0, slow_window_s=2.0,
+    )
+    eng = SloEngine(cfg, _TL)
+    eng.observe(_snap(in_frags=0), now=0.0)
+    eng.observe(_snap(in_frags=200), now=1.0)  # 200/s, no drops
+    by = {s.name: s for s in eng.evaluate(now=1.0)}
+    assert not by["landed_tps_min"].breached
+    assert not by["drop_rate_max"].breached
+    # rate collapses to 10/s and 5% of frags dropped -> both breach
+    eng.observe(_snap(in_frags=210, overruns=10), now=2.0)
+    eng.observe(_snap(in_frags=220, overruns=11), now=3.0)
+    by = {s.name: s for s in eng.evaluate(now=3.0)}
+    assert by["landed_tps_min"].breached
+    assert by["drop_rate_max"].breached
+    # windows with no baseline yet never breach (burn 0, not garbage)
+    eng2 = SloEngine(cfg, _TL)
+    eng2.observe(_snap(in_frags=5), now=0.0)
+    assert not any(s.breached for s in eng2.evaluate(now=0.0))
+
+
+# ---------------------------------------------------------------------------
+# black box storage contract
+
+
+def test_black_box_write_read_wrap_and_join():
+    depth, rw = 8, 5
+    mem = np.zeros(F.BlackBox.footprint(depth, rw), np.uint8)
+    box = F.BlackBox(mem, depth, rw)
+    for i in range(11):  # laps the ring
+        box.write([i, i * 10, i * 100, i % 3, 7])
+    recs = box.read_all()
+    assert len(recs) == depth
+    assert [r[0] for r in recs] == list(range(3, 11))  # oldest first
+    assert recs[-1][1] == 100
+    j = F.BlackBox(mem, join=True)
+    assert (j.depth, j.rec_words) == (depth, rw)
+    assert j.read_all() == recs
+    # short records zero-pad, long ones truncate
+    box.write([99])
+    assert box.read_all()[-1] == [99, 0, 0, 0, 0]
+    dec = F.decode_box_record(
+        [5] + [1] * len(F.BOX_COUNTERS) + [10, 8, 20, 15],
+        ins=["a_b"], outs=["b_c"],
+    )
+    assert dec["ts_us"] == 5 and dec["in_frags"] == 1
+    assert dec["ins"]["a_b"] == {"produced": 10, "consumed": 8}
+    assert dec["outs"]["b_c"] == {"produced": 20, "slowest_consumer": 15}
+
+
+# ---------------------------------------------------------------------------
+# chaos: 1:1 injected fault -> classified incident bundle (acceptance)
+
+
+def _chaos_topology(n_txns: int, faults: list[Fault], seed: int):
+    rows, szs, _ = make_txn_pool(min(n_txns, 256), seed=seed)
+    synth = SynthTile(rows, szs, total=n_txns)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off",
+        async_depth=2,
+    )
+    dedup = DedupTile(depth=1 << 12)
+    sink = SinkTile(record=True)
+    topo = Topology()
+    topo.enable_trace(sample=1, depth=1 << 14)
+    topo.enable_flight(depth=32)
+    topo.link("synth_verify", depth=256, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=256, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=256, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
+    topo.tile(dedup, ins=[("verify_dedup", True)], outs=["dedup_sink"])
+    topo.tile(sink, ins=[("dedup_sink", True)])
+    inj = FaultInjector(seed=seed, faults=faults)
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=0.5, backoff_base_s=0.05, breaker_n=8,
+            replay={"verify": 256, "dedup": 256},
+        ),
+        faults=inj,
+    )
+    return topo, sup, inj, sink
+
+
+def _run_chaos_with_flight(tmp_path, faults, seed, n_txns=128,
+                           expect_restarts=()):
+    import copy
+
+    inc_dir = str(tmp_path)
+    # deep-copy: Fault carries a mutable `fired` latch, so replay runs
+    # must never share fault OBJECTS (only their parameters)
+    topo, sup, inj, sink = _chaos_topology(
+        n_txns, copy.deepcopy(list(faults)), seed
+    )
+    topo.build()
+    rec = FlightRecorder(topo, inc_dir, faults=inj, poll_s=0.02)
+    rec.attach_supervisor(sup)
+    rec.start()
+    sup.start(batch_max=32)
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            bad = {
+                n: d for n in topo.tiles
+                if (d := sup.degraded(n)) is not None
+            }
+            assert not bad, f"tiles degraded: {bad}"
+            injected = inj.dropped_frags() + inj.corrupted_frags()
+            if (
+                len(set(sink.all_sigs().tolist())) >= n_txns - injected
+                and all(
+                    sup.restarts(t) >= 1 for t in expect_restarts
+                )
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError("chaos pipeline did not drain")
+        time.sleep(0.2)  # let trailing triggers surface
+    finally:
+        rec.stop()
+        sup.halt()
+    return topo, inj, rec
+
+
+def test_chaos_faults_map_one_to_one_to_classified_bundles(tmp_path):
+    """THE acceptance loop: a scripted kill of verify and a scripted
+    heartbeat-starving stall of dedup each yield EXACTLY one incident
+    bundle, classified injected-kill / injected-stall; nothing else
+    fires; the CLI agrees end to end."""
+    faults = [
+        Fault("verify", "kill", at=30, on="frag"),
+        Fault("dedup", "stall", at=50, on="frag", duration_s=30.0),
+    ]
+    topo, inj, rec = _run_chaos_with_flight(
+        tmp_path, faults, seed=0xF11647, n_txns=128,
+        expect_restarts=("verify", "dedup"),
+    )
+    try:
+        assert inj.count("kill") == 1 and inj.count("stall") == 1
+        rows = fdtincident.classify_dir(tmp_path)
+        by_class: dict[str, int] = {}
+        for r in rows:
+            by_class[r["class"]] = by_class.get(r["class"], 0) + 1
+        # 1:1: one bundle per injected fault, correctly classified,
+        # nothing unexplained, nothing extra
+        assert by_class.get("injected-kill") == 1, rows
+        assert by_class.get("injected-stall") == 1, rows
+        assert all(r["explained"] for r in rows), rows
+        assert len(rows) == 2, rows
+        kill = next(r for r in rows if r["class"] == "injected-kill")
+        assert kill["tile"] == "verify"
+        stall = next(r for r in rows if r["class"] == "injected-stall")
+        assert stall["tile"] == "dedup"
+
+        # the bundle is self-contained: topology, faultinj record,
+        # per-tile state with black-box history, and the span timeline
+        # carrying the kill annotation
+        b = fdtincident.load_bundle(kill["path"])
+        assert b["trigger"]["kind"] == "restart"
+        assert b["trigger"]["detail"]["reason"] == "crash"
+        assert b["faultinj"]["seed"] == 0xF11647
+        assert ["verify", "kill", 30, None] in b["faultinj"]["fired"]
+        assert set(b["topology"]["tiles"]) == set(topo.tiles)
+        vt = b["tiles"]["verify"]
+        assert vt["counters"]["restarts"] >= 1
+        assert vt["flight"], "black-box history missing"
+        assert any(
+            e.get("fault") == "kill"
+            for e in b["timeline"]["verify"]
+        )
+        # ring snapshots rode along
+        assert "synth_verify" in b["rings"]
+
+        # CLI surfaces: list + classify --strict pass, render is human
+        assert fdtincident.main(["list", str(tmp_path)]) == 0
+        assert fdtincident.main(
+            ["classify", str(tmp_path), "--strict"]
+        ) == 0
+        assert fdtincident.main(["render", kill["path"]]) == 0
+        # --assert-clean: exit 1, bundles exist
+        assert fdtincident.main(["--assert-clean", str(tmp_path)]) == 1
+    finally:
+        topo.close()
+
+
+def test_chaos_clean_run_yields_zero_incidents(tmp_path):
+    topo, inj, rec = _run_chaos_with_flight(
+        tmp_path, [], seed=3, n_txns=64,
+    )
+    try:
+        assert rec.incidents == []
+        assert fdtincident.bundle_paths(tmp_path) == []
+        assert fdtincident.main(["--assert-clean", str(tmp_path)]) == 0
+    finally:
+        topo.close()
+
+
+def test_incident_bundles_replay_diff_clean(tmp_path):
+    """Same seed + schedule twice: the bundles' canonical fields
+    (trigger, classification, faultinj seed + fired record) diff clean;
+    a different schedule diffs dirty."""
+    faults = [Fault("verify", "kill", at=30, on="frag")]
+    a_dir = tmp_path / "a"
+    b_dir = tmp_path / "b"
+    c_dir = tmp_path / "c"
+    for d in (a_dir, b_dir, c_dir):
+        d.mkdir()
+    for d in (a_dir, b_dir):
+        topo, _, _ = _run_chaos_with_flight(
+            d, faults, seed=77, n_txns=96, expect_restarts=("verify",),
+        )
+        topo.close()
+    topo, _, _ = _run_chaos_with_flight(
+        c_dir, [Fault("dedup", "stall", at=40, on="frag",
+                      duration_s=30.0)],
+        seed=78, n_txns=96, expect_restarts=("dedup",),
+    )
+    topo.close()
+    (pa,) = fdtincident.bundle_paths(a_dir)
+    (pb,) = fdtincident.bundle_paths(b_dir)
+    (pc,) = fdtincident.bundle_paths(c_dir)
+    d = fdtincident.diff_bundles(
+        fdtincident.load_bundle(pa), fdtincident.load_bundle(pb)
+    )
+    assert d["canonical_equal"], d["canonical_mismatches"]
+    assert fdtincident.main(["diff", str(pa), str(pb)]) == 0
+    # different schedule: canonical mismatch, exit 1
+    assert fdtincident.main(["diff", str(pa), str(pc)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO breach via scripted backpressure (acceptance)
+
+
+def test_slo_breach_from_backpressure_fires_alarm_and_bundle(tmp_path):
+    """faultinj squeezes verify's credits to zero for thousands of
+    iterations; frags queue behind the squeeze, the exit-tile e2e hist
+    blows through the asserted ceiling, and the burn-rate engine must
+    (a) raise an ALARM row and (b) fire exactly one incident bundle
+    naming the violated SLO."""
+    n_txns = 512
+    # the squeeze arms at verify's second loop tick — before any
+    # meaningful traffic — and holds its credits at zero for thousands
+    # of iterations, parking the whole synth flood in the ring for
+    # seconds
+    faults = [
+        Fault("verify", "backpressure", on="tick", at=2, count=3_000),
+    ]
+    topo, sup, inj, sink = _chaos_topology(n_txns, faults, seed=0x510)
+    # the asserted SLO: e2e p99 under 60 ms (inside the 16-bucket log2
+    # hist domain, which ends at 2^16 us).  Every squeezed frag ages
+    # multiple SECONDS in the ring, so the post-squeeze flood lands
+    # entirely in the overflow bucket, far beyond the ceiling; while
+    # the squeeze holds, no e2e samples land and the windows stay
+    # quiet — the breach fires when the aged flood drains through and
+    # is attributable to the injected backpressure.
+    slo_cfg = SloConfig(
+        e2e_p99_us=60_000.0, budget=0.01,
+        fast_window_s=0.4, slow_window_s=1.2,
+        burn_fast=5.0, burn_slow=2.0,
+    )
+    topo.slo = slo_cfg
+    topo.build()
+    eng = SloEngine(slo_cfg, F.tile_links(topo))
+    rec = FlightRecorder(
+        topo, str(tmp_path), slo=eng, faults=inj, poll_s=0.05
+    )
+    rec.attach_supervisor(sup)
+    rec.start()
+    sup.start(batch_max=32)
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if any(
+                r["class"].startswith("slo-breach")
+                for r in fdtincident.classify_dir(tmp_path)
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f"no SLO incident; statuses={eng.to_dict()}"
+            )
+    finally:
+        rec.stop()
+        sup.halt()
+    try:
+        rows = fdtincident.classify_dir(tmp_path)
+        breaches = [
+            r for r in rows if r["class"] == "slo-breach:e2e_p99_us"
+        ]
+        # edge-triggered: one bundle per breach EDGE.  The aged flood
+        # usually drains in one burst (one edge), but on a loaded host
+        # it can split across quiet windows and re-breach — what must
+        # hold is: at least one bundle, every bundle names this SLO,
+        # nothing unexplained, and no non-SLO incidents fired
+        assert len(breaches) >= 1, rows
+        assert len(breaches) == len(rows), rows
+        assert all(r["explained"] for r in rows), rows
+        b = fdtincident.load_bundle(breaches[0]["path"])
+        # the bundle names the violated SLO, carries its burn rates...
+        assert b["trigger"]["detail"]["slo"] == "e2e_p99_us"
+        assert b["trigger"]["detail"]["breached"] is True
+        assert b["trigger"]["detail"]["burn_fast"] >= 5.0
+        st = {s["name"]: s for s in b["slo"]["status"]}
+        assert st["e2e_p99_us"]["breached"] is True
+        # ...and that frozen engine state renders as a burn-rate ALARM
+        # row (the LIVE engine's windows correctly go quiet again once
+        # the aged flood has drained, so assert on the state the bundle
+        # captured at breach time, not on a later evaluation)
+        from firedancer_tpu.disco.slo import SloStatus
+
+        frozen = SloEngine(slo_cfg)
+        frozen._last = [SloStatus(**s) for s in b["slo"]["status"]]
+        assert any(
+            "ALARM slo e2e_p99_us" in r for r in frozen.alarm_rows()
+        )
+        # the scripted squeeze is on record as the cause
+        assert inj.count("backpressure", "verify") == 1
+        assert b["faultinj"]["fired"], b["faultinj"]
+        # the shared slo gauge region mirrors the engine: the per-SLO
+        # breached gauge is LIVE (it clears once the windows go quiet
+        # again), but the cumulative slo_breaches counter records that
+        # a breach happened, and the gauges are on the Prometheus
+        # surface either way
+        sm = topo._metrics["slo"]
+        assert sm.counter("slo_breaches") >= 1
+        assert sm.counter("slo_evaluations") >= 1
+        from firedancer_tpu.tiles.metric import render_prometheus
+
+        prom = render_prometheus(topo.metrics_registry()).decode()
+        assert "fdt_slo_e2e_p99_us_breached" in prom
+        assert "fdt_slo_e2e_p99_us_burn_fast_x1000" in prom
+    finally:
+        topo.close()
+
+
+# ---------------------------------------------------------------------------
+# profiler: populated keys when on, absent when off
+
+
+def test_profiler_populates_bench_keys():
+    from firedancer_tpu.disco.profile import aggregate, profile_row
+
+    rows, szs, _ = make_txn_pool(64, seed=5)
+    topo = Topology()
+    topo.enable_profile()
+    topo.link("s_d", depth=256, mtu=wire.LINK_MTU)
+    topo.link("d_k", depth=256, mtu=wire.LINK_MTU)
+    topo.tile(SynthTile(rows, szs, total=2000), outs=["s_d"])
+    topo.tile(DedupTile(depth=1 << 10), ins=[("s_d", True)], outs=["d_k"])
+    topo.tile(SinkTile(), ins=[("d_k", True)])
+    topo.build()
+    assert all(
+        ts.ctx.profiler is not None for ts in topo.tiles.values()
+    )
+    topo.start(batch_max=64)
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if topo.metrics("sink").counter("in_frags") >= 64:
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)  # a few housekeeping ticks for sched-lag mass
+    finally:
+        topo.halt()
+    try:
+        profs = topo.profile_metrics()
+        assert set(profs) == set(topo.tiles)
+        agg = aggregate(profs)
+        # the bench keys, populated
+        assert 0.0 <= agg["gil_wait_frac"] <= 1.0
+        assert agg["sched_lag_p99_us"] >= 0.0
+        assert agg["sched_lag_n"] > 0
+        for name, m in profs.items():
+            r = profile_row(m)
+            assert r["samples"] > 0, name
+            assert 0.0 <= r["gil_wait_frac"] <= 1.0
+            # phase attribution adds up to at most the busy time
+            assert (
+                r["frag_frac"] + r["hk_frac"] + r["credit_frac"]
+                <= 1.0 + 1e-6
+            ) or r["busy_wall_ns"] == 0
+    finally:
+        topo.close()
+
+
+def test_profiler_off_installs_nothing():
+    topo = Topology()
+    topo.link("a_b", depth=64, mtu=wire.LINK_MTU)
+    topo.tile(SinkTile(name="src"), outs=["a_b"])
+    topo.tile(SinkTile(), ins=[("a_b", True)])
+    topo.build()
+    assert topo._profilers == {}
+    assert topo._flightboxes == {}
+    assert all(ts.ctx.profiler is None for ts in topo.tiles.values())
+    assert topo.profile_metrics() == {}
+    assert all(
+        not k.startswith(("profile_", "flight_"))
+        for k in topo.wksp._allocs
+    )
+    topo.close()
+
+
+# ---------------------------------------------------------------------------
+# monitor: --once --json + SLO/profile surfacing through the manifest
+
+
+def test_monitor_once_json_and_slo_rows(capsys):
+    from firedancer_tpu.app import monitor as M
+
+    rows, szs, _ = make_txn_pool(32, seed=9)
+    name = f"fdtflight_{int(time.time() * 1e6) & 0xFFFFFF}"
+    topo = Topology(name=name)
+    topo.enable_profile()
+    topo.slo = SloConfig(
+        landed_tps_min=1e9,  # absurd floor: breaches once windows fill
+        fast_window_s=0.1, slow_window_s=0.3,
+    )
+    topo.link("s_k", depth=256, mtu=wire.LINK_MTU)
+    topo.tile(SynthTile(rows, szs, total=500), outs=["s_k"])
+    topo.tile(SinkTile(), ins=[("s_k", True)])
+    topo.build()
+    topo.start(batch_max=64)
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if topo.metrics("sink").counter("in_frags") >= 32:
+                break
+            time.sleep(0.05)
+        mon = M.Monitor(name)
+        # the manifest carried the SLO config and profile regions
+        assert mon.slo is not None
+        assert set(mon.profiles) == set(topo.tiles)
+        doc = mon.once()
+        assert set(doc["tiles"]) == set(topo.tiles)
+        sk = doc["tiles"]["sink"]
+        assert sk["counters"]["in_frags"] >= 32
+        assert "profile" in sk and sk["profile"]["samples"] >= 0
+        assert "slo" in doc
+        # two spaced refreshes fill the burn windows; the absurd TPS
+        # floor must then alarm through the monitor surface
+        time.sleep(0.15)
+        snap = mon.snapshot()
+        time.sleep(0.15)
+        snap = mon.snapshot()
+        alarms = mon.alarms(snap)
+        assert any("slo landed_tps_min" in a for a in alarms), alarms
+        # CLI: --once --json prints one machine-readable document
+        rc = M.main([name, "--once", "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        doc2 = json.loads(out)
+        assert set(doc2["tiles"]) == set(topo.tiles)
+        assert "alarms" in doc2 and "links" in doc2
+        # unknown workspace: usage-error exit code, message on stderr
+        assert M.main(["no_such_wksp_x", "--once", "--json"]) == 2
+    finally:
+        topo.halt()
+        topo.close()

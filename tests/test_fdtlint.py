@@ -51,6 +51,7 @@ BAD_FIXTURES = {
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",
     "purity_bad_branch.py": "purity-untraced-branch",
+    "metrics_bad_undeclared.py": "metrics-schema",
 }
 
 ABI_BAD_RULES = {
@@ -136,6 +137,37 @@ def test_hot_clock_fixture_controls_are_clean():
     hits = [f for f in rep.findings if f.rule == "hot-path-clock"]
     assert len(hits) == 4, hits  # the four BAD reads in ImpatientTile
     assert all(f.line < 32 for f in hits), hits  # controls stay clean
+
+
+def test_metrics_schema_fixture_controls_are_clean():
+    """The rule flags exactly the three undeclared literal writes; the
+    controls (declared names, base schema, dynamic per-link/per-device
+    families, non-literal names, dynamic-schema classes) stay clean."""
+    rep = engine.run_paths([CORPUS / "metrics_bad_undeclared.py"])
+    hits = [f for f in rep.findings if f.rule == "metrics-schema"]
+    assert len(hits) == 3, hits
+    assert {"typo_txns", "gauge_typo", "latency_su"} == {
+        f.msg.split("'")[1] for f in hits
+    }
+
+
+def test_metrics_schema_base_mirror_cannot_drift():
+    """ringlint mirrors the base tile schema literally (it is stdlib-
+    only and cannot import disco.metrics, which pulls numpy); this pins
+    the mirror to the real schema so a base rename fails loudly here
+    instead of silently un-covering the rule."""
+    from firedancer_tpu.analysis import ringlint
+    from firedancer_tpu.disco.metrics import DEVICE_METRICS, MetricsSchema
+
+    assert ringlint.BASE_SCHEMA_COUNTERS == MetricsSchema.BASE_COUNTERS
+    assert ringlint.BASE_SCHEMA_HISTS == MetricsSchema.BASE_HISTS
+    assert ringlint.DEVICE_METRIC_NAMES == DEVICE_METRICS
+    # the device family exempts EXACTLY dev{i}_{metric} — typos near it
+    # must still trip the rule
+    assert ringlint._is_dynamic_metric("dev3_landed")
+    assert not ringlint._is_dynamic_metric("devcie0_landed")
+    assert not ringlint._is_dynamic_metric("dev_resets")
+    assert not ringlint._is_dynamic_metric("dev0_typo")
 
 
 def test_unhooked_fixture_guarded_control_is_clean():

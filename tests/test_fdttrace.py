@@ -117,6 +117,44 @@ def test_hist_percentile_tracks_numpy(name, values):
         assert lo <= est <= hi, (name, q, exact, est)
 
 
+def test_hist_percentile_boundary_contract():
+    """ISSUE 6 satellite: the estimator's boundary behavior is pinned —
+    empty/torn inputs, q extremes, single-bucket and overflow-bucket
+    mass all produce finite, in-bucket estimates."""
+    from firedancer_tpu.disco.metrics import hist_frac_above
+
+    # single bucket: all mass in [64, 128); q=0 -> lower edge, q=100 ->
+    # upper edge, q clamped outside [0, 100]
+    h = _hist_of(np.array([100.0] * 50))
+    assert hist_percentile(h, 0) == 64.0
+    assert hist_percentile(h, 100) == 128.0
+    assert hist_percentile(h, -5) == 64.0
+    assert hist_percentile(h, 250) == 128.0
+    # all mass in the clamped overflow bucket: finite, inside
+    # [2^15, 2^16] (the documented 2x-span bias beyond the top bucket)
+    h = _hist_of(np.array([1e12] * 10))
+    for q in (0.0, 50.0, 100.0):
+        assert (1 << 15) <= hist_percentile(h, q) <= (1 << 16)
+    # torn snapshot: count incremented ahead of its bucket — the walk
+    # must stay inside the occupied mass, not jump to the 2^16 sentinel
+    h = {"buckets": [0] * 6 + [5] + [0] * 9, "count": 50, "sum": 0}
+    assert 64.0 <= hist_percentile(h, 99) <= 128.0
+    # count > 0 with no occupied bucket at all (torn) -> 0.0
+    assert hist_percentile(
+        {"buckets": [0] * 16, "count": 3, "sum": 0}, 50
+    ) == 0.0
+    # negative bucket deltas (windowed diffs of torn reads) are ignored
+    h = {"buckets": [-2, 0, 4] + [0] * 13, "count": 4, "sum": 0}
+    assert 4.0 <= hist_percentile(h, 50) <= 8.0
+    # hist_frac_above (the SLO engine's primitive): exact on bucket
+    # boundaries, clamped at the ends, safe on empty
+    h = _hist_of(np.array([100.0] * 90 + [10000.0] * 10))
+    assert abs(hist_frac_above(h, 1000) - 0.1) < 1e-9
+    assert hist_frac_above(h, 0) > 0.99
+    assert hist_frac_above(h, 1 << 20) == 0.0
+    assert hist_frac_above({"buckets": [], "count": 0}, 5) == 0.0
+
+
 def test_hist_percentile_edge_cases():
     assert hist_percentile({"buckets": [], "count": 0, "sum": 0}, 99) == 0.0
     assert hist_percentile({}, 50) == 0.0
@@ -171,6 +209,83 @@ def test_span_ring_write_read_wrap_and_join():
     assert cur == 30 and dropped == 6  # 14..19 may be mid-overwrite
     assert np.array_equal(ev, more[-depth:][6:])
     ring.words[3] = np.uint64(30)  # restore the quiescent invariant
+
+
+def test_span_ring_concurrent_drain_never_torn_or_duplicated():
+    """ISSUE 6 satellite: a reader draining (the fdttrace --follow
+    path) while the writer wraps the ring must never observe a torn or
+    duplicated event.  Every written row is self-checking (w1/w2/w3 are
+    functions of w0), so any torn row returned as data is detected; the
+    reader's (returned + dropped) accounting must exactly cover the
+    written stream."""
+    import threading
+
+    depth = 256
+    mem = np.zeros(T.SpanRing.footprint(depth), np.uint8)
+    ring = T.SpanRing(mem, depth, sample=1)
+    total = 40_000
+    magic = np.uint64(0x9E3779B97F4A7C15)
+    done = threading.Event()
+
+    # the final burst is one block LARGER than the ring: write_block
+    # keeps only the tail, so the head of that block is unreadably
+    # lapped no matter how the threads interleave — the wrap-accounting
+    # path is exercised deterministically, not scheduling-dependent
+    final_burst = depth + 64
+
+    def writer():
+        rng = np.random.default_rng(7)
+        i = 0
+        while i < total - final_burst:
+            k = min(int(rng.integers(1, 48)), total - final_burst - i)
+            ring.write_block(_rows(i, k))
+            i += k
+        ring.write_block(_rows(i, final_burst))
+        done.set()
+
+    def _rows(i, k):
+        idx = np.arange(i, i + k, dtype=np.uint64)
+        rows = np.empty((k, T.EVENT_WORDS), np.uint64)
+        rows[:, 0] = idx
+        rows[:, 1] = idx ^ magic
+        rows[:, 2] = idx * np.uint64(3)
+        rows[:, 3] = ~idx
+        return rows
+
+    t = threading.Thread(target=writer)
+    t.start()
+    seen: list[int] = []
+    since = 0
+    dropped_total = 0
+    final_pass = False
+    while True:
+        ev, cur, dropped = ring.read(since)
+        # accounting: everything between the cursors is either returned
+        # or declared dropped — nothing silently vanishes
+        assert len(ev) + dropped == cur - since
+        if len(ev):
+            idx = ev[:, 0]
+            # torn-row detection: all four words must be consistent
+            assert np.array_equal(ev[:, 1], idx ^ magic)
+            assert np.array_equal(ev[:, 2], idx * np.uint64(3))
+            assert np.array_equal(ev[:, 3], ~idx)
+            seen.extend(int(x) for x in idx)
+        dropped_total += dropped
+        since = cur
+        if final_pass:
+            break
+        if done.is_set():
+            final_pass = True  # one more drain after the writer stopped
+    t.join()
+    # no duplicates, globally in order, and full coverage
+    assert len(seen) == len(set(seen))
+    assert seen == sorted(seen)
+    assert len(seen) + dropped_total == total
+    # the oversized final burst guarantees at least one lap was
+    # observed regardless of thread scheduling
+    assert dropped_total >= final_burst - depth, (
+        "ring never wrapped under the reader"
+    )
 
 
 def test_tracer_sampling_selects_same_sigs_every_hop():
