@@ -313,8 +313,24 @@ def _bench_landed_tps() -> tuple[float, dict]:
     for p in payers:
         mgr.store(p, Account(1 << 60))
 
+    # process runtime (--runtime process / FDT_RUNTIME): the quic child
+    # binds its own socket, so the port must be KNOWN to the parent —
+    # probe a free one instead of reading the ephemeral port off the
+    # parent's never-booted tile copy (thread mode keeps port 0).
+    # Small probe->bind TOCTOU window, accepted for a bench: a stolen
+    # port fails the child's bind LOUDLY (boot crash + err sidecar).
+    udp_port = 0
+    if os.environ.get("FDT_RUNTIME") == "process":
+        import socket as _socket
+
+        probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        probe.bind(("127.0.0.1", 0))
+        udp_port = probe.getsockname()[1]
+        probe.close()
+
     cfg = C.parse(
         'name = "fdtbench"\n'
+        f"[tiles.quic]\nudp_port = {udp_port}\n"
         # 8192-lane batches: half the per-batch tunnel transfer of 16K
         # so one slow put stalls the pipe for half as long (the tunnel
         # degrades to ~5 MB/s in bad sessions; tunnel_mbps records it)
@@ -347,7 +363,13 @@ def _bench_landed_tps() -> tuple[float, dict]:
         blaster = None
         try:
             rpc_addr = handles["rpc"].addr
-            udp_addr = ("127.0.0.1", handles["net"].udp_addr[1])
+            # process runtime: the net child owns the socket; the fixed
+            # probed port is the contract (the parent's tile copy never
+            # boots, so its udp_addr property would be unset)
+            udp_addr = (
+                "127.0.0.1",
+                udp_port or handles["net"].udp_addr[1],
+            )
             base = rpc_call(rpc_addr, "getTransactionCount")["result"]
             # feedback pacing: keep sent-landed bounded so pack's
             # buffer absorbs the flow instead of burning the finite
@@ -436,12 +458,24 @@ def _tunnel_calibration() -> float:
 
 
 def main() -> None:
+    import argparse
     import os
 
     from firedancer_tpu.utils.hostdev import (
         enable_compilation_cache,
         ensure_cpu_devices,
     )
+
+    ap = argparse.ArgumentParser(description="fdt headline benchmark")
+    ap.add_argument(
+        "--runtime", choices=["thread", "process"], default=None,
+        help="tile runtime for the pipeline benches (ISSUE 7: process "
+        "= one OS process per tile over the shared-memory rings); "
+        "default honors FDT_RUNTIME, else thread",
+    )
+    args, _ = ap.parse_known_args()
+    if args.runtime:
+        os.environ["FDT_RUNTIME"] = args.runtime
 
     # FDT_BENCH_DEVICES=N: multichip mode on a virtual CPU mesh (the
     # --xla_force_host_platform_device_count path) — must pin the
@@ -458,6 +492,9 @@ def main() -> None:
                   "vs_baseline": 0}
     else:
         result = _run_kernel_bench()
+    # which tile runtime the pipeline benches ran (the A/B key for the
+    # ISSUE 7 before/after comparison)
+    result["runtime"] = os.environ.get("FDT_RUNTIME", "thread")
     try:
         result["tunnel_mbps"] = round(_tunnel_calibration(), 1)
     except Exception:

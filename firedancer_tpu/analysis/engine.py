@@ -18,7 +18,7 @@ import json
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from . import abi, purity, ringlint
+from . import abi, procsafe, purity, ringlint
 from .findings import Finding
 
 #: the ctypes binding modules the ABI checker must always cover — every
@@ -93,7 +93,13 @@ def run_repo(root: Path | str | None = None) -> Report:
     rep.findings.extend(abi_findings)
     rep.coverage["abi"] = abi_cov
 
-    # -- ring discipline: tiles/ + disco/ --------------------------------
+    # -- ring discipline + spawn safety: tiles/ + disco/ -----------------
+    proc_safe_files = 0
+    for d in RING_DIRS:
+        for p in sorted((root / d).glob("*.py")):
+            rep.findings.extend(procsafe.check_file(p, rel=root))
+            proc_safe_files += 1
+    rep.coverage["proc_safe_files"] = proc_safe_files
     ring_files: list[str] = []
     for d in RING_DIRS:
         for p in sorted((root / d).glob("*.py")):
@@ -154,6 +160,7 @@ def run_paths(paths: list[Path | str]) -> Report:
         for t in targets:
             ring_files.append(t.as_posix())
             rep.findings.extend(ringlint.check_file(t))
+            rep.findings.extend(procsafe.check_file(t))
             f, n = purity.check_file(t)
             rep.findings.extend(f)
             hot_fns += n

@@ -1,0 +1,234 @@
+"""proc-safe-tile: tiles must survive the process runtime's spawn.
+
+The process-per-tile runtime (disco/topo.py, runtime="process")
+reconstructs each tile in a FRESH interpreter: the tile object rides a
+multiprocessing spawn pickle, and the child re-imports the tile's
+module from scratch.  Two classes of ctor-time state silently break
+that contract:
+
+  * unpicklable handles captured by the ctor (lambdas, threading
+    primitives, sockets, open files, queues): the spawn pickle raises —
+    or worse, a __reduce__ somewhere hides the handle and the child
+    gets a dead resource.  Runtime resources belong in on_boot, which
+    runs IN the child (and re-runs on every restart incarnation).
+  * module-level mutable state a tile method writes: under threads all
+    tiles share the module dict, under spawn each child has its own
+    copy — the same code silently diverges between runtimes, the worst
+    possible failure mode (no error, different behavior).
+
+Observer tiles that deliberately stay parent threads declare
+`proc_safe = False` (disco/mux.py Tile) and are exempt; the
+Worker/Pool/Policy carve-out is shared with ringlint's hook rules
+(those classes run on their own threads inside one process and are
+created in on_boot).  Deliberate violations carry
+`# fdtlint: allow[proc-safe-tile] reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import Finding, apply_pragmas
+
+RULE = "proc-safe-tile"
+
+#: class-name tags that mark worker-layer classes, not tiles (shared
+#: convention with ringlint._DEVICE_OWNER_RE)
+_OWNER_TAGS = ("Worker", "Pool", "Policy")
+
+#: constructor callees whose results cannot ride a spawn pickle
+_UNPICKLABLE_CALLS = {
+    "threading.Thread": "a live thread",
+    "threading.Lock": "a lock",
+    "threading.RLock": "a lock",
+    "threading.Event": "an event",
+    "threading.Condition": "a condition",
+    "threading.Semaphore": "a semaphore",
+    "threading.BoundedSemaphore": "a semaphore",
+    "socket.socket": "a socket",
+    "mmap.mmap": "an mmap",
+    "queue.Queue": "a queue (holds locks)",
+    "queue.SimpleQueue": "a queue",
+    "queue.LifoQueue": "a queue (holds locks)",
+    "queue.PriorityQueue": "a queue (holds locks)",
+    "open": "an open file",
+}
+
+#: mutating attribute calls on a module-level name
+_MUTATORS = {
+    "append", "extend", "add", "update", "setdefault", "pop", "popleft",
+    "appendleft", "insert", "remove", "discard", "clear",
+}
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ""
+
+
+def _is_tile_class(cls: ast.ClassDef) -> bool:
+    if any(tag in cls.name for tag in _OWNER_TAGS):
+        return False
+    names = {b.id for b in cls.bases if isinstance(b, ast.Name)} | {
+        b.attr for b in cls.bases if isinstance(b, ast.Attribute)
+    }
+    if "Tile" in names:
+        return True
+    # subclass-of-a-tile heuristic (SynthTile(Tile) -> BenchTile(SynthTile))
+    return cls.name.endswith("Tile") or any(
+        n.endswith("Tile") for n in names
+    )
+
+
+def _declares_not_proc_safe(cls: ast.ClassDef) -> bool:
+    for stmt in cls.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "proc_safe":
+                if isinstance(value, ast.Constant) and value.value is False:
+                    return True
+    return False
+
+
+def _module_mutables(tree: ast.Module) -> dict[str, int]:
+    """Module-level names bound to mutable containers: {name: lineno}."""
+    out: dict[str, int] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        v = stmt.value
+        mutable = isinstance(v, (ast.Dict, ast.List, ast.Set)) or (
+            isinstance(v, ast.Call)
+            and _src(v.func).split(".")[-1]
+            in ("dict", "list", "set", "defaultdict", "deque", "OrderedDict")
+        )
+        if not mutable:
+            continue
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = stmt.lineno
+    return out
+
+
+def _check_ctor(path: str, cls: ast.ClassDef) -> list[Finding]:
+    findings: list[Finding] = []
+    init = next(
+        (
+            n
+            for n in cls.body
+            if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+        ),
+        None,
+    )
+    if init is None:
+        return findings
+    for node in ast.walk(init):
+        if isinstance(node, ast.Lambda):
+            findings.append(
+                Finding(
+                    path, node.lineno, RULE,
+                    f"lambda captured by {cls.name}.__init__ — lambdas "
+                    "cannot ride the process runtime's spawn pickle; "
+                    "use a module-level function or build the callable "
+                    "in on_boot (which runs in the child)",
+                )
+            )
+        elif isinstance(node, ast.Call):
+            callee = _src(node.func)
+            what = _UNPICKLABLE_CALLS.get(callee)
+            if what is None and "." in callee:
+                what = _UNPICKLABLE_CALLS.get(callee.split(".", 1)[1])
+            if what is not None:
+                findings.append(
+                    Finding(
+                        path, node.lineno, RULE,
+                        f"{callee}() in {cls.name}.__init__ captures "
+                        f"{what} — unpicklable under the process "
+                        "runtime's spawn; create runtime resources in "
+                        "on_boot (runs in the child, re-runs per "
+                        "incarnation)",
+                    )
+                )
+    return findings
+
+
+def _check_module_state(
+    path: str, tree: ast.Module, tiles: list[ast.ClassDef]
+) -> list[Finding]:
+    mutables = _module_mutables(tree)
+    if not mutables:
+        return []
+    findings: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for cls in tiles:
+        for node in ast.walk(cls):
+            name = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Name)
+            ):
+                name = node.func.value.id
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ):
+                        name = t.value.id
+            elif isinstance(node, ast.Global):
+                for n in node.names:
+                    if n in mutables:
+                        name = n
+            if name in mutables and (name, node.lineno) not in seen:
+                seen.add((name, node.lineno))
+                findings.append(
+                    Finding(
+                        path, node.lineno, RULE,
+                        f"tile {cls.name} mutates module-level "
+                        f"{name!r} (defined line {mutables[name]}) — "
+                        "under spawn each child owns a private copy, so "
+                        "thread and process runtimes silently diverge; "
+                        "move the state into the tile (ctor or "
+                        "on_boot/ctx.alloc)",
+                    )
+                )
+    return findings
+
+
+def check_file(path: Path, rel: Path | None = None) -> list[Finding]:
+    src = path.read_text()
+    tree = ast.parse(src, filename=str(path))
+    disp = (
+        path.relative_to(rel).as_posix() if rel is not None else path.as_posix()
+    )
+    tiles = [
+        cls
+        for cls in ast.walk(tree)
+        if isinstance(cls, ast.ClassDef)
+        and _is_tile_class(cls)
+        and not _declares_not_proc_safe(cls)
+    ]
+    if not tiles:
+        return []
+    findings: list[Finding] = []
+    for cls in tiles:
+        findings.extend(_check_ctor(disp, cls))
+    findings.extend(_check_module_state(disp, tree, tiles))
+    return apply_pragmas(findings, src.splitlines())

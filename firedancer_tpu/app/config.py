@@ -8,6 +8,8 @@ Python 3.11+ ships tomllib, so no vendored parser is needed.
 Config shape (all keys optional; defaults below):
 
     name = "fdt"                     # workspace name (monitor attaches)
+    [topo]
+    runtime = "thread"               # "process" = one OS process per tile
     [tiles.quic]
     quic_port = 0                    # 0 = ephemeral
     udp_port = 0
@@ -49,6 +51,9 @@ from firedancer_tpu.tiles.verify import VerifyTile
 @dataclass
 class Config:
     name: str = "fdt"
+    #: tile runtime from `[topo] runtime = "thread"|"process"`; None
+    #: defers to the FDT_RUNTIME env / the thread default (disco/topo.py)
+    runtime: str | None = None
     quic_port: int = 0
     udp_port: int = 0
     verify_count: int = 1
@@ -84,6 +89,7 @@ def parse(text: str) -> Config:
     d = t.get("dedup", {})
     return Config(
         name=doc.get("name", "fdt"),
+        runtime=doc.get("topo", {}).get("runtime"),
         quic_port=q.get("quic_port", 0),
         udp_port=q.get("udp_port", 0),
         verify_count=v.get("count", 1),
@@ -143,7 +149,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     n = cfg.verify_count
     n_banks = cfg.bank_count
     verify_devs = device_assignments(cfg.verify_devices, n)
-    topo = Topology(name=cfg.name)
+    topo = Topology(name=cfg.name, runtime=cfg.runtime)
     # asserted SLOs ride the topology: build() allocates the shared slo
     # gauge region and the manifest carries the config to attached
     # monitors (disco/slo.py, disco/flight.py)
@@ -258,7 +264,7 @@ def build_ingress_topology(
     dedup -> sink (reference connection map, config.c:681-712)."""
     from firedancer_tpu.disco.topo import device_assignments
 
-    topo = Topology(name=cfg.name)
+    topo = Topology(name=cfg.name, runtime=cfg.runtime)
     topo.slo = cfg.slo
     qt = QuicIngressTile(
         identity_secret,

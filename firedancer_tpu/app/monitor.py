@@ -54,7 +54,10 @@ class Monitor:
         self._tile_links: dict[str, dict] = {}
         for name, t in extra.get("tiles", {}).items():
             schema = MetricsSchema(
-                counters=tuple(t["counters"]), hists=tuple(t["hists"])
+                counters=tuple(t["counters"]),
+                hists=tuple(t["hists"]),
+                # layout-affecting: wide hists store more buckets
+                wide_hists=tuple(t.get("wide_hists", ())),
             )
             # schema comes pre-flattened (with_base applied by the topo)
             m = Metrics(self.wksp.view(t["metrics"]), schema)

@@ -174,6 +174,13 @@ class TileFaults:
     def __init__(self, inj: FaultInjector, tile: str, faults: list):
         self.inj = inj
         self.tile = tile
+        #: ordered (global index, fault) pairs for shm state mapping
+        self._mine = list(faults)
+        #: process runtime: shm backing for the cumulative trigger
+        #: state (ticks, frags_seen, per-fault fired flags) — see
+        #: bind_shm.  None in the threaded runtime (the shared injector
+        #: object itself carries the state across restarts).
+        self._shm = None
         #: span tracer (disco/trace.py), bound by the run loop at boot
         #: so injected faults annotate themselves into the tile's trace
         #: (only ever written from the tile's own loop thread)
@@ -203,10 +210,42 @@ class TileFaults:
             (i, f) for i, f in faults if f.kind == "device_error"
         ]
 
+    def bind_shm(self, mem_u8) -> None:
+        """Back the cumulative trigger state with a workspace region so
+        it survives a CHILD PROCESS restart.  The documented contract —
+        "all indices are cumulative across restarts" and a fired fault
+        stays fired — holds in the threaded runtime because every
+        incarnation shares one injector object; a re-spawned child
+        reconstructs the injector from the manifest, so without this a
+        scripted kill would re-fire in EVERY incarnation (a kill loop).
+        Layout: w0 = ticks, w1 = frags_seen, w2+k = fired flag of this
+        tile's k-th fault.  Single writer (the owning tile's loop)."""
+        need = 2 + len(self._mine)
+        w = mem_u8[: (len(mem_u8) // 8) * 8].view(np.uint64)
+        if len(w) < need:
+            raise ValueError(
+                f"fault-state region too small: {len(w)} words for "
+                f"{len(self._mine)} faults"
+            )
+        self._shm = w
+        self.ticks = int(w[0])
+        self.frags_seen = int(w[1])
+        for k, (_, f) in enumerate(self._mine):
+            f.fired = bool(w[2 + k])
+
+    def _persist_fired(self, f: Fault) -> None:
+        if self._shm is not None:
+            for k, (_, mf) in enumerate(self._mine):
+                if mf is f:
+                    self._shm[2 + k] = 1
+                    return
+
     # -- point 1: loop top ------------------------------------------------
 
     def tick(self, ctx) -> None:
         self.ticks += 1
+        if self._shm is not None:
+            self._shm[0] = np.uint64(self.ticks)
         for _, f in self._tick_faults:
             if f.fired:
                 continue
@@ -214,6 +253,10 @@ class TileFaults:
             if ref < f.at:
                 continue
             f.fired = True
+            # persist BEFORE the effect: a kill raises out of this
+            # frame, and the flag must already be durable when the
+            # supervisor respawns the child
+            self._persist_fired(f)
             if f.kind == "kill":
                 self.inj.log(self.tile, "kill", f.at)
                 if self.tracer is not None:
@@ -258,6 +301,8 @@ class TileFaults:
     def mangle_frags(self, il, frags: np.ndarray) -> np.ndarray:
         n = len(frags)
         self.frags_seen += n
+        if self._shm is not None:
+            self._shm[1] = np.uint64(self.frags_seen)
         # drop/corrupt windows index the PER-LINK frag stream: each link
         # is a FIFO, so these indices are deterministic even when a tile
         # drains several in-links in timing-dependent interleavings

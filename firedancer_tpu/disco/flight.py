@@ -8,9 +8,9 @@ answers "what exactly was the system doing when it broke".  Three parts:
     (mcache seq / fseq / credit view) and supervision state, sampled by
     the recorder's watcher thread.  Like the span rings it is a
     single-writer, torn-read-tolerant u64 region: the data survives the
-    death of any tile (and, after the item-1 process-runtime refactor,
-    of any tile process) because it lives in the workspace, not in the
-    tile.
+    death of any tile — including a SIGKILLed tile CHILD PROCESS under
+    the ISSUE 7 process runtime — because it lives in the workspace,
+    not in the tile.
 
   * A trigger engine: supervisor crash/stall restarts, circuit-breaker
     trips and wedges (via Supervisor.add_listener), device quarantines

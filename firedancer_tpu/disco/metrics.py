@@ -19,6 +19,17 @@ from dataclasses import dataclass, field
 import numpy as np
 
 HIST_BUCKETS = 16
+#: wide log2 hists: 24 buckets + the same sum/count tail.  Bucket 23
+#: covers [2^23, 2^24) so a µs-domain wide hist represents ~16.8 s
+#: before clamping; the TOP bucket is the explicit overflow bucket
+#: (values beyond the domain land there and percentiles interpolate
+#: inside it with the documented 2x-span bias).  Introduced for
+#: `sched_lag_us` (disco/profile.py): the 16-bucket domain ends at
+#: 2^16 µs = 65.5 ms, and the threaded-runtime baseline (PROFILE.md
+#: round 8) PINS its p99 at that ceiling — both the pre-refactor
+#: 100 ms-class lags and the post-refactor sub-ms lags must be
+#: representable for the process-runtime A/B to mean anything.
+WIDE_HIST_BUCKETS = 24
 _HIST_WORDS = HIST_BUCKETS + 2  # buckets + sum + count
 
 #: the per-device health/throughput row exported by device-pool tiles
@@ -70,10 +81,19 @@ class MetricsSchema:
 
     counters: monotone u64 counts (also used for gauges via set()).
     hists: 16-bucket log2 histograms with sum/count.
+    wide_hists: names (a subset of hists) stored with WIDE_HIST_BUCKETS
+    buckets instead — a wider domain plus an explicit overflow bucket,
+    for distributions (scheduler lag) whose tail outruns 2^16.  Layout-
+    affecting: every reader of a region must use the SAME schema
+    including this field (it rides the topology manifest).
     """
 
     counters: tuple[str, ...] = ()
     hists: tuple[str, ...] = ()
+    wide_hists: tuple[str, ...] = ()
+
+    def hist_buckets(self, name: str) -> int:
+        return WIDE_HIST_BUCKETS if name in self.wide_hists else HIST_BUCKETS
 
     # every tile gets these on top of its own schema
     BASE_COUNTERS = (
@@ -102,15 +122,19 @@ class MetricsSchema:
         return MetricsSchema(
             counters=MetricsSchema.BASE_COUNTERS + tuple(self.counters),
             hists=MetricsSchema.BASE_HISTS + tuple(self.hists),
+            wide_hists=tuple(self.wide_hists),
         )
 
     def footprint_words(self) -> int:
-        return len(self.counters) + _HIST_WORDS * len(self.hists)
+        return len(self.counters) + sum(
+            self.hist_buckets(h) + 2 for h in self.hists
+        )
 
 
 @dataclass
 class _Hist:
     base: int
+    nb: int = HIST_BUCKETS
 
 
 class Metrics:
@@ -127,8 +151,9 @@ class Metrics:
             off += 1
         self._hist: dict[str, _Hist] = {}
         for h in schema.hists:
-            self._hist[h] = _Hist(off)
-            off += _HIST_WORDS
+            nb = schema.hist_buckets(h)
+            self._hist[h] = _Hist(off, nb)
+            off += nb + 2
 
     @staticmethod
     def footprint(schema: MetricsSchema) -> int:
@@ -144,11 +169,11 @@ class Metrics:
 
     def hist_sample(self, name: str, value: int) -> None:
         h = self._hist[name]
-        b = min(max(int(value), 1).bit_length() - 1, HIST_BUCKETS - 1)
+        b = min(max(int(value), 1).bit_length() - 1, h.nb - 1)
         w = self.words
         w[h.base + b] += np.uint64(1)
-        w[h.base + HIST_BUCKETS] += np.uint64(max(int(value), 0))
-        w[h.base + HIST_BUCKETS + 1] += np.uint64(1)
+        w[h.base + h.nb] += np.uint64(max(int(value), 0))
+        w[h.base + h.nb + 1] += np.uint64(1)
 
     def hist_sample_many(self, name: str, values: np.ndarray) -> None:
         h = self._hist[name]
@@ -157,13 +182,13 @@ class Metrics:
         # hist_sample's max(value, 0) — NOT the raw values
         v = np.maximum(raw, 1)
         buckets = np.minimum(
-            np.floor(np.log2(v)).astype(np.int64), HIST_BUCKETS - 1
+            np.floor(np.log2(v)).astype(np.int64), h.nb - 1
         )
-        counts = np.bincount(buckets, minlength=HIST_BUCKETS).astype(np.uint64)
+        counts = np.bincount(buckets, minlength=h.nb).astype(np.uint64)
         w = self.words
-        w[h.base : h.base + HIST_BUCKETS] += counts
-        w[h.base + HIST_BUCKETS] += np.uint64(int(np.maximum(raw, 0).sum()))
-        w[h.base + HIST_BUCKETS + 1] += np.uint64(len(raw))
+        w[h.base : h.base + h.nb] += counts
+        w[h.base + h.nb] += np.uint64(int(np.maximum(raw, 0).sum()))
+        w[h.base + h.nb + 1] += np.uint64(len(raw))
 
     # -- reader side (any process) ---------------------------------------
 
@@ -174,9 +199,9 @@ class Metrics:
         h = self._hist[name]
         w = self.words
         return {
-            "buckets": w[h.base : h.base + HIST_BUCKETS].tolist(),
-            "sum": int(w[h.base + HIST_BUCKETS]),
-            "count": int(w[h.base + HIST_BUCKETS + 1]),
+            "buckets": w[h.base : h.base + h.nb].tolist(),
+            "sum": int(w[h.base + h.nb]),
+            "count": int(w[h.base + h.nb + 1]),
         }
 
     def read(self) -> dict:
@@ -207,8 +232,11 @@ def hist_percentile(h: dict, q: float) -> float:
         first occupied bucket (the min estimate), q=100 the upper edge
         of the last occupied one (the max estimate);
       * all mass in the overflow bucket interpolates inside
-        [2^(HIST_BUCKETS-1), 2^HIST_BUCKETS] — a finite estimate with
-        the documented 2x-span bias for values beyond the top bucket;
+        [2^(nb-1), 2^nb] for the hist's own bucket count nb (16, or
+        WIDE_HIST_BUCKETS for wide hists — the estimator works off
+        len(buckets), so both widths share this code) — a finite
+        estimate with the documented 2x-span bias for values beyond
+        the top bucket;
       * torn snapshots (the regions are read lock-free, and windowed
         deltas of torn reads can even go negative per bucket) never
         push the walk past the occupied mass: negative bucket counts

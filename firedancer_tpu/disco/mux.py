@@ -190,6 +190,13 @@ class MuxCtx:
         #: the topology's shared workspace — tiles allocate observable
         #: state (tcaches etc.) here so a monitor process can map it
         self.wksp = wksp
+        #: process runtime: a tile-private shm sub-allocator
+        #: (tango.rings.WkspArena) that replaces direct workspace
+        #: allocation — an ATTACHED workspace cannot allocate (the bump
+        #: cursor is host-side state two children would race), so each
+        #: child carves its own pre-sized arena instead.  None in the
+        #: threaded runtime.
+        self.arena = None
         self.credits = 0  # refreshed by the loop before each callback round
         self.halted = False
         #: supervision hooks: the supervisor sets `interrupt` to abandon a
@@ -229,8 +236,13 @@ class MuxCtx:
         incarnation re-running on_boot gets the SAME region back, so
         state that must survive a crash (dedup's tag cache) persists
         across restarts — the tile decides whether to re-init it or
-        rejoin it via `ctx.incarnation`."""
+        rejoin it via `ctx.incarnation`.  In the process runtime the
+        allocation comes from the tile's own shm arena (same idempotent
+        contract; WkspArena keeps the name table in shared memory so
+        the parent/monitors resolve the region by name)."""
         key = f"{self.name}_{name}"
+        if self.arena is not None:
+            return self.arena.alloc(key, footprint)
         if self.wksp is not None:
             return self.wksp.alloc(key, footprint)
         buf = self._local_allocs.get(key)
@@ -281,6 +293,16 @@ class Tile:
         return 0 when full so upstream backpressure propagates through
         the rings instead of an unbounded host buffer."""
         return None
+
+    #: False = this tile stays a THREAD in the parent even under the
+    #: process runtime (Topology.start(mode="process")).  Observer
+    #: tiles that close over parent-side state (the metric tile's
+    #: registry callable, the rpc tile's counter lambdas) are the
+    #: intended users: they only READ shared memory, so keeping them
+    #: in-parent loses no isolation, while their closures could never
+    #: ride a spawn pickle.  Pipeline tiles must be proc-safe (the
+    #: fdtlint `proc-safe-tile` rule guards their ctors).
+    proc_safe = True
 
     #: a manual-credit tile gates each publish on that ring's own
     #: cr_avail() instead of the loop's min-over-all-outs gate.  Needed

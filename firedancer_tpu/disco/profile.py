@@ -64,6 +64,13 @@ PROFILE_SCHEMA = MetricsSchema(
         #: time-based cadence lag under GIL/scheduler contention
         "sched_lag_us",
     ),
+    # sched-lag is a WIDE hist (metrics.WIDE_HIST_BUCKETS): the
+    # 16-bucket domain ends at 2^16 µs and the threaded baseline pins
+    # its p99 exactly there (PROFILE.md round 8 caveat) — the
+    # process-runtime A/B needs the 100 ms-class "before" AND the
+    # sub-ms "after" to be representable in the same storage format,
+    # with the top bucket as the explicit overflow bucket.
+    wide_hists=("sched_lag_us",),
 )
 
 

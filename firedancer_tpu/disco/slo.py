@@ -20,11 +20,15 @@ SLO semantics (all optional; None = not asserted):
                     e2e_us_* hists of the path's EXIT tiles (tiles with
                     no out links: sink/store).  Budget: at most
                     `budget` (default 1%) of samples may exceed it.
-                    NOTE: latency ceilings must sit inside the 16-bucket
-                    log2 hist domain — values clamp into the top bucket
-                    at 2^15 µs and the domain ends at 2^16 µs (~65 ms),
-                    so a ceiling >= 65536 µs can never be observed as
-                    violated by this storage format.
+                    NOTE: a latency ceiling must sit inside its hist's
+                    log2 domain or a violation can never be observed —
+                    the bound is derived from the storage format
+                    (hist_domain_end_us), NOT hardcoded: link latency
+                    hists are 16-bucket (domain ends at 2^16 µs), wide
+                    hists like sched_lag_us run to 2^WIDE_HIST_BUCKETS
+                    µs with an explicit overflow bucket.  SloConfig
+                    validation rejects unobservable ceilings loudly
+                    instead of asserting an SLO that can never fire.
   verify_hop_p99_us verify service-time p99 ceiling (svc_us_* hists of
                     verify* tiles), same budget semantics.
   landed_tps_min    throughput floor: windowed in_frags rate at the
@@ -51,11 +55,21 @@ import time
 from dataclasses import dataclass, field
 
 from .metrics import (
+    HIST_BUCKETS,
+    WIDE_HIST_BUCKETS,
     hist_delta,
     hist_frac_above,
     hist_percentile,
     merge_hists,
 )
+
+
+def hist_domain_end_us(wide: bool = False) -> float:
+    """Largest value a log2 latency hist can distinguish from the
+    overflow clamp — the observability bound for latency ceilings.
+    Derived from the storage format so widening a hist (the sched-lag
+    fix) automatically lifts the matching ceiling-bound check here."""
+    return float(1 << (WIDE_HIST_BUCKETS if wide else HIST_BUCKETS))
 
 #: counters summed into the window's "dropped" numerator — declared
 #: frag loss only (injected drops are declared by faultinj, not here)
@@ -82,6 +96,23 @@ class SloConfig:
     #: must be large, slow burn sustained)
     burn_fast: float = 10.0
     burn_slow: float = 2.0
+
+    def validate(self) -> None:
+        """Reject latency ceilings the storage format can never observe
+        as violated (they would assert an SLO that cannot fire).  The
+        bound comes from the hist width the objective is evaluated
+        over: the per-link latency hists are 16-bucket, so their
+        ceilings must sit under hist_domain_end_us()."""
+        for name in ("e2e_p99_us", "verify_hop_p99_us"):
+            v = getattr(self, name)
+            if v is not None and v >= hist_domain_end_us():
+                raise ValueError(
+                    f"slo {name}={v:,.0f}us is unobservable: the "
+                    f"{HIST_BUCKETS}-bucket latency hist domain ends at "
+                    f"{hist_domain_end_us():,.0f}us — a violation could "
+                    f"never be recorded (lower the ceiling, or widen "
+                    f"the hist like sched_lag_us)"
+                )
 
     def asserted(self) -> list[str]:
         return [
@@ -154,6 +185,7 @@ class SloEngine:
         tile_links: dict[str, dict] | None = None,
         clock=time.monotonic,
     ):
+        cfg.validate()
         self.cfg = cfg
         self.tile_links = tile_links or {}
         self.clock = clock

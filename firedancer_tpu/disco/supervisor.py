@@ -131,6 +131,11 @@ class Supervisor:
         self._listeners: list = []
         self._state: dict[str, _TileState] = {}
         self._loop_kw: dict = {}
+        #: True when the topology runs the process-per-tile runtime —
+        #: failure handling then kills/reaps CHILD PROCESSES (SIGKILL
+        #: works on a wedged child, unlike a wedged thread) and ring
+        #: rejoin happens in the respawned child at boot
+        self._process = False
         self._halting = False
         self._watchdog: threading.Thread | None = None
         self._stop = threading.Event()
@@ -155,10 +160,25 @@ class Supervisor:
         if topo.wksp is None:
             topo.build()
         self._loop_kw = loop_kw
+        topo._loop_kw = dict(loop_kw)
+        self._process = topo._runtime == "process"
+        if self._process and self.faults is not None:
+            # process runtime: the schedule rides the spawn args so
+            # each child reconstructs an IDENTICAL injector (seed +
+            # fault list) — deterministic effects, child-local event
+            # logs (parent-side accounting reads the shm metrics)
+            topo.faults_spec = (self.faults.seed, list(self.faults.faults))
         for name, ts in topo.tiles.items():
             self._state[name] = _TileState()
-            if self.faults is not None:
+            if self.faults is not None and not (
+                self._process and ts.tile.proc_safe
+            ):
                 ts.ctx.faults = self.faults.view(name)
+        if self._process:
+            # publish ONCE, before any child spawns: children attach
+            # via the directory, and re-publishing per spawn would
+            # truncate-rewrite the file under a concurrent attach
+            topo.export_manifest()
         for name in topo.tiles:
             self._spawn(name)
         # boot-wait: every tile leaves BOOT (RUN, or FAIL -> the watchdog
@@ -166,6 +186,12 @@ class Supervisor:
         deadline = time.monotonic() + boot_timeout_s
         for name, ts in topo.tiles.items():
             while topo._cncs[name].signal_query() == R.CNC_BOOT:
+                p = ts.proc
+                if p is not None and not p.is_alive():
+                    # died before signaling (spawn/import crash): mark
+                    # FAIL so the watchdog runs the normal restart path
+                    topo._cncs[name].signal(R.CNC_FAIL)
+                    break
                 if time.monotonic() > deadline:
                     self.halt()
                     raise TimeoutError(f"tile {name!r} stuck in BOOT")
@@ -177,18 +203,16 @@ class Supervisor:
         self._watchdog.start()
 
     def _spawn(self, name: str) -> None:
-        topo, ts, st = self.topo, self.topo.tiles[name], self._state[name]
-        ts.error = None
+        """(Re)spawn one tile via the topology's runtime-aware spawner
+        (child process, or a thread for the thread runtime and
+        proc_safe=False observers)."""
+        topo, st = self.topo, self._state[name]
         st.boot_mono_ns = time.monotonic_ns()
         st.respawn_at = 0.0
-        t = threading.Thread(
-            target=topo._tile_main,
-            args=(ts, self._loop_kw),
-            name=f"tile:{name}",
-        )
-        t.daemon = True
-        ts.thread = t
-        t.start()
+        replay = self.policy.replay
+        if isinstance(replay, dict):
+            replay = replay.get(name, 0)
+        topo._spawn_tile(name, replay=replay)
 
     def halt(self, timeout_s: float = 30.0) -> None:
         self._halting = True
@@ -219,11 +243,13 @@ class Supervisor:
                     continue
                 cnc = self.topo._cncs[name]
                 sig = cnc.signal_query()
-                if sig == R.CNC_FAIL or (
-                    ts.thread is not None
-                    and not ts.thread.is_alive()
-                    and sig == R.CNC_RUN
-                ):
+                proc = ts.proc
+                died = (
+                    not proc.is_alive()
+                    if proc is not None
+                    else ts.thread is not None and not ts.thread.is_alive()
+                )
+                if sig == R.CNC_FAIL or (died and sig == R.CNC_RUN):
                     self._handle_failure(name, "crash")
                     continue
                 if sig == R.CNC_RUN:
@@ -235,8 +261,12 @@ class Supervisor:
                 elif sig == R.CNC_BOOT:
                     # a re-incarnation hung in on_boot never reaches RUN
                     # or FAIL on its own — without this deadline it
-                    # would be invisible to every other clause forever
-                    if now_ns - st.boot_mono_ns > int(
+                    # would be invisible to every other clause forever;
+                    # a child that DIED in boot (import crash) is
+                    # detectable immediately by its exit
+                    if proc is not None and died:
+                        self._handle_failure(name, "boot crash")
+                    elif now_ns - st.boot_mono_ns > int(
                         p.boot_timeout_s * 1e9
                     ):
                         self._handle_failure(name, "boot timeout")
@@ -248,18 +278,41 @@ class Supervisor:
         topo, ts, st = self.topo, self.topo.tiles[name], self._state[name]
         ctx = ts.ctx
         metrics = topo._metrics[name]
-        # abandon the incarnation: a stalled loop exits at its next
-        # interrupt check; a crashed one is already on its way out
-        ctx.interrupt.set()
-        ts.thread.join(timeout=p.join_timeout_s)
-        if ts.thread.is_alive():
-            # the thread ignored the interrupt: restarting over a live
-            # writer would break the single-writer ring discipline
-            st.degraded = "wedged"
-            metrics.set("degraded", 1)
-            log.err("tile %s wedged (interrupt ignored); degraded", name)
-            self._emit(name, "wedged", {"reason": reason})
-            return
+        if ts.proc is not None:
+            # a child PROCESS can actually be killed — the wedged-thread
+            # escape hatch the threaded runtime lacks.  SIGKILL, reap,
+            # and the single-writer discipline is guaranteed by the
+            # process exit (no Python cooperation needed).
+            proc = ts.proc
+            if proc.is_alive():
+                proc.kill()
+            proc.join(timeout=p.join_timeout_s)
+            if proc.is_alive():
+                # unkillable (uninterruptible D-state): restarting over
+                # a live writer would break the rings — degrade
+                st.degraded = "wedged"
+                metrics.set("degraded", 1)
+                log.err("tile %s child unkillable; degraded", name)
+                self._emit(name, "wedged", {"reason": reason})
+                return
+            try:
+                proc.close()
+            except ValueError:
+                pass
+            ts.proc = None
+        else:
+            # abandon the incarnation: a stalled loop exits at its next
+            # interrupt check; a crashed one is already on its way out
+            ctx.interrupt.set()
+            ts.thread.join(timeout=p.join_timeout_s)
+            if ts.thread.is_alive():
+                # the thread ignored the interrupt: restarting over a
+                # live writer would break the single-writer discipline
+                st.degraded = "wedged"
+                metrics.set("degraded", 1)
+                log.err("tile %s wedged (interrupt ignored); degraded", name)
+                self._emit(name, "wedged", {"reason": reason})
+                return
         now = time.monotonic()
         # circuit breaker over a sliding failure window
         st.fail_times.append(now)
@@ -288,26 +341,38 @@ class Supervisor:
             else min(st.backoff_s * 2.0, p.backoff_max_s)
         )
         # ring rejoin: consumer seqs from the published fseqs (with the
-        # configured replay window), producer cursors from the mcaches
-        replay = p.replay
-        if isinstance(replay, dict):
-            replay = replay.get(name, 0)
+        # configured replay window), producer cursors from the mcaches.
+        # Thread runtime: repaired here, parent-side.  Process runtime:
+        # the NEW CHILD runs the same rejoin_links at boot (its endpoint
+        # objects live in the child; the repair inputs — fseqs, mcache
+        # cursors — are all shm), so the parent only does bookkeeping.
+        is_proc = self._process and ts.tile.proc_safe
+        if not is_proc:
+            replay = p.replay
+            if isinstance(replay, dict):
+                replay = replay.get(name, 0)
 
-        def _account_skip(il, skipped):
-            metrics.inc("overrun_frags", skipped)
-            il.fseq.diag_add(0, skipped)
+            def _account_skip(il, skipped):
+                metrics.inc("overrun_frags", skipped)
+                il.fseq.diag_add(0, skipped)
 
-        rejoin_links(ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip)
+            rejoin_links(
+                ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip
+            )
         if ctx.tracer is not None:
-            # the dead incarnation's thread is joined above and the new
-            # one has not spawned, so this is the ring's only writer —
-            # the restart annotation makes the kill -> rejoin gap
-            # visible (and assertable) in the assembled trace
+            # the dead incarnation (thread joined / process reaped) is
+            # gone and the new one has not spawned, so this is the
+            # ring's only writer — the restart annotation makes the
+            # kill -> rejoin gap visible (and assertable) in the trace
             ctx.tracer.fault(
                 "restart", seq=ctx.incarnation + 1,
                 aux64=st.restarts + 1,
             )
-        ts.tile.on_crash(ctx)
+        if not is_proc:
+            # process children take their resources (sockets, worker
+            # threads, device handles) down with them — on_crash is a
+            # thread-runtime cleanup hook
+            ts.tile.on_crash(ctx)
         ctx.interrupt.clear()
         ctx.booted = False
         ctx.incarnation += 1

@@ -3,12 +3,31 @@
 Reference model: src/disco/topo/fd_topo.h:28-230 (fd_topo_t = wksps,
 links, tiles, objs; built by fd_topob_*) and fd_topo_run.c (join
 workspaces → init → run loop).  The reference runs each tile as a
-sandboxed process over hugetlbfs shared memory; this build's default
-runner is one thread per tile over one process-local workspace (the
-reference's own tests use exactly this shape, e.g.
-src/disco/dedup/test_dedup.c:654-660), with the same objects working
-cross-process when the workspace is named (/dev/shm-backed, see
-tango.rings.Workspace).
+sandboxed PROCESS over hugetlbfs shared memory (fd_topo_run_tile_t);
+this build supports both shapes over the same /dev/shm-backed objects:
+
+  * runtime="thread" (default): one thread per tile in one interpreter
+    — the shape the reference's own tests use (e.g.
+    src/disco/dedup/test_dedup.c:654-660), bit-identical to the
+    pre-process-runtime behavior, and what tier-1 runs.
+  * runtime="process": one OS process per tile.  The parent builds the
+    named workspace and publishes a boot manifest; each child
+    re-attaches via tango.rings.Workspace.attach(), rebinds its
+    mcache/dcache/fseq/cnc views and metrics/trace/profile regions by
+    manifest name, and enters the same disco/mux.py run loop unchanged
+    — the ring protocol is process-safe (fdtmc-verified, PR 3).  The
+    control plane (boot acks, heartbeats, incarnation, boot-vs-run
+    failure classification) lives entirely in shared-memory words
+    (cnc + a per-tile pstat region), so the supervisor can watchdog,
+    SIGKILL, and in-place restart a child with the same rejoin
+    discipline as thread restarts.  This is what escapes the GIL:
+    PROFILE.md round 8 measured ~94% of every tile's non-sleeping wall
+    time as runnable-but-not-running in the threaded runtime.
+
+Runtime selection: Topology(runtime=...) / start(mode=...) >
+FDT_RUNTIME env > "thread".  Observer tiles that close over parent
+state (metric/rpc) declare proc_safe=False and stay threads in the
+parent even in process mode — they only read shared memory.
 
 Fail-stop supervision mirrors run/run.c:264-270: any tile failure halts
 the whole topology.
@@ -16,15 +35,47 @@ the whole topology.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
+
+import numpy as np
 
 from firedancer_tpu.tango import rings as R
 
 from .metrics import Metrics, MetricsSchema
 from .mux import InLink, MuxCtx, OutLink, Tile, link_hist_names, run_loop
 from .trace import SpanRing, TraceConfig, Tracer
+
+#: per-tile process-control shm words ("pstat" region): the control
+#: plane a child and its parent share beyond the cnc.  Single writer
+#: per word: the parent owns INCARNATION (set before each spawn), the
+#: child owns PID and BOOTED (its crash handler records whether
+#: on_boot had completed, so the parent can classify FAIL as a
+#: construction error vs a post-RUN crash without any Python-object
+#: channel).
+PSTAT_INCARNATION, PSTAT_PID, PSTAT_BOOTED = 0, 1, 2
+_PSTAT_BYTES = 64
+#: per-tile faultinj cumulative-trigger state (TileFaults.bind_shm):
+#: 2 counter words + up to 62 per-fault fired flags
+_FSTAT_BYTES = 512
+
+
+def _err_path(wksp_name: str, tile: str) -> str:
+    """Child-crash report sidecar: the process analog of TileSpec.error
+    (a traceback cannot cross the process boundary as an object)."""
+    return f"/dev/shm/fdt_wksp_{wksp_name}.err_{tile}"
+
+
+def _read_err(wksp_name: str | None, tile: str) -> str:
+    if wksp_name is None:
+        return ""
+    try:
+        with open(_err_path(wksp_name, tile)) as f:
+            return f.read()[-4000:]
+    except OSError:
+        return ""
 
 
 def device_assignments(spec, n_tiles: int) -> list[list[int]]:
@@ -73,6 +124,9 @@ class TileSpec:
     outs: list[str]
     ctx: MuxCtx | None = None
     thread: threading.Thread | None = None
+    #: process runtime: the tile's child process (multiprocessing
+    #: handle).  None for thread tiles and proc_safe=False observers.
+    proc: object | None = None
     error: BaseException | None = None
 
 
@@ -88,9 +142,27 @@ class Topology:
     """
 
     def __init__(
-        self, name: str | None = None, trace: TraceConfig | None = None
+        self,
+        name: str | None = None,
+        trace: TraceConfig | None = None,
+        runtime: str | None = None,
     ):
         self.name = name
+        #: tile runtime: "thread" | "process" | None (resolve from the
+        #: FDT_RUNTIME env at build/start).  Must be settled before
+        #: build() — the process runtime adds workspace regions
+        #: (per-tile arenas/pstat, per-dcache shm cursors).
+        self.runtime = runtime
+        self._runtime: str | None = None  # resolved at build()
+        #: process runtime: fault-injection schedule that rides the
+        #: spawn args so children reconstruct IDENTICAL injector
+        #: behavior deterministically — (seed, [Fault, ...]).  Set by
+        #: Supervisor.start (from its FaultInjector) or directly by
+        #: chaos harnesses.
+        self.faults_spec: tuple[int, list] | None = None
+        #: loop kwargs captured at start() so the supervisor can
+        #: respawn children with identical run-loop parameters
+        self._loop_kw: dict = {}
         self.links: dict[str, LinkSpec] = {}
         self.tiles: dict[str, TileSpec] = {}
         self.wksp: R.Workspace | None = None
@@ -173,6 +245,26 @@ class Topology:
 
     # ---- build ----------------------------------------------------------
 
+    def _resolve_runtime(self, mode: str | None = None) -> str:
+        rt = mode or self.runtime or os.environ.get("FDT_RUNTIME") or "thread"
+        if rt not in ("thread", "process"):
+            raise ValueError(
+                f"unknown tile runtime {rt!r} (thread|process; from "
+                f"start(mode=), Topology(runtime=), or FDT_RUNTIME)"
+            )
+        return rt
+
+    @staticmethod
+    def _spawn_method() -> str:
+        """multiprocessing start method for tile children.  Default
+        "spawn": a pristine interpreter per tile — no inherited GIL
+        state, locks, or jax runtime; the child reconstructs everything
+        from the manifest + pickled TileSpec, which is exactly what the
+        fdtlint proc-safe-tile rule keeps honest.  FDT_SPAWN=fork opts
+        into fork for import-cost-sensitive hosts (unsafe if the parent
+        already initialized a device runtime)."""
+        return os.environ.get("FDT_SPAWN", "spawn")
+
     def _tile_schema(self, ts: TileSpec) -> MetricsSchema:
         """The tile's own schema + base + the per-in-link latency
         attribution hists (qwait/svc/e2e per consumed link) the run
@@ -183,7 +275,10 @@ class Topology:
         link_hists = tuple(
             h for ln, _rel in ts.ins for h in link_hist_names(ln)
         )
-        return MetricsSchema(base.counters, base.hists + link_hists)
+        return MetricsSchema(
+            base.counters, base.hists + link_hists,
+            wide_hists=base.wide_hists,
+        )
 
     def _footprint(self) -> int:
         total = 4096
@@ -195,7 +290,11 @@ class Topology:
         for ts in self.tiles.values():
             total += R.CNC.footprint() + 128
             total += Metrics.footprint(self._tile_schema(ts)) + 256
-            total += ts.tile.wksp_footprint() + 256
+            if not (self._runtime == "process" and ts.tile.proc_safe):
+                # process-runtime children allocate tile state from
+                # their arena (budgeted below), not the workspace —
+                # budgeting both would double-size /dev/shm
+                total += ts.tile.wksp_footprint() + 256
             if self.trace is not None:
                 total += SpanRing.footprint(self.trace.depth) + 256
             if self.profile is not None:
@@ -213,10 +312,29 @@ class Topology:
             from .slo import slo_metrics_schema
 
             total += Metrics.footprint(slo_metrics_schema(self.slo)) + 256
+        if self._runtime == "process":
+            # process-runtime control plane + child-side allocation
+            # arenas (ctx.alloc cannot bump an attached workspace).
+            # proc_safe=False observers stay parent threads and use
+            # none of it — budgeting theirs would just waste /dev/shm.
+            for ls in self.links.values():
+                if ls.mtu:
+                    total += 64 + 128  # shm dcache cursor word
+            for ts in self.tiles.values():
+                if not ts.tile.proc_safe:
+                    continue
+                total += _PSTAT_BYTES + 128
+                total += _FSTAT_BYTES + 128
+                total += R.WkspArena.footprint(ts.tile.wksp_footprint())
+                total += 256
         return total
 
-    def build(self) -> None:
+    def build(self, runtime: str | None = None) -> None:
         assert self.wksp is None, "already built"
+        self._runtime = self._resolve_runtime(runtime)
+        if self._runtime == "process" and self.name is None:
+            # children attach by name; auto-name anonymous topologies
+            self.name = f"p{os.getpid()}_{os.urandom(3).hex()}"
         self.wksp = R.Workspace(self._footprint(), name=self.name)
         for ls in self.links.values():
             self._mcaches[ls.name] = R.MCache.create(
@@ -230,6 +348,17 @@ class Topology:
                 self._fseqs[(ls.name, cons)] = R.FSeq.create(
                     self.wksp, f"fs_{ls.name}_{cons}"
                 )
+        if self._runtime == "process":
+            # shm-backed dcache producer cursors: a restarted producer
+            # CHILD must resume at its published chunk, not rewind to 0
+            # over payloads in-flight frags still reference (thread
+            # restarts keep the DCache object, so only the process
+            # runtime needs the shared word)
+            for ls in self.links.values():
+                if ls.mtu:
+                    self._dcaches[ls.name].bind_cursor(
+                        self.wksp.alloc(f"dcur_{ls.name}", 64, align=64)
+                    )
         # link ids: declaration-order small ints, shared with the span
         # events (u8 field) and the manifest's id -> name table
         link_ids = {ln: i for i, ln in enumerate(self.links)}
@@ -270,6 +399,19 @@ class Topology:
                 )
                 self._flightboxes[name] = BlackBox(
                     bmem, self.flight.depth, rw
+                )
+        if self._runtime == "process":
+            for name, ts in self.tiles.items():
+                if not ts.tile.proc_safe:
+                    continue  # parent-thread observers use the wksp path
+                self.wksp.alloc(f"pstat_{name}", _PSTAT_BYTES, align=64)
+                # cumulative faultinj trigger state (ticks/frags/fired
+                # flags) — survives child restarts so scripted faults
+                # fire once, as in the threaded runtime
+                self.wksp.alloc(f"fstat_{name}", _FSTAT_BYTES, align=64)
+                self.wksp.alloc(
+                    f"arena_{name}",
+                    R.WkspArena.footprint(ts.tile.wksp_footprint()),
                 )
         if self.slo is not None:
             from .slo import slo_metrics_schema
@@ -335,6 +477,9 @@ class Topology:
                 "cnc": f"cnc_{name}",
                 "counters": list(schema.counters),
                 "hists": list(schema.hists),
+                # layout-affecting (wide hists store more buckets):
+                # attached readers must reconstruct the same schema
+                "wide_hists": list(schema.wide_hists),
                 "ins": [ln for ln, _rel in ts.ins],
                 "outs": list(ts.outs),
             }
@@ -376,7 +521,68 @@ class Topology:
                 "config": self.slo.to_dict(),
                 "metrics": "metrics_slo",
             }
+        if self._runtime == "process":
+            extra["boot"] = self._boot_manifest()
         self.wksp.publish_directory(extra)
+
+    def _boot_manifest(self) -> dict:
+        """The child-side reconstruction contract: everything a spawned
+        tile process needs to rebind its endpoints by name — link
+        geometry (depth/mtu/ids, mcache/dcache/fseq alloc names, the
+        shm dcache-cursor words), per-tile cnc/metrics/arena/pstat
+        names, the flattened metrics schemas (including wide-hist
+        widths — layout-affecting), and trace/profile enables.  Faultinj
+        schedules and the replay window ride the spawn args instead
+        (they are per-spawn, the manifest is per-build)."""
+        link_ids = {ln: i for i, ln in enumerate(self.links)}
+        links = {}
+        for ls in self.links.values():
+            links[ls.name] = {
+                "id": link_ids[ls.name],
+                "depth": ls.depth,
+                "mtu": ls.mtu,
+                "producer": ls.producer,
+                "mcache": f"mc_{ls.name}",
+                "dcache": f"dc_{ls.name}" if ls.mtu else None,
+                "dcur": f"dcur_{ls.name}" if ls.mtu else None,
+                "consumers": [
+                    [cons, rel, f"fs_{ls.name}_{cons}"]
+                    for cons, rel in ls.consumers
+                ],
+            }
+        tiles = {}
+        for name, ts in self.tiles.items():
+            schema = self._schemas.get(name) or self._tile_schema(ts)
+            proc = ts.tile.proc_safe  # observers have no child regions
+            tiles[name] = {
+                "ins": [[ln, rel] for ln, rel in ts.ins],
+                "outs": list(ts.outs),
+                "cnc": f"cnc_{name}",
+                "metrics": f"metrics_{name}",
+                "schema": {
+                    "counters": list(schema.counters),
+                    "hists": list(schema.hists),
+                    "wide_hists": list(schema.wide_hists),
+                },
+                "arena": f"arena_{name}" if proc else None,
+                "pstat": f"pstat_{name}" if proc else None,
+                "fstat": f"fstat_{name}" if proc else None,
+                "trace": f"trace_{name}" if self.trace is not None else None,
+                "profile": (
+                    f"profile_{name}" if self.profile is not None else None
+                ),
+            }
+        return {
+            "runtime": "process",
+            "spawn": self._spawn_method(),
+            "links": links,
+            "tiles": tiles,
+            "trace": (
+                {"sample": self.trace.sample, "depth": self.trace.depth}
+                if self.trace is not None
+                else None
+            ),
+        }
 
     # ---- run ------------------------------------------------------------
 
@@ -394,18 +600,29 @@ class Topology:
             log.err("tile failed: %r\n%s", e, traceback.format_exc())
             ts.error = e
 
-    def start(self, boot_timeout_s: float = 600.0, **loop_kw) -> None:
+    def start(
+        self,
+        boot_timeout_s: float = 600.0,
+        mode: str | None = None,
+        **loop_kw,
+    ) -> None:
         # default boot budget is generous: tile on_boot warms device
         # compile caches, and first compiles are slow (tens of seconds)
+        runtime = self._resolve_runtime(mode)
         if self.wksp is None:
-            self.build()
-        for name, ts in self.tiles.items():
-            t = threading.Thread(
-                target=self._tile_main, args=(ts, loop_kw), name=f"tile:{name}"
+            self.build(runtime=runtime)
+        elif runtime != self._runtime:
+            raise RuntimeError(
+                f"topology built for runtime {self._runtime!r}; cannot "
+                f"start as {runtime!r} (the process runtime changes the "
+                f"workspace layout — set it before build())"
             )
-            t.daemon = True
-            ts.thread = t
-            t.start()
+        self._loop_kw = dict(loop_kw)
+        if runtime == "process":
+            self._start_process(boot_timeout_s)
+            return
+        for name in self.tiles:
+            self._spawn_tile(name)
         # wait for every tile to reach RUN (or fail during boot)
         deadline = time.monotonic() + boot_timeout_s
         for name, ts in self.tiles.items():
@@ -438,16 +655,173 @@ class Topology:
         # etc.) must appear in the directory the monitor attaches to
         self.export_manifest()
 
+    # ---- process runtime -------------------------------------------------
+
+    def _start_process(self, boot_timeout_s: float) -> None:
+        # publish BEFORE spawn: children reconstruct their endpoints
+        # from the directory's boot manifest (child on_boot allocations
+        # land in per-tile shm arenas, so no re-publish is needed for
+        # monitors — the arena name tables live in shared memory)
+        self.export_manifest()
+        for name in self.tiles:
+            self._spawn_tile(name)
+        deadline = time.monotonic() + boot_timeout_s
+        for name, ts in self.tiles.items():
+            cnc = self._cncs[name]
+            while cnc.signal_query() == R.CNC_BOOT:
+                if ts.error is not None:  # proc_safe=False thread tile
+                    self.halt()
+                    raise ts.error
+                p = ts.proc
+                if p is not None and not p.is_alive():
+                    # died before reaching RUN or FAIL (spawn/import
+                    # crash): the err sidecar carries the traceback
+                    err = _read_err(self.name, name)
+                    rc = p.exitcode  # before halt() reaps/closes it
+                    self.halt()
+                    raise RuntimeError(
+                        f"tile {name!r} process died during boot "
+                        f"(exitcode {rc})"
+                        + (f":\n{err}" if err else "")
+                    )
+                if time.monotonic() > deadline:
+                    self.halt()
+                    raise TimeoutError(f"tile {name!r} stuck in BOOT")
+                time.sleep(1e-3)
+            if cnc.signal_query() == R.CNC_FAIL:
+                p = ts.proc
+                if p is not None:
+                    p.join(timeout=10.0)
+                    booted = bool(self._pstat(name)[PSTAT_BOOTED])
+                elif ts.thread is not None:
+                    ts.thread.join(timeout=10.0)
+                    booted = ts.ctx.booted
+                else:
+                    booted = False
+                if not booted:
+                    # construction error (bad config, missing device) —
+                    # same classification as the thread runtime, read
+                    # from the pstat shm word instead of ctx.booted
+                    err = _read_err(self.name, name)
+                    self.halt()
+                    if ts.error is not None:
+                        raise ts.error
+                    raise RuntimeError(
+                        f"tile {name!r} failed during boot"
+                        + (f":\n{err}" if err else "")
+                    )
+        # re-publish after boot (atomic rename, safe under concurrent
+        # attaches): parent-thread OBSERVER tiles' on_boot allocations
+        # go to the workspace alloc table — the same post-boot
+        # re-export invariant the thread runtime keeps.  Child-side
+        # allocations need no re-export (arena name tables are in shm).
+        self.export_manifest()
+
+    def _pstat(self, name: str) -> np.ndarray:
+        return self.wksp.view(f"pstat_{name}")[: 4 * 8].view(np.uint64)
+
+    def tile_pid(self, name: str) -> int | None:
+        """The tile's child pid (process runtime; None for threads)."""
+        ts = self.tiles[name]
+        if ts.proc is None:
+            return None
+        pid = int(self._pstat(name)[PSTAT_PID])
+        return pid or ts.proc.pid
+
+    def _spawn_tile(self, name: str, replay: int = 0) -> None:
+        """Spawn one tile in the resolved runtime (process children, or
+        threads for proc_safe=False observers).  Shared by start() and
+        the supervisor's restart path; `replay` is the reliable-link
+        rejoin rewind the CHILD applies (tango.rings.consumer_rejoin)
+        when its incarnation > 0."""
+        ts = self.tiles[name]
+        ts.error = None
+        if self._runtime != "process" or not ts.tile.proc_safe:
+            t = threading.Thread(
+                target=self._tile_main,
+                args=(ts, self._loop_kw),
+                name=f"tile:{name}",
+            )
+            t.daemon = True
+            ts.thread = t
+            t.start()
+            return
+        import multiprocessing as mp
+
+        # fresh incarnation contract: parent owns the incarnation word,
+        # child owns pid/booted — clear the child-owned words and the
+        # stale crash report before the new incarnation starts
+        pstat = self._pstat(name)
+        pstat[PSTAT_INCARNATION] = np.uint64(ts.ctx.incarnation)
+        pstat[PSTAT_PID] = 0
+        pstat[PSTAT_BOOTED] = 0
+        try:
+            os.unlink(_err_path(self.name, name))
+        except OSError:
+            pass
+        mpctx = mp.get_context(self._spawn_method())
+        p = mpctx.Process(
+            target=_tile_process_main,
+            args=(
+                self.name,
+                name,
+                ts.tile,
+                self._loop_kw,
+                ts.ctx.incarnation,
+                replay,
+                self.faults_spec,
+            ),
+            name=f"tile:{name}",
+            daemon=True,
+        )
+        ts.proc = p
+        p.start()
+
+    def _reap(self, ts: TileSpec, timeout_s: float) -> None:
+        """Join a child with bounded escalation: HALT should have ended
+        it; a survivor gets SIGTERM then SIGKILL, and the handle is
+        always closed so no zombie outlives the topology (children that
+        died mid-boot are reaped the same way — join on a dead process
+        returns immediately)."""
+        p = ts.proc
+        if p is None:
+            return
+        p.join(timeout=timeout_s)
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=5.0)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=5.0)
+        try:
+            p.close()
+        except ValueError:
+            pass  # still alive after SIGKILL: unkillable (D-state); leak
+        ts.proc = None
+
     def poll_failure(self) -> None:
         """Fail-stop check: if any tile died, halt everything and re-raise."""
         for name, ts in self.tiles.items():
             if ts.error is not None:
                 self.halt()
                 raise RuntimeError(f"tile {name!r} failed") from ts.error
+            p = ts.proc
+            if p is None:
+                continue
+            sig = self._cncs[name].signal_query()
+            if sig == R.CNC_FAIL or (sig == R.CNC_RUN and not p.is_alive()):
+                err = _read_err(self.name, name)
+                self.halt()
+                raise RuntimeError(
+                    f"tile {name!r} process failed"
+                    + (f":\n{err}" if err else "")
+                )
 
     def halt(self, timeout_s: float = 30.0) -> None:
         """Halt upstream-first so in-flight frags drain before consumers
-        stop."""
+        stop.  Process children are reaped with bounded SIGTERM→SIGKILL
+        escalation (a child that died mid-boot is reaped the same way),
+        so repeated bench runs never accumulate zombies."""
         order = self._topo_order()
         for name in order:
             cnc = self._cncs.get(name)
@@ -455,6 +829,8 @@ class Topology:
                 continue
             cnc.signal(R.CNC_HALT)
             ts = self.tiles[name]
+            if ts.proc is not None:
+                self._reap(ts, timeout_s)
             if ts.thread is not None:
                 ts.thread.join(timeout=timeout_s)
 
@@ -490,7 +866,197 @@ class Topology:
         when profiling is off."""
         return {name: p.m for name, p in self._profilers.items()}
 
+    def tile_alloc_view(self, tile: str, name: str) -> np.ndarray:
+        """Resolve a tile's ctx.alloc region by name from the PARENT
+        (tests, benches): the per-tile shm arena in the process
+        runtime, the workspace alloc table in the threaded one, the
+        ctx-local buffer for anonymous thread topologies."""
+        key = f"{tile}_{name}"
+        if self._runtime == "process" and self.tiles[tile].tile.proc_safe:
+            # join=True: read-only attach — never initialize the header
+            # (that is the owning child's job; racing it would corrupt
+            # the name table)
+            return R.WkspArena(
+                self.wksp.view(f"arena_{tile}"), join=True
+            ).view(key)
+        if self.wksp is not None and key in self.wksp._allocs:
+            return self.wksp.view(key)
+        return self.tiles[tile].ctx._local_allocs[key]
+
     def close(self) -> None:
+        # reap stragglers first (failed starts, children dead mid-boot):
+        # unlinking shm under a live child is POSIX-safe but the zombie
+        # and its err sidecar must not outlive the topology
+        for ts in self.tiles.values():
+            if ts.proc is not None:
+                self._reap(ts, timeout_s=1.0)
         if self.wksp is not None:
             self.wksp.unlink()
             self.wksp = None
+
+
+# ---------------------------------------------------------------------------
+# process-runtime child entrypoint
+#
+# Runs in a FRESH interpreter (spawn) or forked child: re-attach the named
+# workspace, rebind every endpoint by boot-manifest name, rebuild the
+# MuxCtx, rejoin the rings if this is a re-incarnation, and enter the
+# SAME run loop the threaded runtime uses — the ring protocol itself is
+# process-safe (fdtmc-verified), so nothing below the ctx changes.
+
+
+def _tile_process_main(
+    wksp_name: str,
+    tile_name: str,
+    tile: Tile,
+    loop_kw: dict,
+    incarnation: int,
+    replay: int,
+    faults_spec: tuple | None,
+) -> None:
+    import sys
+    import traceback
+
+    from firedancer_tpu.utils import log
+
+    log.set_tile(tile_name)
+    err_path = _err_path(wksp_name, tile_name)
+    ctx = None
+    cnc = None
+    pstat = None
+    try:
+        ws, extra = R.Workspace.attach(wksp_name)
+        boot = extra["boot"]
+        links = boot["links"]
+        t = boot["tiles"][tile_name]
+        pstat = ws.view(t["pstat"])[: 4 * 8].view(np.uint64)
+        pstat[PSTAT_PID] = os.getpid()
+        mcaches: dict[str, R.MCache] = {}
+        dcaches: dict[str, R.DCache] = {}
+
+        def _mc(ln: str) -> R.MCache:
+            if ln not in mcaches:
+                mcaches[ln] = R.MCache(
+                    ws.view(links[ln]["mcache"]), links[ln]["depth"],
+                    join=True,
+                )
+            return mcaches[ln]
+
+        def _dc(ln: str, producer: bool = False) -> R.DCache | None:
+            spec = links[ln]
+            if spec["dcache"] is None:
+                return None
+            if ln not in dcaches:
+                dcaches[ln] = R.DCache(
+                    ws.view(spec["dcache"]), spec["mtu"], spec["depth"]
+                )
+            dc = dcaches[ln]
+            if producer and spec["dcur"] is not None:
+                dc.bind_cursor(ws.view(spec["dcur"]))
+            return dc
+
+        cnc = R.CNC(ws.view(t["cnc"]), join=True)
+        sch = t["schema"]
+        schema = MetricsSchema(
+            counters=tuple(sch["counters"]),
+            hists=tuple(sch["hists"]),
+            wide_hists=tuple(sch.get("wide_hists", ())),
+        )
+        metrics = Metrics(ws.view(t["metrics"]), schema)
+        tracer = None
+        if boot.get("trace") is not None and t["trace"] is not None:
+            ring = SpanRing(ws.view(t["trace"]), join=True)
+            tracer = Tracer(ring, boot["trace"]["sample"], name=tile_name)
+        profiler = None
+        if t["profile"] is not None:
+            from .profile import PROFILE_SCHEMA, TileProfiler
+
+            profiler = TileProfiler(
+                Metrics(ws.view(t["profile"]), PROFILE_SCHEMA)
+            )
+        ins = [
+            InLink(
+                ln,
+                _mc(ln),
+                _dc(ln),
+                R.FSeq(
+                    ws.view(
+                        next(
+                            c[2]
+                            for c in links[ln]["consumers"]
+                            if c[0] == tile_name
+                        )
+                    ),
+                    join=True,
+                ),
+                bool(rel),
+                link_id=links[ln]["id"],
+                h_qwait=f"qwait_us_{ln}",
+                h_svc=f"svc_us_{ln}",
+                h_e2e=f"e2e_us_{ln}",
+            )
+            for ln, rel in t["ins"]
+        ]
+        outs = [
+            OutLink(
+                ln,
+                _mc(ln),
+                _dc(ln, producer=True),
+                [
+                    R.FSeq(ws.view(c[2]), join=True)
+                    for c in links[ln]["consumers"]
+                    if c[1]
+                ],
+                link_id=links[ln]["id"],
+                tracer=tracer,
+            )
+            for ln in t["outs"]
+        ]
+        ctx = MuxCtx(tile_name, cnc, ins, outs, metrics, wksp=ws)
+        ctx.tracer = tracer
+        ctx.profiler = profiler
+        ctx.arena = R.WkspArena(ws.view(t["arena"]))
+        ctx.incarnation = incarnation
+        if faults_spec is not None:
+            from .faultinj import FaultInjector
+
+            seed, faults = faults_spec
+            tf = FaultInjector(seed=seed, faults=faults).view(tile_name)
+            # cumulative trigger state lives in shm so a restarted
+            # incarnation does not re-fire already-fired faults
+            tf.bind_shm(ws.view(t["fstat"]))
+            ctx.faults = tf
+        if incarnation > 0:
+            # ring rejoin runs IN the child (the dead incarnation's seqs
+            # live in the shm fseqs/mcaches, so the repair is derivable
+            # here) — same helper, and the same loss accounting, as the
+            # thread runtime's supervisor-side rejoin
+            from .supervisor import rejoin_links
+
+            def _account_skip(il, skipped):
+                metrics.inc("overrun_frags", skipped)
+                il.fseq.diag_add(0, skipped)
+
+            rejoin_links(
+                ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip
+            )
+        run_loop(tile, ctx, **loop_kw)
+    except BaseException:  # noqa: BLE001 — fail-stop, reported via shm
+        try:
+            with open(err_path, "w") as f:
+                f.write(traceback.format_exc())
+        except OSError:
+            pass
+        booted = bool(ctx is not None and ctx.booted)
+        if pstat is not None:
+            pstat[PSTAT_BOOTED] = 1 if booted else 0
+        # run_loop signals FAIL for its own exceptions; cover crashes
+        # before/outside it so the parent's cnc wait always resolves
+        if cnc is not None and cnc.signal_query() != R.CNC_FAIL:
+            cnc.signal(R.CNC_FAIL)
+        log.err("tile process failed: see %s", err_path)
+        # exit code mirrors the thread runtime's boot/run classification
+        sys.exit(2 if not booted else 1)
+    else:
+        if pstat is not None:
+            pstat[PSTAT_BOOTED] = 1

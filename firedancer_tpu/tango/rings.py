@@ -411,8 +411,12 @@ class Workspace:
             "allocs": {k: list(v) for k, v in self._allocs.items()},
             "extra": extra or {},
         }
-        with open(self._dir_path(), "w") as f:
+        # write-then-rename: a concurrent attach (a spawning tile child,
+        # a monitor) must never read a truncated in-place rewrite
+        tmp = self._dir_path() + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(doc, f)
+        os.replace(tmp, self._dir_path())
 
     @classmethod
     def attach(cls, name: str) -> tuple["Workspace", dict]:
@@ -443,11 +447,137 @@ class Workspace:
     def unlink(self) -> None:
         self.close()
         if self.name is not None:
-            for p in (self._path, self._dir_path()):
+            import glob
+
+            for p in (self._path, self._dir_path(),
+                      self._dir_path() + ".tmp"):
                 try:
                     os.unlink(p)
                 except FileNotFoundError:
                     pass
+            # per-tile sidecar files (child-process error reports) share
+            # the workspace prefix; a close must never leak them — bench
+            # reruns on the same host would otherwise accumulate stale
+            # /dev/shm entries (the leak the process-runtime test
+            # fixture asserts against)
+            for p in glob.glob(f"/dev/shm/fdt_wksp_{self.name}.err_*"):
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+
+
+class WkspArena:
+    """A tile-private named sub-allocator INSIDE a workspace region,
+    with its name table in the shared memory itself.
+
+    The process-per-tile runtime needs tiles to allocate observable
+    state (dedup's tcache, sink sig logs) from a CHILD process, but an
+    attached Workspace cannot allocate (two children bumping the same
+    host-side cursor would hand out overlapping regions).  Each tile
+    instead gets one arena region, pre-sized by the topology from
+    Tile.wksp_footprint(), and carves it with this allocator.  The
+    name -> (offset, footprint) table lives in the region's header —
+    single writer (the owning tile), torn-read tolerant — so the
+    parent, monitors, and tests can resolve a tile's allocations by
+    name without replaying the tile's allocation order.
+
+    Same contracts as Workspace.alloc: idempotent by name with the
+    footprint checked, so a restarted incarnation re-running on_boot
+    REJOINS its regions (what lets dedup's tag cache survive a child
+    kill) instead of leaking copies.
+    """
+
+    MAGIC = 0x46445441414E4552  # "FDTAANER"
+    NAME_BYTES = 40
+    _ENT_WORDS = 7  # 5 name words + off + fp
+    _HDR_WORDS = 4  # magic, capacity, count, data_off(words)
+
+    def __init__(
+        self, mem_u8: np.ndarray, max_entries: int = 64,
+        join: bool = False,
+    ):
+        """join=False: the OWNING tile — initialize the header if this
+        is the region's first use (a restarted owner finds the magic
+        and rejoins).  join=True: a READER (parent/monitor) — never
+        write the header; raises if the owner has not initialized yet
+        (a reader that auto-initialized would race the owner's header
+        stores)."""
+        self.mem = mem_u8
+        self.words = mem_u8[: (len(mem_u8) // 8) * 8].view(np.uint64)
+        if int(self.words[0]) == self.MAGIC:
+            # live arena (attach, or a restarted owner rejoining)
+            self.capacity = int(self.words[1])
+        elif join:
+            raise RuntimeError(
+                "arena not initialized yet (owning tile has not booted)"
+            )
+        else:
+            self.capacity = max_entries
+            self.words[1] = max_entries
+            self.words[2] = 0
+            self.words[3] = self._HDR_WORDS + max_entries * self._ENT_WORDS
+            # magic last: an attacher that sees it sees a full header
+            self.words[0] = np.uint64(self.MAGIC)
+        self._data0 = int(self.words[3]) * 8
+
+    @classmethod
+    def footprint(cls, data_bytes: int, max_entries: int = 64) -> int:
+        """Region size for `data_bytes` of payload: header + name table
+        + payload + per-alloc alignment slack."""
+        hdr = (cls._HDR_WORDS + max_entries * cls._ENT_WORDS) * 8
+        return hdr + int(data_bytes) + 128 * max_entries
+
+    def _entry(self, i: int) -> tuple[str, int, int]:
+        base = self._HDR_WORDS + i * self._ENT_WORDS
+        raw = self.words[base : base + 5].tobytes()
+        name = raw.rstrip(b"\0").decode("utf-8", "replace")
+        return name, int(self.words[base + 5]), int(self.words[base + 6])
+
+    def names(self) -> list[str]:
+        return [self._entry(i)[0] for i in range(int(self.words[2]))]
+
+    def alloc(self, name: str, footprint: int, align: int = 128) -> np.ndarray:
+        enc = name.encode()
+        if len(enc) > self.NAME_BYTES:
+            raise ValueError(f"arena alloc name too long: {name!r}")
+        n = int(self.words[2])
+        off_end = self._data0
+        for i in range(n):
+            nm, off, fp = self._entry(i)
+            if nm == name:
+                if fp != footprint:
+                    raise ValueError(
+                        f"arena realloc of {name!r} with footprint "
+                        f"{footprint} != existing {fp}"
+                    )
+                return self.mem[off : off + fp]
+            off_end = max(off_end, off + fp)
+        if n >= self.capacity:
+            raise MemoryError(f"arena name table full allocating {name!r}")
+        off = (off_end + align - 1) & ~(align - 1)
+        if off + footprint > len(self.mem):
+            raise MemoryError(
+                f"arena full allocating {name!r} ({footprint}B; "
+                f"did the tile's wksp_footprint() under-report?)"
+            )
+        base = self._HDR_WORDS + n * self._ENT_WORDS
+        self.words[base : base + 5] = np.frombuffer(
+            enc.ljust(self.NAME_BYTES, b"\0"), np.uint64
+        )
+        self.words[base + 5] = off
+        self.words[base + 6] = footprint
+        # count last (release order): a reader never sees a half-written
+        # entry as live
+        self.words[2] = np.uint64(n + 1)
+        return self.mem[off : off + footprint]
+
+    def view(self, name: str) -> np.ndarray:
+        for i in range(int(self.words[2])):
+            nm, off, fp = self._entry(i)
+            if nm == name:
+                return self.mem[off : off + fp]
+        raise KeyError(name)
 
 
 # ---------------------------------------------------------------------------
@@ -579,7 +709,37 @@ class DCache:
         self.mtu = mtu
         self.depth = depth
         self.wmark_chunks = len(mem) // CHUNK_SZ
-        self.chunk = 0  # producer cursor
+        #: producer cursor — host-local by default; bind_cursor() backs
+        #: it with a shared-memory word for cross-process producers
+        self._cursor_mem: np.ndarray | None = None
+        self._chunk = 0
+
+    @property
+    def chunk(self) -> int:
+        if self._cursor_mem is not None:
+            return int(self._cursor_mem[0])
+        return self._chunk
+
+    @chunk.setter
+    def chunk(self, v: int) -> None:
+        if self._cursor_mem is not None:
+            self._cursor_mem[0] = np.uint64(v)
+        else:
+            self._chunk = v
+
+    def bind_cursor(self, mem: np.ndarray) -> None:
+        """Back the producer cursor with a u64 workspace word, so a
+        producer PROCESS that crashes and re-attaches resumes at its
+        published position instead of rewinding to chunk 0 — rewinding
+        would scatter new payloads over chunks that in-flight frag
+        metas still reference.  (Thread-mode restarts keep the Python
+        object, so the plain attribute is already restart-safe there.)
+        The word is written only by the producing tile; first bind
+        seeds it from the current host-side cursor."""
+        cur = self.chunk
+        self._cursor_mem = mem[:8].view(np.uint64)
+        if int(self._cursor_mem[0]) == 0 and cur:
+            self._cursor_mem[0] = np.uint64(cur)
 
     @staticmethod
     def footprint(mtu: int, depth: int) -> int:

@@ -14,7 +14,7 @@ power-of-two histograms (disco/metrics.py layout).
 from __future__ import annotations
 
 from firedancer_tpu.ballet.http import HttpServer
-from firedancer_tpu.disco.metrics import HIST_BUCKETS, Metrics, MetricsSchema
+from firedancer_tpu.disco.metrics import Metrics, MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
 
 
@@ -29,7 +29,9 @@ def render_prometheus(tiles: dict[str, Metrics]) -> bytes:
             h = m.hist(hname)
             out.append(f"# TYPE fdt_{tile}_{hname} histogram")
             cum = 0
-            for b in range(HIST_BUCKETS):
+            # width-agnostic: wide hists (sched_lag_us-class) carry
+            # more than HIST_BUCKETS buckets
+            for b in range(len(h["buckets"])):
                 cum += h["buckets"][b]
                 le = (1 << (b + 1)) - 1
                 out.append(
@@ -50,6 +52,10 @@ class MetricTile(Tile):
 
     name = "metric"
     schema = MetricsSchema(counters=("scrapes", "bad_requests"))
+    #: observer tile: closes over the topology's registry callable, so
+    #: it stays a parent THREAD under the process runtime (it only
+    #: reads shared memory — no isolation is lost)
+    proc_safe = False
 
     def __init__(
         self,

@@ -34,6 +34,12 @@ from firedancer_tpu.disco.shredder import EntryBatchMeta, Shredder
 from firedancer_tpu.tiles.poh import SLOT_BOUNDARY_TAG
 
 
+def _null_signer(root) -> bytes:
+    """Placeholder Shredder signer (real signatures arrive via the
+    keyguard rings); module-level so the tile stays spawn-picklable."""
+    return b"\0" * 64
+
+
 def shred_tag(slot: int, idx: int, is_code: bool) -> int:
     """Frag sig for a shred: slot<<32 | code_bit<<31 | idx."""
     return (slot << 32) | (int(is_code) << 31) | idx
@@ -77,7 +83,10 @@ class ShredTile(Tile):
         self.signer = signer
         self.shred_dest = shred_dest
         self.identity = identity
-        self._shredder = Shredder(shred_version, signer=lambda root: b"\0" * 64)
+        # _null_signer (module-level, picklable) instead of a ctor
+        # lambda: the tile object must survive the process runtime's
+        # spawn pickle (fdtlint proc-safe-tile)
+        self._shredder = Shredder(shred_version, signer=_null_signer)
         self._slot: int | None = None
         self._batch = bytearray()
         #: FEC sets waiting for their root signature: tag -> (slot, FecSet)

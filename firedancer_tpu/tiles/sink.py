@@ -1,7 +1,19 @@
 """Sink tile: terminal consumer that counts (and optionally records) frags.
 
 Test/bench helper — the analog of the rx tiles the reference's multi-tile
-concurrency tests spawn (src/disco/dedup/test_dedup.c:654-660)."""
+concurrency tests spawn (src/disco/dedup/test_dedup.c:654-660).
+
+Two recording surfaces:
+  * record=True — host-side lists (sigs/payloads/sizes), readable via
+    all_sigs() from the same process.  Thread runtime only: in the
+    process runtime the lists fill in the CHILD and the parent's copy
+    stays empty.
+  * shm_log=N — a sig log IN THE WORKSPACE (ctx.alloc region: cursor
+    word + N u64 slots), written by the sink and readable from ANY
+    process via Topology.tile_alloc_view(name, "siglog") +
+    read_siglog().  This is what the process-runtime parity/chaos
+    checks diff across runtimes.
+"""
 
 from __future__ import annotations
 
@@ -12,17 +24,66 @@ import numpy as np
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
 
+SIGLOG_ALLOC = "siglog"
+
+#: guards SinkTile's lazy per-instance Lock creation: two threads (the
+#: mux loop in on_frags, a test in all_sigs) racing the first access
+#: must end up sharing ONE lock, or mutual exclusion is silently lost
+_LOCK_INIT = threading.Lock()
+
+
+def siglog_footprint(cap: int) -> int:
+    return 8 * (1 + cap)
+
+
+def read_siglog(mem: np.ndarray) -> np.ndarray:
+    """Decode a sink shm sig log region: the first min(cursor, cap)
+    recorded sigs (the log is a truncating append, not a ring — parity
+    checks need exact prefixes, so overflow drops the tail and the
+    cursor keeps counting for the caller to notice)."""
+    words = mem[: (len(mem) // 8) * 8].view(np.uint64)
+    cap = len(words) - 1
+    n = min(int(words[0]), cap)
+    return words[1 : 1 + n].copy()
+
 
 class SinkTile(Tile):
     schema = MetricsSchema(counters=("sunk_frags",), hists=("latency_us",))
 
-    def __init__(self, *, record: bool = False, name: str = "sink"):
+    def __init__(
+        self,
+        *,
+        record: bool = False,
+        shm_log: int = 0,
+        name: str = "sink",
+    ):
         self.name = name
         self.record = record
+        self.shm_log = int(shm_log)
         self.sigs: list[np.ndarray] = []
         self.payloads: list[np.ndarray] = []
         self.sizes: list[np.ndarray] = []
-        self.lock = threading.Lock()
+        # NOT created here: a Lock captured by the ctor would not
+        # survive the process runtime's spawn pickle (the fdtlint
+        # proc-safe-tile rule); created on first use instead
+        self._lock: threading.Lock | None = None
+        self._slog: np.ndarray | None = None
+
+    @property
+    def lock(self) -> threading.Lock:
+        if self._lock is None:
+            with _LOCK_INIT:
+                if self._lock is None:
+                    self._lock = threading.Lock()
+        return self._lock
+
+    def wksp_footprint(self) -> int:
+        return siglog_footprint(self.shm_log) if self.shm_log else 0
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        if self.shm_log:
+            mem = ctx.alloc(SIGLOG_ALLOC, siglog_footprint(self.shm_log))
+            self._slog = mem[: (len(mem) // 8) * 8].view(np.uint64)
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         ctx.metrics.inc("sunk_frags", len(frags))
@@ -34,6 +95,17 @@ class SinkTile(Tile):
 
         lat = np.maximum(ts_diff_arr(now_ts(), frags["tsorig"]), 0)
         ctx.metrics.hist_sample_many("latency_us", lat)
+        if self._slog is not None:
+            w = self._slog
+            cap = len(w) - 1
+            cur = int(w[0])
+            keep = frags["sig"][: max(cap - cur, 0)]
+            if len(keep):
+                w[1 + cur : 1 + cur + len(keep)] = keep
+            # cursor counts EVERY sig (overflow visible to readers);
+            # bumped after the stores so a concurrent reader never sees
+            # slots it could misread as live
+            w[0] = np.uint64(cur + len(frags))
         if self.record:
             rows = ctx.ins[in_idx].gather(frags)
             with self.lock:

@@ -1,11 +1,12 @@
 #!/usr/bin/env python3
-"""checkall — the one-shot local gate: fdtlint + bounded fdtmc + the
-tier-1 pytest suite, aggregated into one exit code.
+"""checkall — the one-shot local gate: fdtlint + bounded fdtmc + a
+process-runtime smoke + the tier-1 pytest suite, aggregated into one
+exit code.
 
 Usage:
-    scripts/checkall.py                 # all three stages
+    scripts/checkall.py                 # all four stages
     scripts/checkall.py --json          # machine-readable summary
-    scripts/checkall.py --skip mc       # skip stages (lint,mc,pytest)
+    scripts/checkall.py --skip mc       # skip stages (lint,mc,proc,pytest)
     scripts/checkall.py --mc-budget 200 # bound the model checker
     scripts/checkall.py --pytest-timeout 1200
 
@@ -80,6 +81,37 @@ def _stage_mc(budget: int, timeout_s: float) -> dict:
     return stage
 
 
+def _stage_proc(timeout_s: float) -> dict:
+    """Process-runtime smoke: a small pipeline under one-process-per-
+    tile (scripts/proc_smoke.py) — end-to-end delivery, clean child
+    reaping, and the no-shm-leak assertion."""
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rc, out = _run(
+        [
+            sys.executable, str(REPO / "scripts" / "proc_smoke.py"),
+            "--runtime", "process", "--txns", "512", "--json",
+        ],
+        timeout_s, env=env,
+    )
+    stage = {"rc": rc, "seconds": round(time.perf_counter() - t0, 2)}
+    try:
+        # combined stdout+stderr: the JSON result is the one line that
+        # parses (proc_smoke prints it compact, single-line)
+        doc = next(
+            json.loads(ln)
+            for ln in out.splitlines()
+            if ln.startswith("{") and ln.rstrip().endswith("}")
+        )
+        stage["landed"] = doc.get("landed")
+        stage["tps"] = doc.get("tps")
+        stage["shm_leak"] = doc.get("shm_leak")
+    except Exception:  # noqa: BLE001 — non-JSON tail is fine on rc != 0
+        stage["tail"] = out[-2000:]
+    return stage
+
+
 def _stage_pytest(timeout_s: float, extra: list[str]) -> dict:
     t0 = time.perf_counter()
     env = dict(os.environ)
@@ -109,16 +141,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
-                    help="comma list of stages to skip: lint,mc,pytest")
+                    help="comma list of stages to skip: lint,mc,proc,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
+    ap.add_argument("--proc-timeout", type=float, default=600.0)
     ap.add_argument("--pytest-timeout", type=float, default=1800.0)
     ap.add_argument("--pytest-args", default="",
                     help="extra args appended to the pytest command")
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
-    bad = skip - {"lint", "mc", "pytest"}
+    bad = skip - {"lint", "mc", "proc", "pytest"}
     if bad:
         print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
         return 2
@@ -135,6 +168,12 @@ def main(argv: list[str] | None = None) -> int:
         if not args.json:
             print(f"checkall mc: rc={stages['mc']['rc']} "
                   f"({stages['mc']['seconds']}s)", flush=True)
+    if "proc" not in skip:
+        stages["proc"] = _stage_proc(args.proc_timeout)
+        if not args.json:
+            print(f"checkall proc: rc={stages['proc']['rc']} "
+                  f"({stages['proc'].get('landed', '?')} landed, "
+                  f"{stages['proc']['seconds']}s)", flush=True)
     if "pytest" not in skip:
         stages["pytest"] = _stage_pytest(
             args.pytest_timeout, args.pytest_args.split()

@@ -1,0 +1,262 @@
+#!/usr/bin/env python3
+"""proc_smoke — process-runtime smoke gate + threaded-vs-process A/B.
+
+Smoke (default): run a small synth → verify(host) → dedup → sink
+pipeline under `Topology.start(mode=...)`, assert end-to-end delivery
+(every unique txn lands exactly once, counted via the sink's shm sig
+log so the check works cross-process), assert clean shutdown, and
+assert no /dev/shm/fdt_wksp_* leak.  `scripts/checkall.py` runs this as
+its process-mode stage.
+
+A/B (--ab): run PARALLEL RELAY CHAINS (synth → dedup → sink, pure
+tango/interpreter work — the round-3b "host pipeline caps on pure GIL
+contention" shape) with the run-loop profiler enabled in both runtimes
+and print the contended-interpreter keys side by side — gil_wait_frac,
+sched_lag_p99_us, relay tps — the measurement contract of the ISSUE 7
+refactor (PROFILE.md round 9).
+
+Usage:
+    scripts/proc_smoke.py [--runtime thread|process] [--txns N] [--json]
+    scripts/proc_smoke.py --ab [--txns N] [--json]
+
+Exit status: 0 ok, 1 check failed, 2 crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def run_pipeline(
+    runtime: str,
+    n_txns: int = 2048,
+    repeat: int = 2,
+    profile: bool = False,
+    deadline_s: float = 180.0,
+) -> dict:
+    """One pipeline run; returns {ok, tps, landed, unique, ...}."""
+    import numpy as np  # noqa: F401  (env sanity before topology work)
+
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.dedup import DedupTile
+    from firedancer_tpu.tiles.sink import SinkTile, read_siglog
+    from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+    from firedancer_tpu.tiles.verify import VerifyTile
+
+    total = n_txns * repeat
+    rows, szs, _ = make_txn_pool(n_txns, seed=7)
+    topo = Topology(
+        name=f"smoke{os.getpid()}_{runtime[:4]}", runtime=runtime
+    )
+    if profile:
+        topo.enable_profile()
+    topo.link("synth_verify", depth=1 << 12, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=1 << 12, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=1 << 12, mtu=wire.LINK_MTU)
+    synth = SynthTile(rows, szs, total=total, repeat=repeat)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=512, pre_dedup=False, device="off"
+    )
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
+    topo.tile(
+        DedupTile(depth=1 << 14), ins=[("verify_dedup", True)],
+        outs=["dedup_sink"],
+    )
+    topo.tile(
+        SinkTile(shm_log=max(2 * n_txns, 1 << 12)),
+        ins=[("dedup_sink", True)],
+    )
+    out: dict = {"runtime": runtime, "sent": total, "ok": False}
+    topo.build()
+    t0 = time.perf_counter()
+    topo.start(batch_max=512, boot_timeout_s=600.0)
+    boot_s = time.perf_counter() - t0
+    try:
+        t0 = time.perf_counter()
+        deadline = t0 + deadline_s
+        md = topo.metrics("dedup")
+        ms = topo.metrics("sink")
+        while time.perf_counter() < deadline:
+            topo.poll_failure()
+            # gate on the SINK too: reading the siglog on dedup
+            # progress alone races the last dedup->sink hop
+            if (
+                md.counter("in_frags") >= total
+                and ms.counter("in_frags") >= n_txns
+            ):
+                break
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        sigs = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+        uniq = set(sigs.tolist())
+        topo.halt()
+        out.update(
+            boot_s=round(boot_s, 2),
+            seconds=round(dt, 3),
+            tps=round(md.counter("in_frags") / dt, 1) if dt else 0.0,
+            landed=len(sigs),
+            unique=len(uniq),
+            dups_dropped=topo.metrics("dedup").counter("dup_txns"),
+            verify_fail=topo.metrics("verify").counter(
+                "verify_fail_txns"
+            ),
+        )
+        if profile:
+            from firedancer_tpu.disco.profile import aggregate
+
+            agg = aggregate(topo.profile_metrics())
+            out["gil_wait_frac"] = agg["gil_wait_frac"]
+            out["sched_lag_p99_us"] = agg["sched_lag_p99_us"]
+        out["ok"] = (
+            md.counter("in_frags") >= total
+            and len(uniq) == n_txns
+            and len(sigs) == len(uniq)
+        )
+    finally:
+        topo.close()
+    leaked = glob.glob(f"/dev/shm/fdt_wksp_{topo.name}*")
+    out["shm_leak"] = leaked
+    if leaked:
+        out["ok"] = False
+    return out
+
+
+def run_relay_ab(
+    runtime: str,
+    n_chains: int = 2,
+    total: int = 200_000,
+    deadline_s: float = 180.0,
+) -> dict:
+    """Parallel relay chains, profiled: every tile's per-iteration work
+    is Python/tango bytecode (no numpy heavy ops that would release the
+    GIL), so the threaded runtime serializes the chains on the
+    interpreter while the process runtime runs them on real cores.
+    idle_sleep is coarsened to 1 ms: the loop's default 50 µs sleep-spin
+    is GIL-throttled under threads but burns REAL cores as processes —
+    idle wakeup rate is a bench knob, not a protocol constant."""
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.dedup import DedupTile
+    from firedancer_tpu.tiles.sink import SinkTile
+    from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+
+    pool_n = 256
+    rows, szs, _ = make_txn_pool(pool_n, seed=7)
+    topo = Topology(name=f"ab{os.getpid()}_{runtime[:4]}", runtime=runtime)
+    topo.enable_profile()
+    for c in range(n_chains):
+        topo.link(f"s{c}", depth=1 << 12, mtu=wire.LINK_MTU)
+        topo.link(f"d{c}", depth=1 << 12, mtu=wire.LINK_MTU)
+        topo.tile(
+            SynthTile(rows, szs, total=total, name=f"synth{c}"),
+            outs=[f"s{c}"],
+        )
+        topo.tile(
+            DedupTile(depth=1 << 20, name=f"dedup{c}"),
+            ins=[(f"s{c}", True)], outs=[f"d{c}"],
+        )
+        topo.tile(SinkTile(name=f"sink{c}"), ins=[(f"d{c}", True)])
+    out: dict = {"runtime": runtime, "chains": n_chains, "ok": False}
+    topo.build()
+    topo.start(batch_max=1024, boot_timeout_s=600.0, idle_sleep_s=1e-3)
+    try:
+        t0 = time.perf_counter()
+        deadline = t0 + deadline_s
+        while time.perf_counter() < deadline:
+            topo.poll_failure()
+            if all(
+                topo.metrics(f"dedup{c}").counter("in_frags") >= total
+                for c in range(n_chains)
+            ):
+                break
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        from firedancer_tpu.disco.profile import aggregate
+
+        agg = aggregate(topo.profile_metrics())
+        topo.halt()
+        done = sum(
+            topo.metrics(f"dedup{c}").counter("in_frags")
+            for c in range(n_chains)
+        )
+        out.update(
+            tps=round(done / dt, 1),
+            gil_wait_frac=agg["gil_wait_frac"],
+            sched_lag_p99_us=agg["sched_lag_p99_us"],
+            ok=done >= n_chains * total,
+        )
+    finally:
+        topo.close()
+    leaked = glob.glob(f"/dev/shm/fdt_wksp_{topo.name}*")
+    out["shm_leak"] = leaked
+    if leaked:
+        out["ok"] = False
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proc_smoke", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--runtime", default="process",
+                    choices=["thread", "process"])
+    ap.add_argument("--txns", type=int, default=2048)
+    ap.add_argument("--repeat", type=int, default=2)
+    ap.add_argument("--ab", action="store_true",
+                    help="run BOTH runtimes with profiling; print the "
+                         "gil_wait/sched_lag/tps A/B")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.ab:
+        doc = {
+            rt: run_relay_ab(rt) for rt in ("thread", "process")
+        }
+        t, p = doc["thread"], doc["process"]
+        doc["speedup"] = (
+            round(p["tps"] / t["tps"], 2) if t.get("tps") else None
+        )
+        doc["ok"] = t["ok"] and p["ok"]
+        if args.json:
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            for rt in ("thread", "process"):
+                r = doc[rt]
+                print(
+                    f"{rt:>8}: tps={r['tps']:,.0f} "
+                    f"gil_wait_frac={r.get('gil_wait_frac')} "
+                    f"sched_lag_p99_us={r.get('sched_lag_p99_us'):,.0f} "
+                    f"ok={r['ok']}"
+                )
+            print(f"speedup: {doc['speedup']}x")
+        return 0 if doc["ok"] else 1
+
+    r = run_pipeline(
+        args.runtime, n_txns=args.txns, repeat=args.repeat
+    )
+    if args.json:
+        print(json.dumps(r, sort_keys=True))
+    else:
+        print(
+            f"proc_smoke [{r['runtime']}]: "
+            f"{'ok' if r['ok'] else 'FAILED'} — landed {r['landed']} "
+            f"({r['unique']} unique of {args.txns}) at {r['tps']:,.0f} "
+            f"frags/s, boot {r['boot_s']}s, leak={r['shm_leak']}"
+        )
+    return 0 if r["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""proc-safe-tile corpus: ctor-captured unpicklable handles + module
+state mutated by a tile.  BAD lines live in UnsafeTile / the module
+dict; the controls (on_boot resources, proc_safe=False observers,
+Worker-layer classes, unmutated module constants) must stay clean."""
+
+import threading
+
+_SEEN_TAGS = {}  # BAD when a tile mutates it (spawn-divergent state)
+
+_LIMITS = {"max": 4096}  # control: read-only module constant
+
+
+class UnsafeTile:
+    name = "unsafe"
+
+    def __init__(self):
+        self.lock = threading.Lock()  # BAD: unpicklable under spawn
+        self.worker = threading.Thread(target=self._run)  # BAD
+        self.on_done = lambda n: n + 1  # BAD: lambda in ctor
+        self.log = open("/dev/null", "w")  # BAD: open file handle
+
+    def _run(self):
+        pass
+
+    def on_frags(self, ctx, in_idx, frags):
+        _SEEN_TAGS[int(frags["sig"][0])] = True  # BAD: module state
+        return _LIMITS["max"]  # control: read is fine
+
+
+class SafeTile:
+    """Control: runtime resources created in on_boot (runs in the
+    child), ctor holds only picklable config."""
+
+    name = "safe"
+
+    def __init__(self, depth: int = 64):
+        self.depth = depth
+        self._lock = None
+
+    def on_boot(self, ctx):
+        self._lock = threading.Lock()
+        self._cb = lambda n: n + 1  # control: child-side callable
+
+    def on_frags(self, ctx, in_idx, frags):
+        pass
+
+
+class ObserverTile:
+    """Control: declares proc_safe = False (stays a parent thread)."""
+
+    name = "observer"
+    proc_safe = False
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.lock = threading.Lock()  # allowed: never spawn-pickled
+
+    def on_frags(self, ctx, in_idx, frags):
+        pass
+
+
+class DeviceWorker:
+    """Control: worker-layer class (created in on_boot, owns threads)."""
+
+    def __init__(self):
+        self.q = threading.Event()
+        self.thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        pass

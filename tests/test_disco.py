@@ -41,6 +41,72 @@ def test_metrics_counters_and_hists():
     assert m2.counter("a") == 6
 
 
+def test_metrics_wide_hist_domain():
+    """sched_lag-class wide hists: 24 buckets, values past the 16-bucket
+    2^16 ceiling stay representable, with the top bucket as the explicit
+    overflow bucket.  Normal hists keep the 16-bucket layout (the two
+    widths coexist in one region)."""
+    from firedancer_tpu.disco.metrics import (
+        WIDE_HIST_BUCKETS,
+        hist_percentile,
+    )
+
+    schema = MetricsSchema(
+        counters=("c",), hists=("narrow", "wide"), wide_hists=("wide",)
+    )
+    mem = np.zeros(Metrics.footprint(schema), dtype=np.uint8)
+    m = Metrics(mem, schema)
+    # 100 ms-class lag (PROFILE.md round 8's clamped regime) and a
+    # sub-ms lag must BOTH be representable in the wide hist
+    m.hist_sample("wide", 100_000)
+    m.hist_sample("wide", 500)
+    m.hist_sample_many("wide", np.array([100_000, 100_000, 100_000]))
+    h = m.hist("wide")
+    assert len(h["buckets"]) == WIDE_HIST_BUCKETS
+    assert h["count"] == 5
+    assert h["buckets"][16] == 4  # 100_000 in [2^16, 2^17) — NOT clamped
+    p99 = hist_percentile(h, 99)
+    assert 65_536 < p99 < 262_144, p99
+    # the narrow hist still clamps at its 16-bucket overflow
+    m.hist_sample("narrow", 100_000)
+    hn = m.hist("narrow")
+    assert len(hn["buckets"]) == 16
+    assert hn["buckets"][15] == 1
+    # overflow bucket: wide values beyond 2^24 land in the top bucket
+    m.hist_sample("wide", 1 << 30)
+    assert m.hist("wide")["buckets"][WIDE_HIST_BUCKETS - 1] == 1
+    # cross-reader parity: a second Metrics over the same region with
+    # the same schema decodes identically (the manifest contract)
+    assert Metrics(mem, schema).hist("wide") == m.hist("wide")
+    # the topology's schema flattening must PRESERVE wideness (a tile
+    # declaring a wide hist whose width silently dropped to 16 buckets
+    # would re-introduce the sched_lag saturation bug per-tile)
+    class _WideTile(Tile):
+        name = "w"
+        schema = MetricsSchema(hists=("x_us",), wide_hists=("x_us",))
+
+    topo = Topology()
+    topo.tile(_WideTile())
+    assert topo._tile_schema(topo.tiles["w"]).wide_hists == ("x_us",)
+
+
+def test_slo_ceiling_bound_derived_from_hist_width():
+    """The slo ceiling-bound check is derived from the storage format:
+    a 16-bucket-unobservable ceiling is rejected loudly, and the bound
+    moved with the hist width (wide domain >= 2^24)."""
+    from firedancer_tpu.disco.slo import (
+        SloConfig,
+        SloEngine,
+        hist_domain_end_us,
+    )
+
+    assert hist_domain_end_us() == float(1 << 16)
+    assert hist_domain_end_us(wide=True) == float(1 << 24)
+    SloEngine(SloConfig(e2e_p99_us=50_000))  # observable: fine
+    with pytest.raises(ValueError, match="unobservable"):
+        SloEngine(SloConfig(e2e_p99_us=70_000))
+
+
 # ---------------------------------------------------------------------------
 # wire format
 

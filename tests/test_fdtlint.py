@@ -48,6 +48,7 @@ BAD_FIXTURES = {
     "ring_bad_unhooked_ringop.py": "ring-mc-hook",
     "ring_bad_device_dispatch.py": "device-dispatch",
     "ring_bad_hot_clock.py": "hot-path-clock",
+    "proc_bad_unsafe_tile.py": "proc-safe-tile",
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",
     "purity_bad_branch.py": "purity-untraced-branch",
@@ -137,6 +138,17 @@ def test_hot_clock_fixture_controls_are_clean():
     hits = [f for f in rep.findings if f.rule == "hot-path-clock"]
     assert len(hits) == 4, hits  # the four BAD reads in ImpatientTile
     assert all(f.line < 32 for f in hits), hits  # controls stay clean
+
+
+def test_proc_safe_fixture_controls_are_clean():
+    """The rule flags the four unpicklable ctor captures + the module-
+    state mutation in UnsafeTile, and NONE of the controls (on_boot
+    resources, proc_safe=False observers, Worker classes, read-only
+    module constants)."""
+    rep = engine.run_paths([CORPUS / "proc_bad_unsafe_tile.py"])
+    hits = [f for f in rep.findings if f.rule == "proc-safe-tile"]
+    assert len(hits) == 5, hits
+    assert all(f.line < 30 for f in hits), hits  # controls stay clean
 
 
 def test_metrics_schema_fixture_controls_are_clean():
