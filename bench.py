@@ -438,6 +438,100 @@ def _bench_landed_tps() -> tuple[float, dict]:
             topo.close()
 
 
+def _bench_bank_exec() -> dict:
+    """Bank-executor A/B on ONE batch (ISSUE 9): the native shared-
+    memory batch executor (fdt_bank_exec, one GIL-released call per
+    batch) vs the per-txn python fast path (execute_fast_transfers) on
+    identical scan-classified transfer batches, post-states asserted
+    EQUAL before timing is trusted.  Both sides start from the bank
+    tile's real input shape (decoded scratch rows + scan outputs), so
+    the python side pays its true per-txn costs (.tobytes(), list
+    marshalling) and the native side pays resolve + commit.
+
+    Keys: bank_exec_txns_per_s (native), bank_exec_txns_per_s_py,
+    bank_exec_speedup."""
+    from firedancer_tpu.ballet import pack as BP
+    from firedancer_tpu.ballet import txn as BT
+    from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+    from firedancer_tpu.flamenco.runtime import BankTable, Executor
+    from firedancer_tpu.funk.funk import Funk
+
+    rng = np.random.default_rng(23)
+    n_payers, batch_n, rounds = 1024, 4096, 6
+    payers = [bytes(rng.integers(0, 256, 32, np.uint8))
+              for _ in range(n_payers)]
+    txns = []
+    for i in range(batch_n):
+        p = payers[i % n_payers]
+        d = payers[(i * 7 + 3) % n_payers]
+        data = (2).to_bytes(4, "little") + int(
+            1 + rng.integers(1, 9_999)
+        ).to_bytes(8, "little")
+        txns.append(BT.build(
+            [bytes(64)], [p, d, bytes(32)], bytes(32),
+            [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+        ))
+    width = max(len(t) for t in txns)
+    rows = np.zeros((batch_n, width), np.uint8)
+    szs = np.zeros(batch_n, np.uint32)
+    for i, t in enumerate(txns):
+        rows[i, : len(t)] = np.frombuffer(t, np.uint8)
+        szs[i] = len(t)
+    scan = BP.txn_scan(rows, szs)
+    assert scan.ok.all() and scan.fast.all()
+    idx = np.arange(batch_n, dtype=np.int64)
+
+    def _mk():
+        funk = Funk()
+        mgr = AccountMgr(funk)
+        for p in payers:
+            mgr.store(p, Account(1 << 40))
+        ex = Executor(funk)
+        ex.begin_slot(0)
+        return funk, ex
+
+    def _state(funk):
+        mgr = AccountMgr(funk)
+        return {p: mgr.load(p).lamports for p in payers}
+
+    # native: resolve + exec + commit per round (the tile's real cycle)
+    funk_n, ex_n = _mk()
+    tab = BankTable(
+        np.zeros(BankTable.footprint(1 << 12), np.uint8), 1 << 12
+    )
+    best_n = float("inf")
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        ex_n.execute_fast_transfers_native(
+            tab, rows, szs, idx, scan, tag=r + 1
+        )
+        tab.commit(funk_n)
+        best_n = min(best_n, time.perf_counter() - t0)
+
+    # python fast path, same batch shape (includes the tile's per-txn
+    # .tobytes() + list marshalling, as tiles/bank.py paid pre-ISSUE 9)
+    funk_p, ex_p = _mk()
+    best_p = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        payloads = [rows[i, : szs[i]].tobytes() for i in range(batch_n)]
+        ex_p.execute_fast_transfers(
+            payloads, scan.fee.tolist(), scan.lamports.tolist(),
+            scan.payer_off.tolist(), scan.src_off.tolist(),
+            scan.dst_off.tolist(),
+        )
+        best_p = min(best_p, time.perf_counter() - t0)
+    assert _state(funk_n) == _state(funk_p), "bank A/B diverged"
+
+    native = batch_n / best_n
+    py = batch_n / best_p
+    return {
+        "bank_exec_txns_per_s": round(native, 1),
+        "bank_exec_txns_per_s_py": round(py, 1),
+        "bank_exec_speedup": round(native / py, 2),
+    }
+
+
 def _tunnel_calibration() -> float:
     """H2D bandwidth through the axon tunnel, MB/s (best of 3).
 
@@ -497,6 +591,13 @@ def main() -> None:
     result["runtime"] = os.environ.get("FDT_RUNTIME", "thread")
     try:
         result["tunnel_mbps"] = round(_tunnel_calibration(), 1)
+    except Exception:
+        pass
+    try:
+        if "bank" not in skip:
+            # bank executor A/B: native shared-memory batch exec vs the
+            # per-txn python fast path on the same batch (ISSUE 9)
+            result.update(_bench_bank_exec())
     except Exception:
         pass
     try:

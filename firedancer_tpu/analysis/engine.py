@@ -37,6 +37,7 @@ BINDING_MODULES = [
     "firedancer_tpu/ballet/zstd.py",
     "firedancer_tpu/tiles/pack.py",
     "firedancer_tpu/tiles/bank.py",
+    "firedancer_tpu/flamenco/runtime.py",  # fdt_bank_* batch executor
 ]
 
 #: directories the ring-discipline linter covers (the tile layer)

@@ -21,6 +21,10 @@ Config shape (all keys optional; defaults below):
     stall_patience_s = 120.0         # per-device tunnel-stall patience
     [tiles.dedup]
     signature_cache_size = 4194302   # default.toml:760
+    [tiles.bank]
+    count = 2                        # bank shards (processes under PR 7)
+    native = true                    # fdt_bank shared-memory executor
+    table_slots = 16384              # shared account-table slots (pow2)
     [links]
     depth = 1024
     [slo]                            # asserted SLOs (disco/slo.py)
@@ -67,6 +71,12 @@ class Config:
     dedup_depth: int = 4_194_302
     link_depth: int = 1024
     bank_count: int = 2
+    #: native shared-memory batch executor (tango/native/fdt_bank.c);
+    #: false = the per-txn python fast path (A/B + escape hatch)
+    bank_native: bool = True
+    #: shared account-table slots (64 B each, power of two) — one table
+    #: shared by every bank shard, sized for the hot payer working set
+    bank_table_slots: int = 16384
     pack_device_select: bool = False
     pack_depth: int = 4096
     pack_mb_inflight: int = 1
@@ -100,6 +110,8 @@ def parse(text: str) -> Config:
         dedup_depth=d.get("signature_cache_size", 4_194_302),
         link_depth=doc.get("links", {}).get("depth", 1024),
         bank_count=t.get("bank", {}).get("count", 2),
+        bank_native=t.get("bank", {}).get("native", True),
+        bank_table_slots=t.get("bank", {}).get("table_slots", 16384),
         pack_device_select=t.get("pack", {}).get("device_select", False),
         pack_depth=t.get("pack", {}).get("depth", 4096),
         pack_mb_inflight=t.get("pack", {}).get("mb_inflight", 1),
@@ -212,7 +224,10 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     )
     for i in range(n_banks):
         topo.tile(
-            BankTile(i, funk=funk),
+            BankTile(
+                i, funk=funk, native=cfg.bank_native,
+                table_slots=cfg.bank_table_slots,
+            ),
             ins=[(f"pack_bank{i}", True)],
             outs=[f"bank{i}_pack", f"bank{i}_poh"],
         )

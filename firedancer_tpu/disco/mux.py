@@ -245,6 +245,11 @@ class MuxCtx:
             return self.arena.alloc(key, footprint)
         if self.wksp is not None:
             return self.wksp.alloc(key, footprint)
+        return self._local_alloc(key, footprint)
+
+    def _local_alloc(self, key: str, footprint: int) -> np.ndarray:
+        """Process-local fallback buffer for workspace-less ctx
+        (standalone tile tests): idempotent by key, footprint-checked."""
         buf = self._local_allocs.get(key)
         if buf is None:
             buf = self._local_allocs[key] = np.zeros(
@@ -256,6 +261,23 @@ class MuxCtx:
                 f"existing {len(buf)}"
             )
         return buf
+
+    def shared(self, name: str, footprint: int) -> np.ndarray:
+        """A topology-WIDE shared region: every tile asking for `name`
+        gets the SAME memory (the bank tiles' shared account table),
+        unlike alloc(), which is namespaced per tile.
+
+        The region must be declared via Tile.shared_wksp_footprints()
+        so the topology budgets and allocates it at build time — that
+        is what lets a process-runtime child JOIN it here (an attached
+        workspace cannot allocate new regions, but Workspace.alloc is
+        idempotent by name so this call resolves the parent's
+        allocation).  Standalone ctx (no workspace): a process-local
+        buffer, so direct tile tests still run."""
+        key = f"shared_{name}"
+        if self.wksp is not None:
+            return self.wksp.alloc(key, footprint)
+        return self._local_alloc(key, footprint)
 
     def publish(self, sigs, rows=None, szs=None, ctls=None, tsorigs=None) -> int:
         """Publish to every out link (the common single-out case)."""
@@ -281,6 +303,15 @@ class Tile:
         """Bytes of shared-workspace state this tile allocates in on_boot
         (beyond links/metrics, which the topology accounts for itself)."""
         return 0
+
+    def shared_wksp_footprints(self) -> dict[str, int]:
+        """Topology-WIDE shared regions this tile joins via
+        ctx.shared(name, footprint): {name: footprint}.  The topology
+        allocates each named region ONCE at build (tiles naming the
+        same region must agree on its footprint), which is what makes
+        it reachable from process-runtime children — the bank tiles'
+        shared account table is the motivating case."""
+        return {}
 
     def on_boot(self, ctx: MuxCtx) -> None: ...
 

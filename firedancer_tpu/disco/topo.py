@@ -280,8 +280,27 @@ class Topology:
             wide_hists=base.wide_hists,
         )
 
+    def _shared_regions(self) -> dict[str, int]:
+        """Topology-wide shared regions declared by tiles
+        (Tile.shared_wksp_footprints): {name: footprint}.  Tiles naming
+        the same region must agree on its size — the whole point is
+        that every bank shard maps ONE account table."""
+        shared: dict[str, int] = {}
+        for name, ts in self.tiles.items():
+            for nm, fp in ts.tile.shared_wksp_footprints().items():
+                if nm in shared and shared[nm] != fp:
+                    raise ValueError(
+                        f"shared region {nm!r}: tile {name!r} declares "
+                        f"footprint {fp} but another tile declared "
+                        f"{shared[nm]} (shards must agree)"
+                    )
+                shared[nm] = fp
+        return shared
+
     def _footprint(self) -> int:
         total = 4096
+        for fp in self._shared_regions().values():
+            total += fp + 256
         for ls in self.links.values():
             total += R.MCache.footprint(ls.depth) + 256
             if ls.mtu:
@@ -359,6 +378,12 @@ class Topology:
                     self._dcaches[ls.name].bind_cursor(
                         self.wksp.alloc(f"dcur_{ls.name}", 64, align=64)
                     )
+        # topology-wide shared regions (bank account table): allocated
+        # HERE, before any tile boots and before the directory publish,
+        # so process-runtime children can join them by name (an attached
+        # workspace resolves, never allocates)
+        for nm, fp in sorted(self._shared_regions().items()):
+            self.wksp.alloc(f"shared_{nm}", fp)
         # link ids: declaration-order small ints, shared with the span
         # events (u8 field) and the manifest's id -> name table
         link_ids = {ln: i for i, ln in enumerate(self.links)}

@@ -29,7 +29,7 @@ import struct
 from firedancer_tpu.ballet import txn as T
 from firedancer_tpu.ballet.base58 import decode_32
 from firedancer_tpu.flamenco.accounts import (
-    Account, AccountMgr, SYSTEM_PROGRAM_ID,
+    _HDR, Account, AccountMgr, SYSTEM_PROGRAM_ID,
 )
 from firedancer_tpu.funk.funk import Funk, ROOT_XID
 
@@ -272,6 +272,224 @@ def find_program_address(seeds, program_id: bytes):
     return None
 
 
+def classify_record(raw: bytes | None) -> tuple[int, int]:
+    """THE table-executability rule, shared by every fast path:
+    -> (BankTable.ST_*, lamports).  TRIVIAL means a header-only
+    system-owned account with no executable/rent bits — exactly what
+    the native table (and the python fast path's lamports cache) may
+    hold; anything else is NONTRIVIAL and must execute generally."""
+    if raw is None:
+        return BankTable.ST_ABSENT, 0
+    if len(raw) != _HDR.size:
+        return BankTable.ST_NONTRIVIAL, 0
+    lam, owner, execu, rent = _HDR.unpack(raw)
+    if owner != SYSTEM_PROGRAM_ID or execu or rent:
+        return BankTable.ST_NONTRIVIAL, 0
+    return BankTable.ST_TRIVIAL, lam
+
+
+class BankTable:
+    """Shared-memory native account table + per-bank undo journal — the
+    host-side handle on tango/native/fdt_bank.c.
+
+    The table region lives in the topology workspace so every bank tile
+    (thread or process) shards over ONE table and it survives SIGKILL
+    restarts; the 256-byte journal region is per-bank (tile arena) and
+    makes each txn's slot writes atomic across a crash.  Funk remains
+    the system of record: `commit()` drains entries funk has not seen
+    yet (per-slot version words) with the existing lam_cache
+    invalidation discipline, and `recover()` is the restart protocol
+    (roll back a half-applied txn, then drain everything pending).
+
+    Only TRIVIAL system accounts (header-only, system-owned, no
+    executable/rent bits) are table-executable; other accounts are
+    cached as NONTRIVIAL markers so the executor can stop and fall back
+    per txn.  See Executor.execute_fast_transfers_native."""
+
+    ST_EMPTY, ST_BUSY, ST_TRIVIAL, ST_NONTRIVIAL, ST_ABSENT = range(5)
+    #: per-txn exec statuses (fdt_bank.h FDT_BANK_*)
+    OK, FAIL, REJECT, MISS, NONTRIV = range(5)
+    JOURNAL_BYTES = 256
+    _DRAIN_MAX = 4096
+
+    def __init__(self, mem, slot_cnt: int, journal=None):
+        import numpy as np
+
+        from firedancer_tpu.tango import rings as R
+
+        self.lib = R._lib
+        self.mem = mem
+        assert mem.flags["C_CONTIGUOUS"]
+        rc = self.lib.fdt_bank_tab_new(mem.ctypes.data, slot_cnt)
+        if rc < 0:
+            raise ValueError(
+                f"fdt_bank table init failed (slot_cnt={slot_cnt}; power "
+                f"of two, geometry must match an existing table)"
+            )
+        self.rejoined = bool(rc)
+        self.slot_cnt = slot_cnt
+        if journal is None:
+            journal = np.zeros(self.JOURNAL_BYTES, np.uint8)
+        self.journal = journal
+        self._jw = journal[: self.JOURNAL_BYTES].view(np.uint64)
+        # commit drain scratch (reused across calls)
+        self._dk = np.zeros((self._DRAIN_MAX, 32), np.uint8)
+        self._dl = np.zeros(self._DRAIN_MAX, np.uint64)
+        self._ds = np.zeros(self._DRAIN_MAX, np.uint8)
+        self._dslot = np.zeros(self._DRAIN_MAX, np.uint64)
+        self._dver = np.zeros(self._DRAIN_MAX, np.uint64)
+        self._g1 = np.zeros(1, np.uint64)  # get() out scratch
+
+    @classmethod
+    def footprint(cls, slot_cnt: int) -> int:
+        from firedancer_tpu.tango import rings as R
+
+        fp = int(R._lib.fdt_bank_tab_footprint(slot_cnt))
+        if not fp:
+            raise ValueError(f"bad bank table slot_cnt {slot_cnt}")
+        return fp
+
+    # -- key ops ----------------------------------------------------------
+    # key bytes pass straight through the c_void_p args (no per-call
+    # numpy marshalling — the batch cold-resolve path calls these for
+    # every key of every remaining txn)
+
+    def get(self, key: bytes) -> tuple[int, int]:
+        """-> (state, lamports); lamports meaningful for ST_TRIVIAL."""
+        st = self.lib.fdt_bank_tab_get(
+            self.mem.ctypes.data, key, self._g1.ctypes.data
+        )
+        return int(st), int(self._g1[0])
+
+    def put(self, key: bytes, state: int, lamports: int = 0,
+            dirty: bool = False) -> bool:
+        """Upsert; False when the table is full (caller falls back)."""
+        return (
+            self.lib.fdt_bank_tab_put(
+                self.mem.ctypes.data, key, state, lamports, int(dirty)
+            )
+            == 0
+        )
+
+    def resolve(self, funk, xid: bytes, key: bytes) -> int:
+        """Classify the funk record for `key` into the table (marked
+        funk-synced).  Returns the state cached, or ST_EMPTY when the
+        table is full."""
+        st, lam = classify_record(funk.rec_read(xid, key))
+        if not self.put(key, st, lam):
+            return self.ST_EMPTY
+        return st
+
+    # -- microblock journal ----------------------------------------------
+
+    #: python-owned journal word (past the C undo area): seq AFTER the
+    #: last fully-completed microblock tag, 0 = none yet.  Frag seqs are
+    #: monotonic per link, so a redelivered microblock below this mark
+    #: was applied in full by a previous incarnation and must be SKIPPED
+    #: — the supervisor replay window spans many microblocks, and the
+    #: (tag, done) pair above only protects the last one.
+    _JW_COMPLETED = 31
+
+    def begin(self, tag: int) -> int:
+        """Adopt a microblock: returns the txns a previous incarnation
+        already applied under this tag (0 for a fresh microblock)."""
+        if int(self._jw[0]) == tag:
+            return int(self._jw[1])
+        # done first, tag last: a kill between the stores must never
+        # leave (new tag, stale done) — that resume would skip txns
+        self._jw[1] = 0
+        self._jw[0] = tag
+        return 0
+
+    def mark_done(self, tag: int, done: int) -> None:
+        """Record python-side (fallback/slow) txn completion so a
+        restart resumes after it."""
+        if int(self._jw[0]) == tag:
+            self._jw[1] = done
+
+    def mark_complete(self, tag: int) -> None:
+        """Record a fully-executed microblock: replay below this seq
+        re-publishes (completion frees pack) but never re-executes."""
+        from firedancer_tpu.tango.rings import seq_u64
+
+        self._jw[self._JW_COMPLETED] = seq_u64(tag + 1)
+
+    def already_complete(self, tag: int) -> bool:
+        from firedancer_tpu.tango.rings import seq_lt
+
+        v = int(self._jw[self._JW_COMPLETED])
+        return v != 0 and seq_lt(tag, v)
+
+    # -- funk write-back --------------------------------------------------
+
+    def commit(self, funk, xid: bytes = ROOT_XID) -> int:
+        """Drain every entry funk has not seen into funk records, with
+        the lam_cache discipline the python fast path keeps (rec_write
+        invalidates; the fresh decode is re-cached).  Returns entries
+        written back."""
+        pack = _HDR.pack
+        absent = self.ST_ABSENT
+        total = 0
+        while True:
+            got = int(
+                self.lib.fdt_bank_commit(
+                    self.mem.ctypes.data, self._dk.ctypes.data,
+                    self._dl.ctypes.data, self._ds.ctypes.data,
+                    self._dslot.ctypes.data, self._dver.ctypes.data,
+                    self._DRAIN_MAX,
+                )
+            )
+            if got:
+                keys = [self._dk[m].tobytes() for m in range(got)]
+                lams = self._dl[:got].tolist()
+                sts = self._ds[:got].tolist()
+                funk.rec_write_many(
+                    xid,
+                    (
+                        (
+                            keys[m],
+                            None if sts[m] == absent
+                            else pack(lams[m], SYSTEM_PROGRAM_ID, 0, 0),
+                        )
+                        for m in range(got)
+                    ),
+                )
+                if xid == ROOT_XID:
+                    # re-warm the cache the write-back just invalidated
+                    funk.lam_cache.update(
+                        (keys[m], lams[m])
+                        for m in range(got)
+                        if sts[m] != absent
+                    )
+                # funk has the records: NOW retire the drained versions
+                # (a kill before this ack re-drains them — funk write-
+                # back is idempotent, so at-least-once is lossless)
+                self.lib.fdt_bank_commit_ack(
+                    self.mem.ctypes.data, self._dslot.ctypes.data,
+                    self._dver.ctypes.data, got,
+                )
+            total += got
+            if got < self._DRAIN_MAX:
+                return total
+
+    def recover(self, funk, xid: bytes = ROOT_XID) -> tuple[int, int, bool]:
+        """Restart protocol: roll back a half-applied txn (undo journal)
+        and drain everything pending into funk.  Returns (microblock
+        tag, txns done under it, rolled_back) so the tile can resume a
+        redelivered microblock exactly once."""
+        import numpy as np
+
+        out = np.zeros(2, np.uint64)
+        rolled = bool(
+            self.lib.fdt_bank_recover(
+                self.mem.ctypes.data, self.journal.ctypes.data,
+                out.ctypes.data,
+            )
+        )
+        self.commit(funk, xid)
+        return int(out[0]), int(out[1]), rolled
+
+
 class Executor:
     """Executes parsed transactions against a funk fork."""
 
@@ -292,6 +510,13 @@ class Executor:
         #: lamports/sig recorded into initialized nonce accounts
         self.lamports_per_signature = FEE_PER_SIGNATURE
         self._slot_hashes = None  # sysvar.SlotHashes, built lazily
+        #: static + ALT-resolved keys of the last execute_txn call — the
+        #: bank's table<->funk resync reads it (execute_txn_with_table)
+        self.last_touched: list[bytes] = []
+        #: txns of the last execute_fast_transfers_native call that ran
+        #: through the per-txn general-executor fallback (the bank tile
+        #: subtracts these from its native_txns metric)
+        self.last_fallbacks = 0
 
     def begin_slot(self, slot: int, unix_timestamp: int = 0,
                    blockhash: bytes | None = None) -> None:
@@ -416,6 +641,7 @@ class Executor:
             if isinstance(resolved, str):
                 return TxnResult(False, resolved)
             keys += resolved
+        self.last_touched = keys
         fee = FEE_PER_SIGNATURE * desc.signature_cnt
 
         payer = self.mgr.load(keys[0])
@@ -496,10 +722,7 @@ class Executor:
         rec_read = funk.rec_read
         rec_write = funk.rec_write
         xid = self.xid
-        from firedancer_tpu.flamenco.accounts import _HDR
-
         hdr_pack = _HDR.pack
-        hdr_sz = _HDR.size
         zero_check = self.features.active(
             "system_transfer_zero_check", self.slot
         )
@@ -509,13 +732,12 @@ class Executor:
             v = cache.get(key)
             if v is not None:
                 return v
-            raw = rec_read(xid, key)
-            if raw is None:
+            # one classification rule for every fast path (the native
+            # table's resolve uses the same helper)
+            st, lam = classify_record(rec_read(xid, key))
+            if st == BankTable.ST_ABSENT:
                 return ABSENT
-            if len(raw) != hdr_sz:
-                return NONTRIVIAL  # has data: not a trivial system acct
-            lam, owner, execu, rent = _HDR.unpack(raw)
-            if owner != SYSTEM_PROGRAM_ID or execu or rent:
+            if st == BankTable.ST_NONTRIVIAL:
                 return NONTRIVIAL
             cache[key] = lam
             return lam
@@ -602,6 +824,138 @@ class Executor:
             for k, v in vals.items():
                 put(k, v)
         return fees_total, executed, failed
+
+    # ---- native batched fast path (fdt_bank) ----------------------------
+
+    def execute_fast_transfers_native(
+        self, table, rows, szs, idx, scan, tag: int = 0, start: int = 0
+    ) -> tuple[int, int, int]:
+        """Execute the scan-classified fast-transfer subset `idx` of
+        `rows` through the native shared-memory executor
+        (tango/native/fdt_bank.c fdt_bank_exec): one GIL-released C call
+        applies the whole run, stopping only at a txn the table cannot
+        represent.  Stops are handled here IN ORDER — a cache MISS
+        batch-resolves every remaining key from funk and retries; a
+        NONTRIVIAL account runs that one txn through the general
+        executor (with table<->funk coherence, execute_txn_with_table)
+        and the batch resumes after it — so the observable semantics
+        stay exactly execute_fast_transfers', which is pinned to
+        execute_txn by tests/test_bank_fast.py + test_bank_native.py.
+
+        `tag` names the microblock (the carrying frag's seq) for the
+        crash-resume journal; `start` skips txns a previous incarnation
+        already applied.  Returns (fees_collected, executed, failed);
+        table mutations stay pending for BankTable.commit()."""
+        import numpy as np
+
+        lib = table.lib
+        n = len(idx)
+        if start >= n:
+            return 0, 0, 0
+        idx64 = np.ascontiguousarray(idx, np.int64)
+        status = np.zeros(n, np.uint8)
+        ofees = np.zeros(n, np.uint64)
+        zero_check = int(
+            self.features.active("system_transfer_zero_check", self.slot)
+        )
+        fees = executed = failed = 0
+        t = int(start)
+        resolved = False
+        self.last_fallbacks = 0
+        while t < n:
+            done = lib.fdt_bank_exec(
+                rows.ctypes.data, rows.shape[1], idx64.ctypes.data, t, n,
+                scan.payer_off.ctypes.data, scan.src_off.ctypes.data,
+                scan.dst_off.ctypes.data, scan.fee.ctypes.data,
+                scan.lamports.ctypes.data, table.mem.ctypes.data,
+                table.journal.ctypes.data, tag, zero_check,
+                status.ctypes.data, ofees.ctypes.data,
+            )
+            if done > t:
+                executed += done - t
+                failed += int(np.count_nonzero(status[t:done]))
+                fees += int(ofees[t:done].sum())
+                t = done
+            if t >= n:
+                break
+            st = int(status[t])
+            if st == BankTable.MISS and not resolved:
+                # cold keys: resolve the whole remaining subset from
+                # funk in ONE pass — a later MISS can then only mean the
+                # table is full, which falls back below (re-resolving
+                # per stop would make a full table O(n^2))
+                resolved = True
+                self._bank_resolve(table, rows, idx64[t:], scan)
+                continue
+            # NONTRIVIAL account (or a miss the table could not absorb,
+            # e.g. full): the general executor runs this one txn in
+            # sequence, then the native batch resumes after it
+            i = int(idx64[t])
+            r = self.execute_txn_with_table(
+                table, rows[i, : szs[i]].tobytes()
+            )
+            fees += r.fee
+            executed += 1
+            failed += not r.ok
+            self.last_fallbacks += 1
+            t += 1
+            table.mark_done(tag, t)
+        return fees, executed, failed
+
+    def _bank_resolve(self, table, rows, sub_idx, scan) -> None:
+        """Classify every uncached payer/src/dst key of the remaining
+        subset txns from funk into the table (TRIVIAL lamports,
+        NONTRIVIAL marker, or known-ABSENT).  A full table is tolerated:
+        the executor stops again and the txn falls back."""
+        for t in sub_idx:
+            t = int(t)
+            for off in (
+                int(scan.payer_off[t]), int(scan.src_off[t]),
+                int(scan.dst_off[t]),
+            ):
+                key = rows[t, off : off + 32].tobytes()
+                if table.get(key)[0] == BankTable.ST_EMPTY:
+                    table.resolve(self.funk, self.xid, key)
+
+    def execute_txn_with_table(self, table, payload: bytes) -> TxnResult:
+        """General-executor escape hatch for a txn scheduled into the
+        native path: flush the txn's table-held accounts into funk first
+        (the table is authoritative for TRIVIAL entries and funk may lag
+        a commit), run execute_txn, then resync every touched key back
+        into the table (update-only: keys the table never cached stay
+        uncached).  Pack's account locks are still held by this
+        microblock, so no other bank can race the flush/resync."""
+        desc = T.parse(payload)
+        if desc is not None:
+            keys = [
+                bytes(desc.acct_addr(payload, j))
+                for j in range(desc.acct_addr_cnt)
+            ]
+            if desc.addr_table_adtl_cnt > 0:
+                # ALT-resolved keys can be trivial table-held accounts
+                # too: flushing only the static keys would let the
+                # general executor read a stale funk balance (and the
+                # resync below would then clobber the table with it)
+                resolved = self._resolve_alts(payload, desc)
+                if not isinstance(resolved, str):
+                    keys += resolved
+            for k in keys:
+                st, lam = table.get(k)
+                if st == BankTable.ST_TRIVIAL:
+                    self.funk.rec_write(
+                        self.xid, k, _HDR.pack(lam, SYSTEM_PROGRAM_ID, 0, 0)
+                    )
+                    if self.xid == ROOT_XID:
+                        self.funk.lam_cache[k] = lam
+                elif st == BankTable.ST_ABSENT:
+                    self.funk.rec_remove(self.xid, k)
+        self.last_touched = []
+        r = self.execute_txn(payload, desc)
+        for k in self.last_touched:
+            st, _ = table.get(k)
+            if st not in (BankTable.ST_EMPTY, BankTable.ST_BUSY):
+                table.resolve(self.funk, self.xid, k)
+        return r
 
     # ---- dispatch -------------------------------------------------------
 

@@ -423,13 +423,15 @@ static void tc_map_remove( uint64_t * map, uint64_t mask, uint64_t tag ) {
   map[ hole ] = 0UL;
 }
 
-uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
-                           uint8_t * is_dup ) {
+uint64_t fdt_tcache_dedup_j( void * tcache, uint64_t const * tags,
+                             uint64_t n, uint8_t * is_dup, uint64_t * jnl,
+                             uint64_t jcap ) {
   fdt_tcache_hdr_t * h = (fdt_tcache_hdr_t *)tcache;
   uint64_t * ring = tc_ring( tcache );
   uint64_t * map  = tc_map( tcache );
   uint64_t mask   = h->map_cnt - 1UL;
   uint64_t dups   = 0;
+  uint64_t jcnt   = 0;
   for( uint64_t i = 0; i < n; i++ ) {
     uint64_t tag = tags[ i ];
     if( !tag ) { is_dup[ i ] = 0; continue; } /* null tag: pass-through */
@@ -439,6 +441,18 @@ uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
       continue;
     }
     is_dup[ i ] = 0;
+    /* journal BEFORE the insert becomes visible: a kill at any point
+       from here on leaves the tag recoverable (tag word first, count
+       published after with release ordering) */
+    if( jnl ) {
+      if( jcnt < jcap ) {
+        jnl[ 4 + jcnt ] = tag;
+        __atomic_store_n( &jnl[ 2 ], jcnt + 1UL, __ATOMIC_RELEASE );
+        jcnt++;
+      } else {
+        __atomic_store_n( &jnl[ 3 ], 1UL, __ATOMIC_RELEASE );
+      }
+    }
     if( h->ring_cnt == h->depth ) {
       uint64_t old = ring[ h->ring_head ];
       if( old ) tc_map_remove( map, mask, old );
@@ -450,6 +464,13 @@ uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
     tc_map_insert( map, mask, tag );
   }
   return dups;
+}
+
+uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
+                           uint8_t * is_dup ) {
+  /* the unjournaled dedup IS the journaled one with no journal — one
+     insert/evict body, so the two can never disagree */
+  return fdt_tcache_dedup_j( tcache, tags, n, is_dup, 0, 0 );
 }
 
 int fdt_tcache_query( void const * tcache, uint64_t tag ) {

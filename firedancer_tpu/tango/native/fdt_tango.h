@@ -214,6 +214,17 @@ uint64_t fdt_tcache_dedup( void * tcache, uint64_t const * tags, uint64_t n,
 int fdt_tcache_query( void const * tcache, uint64_t tag );
 void fdt_tcache_reset( void * tcache );
 
+/* Journaled dedup: identical to fdt_tcache_dedup, but every tag ABOUT
+   TO BE INSERTED is first appended to a crash journal (jnl[2] = count,
+   written release AFTER the tag word, tags from jnl[4]; jnl[3] set when
+   jcap overflows — jnl[0]/jnl[1] are caller-owned phase/seq words).  A
+   consumer killed between the insert and its downstream publish can
+   then grant the journaled tags a one-shot replay amnesty instead of
+   losing them to its own surviving history (tiles/dedup.py). */
+uint64_t fdt_tcache_dedup_j( void * tcache, uint64_t const * tags,
+                             uint64_t n, uint8_t * is_dup, uint64_t * jnl,
+                             uint64_t jcap );
+
 #ifdef __cplusplus
 }
 #endif

@@ -58,6 +58,7 @@ def _load() -> ct.CDLL:
             _HERE / "native" / "fdt_tango.c",
             _HERE / "native" / "fdt_sha512.c",
             _HERE / "native" / "fdt_pack.c",
+            _HERE / "native" / "fdt_bank.c",
         ],
     )
     lib = ct.CDLL(str(so))
@@ -105,6 +106,7 @@ def _load() -> ct.CDLL:
         "fdt_tcache_new": (i32, [vp, u64, u64]),
         "fdt_tcache_depth": (u64, [vp]),
         "fdt_tcache_dedup": (u64, [vp, vp, u64, vp]),
+        "fdt_tcache_dedup_j": (u64, [vp, vp, u64, vp, vp, u64]),
         "fdt_tcache_query": (i32, [vp, u64]),
         "fdt_tcache_reset": (None, [vp]),
         "fdt_verify_expand": (
@@ -141,6 +143,23 @@ def _load() -> ct.CDLL:
             [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
              vp, vp, ct.c_int64, vp, vp, ct.c_int64],
         ),
+        "fdt_bank_tab_footprint": (u64, [u64]),
+        "fdt_bank_tab_new": (i32, [vp, u64]),
+        "fdt_bank_tab_slots": (u64, [vp]),
+        "fdt_bank_tab_put": (
+            ct.c_int64, [vp, vp, ct.c_int64, u64, ct.c_int64],
+        ),
+        "fdt_bank_tab_get": (ct.c_int64, [vp, vp, vp]),
+        "fdt_bank_exec": (
+            ct.c_int64,
+            [vp, ct.c_int64, vp, ct.c_int64, ct.c_int64, vp, vp, vp, vp,
+             vp, vp, vp, u64, ct.c_int64, vp, vp],
+        ),
+        "fdt_bank_commit": (
+            ct.c_int64, [vp, vp, vp, vp, vp, vp, ct.c_int64],
+        ),
+        "fdt_bank_commit_ack": (None, [vp, vp, vp, ct.c_int64]),
+        "fdt_bank_recover": (ct.c_int64, [vp, vp, vp]),
         "fdt_mb_encode": (
             ct.c_int64,
             [vp, ct.c_int64, vp, vp, ct.c_int64, u32, u32, vp, ct.c_int64],
@@ -996,6 +1015,21 @@ class TCache:
         is_dup = np.zeros(len(tags), dtype=np.uint8)
         _lib.fdt_tcache_dedup(
             _ptr(self.mem), tags.ctypes.data, len(tags), is_dup.ctypes.data
+        )
+        return is_dup.astype(bool)
+
+    def dedup_j(self, tags: np.ndarray, jnl: np.ndarray) -> np.ndarray:
+        """dedup() with a crash journal: every tag about to be inserted
+        is appended to `jnl` (u64 words: [0] phase / [1] seq0 — caller
+        owned, [2] count, [3] overflow, tags from [4]) BEFORE the
+        insert, so a consumer killed between insert and publish can
+        amnesty the replay instead of losing the batch (tiles/dedup.py
+        exactly-once discipline)."""
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        is_dup = np.zeros(len(tags), dtype=np.uint8)
+        _lib.fdt_tcache_dedup_j(
+            _ptr(self.mem), tags.ctypes.data, len(tags),
+            is_dup.ctypes.data, jnl.ctypes.data, len(jnl) - 4,
         )
         return is_dup.astype(bool)
 

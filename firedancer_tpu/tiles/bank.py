@@ -6,16 +6,31 @@ fd_ext_bank_load_and_execute_txns, fd_bank.c:100-104), flags itself free
 through the busy fseq, and forwards the executed microblock to the poh
 tile for mixin.
 
-Execution is BATCHED: one native call (fdt_mb_decode + fdt_txn_scan)
-parses and classifies the whole microblock, the dominant txn class
-(simple system transfers) executes through the runtime's allocation-free
-fast path over the funk lamports cache
-(flamenco/runtime.py execute_fast_transfers), and only the remainder
-walks the general per-txn executor.  That is this build's analog of the
-reference never executing in the tile's own interpreter loop.
+Execution is BATCHED end to end: one native call (fdt_mb_decode +
+fdt_txn_scan) parses and classifies the whole microblock, and the
+dominant txn class (simple system transfers) executes through ONE
+GIL-released native call per microblock (fdt_bank_exec) against a
+shared-memory account table that every bank shard maps — the analog of
+the reference handing the whole microblock to an external engine rather
+than executing in the tile's interpreter.  Only NONTRIVIAL accounts
+(data, non-system owner) fall back to the general per-txn executor, in
+sequence, and the table<->funk coherence protocol in
+flamenco/runtime.py keeps both views identical.
+
+The table lives in the topology workspace (ctx.shared), so bank tiles
+sharded as PROCESSES (PR 7 runtime) execute against one table without
+touching the GIL or each other — pack's exact account-lock tables
+already guarantee no two in-flight microblocks share a writable
+account.  The per-bank undo journal + per-slot version words make a
+SIGKILL mid-microblock lossless: on_boot rolls back a half-applied txn,
+drains pending funk write-backs, and a redelivered microblock resumes
+at the exact txn the dead incarnation reached.
 
 Completion travels as a frag on the bank→pack ring (sig = bank<<32 |
 handle); the executed microblock is forwarded on the bank→poh ring.
+A malformed microblock is a metered drop (`malformed_microblocks`) that
+still frees the bank at pack — one bad frag must not take the bank
+down, matching the slow path's one-bad-txn rule.
 """
 
 from __future__ import annotations
@@ -52,29 +67,79 @@ class BankTile(Tile):
             "failed_txns",
             "fast_txns",
             "fees_lamports",
+            "malformed_microblocks",
+            "native_txns",
+            "committed_accounts",
         ),
     )
 
-    def __init__(self, bank_id: int, name: str | None = None, *, funk=None):
+    #: default shared account-table slots (64 B each; all bank shards
+    #: must agree — the topology asserts it)
+    TABLE_SLOTS = 1 << 14
+
+    #: funk write-back cadence: the table is authoritative (fallback
+    #: txns flush per-key, restarts drain via recover), so the batched
+    #: commit amortizes over microblocks — hot payers are written once
+    #: per window instead of once per microblock.  Housekeeping ticks
+    #: bound the staleness funk observers (RPC) can see.
+    COMMIT_EVERY = 16
+
+    def __init__(self, bank_id: int, name: str | None = None, *, funk=None,
+                 native: bool = True, table_slots: int | None = None,
+                 commit_every: int | None = None):
         self.bank_id = bank_id
         self.name = name or f"bank{bank_id}"
         self.funk = funk
+        self.native = native
+        self.table_slots = table_slots or self.TABLE_SLOTS
+        self.commit_every = commit_every or self.COMMIT_EVERY
         self._executor = None
+        self._table = None
+        self._mb_uncommitted = 0
         # native-decode scratch (grown on demand)
         self._srows = np.zeros((256, T.MTU), np.uint8)
         self._sszs = np.zeros(256, np.uint32)
 
+    def _use_native(self) -> bool:
+        return self.native and self.funk is not None
+
+    def shared_wksp_footprints(self) -> dict[str, int]:
+        if not self._use_native():
+            return {}
+        from firedancer_tpu.flamenco.runtime import BankTable
+
+        return {"banktab": BankTable.footprint(self.table_slots)}
+
+    def wksp_footprint(self) -> int:
+        # per-bank undo journal (shm arena in the process runtime, so a
+        # restarted incarnation resumes a half-applied microblock)
+        return 512
+
     def on_boot(self, ctx: MuxCtx) -> None:
         if self.funk is not None:
-            from firedancer_tpu.flamenco.runtime import Executor
+            from firedancer_tpu.flamenco.runtime import BankTable, Executor
 
             self._executor = Executor(self.funk)
             # sysvar accounts (clock/rent/epoch schedule) materialize at
             # slot start so programs can read them like any account
             self._executor.begin_slot(0)
+            if self.native:
+                mem = ctx.shared(
+                    "banktab", BankTable.footprint(self.table_slots)
+                )
+                jnl = ctx.alloc("bankjnl", BankTable.JOURNAL_BYTES)
+                self._table = BankTable(
+                    mem, self.table_slots, journal=jnl
+                )
+                # restart protocol: roll back a half-applied txn and
+                # drain pending write-backs BEFORE any new microblock;
+                # the journal keeps (tag, txns done) so a redelivered
+                # microblock resumes exactly once (see _execute)
+                self._table.recover(self.funk, self._executor.xid)
 
     def _decode(self, buf: np.ndarray):
-        """Native microblock decode -> (rows view, szs view) scratch."""
+        """Native microblock decode -> (rows view, szs view) scratch, or
+        None on a malformed microblock (metered drop at the caller)."""
         n = int(buf[6:8].view("<u2")[0])
         if n > len(self._sszs):
             cap = 1 << (n - 1).bit_length()
@@ -85,45 +150,121 @@ class BankTile(Tile):
             self._srows.ctypes.data, self._srows.shape[1],
             self._sszs.ctypes.data, len(self._sszs),
         )
-        assert got == n, "malformed microblock from pack"
+        if got != n:
+            return None
         return self._srows[:n], self._sszs[:n]
 
-    def _execute(self, ctx: MuxCtx, rows: np.ndarray, szs: np.ndarray) -> int:
-        """Execute one decoded microblock; returns fees collected."""
+    def _execute(self, ctx: MuxCtx, rows: np.ndarray, szs: np.ndarray,
+                 tag: int) -> int | None:
+        """Execute one decoded microblock; returns fees collected, or
+        None when a previous incarnation already applied it in full (a
+        replayed frag must re-publish but never re-execute).  `tag` is
+        the carrying frag's seq — the crash-resume journal key."""
         ex = self._executor
         n = len(rows)
         if ex is None:
             return execute_txns([rows[i, : szs[i]] for i in range(n)])
+        tbl = self._table
+        if tbl is not None and tbl.already_complete(tag):
+            # the supervisor's replay window spans many microblocks;
+            # ones below the completed-seq mark were fully applied (and
+            # counted) by a dead incarnation — re-executing them against
+            # the surviving shm table would double-apply every transfer.
+            # Known process-runtime limitation: slow-path (NONTRIVIAL)
+            # writes of the dead incarnation lived only in its pickled
+            # funk COPY and are NOT re-materialized here — re-executing
+            # them would double-apply any trivial table-held account the
+            # txn also touches, corrupting the shared table to patch a
+            # funk copy that is divergent across bank processes anyway
+            # (PR 7's documented funk model; shared-memory funk is
+            # ROADMAP work).  The shm table — the authoritative state
+            # this PR adds — stays exactly-once.
+            return None
         scan = P.txn_scan(rows, szs)
         fast_idx = np.flatnonzero(scan.fast)
+        slow_idx = np.flatnonzero(~scan.fast.astype(bool))
+        nf = len(fast_idx)
+        # txns a dead incarnation already applied under this tag (fast
+        # subset positions [0, nf), then slow positions [nf, n)) — their
+        # metrics were counted by that incarnation (shm), so skip silently
+        resume = tbl.begin(tag) if tbl is not None else 0
         fees = 0
-        if len(fast_idx):
-            payloads = [rows[i, : szs[i]].tobytes() for i in fast_idx]
-            f, executed, failed = ex.execute_fast_transfers(
-                payloads,
-                scan.fee[fast_idx].tolist(),
-                scan.lamports[fast_idx].tolist(),
-                scan.payer_off[fast_idx].tolist(),
-                scan.src_off[fast_idx].tolist(),
-                scan.dst_off[fast_idx].tolist(),
-            )
+        if nf:
+            if tbl is not None:
+                # one GIL-released native call for the whole fast run;
+                # scratch rows feed C directly (no per-txn .tobytes());
+                # metrics count what THIS incarnation executed, so a
+                # mid-microblock resume never double-counts
+                f, executed, failed = ex.execute_fast_transfers_native(
+                    tbl, rows, szs, fast_idx, scan,
+                    tag=tag, start=min(resume, nf),
+                )
+                ctx.metrics.inc(
+                    "native_txns", executed - ex.last_fallbacks
+                )
+            else:
+                payloads = [rows[i, : szs[i]].tobytes() for i in fast_idx]
+                f, executed, failed = ex.execute_fast_transfers(
+                    payloads,
+                    scan.fee[fast_idx].tolist(),
+                    scan.lamports[fast_idx].tolist(),
+                    scan.payer_off[fast_idx].tolist(),
+                    scan.src_off[fast_idx].tolist(),
+                    scan.dst_off[fast_idx].tolist(),
+                )
             fees += f
-            ctx.metrics.inc("fast_txns", len(fast_idx))
+            ctx.metrics.inc("fast_txns", executed)
             if failed:
                 ctx.metrics.inc("failed_txns", failed)
-        slow_idx = np.flatnonzero(~scan.fast.astype(bool))
-        for i in slow_idx:
+        for k in range(len(slow_idx)):
+            pos = nf + k
+            if pos < resume:
+                continue
+            i = slow_idx[k]
             # one malformed txn must not take the bank down: record it as
             # failed and keep executing the microblock
             try:
-                res = ex.execute_txn(rows[i, : szs[i]].tobytes())
+                payload = rows[i, : szs[i]].tobytes()
+                res = (
+                    ex.execute_txn_with_table(tbl, payload)
+                    if tbl is not None
+                    else ex.execute_txn(payload)
+                )
             except Exception:
                 ctx.metrics.inc("failed_txns")
+                if tbl is not None:
+                    tbl.mark_done(tag, pos + 1)
                 continue
             fees += res.fee
             if not res.ok:
                 ctx.metrics.inc("failed_txns")
+            if tbl is not None:
+                tbl.mark_done(tag, pos + 1)
+        if tbl is not None:
+            tbl.mark_complete(tag)
+            self._mb_uncommitted += 1
+            if self._mb_uncommitted >= self.commit_every:
+                self._commit(ctx)
         return fees
+
+    def _commit(self, ctx: MuxCtx) -> None:
+        """Batched funk write-back of everything the window dirtied (and
+        anything a crashed sibling left pending)."""
+        self._mb_uncommitted = 0
+        ncom = self._table.commit(self._executor.funk, self._executor.xid)
+        if ncom:
+            ctx.metrics.inc("committed_accounts", ncom)
+
+    def during_housekeeping(self, ctx: MuxCtx) -> None:
+        # bound funk staleness for observers (RPC txn counts read
+        # metrics, but balances read funk): a clean table makes this a
+        # single native scan
+        if self._table is not None and self._mb_uncommitted:
+            self._commit(ctx)
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        if self._table is not None:
+            self._commit(ctx)
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
@@ -133,12 +274,21 @@ class BankTile(Tile):
             handle = int(buf[0:4].view("<u4")[0])
             bank = int(buf[4:6].view("<u2")[0])
             assert bank == self.bank_id
-            trows, tszs = self._decode(buf)
-            fees = self._execute(ctx, trows, tszs)
-            ctx.metrics.inc("executed_microblocks")
-            ctx.metrics.inc("executed_txns", len(trows))
-            ctx.metrics.inc("fees_lamports", fees)
             tag = np.array([(bank << 32) | handle], dtype=np.uint64)
+            dec = self._decode(buf)
+            if dec is None:
+                # malformed microblock: metered drop — but the bank MUST
+                # still complete at pack or its handle and account locks
+                # leak; nothing is forwarded to poh
+                ctx.metrics.inc("malformed_microblocks")
+                ctx.outs[0].publish(tag)
+                continue
+            trows, tszs = dec
+            fees = self._execute(ctx, trows, tszs, int(frags["seq"][i]))
+            if fees is not None:
+                ctx.metrics.inc("executed_microblocks")
+                ctx.metrics.inc("executed_txns", len(trows))
+                ctx.metrics.inc("fees_lamports", fees)
             # forward to poh first, then free the bank at pack
             ctx.outs[1].publish(
                 tag, buf[None, :], np.array([len(buf)], dtype=np.uint16)

@@ -1,0 +1,680 @@
+"""fdt_bank: the native shared-memory batch executor (tier-1, ISSUE 9).
+
+Contracts pinned here:
+
+  1. differential fuzz — randomized fast-transfer batches (duplicate
+     keys, dst==payer, absent dst, underfunded and below-fee payers,
+     self-transfers, zero-lamport transfers with the
+     system_transfer_zero_check feature on AND off, NONTRIVIAL-account
+     fallbacks mixed in) must produce fees/stats/post-state IDENTICAL
+     to the execute_txn golden applied in the same order;
+  2. crash safety — a bank process SIGKILLed mid-slot leaves the shm
+     table equal to the golden prefix after recover() (undo-journal
+     rollback + dirty drain), and the resumed execution applies each
+     txn exactly once (zero lost / zero duplicated lamports);
+  3. robustness — a malformed microblock is a metered drop that still
+     frees the bank at pack; a full table falls back to the general
+     executor without diverging.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import pack as P
+from firedancer_tpu.ballet import txn as T
+from firedancer_tpu.flamenco.accounts import (
+    Account, AccountMgr, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.flamenco.features import DISABLED
+from firedancer_tpu.flamenco.runtime import BankTable, Executor
+from firedancer_tpu.disco.metrics import MetricsSchema as _MetricsSchema
+from firedancer_tpu.disco.mux import Tile as _MuxTile
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.tango import rings as R
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _key(rng) -> bytes:
+    return bytes(rng.integers(0, 256, 32, np.uint8))
+
+
+def _xfer(payer: bytes, dest: bytes, amount: int) -> bytes:
+    data = (2).to_bytes(4, "little") + amount.to_bytes(8, "little")
+    return T.build(
+        [bytes(64)], [payer, dest, SYSTEM_PROGRAM_ID], bytes(32),
+        [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+    )
+
+
+def _self_xfer(payer: bytes, amount: int) -> bytes:
+    data = (2).to_bytes(4, "little") + amount.to_bytes(8, "little")
+    return T.build(
+        [bytes(64)], [payer, SYSTEM_PROGRAM_ID], bytes(32),
+        [(1, [0, 0], data)], readonly_unsigned_cnt=1,
+    )
+
+
+def _xfer2(payer: bytes, src: bytes, dest: bytes, amount: int) -> bytes:
+    """Two-signer transfer where the SOURCE is the second signer, not
+    the fee payer — the only shape that reaches the absent/underfunded
+    source branches (a payer-source always exists once the fee
+    cleared)."""
+    data = (2).to_bytes(4, "little") + amount.to_bytes(8, "little")
+    return T.build(
+        [bytes(64), bytes(64)], [payer, src, dest, SYSTEM_PROGRAM_ID],
+        bytes(32), [(3, [1, 2], data)], readonly_unsigned_cnt=1,
+    )
+
+
+def _pack_rows(txns):
+    width = max(len(t) for t in txns)
+    rows = np.zeros((len(txns), width), np.uint8)
+    szs = np.zeros(len(txns), np.uint32)
+    for i, t in enumerate(txns):
+        rows[i, : len(t)] = np.frombuffer(t, np.uint8)
+        szs[i] = len(t)
+    return rows, szs
+
+
+def _fund(funding):
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    for k, acct in funding.items():
+        mgr.store(k, acct)
+    ex = Executor(funk)
+    ex.begin_slot(0)
+    return funk, ex
+
+
+def _snap(funk):
+    mgr = AccountMgr(funk)
+    return {
+        k: (a.lamports, a.owner, a.data)
+        for k, a in ((k, mgr.load(k)) for k in funk.root)
+        if a is not None
+    }
+
+
+def _run_native(txns, funding, *, slots=1 << 10, zero_check=True, tag=1):
+    funk, ex = _fund(funding)
+    if not zero_check:
+        ex.features.slots["system_transfer_zero_check"] = DISABLED
+    rows, szs = _pack_rows(txns)
+    scan = P.txn_scan(rows, szs)
+    assert scan.ok.all() and scan.fast.all(), "fixture must be fast-class"
+    tab = BankTable(np.zeros(BankTable.footprint(slots), np.uint8), slots)
+    stats = ex.execute_fast_transfers_native(
+        tab, rows, szs, np.arange(len(txns), dtype=np.int64), scan, tag=tag
+    )
+    tab.commit(funk)
+    return funk, stats, tab
+
+
+def _run_golden(txns, funding, *, zero_check=True):
+    funk, ex = _fund(funding)
+    if not zero_check:
+        ex.features.slots["system_transfer_zero_check"] = DISABLED
+    fees = executed = failed = 0
+    for t in txns:
+        r = ex.execute_txn(t)
+        fees += r.fee
+        executed += 1
+        failed += not r.ok
+    return funk, (fees, executed, failed)
+
+
+# ---------------------------------------------------------------------------
+# 1. differential fuzz vs the execute_txn golden
+
+
+def _fuzz_batch(rng, n_txns=48):
+    """A batch exercising every fast-path edge at once, plus NONTRIVIAL
+    fallbacks.  Returns (txns, funding)."""
+    owner = _key(rng)
+    payers = [_key(rng) for _ in range(6)]
+    dests = [_key(rng) for _ in range(4)]
+    prog_owned = _key(rng)
+    data_acct = _key(rng)
+    poor = _key(rng)
+    broke = _key(rng)
+    funding = {
+        **{p: Account(int(rng.integers(20_000, 2_000_000)))
+           for p in payers},
+        poor: Account(5_000 + int(rng.integers(0, 400))),
+        broke: Account(int(rng.integers(0, 5_000))),
+        prog_owned: Account(777, owner, False, 0, b"state"),
+        data_acct: Account(999, SYSTEM_PROGRAM_ID, False, 0, b"d"),
+    }
+    txns = []
+    for _ in range(n_txns):
+        kind = int(rng.integers(0, 13))
+        p = payers[int(rng.integers(0, len(payers)))]
+        amt = int(rng.integers(1, 9_999))
+        if amt % 5_000 == 0:
+            amt += 1  # torn-txn detectability (see crash test)
+        if kind == 10:
+            # source (2nd signer) ABSENT: fee stands, transfer fails —
+            # except a 0-lamport transfer pre-zero_check (silent no-op)
+            z_amt = amt if rng.integers(0, 2) else 0
+            txns.append(_xfer2(p, _key(rng), dests[0], z_amt))
+        elif kind == 11:
+            # source underfunded relative to the amount (fee from payer)
+            txns.append(_xfer2(p, poor, dests[1], 900_000))
+        elif kind == 12:
+            # source == dest via distinct offsets (self-transfer no-op)
+            q = payers[int(rng.integers(0, len(payers)))]
+            txns.append(_xfer2(p, q, q, amt))
+        elif kind == 0:
+            txns.append(_xfer(poor, dests[0], 900_000))     # underfunded
+        elif kind == 1:
+            txns.append(_xfer(broke, dests[0], 1))          # below fee
+        elif kind == 2:
+            txns.append(_self_xfer(p, amt))                 # self no-op
+        elif kind == 3:
+            txns.append(_xfer(p, p, amt))                   # dst == payer
+        elif kind == 4:
+            txns.append(_xfer(p, prog_owned, amt))          # NONTRIV dst
+        elif kind == 5:
+            txns.append(_xfer(p, data_acct, amt))           # NONTRIV dst 2
+        elif kind == 6:
+            txns.append(_xfer(p, _key(rng), 0))             # 0 to absent
+        elif kind == 7:
+            # payer another payer (duplicate-key aliasing in-batch)
+            q = payers[int(rng.integers(0, len(payers)))]
+            txns.append(_xfer(p, q, amt))
+        else:
+            txns.append(
+                _xfer(p, dests[int(rng.integers(0, len(dests)))], amt)
+            )
+    return txns, funding
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404])
+@pytest.mark.parametrize("zero_check", [True, False])
+def test_fuzz_native_matches_golden(seed, zero_check):
+    rng = np.random.default_rng(seed)
+    for _ in range(3):
+        txns, funding = _fuzz_batch(rng)
+        nf, ns, _tab = _run_native(
+            txns, funding, zero_check=zero_check, tag=seed
+        )
+        gf, gs = _run_golden(txns, funding, zero_check=zero_check)
+        assert ns == gs, f"stats diverged (seed {seed})"
+        assert _snap(nf) == _snap(gf), f"post-state diverged (seed {seed})"
+
+
+def test_sequential_dependency_and_warm_table_reuse():
+    """txn k+1 spends what txn k landed; a second batch on the warm
+    table (zero misses -> one native call) stays golden-equal."""
+    rng = np.random.default_rng(7)
+    a, b, c = _key(rng), _key(rng), _key(rng)
+    funding = {a: Account(1_000_000), b: Account(10_000)}
+    batch1 = [_xfer(a, b, 500_000), _xfer(b, c, 490_000)]
+    batch2 = [_xfer(c, a, 123_457), _xfer(b, a, 1)]
+
+    funk_n, ex_n = _fund(funding)
+    tab = BankTable(np.zeros(BankTable.footprint(256), np.uint8), 256)
+    funk_g, ex_g = _fund(funding)
+    for tag, batch in ((1, batch1), (2, batch2)):
+        rows, szs = _pack_rows(batch)
+        scan = P.txn_scan(rows, szs)
+        ex_n.execute_fast_transfers_native(
+            tab, rows, szs, np.arange(len(batch), dtype=np.int64), scan,
+            tag=tag,
+        )
+        tab.commit(funk_n)
+        for t in batch:
+            ex_g.execute_txn(t)
+    assert _snap(funk_n) == _snap(funk_g)
+
+
+def test_table_full_falls_back_without_divergence():
+    """A table too small for the working set must fail CLOSED: txns the
+    table cannot host run through the general executor, and the result
+    still equals golden."""
+    rng = np.random.default_rng(11)
+    txns, funding = _fuzz_batch(rng, n_txns=32)
+    nf, ns, _ = _run_native(txns, funding, slots=4, tag=3)
+    gf, gs = _run_golden(txns, funding)
+    assert ns == gs
+    assert _snap(nf) == _snap(gf)
+
+
+def test_commit_keeps_lam_cache_discipline():
+    """commit() write-backs must leave funk.lam_cache holding exactly
+    the decoded lamports of the live root record (the coherence rule
+    execute_fast_transfers established)."""
+    rng = np.random.default_rng(13)
+    p, d = _key(rng), _key(rng)
+    funding = {p: Account(1_000_000)}
+    funk, stats, tab = _run_native([_xfer(p, d, 100)], funding, tag=9)
+    assert stats == (5000, 1, 0)
+    mgr = AccountMgr(funk)
+    assert funk.lam_cache[p] == mgr.load(p).lamports == 1_000_000 - 5_100
+    assert funk.lam_cache[d] == mgr.load(d).lamports == 100
+    # table and funk agree (the table is the authoritative copy)
+    assert tab.get(p) == (BankTable.ST_TRIVIAL, 1_000_000 - 5_100)
+
+
+# ---------------------------------------------------------------------------
+# 2. crash safety: journal rollback + SIGKILL mid-slot
+
+
+def test_journal_rollback_restores_slots():
+    """A journal left in phase=APPLYING (killed between the undo record
+    and the done-count advance) must roll its slots back exactly and
+    re-mark them dirty for the funk drain."""
+    slots = 64
+    tab = BankTable(np.zeros(BankTable.footprint(slots), np.uint8), slots)
+    key_a, key_b = bytes(range(32)), bytes(range(32, 64))
+    assert tab.put(key_a, BankTable.ST_TRIVIAL, 1000)
+    assert tab.put(key_b, BankTable.ST_ABSENT, 0)
+    # find the slot indices via a drain-free probe: hash order is
+    # implementation detail, so locate by get + brute scan of the region
+    mem = tab.mem
+    slot_words = mem[64:].view(np.uint64).reshape(slots, 8)
+    idx = {}
+    for i in range(slots):
+        kb = slot_words[i, :4].tobytes()
+        if kb == key_a:
+            idx[key_a] = i
+        elif kb == key_b:
+            idx[key_b] = i
+    # simulate a crash mid-apply: slots already mutated, journal armed
+    # (the done-count was advanced but the phase never cleared, so the
+    # rollback must ALSO rewind done to the pre-txn count)
+    tab.put(key_a, BankTable.ST_TRIVIAL, 42, dirty=True)
+    tab.put(key_b, BankTable.ST_TRIVIAL, 43, dirty=True)
+    jw = tab._jw
+    jw[0] = 77   # tag
+    jw[1] = 4    # txns done (already advanced for the in-flight txn)
+    jw[2] = 1    # phase: APPLYING
+    jw[3] = 2    # undo entries
+    jw[4] = 3    # done-count BEFORE the in-flight txn
+    jw[5:8] = (idx[key_a], BankTable.ST_TRIVIAL, 1000)
+    jw[8:11] = (idx[key_b], BankTable.ST_ABSENT, 0)
+    funk = Funk()
+    tag, done, rolled = tab.recover(funk)
+    assert rolled and (tag, done) == (77, 3), "done must rewind to pre-txn"
+    assert tab.get(key_a) == (BankTable.ST_TRIVIAL, 1000)
+    assert tab.get(key_b)[0] == BankTable.ST_ABSENT
+    # the rollback re-dirtied both: the drain restored funk's view
+    assert funk.rec_read(b"\x00" * 32, key_a) is not None
+    assert funk.rec_read(b"\x00" * 32, key_b) is None
+    assert int(tab._jw[2]) == 0
+
+
+def test_mid_microblock_resume_applies_exactly_once():
+    """A bank that died with a microblock half done must resume at the
+    journal's txn count: re-running the WHOLE batch under the same tag
+    applies only the unapplied suffix (the dead incarnation's prefix is
+    skipped via the shm journal, not re-executed)."""
+    rng = np.random.default_rng(17)
+    pool = [_key(rng) for _ in range(8)]
+    funding = {k: Account(1_000_000) for k in pool}
+    txns = [
+        _xfer(pool[i % 8], pool[(i + 3) % 8], 1_001 + 7 * i)
+        for i in range(16)
+    ]
+    funk, ex = _fund(funding)
+    tab = BankTable(np.zeros(BankTable.footprint(256), np.uint8), 256)
+    rows, szs = _pack_rows(txns)
+    scan = P.txn_scan(rows, szs)
+    idx = np.arange(16, dtype=np.int64)
+    # "crash" after 7 txns: run the prefix only, then replay the whole
+    # microblock under the same tag as a restarted bank would
+    ex.execute_fast_transfers_native(tab, rows, szs, idx[:7], scan, tag=55)
+    assert int(tab._jw[1]) == 7
+    start = tab.begin(55)
+    assert start == 7
+    ex.execute_fast_transfers_native(
+        tab, rows, szs, idx, scan, tag=55, start=start
+    )
+    tab.commit(funk)
+    gfunk, gex = _fund(funding)
+    for t in txns:
+        gex.execute_txn(t)
+    assert _snap(funk) == _snap(gfunk)
+
+
+def test_replayed_completed_microblock_never_reexecutes():
+    """The supervisor's restart replay redelivers MANY microblocks (the
+    consumer fseq only advances at housekeeping cadence), not just the
+    half-done one — every fully-completed microblock below the journal's
+    completed-seq mark must re-publish but never re-execute, or the
+    surviving shm table double-applies its transfers."""
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import MuxCtx
+    from firedancer_tpu.tiles.bank import BankTile
+
+    rng = np.random.default_rng(41)
+    a, b = _key(rng), _key(rng)
+    funk, _ = _fund({a: Account(1_000_000), b: Account(1_000_000)})
+    bank = BankTile(0, funk=funk, table_slots=256)
+    ctx = MuxCtx(
+        "bank0",
+        R.CNC(np.zeros(R.CNC.footprint(), np.uint8)),
+        [], [],
+        Metrics(
+            np.zeros(Metrics.footprint(bank.schema), np.uint8), bank.schema
+        ),
+    )
+    bank.on_boot(ctx)
+    txns = [_xfer(a, b, 1_111), _xfer(b, a, 2_223)]
+    rows, szs = _pack_rows(txns)
+
+    def _lam(k):
+        return AccountMgr(funk).load(k).lamports
+
+    fees = bank._execute(ctx, rows, szs, tag=100)
+    bank._commit(ctx)
+    assert fees == 10_000
+    snap = (_lam(a), _lam(b))
+    # redelivery of the SAME and of an EARLIER frag seq: skipped whole
+    assert bank._execute(ctx, rows, szs, tag=100) is None
+    assert bank._execute(ctx, rows, szs, tag=99) is None
+    bank._commit(ctx)
+    assert (_lam(a), _lam(b)) == snap, "replayed microblock re-executed"
+    # a genuinely NEW microblock still executes
+    assert bank._execute(ctx, rows, szs, tag=101) == 10_000
+
+
+# -- SIGKILL harness --------------------------------------------------------
+
+RESTART_SLOTS = 1 << 10
+RESTART_BATCH_N = 16
+RESTART_BATCHES = 64
+
+
+def _restart_corpus(seed: int):
+    """Deterministic corpus shared by parent, child, and golden: chained
+    fast transfers over a small account pool.  Amounts are never
+    multiples of the 5000 fee so a torn (half-applied) txn cannot hide
+    inside a fee-shaped delta."""
+    rng = np.random.default_rng(seed)
+    pool = [_key(rng) for _ in range(24)]
+    funding = {
+        k: Account(int(rng.integers(1_000_000, 5_000_000))) for k in pool
+    }
+    txns = []
+    for _ in range(RESTART_BATCHES * RESTART_BATCH_N):
+        a = pool[int(rng.integers(0, len(pool)))]
+        b = pool[int(rng.integers(0, len(pool)))]
+        amt = int(rng.integers(1, 50_000))
+        if amt % 5_000 == 0:
+            amt += 1
+        txns.append(_xfer(a, b, amt))
+    return pool, funding, txns
+
+
+def _exec_batches(tab, ex, txns, first_batch, last_batch, prog=None,
+                  sleep_s=0.0):
+    rows, szs = _pack_rows(txns)
+    scan = P.txn_scan(rows, szs)
+    for b in range(first_batch, last_batch):
+        lo = b * RESTART_BATCH_N
+        idx = np.arange(lo, lo + RESTART_BATCH_N, dtype=np.int64)
+        tag = 1000 + b
+        start = tab.begin(tag)
+        ex.execute_fast_transfers_native(
+            tab, rows, szs, idx, scan, tag=tag, start=start
+        )
+        if prog is not None:
+            prog[0] = b + 1
+        if sleep_s:
+            time.sleep(sleep_s)
+
+
+def _restart_child(wksp_name: str, seed: int) -> None:
+    """The 'bank process': executes the corpus batch by batch against
+    the shm table until killed."""
+    ws, _extra = R.Workspace.attach(wksp_name)
+    tab = BankTable(
+        ws.view("shared_banktab"), RESTART_SLOTS, journal=ws.view("jnl")
+    )
+    prog = ws.view("prog")[:16].view(np.uint64)
+    _pool, funding, txns = _restart_corpus(seed)
+    funk, ex = _fund(funding)
+    prog[1] = os.getpid()  # ready signal for the parent's kill timer
+    _exec_batches(tab, ex, txns, int(prog[0]), RESTART_BATCHES, prog=prog,
+                  sleep_s=0.002)
+
+
+_CHILD_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+import test_bank_native as M
+M._restart_child({name!r}, {seed})
+"""
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_sigkill_restart_zero_lost_zero_duplicated(seed, tmp_path):
+    """SIGKILL a bank process mid-slot; after shm-table rejoin +
+    recover(), the table must equal the golden prefix EXACTLY (the
+    journal names how many txns landed), and resuming applies the rest
+    exactly once — final state equals the full golden run."""
+    name = f"banktest_{os.getpid()}_{seed}"
+    ws = R.Workspace(BankTable.footprint(RESTART_SLOTS) + 8192, name=name)
+    try:
+        ws.alloc("shared_banktab", BankTable.footprint(RESTART_SLOTS))
+        ws.alloc("jnl", BankTable.JOURNAL_BYTES)
+        ws.alloc("prog", 128)
+        ws.publish_directory()
+        prog = ws.view("prog")[:16].view(np.uint64)
+
+        p = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SCRIPT.format(
+                repo=REPO, tests=os.path.join(REPO, "tests"),
+                name=name, seed=seed,
+            )],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            deadline = time.monotonic() + 60.0
+            while not int(prog[1]):
+                assert p.poll() is None, "child died before executing"
+                assert time.monotonic() < deadline, "child never started"
+                time.sleep(0.005)
+            # let it get partway into the slot, then SIGKILL mid-flight
+            time.sleep(0.02 + 0.03 * (seed % 3))
+            os.kill(p.pid, signal.SIGKILL)
+        finally:
+            p.wait(timeout=30)
+
+        # ---- restart: rejoin the shm table, roll back, resume --------
+        pool, funding, txns = _restart_corpus(seed)
+        funk, ex = _fund(funding)
+        tab = BankTable(
+            ws.view("shared_banktab"), RESTART_SLOTS,
+            journal=ws.view("jnl"),
+        )
+        assert tab.rejoined
+        tag, done, _rolled = tab.recover(funk, ex.xid)
+        batches_done = int(prog[0])
+        if tag >= 1000:
+            applied = max(
+                batches_done * RESTART_BATCH_N,
+                (tag - 1000) * RESTART_BATCH_N + done,
+            )
+        else:
+            applied = batches_done * RESTART_BATCH_N
+        assert 0 <= applied <= len(txns)
+
+        # golden prefix: exactly `applied` txns landed, none torn
+        gfunk, gex = _fund(funding)
+        for t in txns[:applied]:
+            gex.execute_txn(t)
+        gmgr = AccountMgr(gfunk)
+        for k in pool:
+            want = gmgr.load(k)
+            st, lam = tab.get(k)
+            if st == BankTable.ST_EMPTY:
+                # never cached: the account was never touched natively
+                assert want.lamports == funding[k].lamports, (
+                    "untouched account diverged"
+                )
+            else:
+                assert st == BankTable.ST_TRIVIAL
+                assert lam == want.lamports, (
+                    f"lamports diverged after kill (applied={applied})"
+                )
+
+        # resume from the journal: every remaining txn exactly once
+        current = (tag - 1000) if tag >= 1000 else batches_done
+        _exec_batches(tab, ex, txns, max(current, 0), RESTART_BATCHES)
+        tab.commit(funk, ex.xid)
+        for t in txns[applied:]:
+            gex.execute_txn(t)
+        for k in pool:
+            st, lam = tab.get(k)
+            assert st == BankTable.ST_TRIVIAL
+            assert lam == gmgr.load(k).lamports, "resume lost/duplicated"
+    finally:
+        ws.unlink()
+
+
+# ---------------------------------------------------------------------------
+# 3. process-runtime sharding: every bank child maps ONE shared table
+
+
+class _ProbeTile(_MuxTile):
+    """Minimal proc-safe tile asserting ctx.shared crosses the process
+    boundary: each shard writes its pid into the SAME region.  Module
+    level so multiprocessing spawn can unpickle it in the child."""
+
+    schema = _MetricsSchema()
+
+    def __init__(self, i: int):
+        self.i = i
+        self.name = f"probe{i}"
+
+    def shared_wksp_footprints(self):
+        return {"probetab": 4096}
+
+    def on_boot(self, ctx):
+        w = ctx.shared("probetab", 4096)[:64].view(np.uint64)
+        w[self.i] = os.getpid()
+
+
+def test_process_shards_map_one_shared_region():
+    """Two tiles under the process runtime must resolve ctx.shared to
+    the parent's single workspace allocation — the mechanism that lets
+    N bank processes execute against one account table."""
+    from firedancer_tpu.disco import Topology
+
+    topo = Topology(name=f"shardprobe_{os.getpid()}", runtime="process")
+    topo.tile(_ProbeTile(0))
+    topo.tile(_ProbeTile(1))
+    topo.build()
+    topo.start(boot_timeout_s=300.0)
+    try:
+        w = topo.wksp.view("shared_probetab")[:64].view(np.uint64)
+        deadline = time.monotonic() + 30.0
+        while not (int(w[0]) and int(w[1])):
+            topo.poll_failure()
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        pids = {int(w[0]), int(w[1])}
+        assert len(pids) == 2 and os.getpid() not in pids, (
+            "shards must be distinct child processes writing one region"
+        )
+    finally:
+        topo.halt()
+        topo.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. the bank tile: malformed microblocks are a metered drop
+
+
+def _mb_encode(handle: int, bank: int, txns) -> bytes:
+    out = (
+        handle.to_bytes(4, "little")
+        + bank.to_bytes(2, "little")
+        + len(txns).to_bytes(2, "little")
+    )
+    for t in txns:
+        out += len(t).to_bytes(2, "little") + t
+    return out
+
+
+def test_malformed_microblock_is_metered_drop():
+    """A truncated microblock must not kill the bank tile NOR leak its
+    pack handle: the tile meters `malformed_microblocks`, publishes the
+    completion (freeing the bank at pack), forwards nothing to poh, and
+    keeps executing subsequent valid microblocks."""
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.disco.mux import OutLink
+    from firedancer_tpu.tiles.bank import BankTile
+
+    rng = np.random.default_rng(21)
+    payer, dest = _key(rng), _key(rng)
+    funk = Funk()
+    AccountMgr(funk).store(payer, Account(1_000_000))
+
+    topo = Topology()
+    topo.link("pack_bank0", depth=64, mtu=65_535)
+    topo.link("bank0_pack", depth=64)
+    topo.link("bank0_poh", depth=64, mtu=65_535)
+    bank = BankTile(0, funk=funk)
+    topo.tile(
+        bank, ins=[("pack_bank0", True)],
+        outs=["bank0_pack", "bank0_poh"],
+    )
+    topo.build()
+    feeder = OutLink(
+        "pack_bank0", topo._mcaches["pack_bank0"],
+        topo._dcaches["pack_bank0"],
+        [topo._fseqs[("pack_bank0", "bank0")]],
+    )
+    topo.start()
+    try:
+        good = _mb_encode(1, 0, [_xfer(payer, dest, 500)])
+        # claims 3 txns, carries half of one: fdt_mb_decode fails
+        bad = bytearray(_mb_encode(2, 0, [_xfer(payer, dest, 7)]))
+        bad[6:8] = (3).to_bytes(2, "little")
+        for payload in (bytes(bad), good):
+            row = np.frombuffer(payload, np.uint8)[None, :]
+            feeder.publish(
+                np.array([0], np.uint64), row,
+                np.array([len(payload)], np.uint16),
+            )
+        m = topo.metrics("bank0")
+        deadline = time.monotonic() + 30.0
+        while m.counter("executed_microblocks") < 1:
+            topo.poll_failure()  # the tile must NOT die on the bad frag
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert m.counter("malformed_microblocks") == 1
+        assert m.counter("executed_microblocks") == 1  # only the good one
+        # BOTH frags completed back to pack (handle freed), but only the
+        # good one was forwarded to poh
+        assert topo._mcaches["bank0_pack"].seq_query() == 2
+        assert topo._mcaches["bank0_poh"].seq_query() == 1
+        # and the good one really executed through the native table;
+        # the funk write-back lands on the housekeeping commit cadence
+        assert m.counter("native_txns") == 1
+        mgr = AccountMgr(funk)
+        while mgr.load(dest) is None:
+            topo.poll_failure()
+            assert time.monotonic() < deadline, "commit never drained"
+            time.sleep(0.01)
+        assert mgr.load(dest).lamports == 500
+    finally:
+        topo.halt()
+        topo.close()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -106,3 +106,25 @@ def test_checkpoint_restore(tmp_path):
         bad = str(tmp_path / "bad.ckpt")
         open(bad, "wb").write(b"garbage!")
         Funk.restore(bad)
+
+
+def test_rec_write_many_batch_semantics():
+    """Batch write-back (the bank table's commit path): one frozen
+    check, None removes, every touched key drops out of lam_cache."""
+    f = Funk()
+    f.rec_write(ROOT_XID, b"a", b"old")
+    f.rec_write(ROOT_XID, b"gone", b"x")
+    f.lam_cache[b"a"] = 123
+    f.lam_cache[b"gone"] = 7
+    f.rec_write_many(ROOT_XID, [(b"a", b"new"), (b"b", b"v"), (b"gone", None)])
+    assert f.root[b"a"] == b"new" and f.root[b"b"] == b"v"
+    assert b"gone" not in f.root
+    assert b"a" not in f.lam_cache and b"gone" not in f.lam_cache
+    # txn writes shadow (None is the tombstone) and respect frozen
+    f.txn_prepare(ROOT_XID, b"\x01" * 32)
+    f.rec_write_many(b"\x01" * 32, [(b"a", None), (b"c", b"cc")])
+    assert f.rec_read(b"\x01" * 32, b"a") is None
+    assert f.rec_read(b"\x01" * 32, b"c") == b"cc"
+    assert f.root[b"a"] == b"new"
+    with pytest.raises(AssertionError):
+        f.rec_write_many(ROOT_XID, [(b"z", b"1")])  # root frozen now

@@ -1,11 +1,14 @@
 """ASan/UBSan pass over the native layer (slow tier, `-m sanitize`).
 
 Rebuilds tango/native with FDT_SAN=1 into a scratch cache and re-runs
-the native test surface (tests/test_tango.py + tests/test_pack_native.py)
-in a subprocess with the sanitizer runtimes preloaded.  Memory-safety
-bugs in fdt_tango.c / fdt_pack.c / fdt_sha512.c — the code Python hands
-raw pointers to — become test failures here instead of corruption in a
-soak run.
+the native test surface (tests/test_tango.py + tests/test_pack_native.py
++ tests/test_bank_native.py) in a subprocess with the sanitizer runtimes
+preloaded.  Memory-safety bugs in fdt_tango.c / fdt_pack.c /
+fdt_sha512.c / fdt_bank.c — the code Python hands raw pointers to —
+become test failures here instead of corruption in a soak run.  The
+bank surface also runs its SIGKILL/process-spawn harnesses under the
+preload, so the shm table's claim/publish protocol is ASan-checked
+across real process boundaries.
 
 Skips (not fails) when the toolchain cannot produce a runnable sanitized
 build: no sanitizer runtime libraries, or a compiler without
@@ -29,8 +32,13 @@ REPO = Path(__file__).resolve().parent.parent
 pytestmark = [pytest.mark.slow, pytest.mark.sanitize]
 
 #: the tests that exercise every exported native entry point through
-#: ctypes (rings bindings + the pack/txn scan layer)
-NATIVE_SURFACE = ["tests/test_tango.py", "tests/test_pack_native.py"]
+#: ctypes (rings bindings + the pack/txn scan layer + the fdt_bank
+#: shared-memory batch executor)
+NATIVE_SURFACE = [
+    "tests/test_tango.py",
+    "tests/test_pack_native.py",
+    "tests/test_bank_native.py",
+]
 
 
 def _san_env(cache_dir: Path, preload: str) -> dict:

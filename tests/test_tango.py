@@ -203,6 +203,27 @@ def test_tcache_vs_python_model():
         assert list(got) == want
 
 
+def test_tcache_dedup_journaled(wksp):
+    """fdt_tcache_dedup_j: identical dedup semantics, plus every
+    inserted tag journaled (in order, before the insert) with the
+    overflow flag on capacity exhaustion."""
+    tc = TCache.create(wksp, "tcj", depth=8)
+    jnl = np.zeros(4 + 4, np.uint64)  # capacity 4 tags
+    tags = np.array([5, 6, 5, 0, 7], dtype=np.uint64)
+    dup = tc.dedup_j(tags, jnl)
+    assert list(dup) == [False, False, True, False, False]
+    assert int(jnl[2]) == 3 and int(jnl[3]) == 0
+    assert jnl[4:7].tolist() == [5, 6, 7]  # inserted tags, in order
+    # second batch: dups journal nothing; overflow sets the flag
+    jnl[2] = 0
+    dup = tc.dedup_j(np.array([5, 8, 9, 10, 11, 12], np.uint64), jnl)
+    assert list(dup) == [True] + [False] * 5
+    assert int(jnl[2]) == 4 and int(jnl[3]) == 1  # capped + flagged
+    assert jnl[4:8].tolist() == [8, 9, 10, 11]
+    # cache state matches the unjournaled call's semantics
+    assert tc.query(12) and not tc.query(99)
+
+
 def test_tcache_reset(wksp):
     tc = TCache.create(wksp, "tc", depth=4)
     tc.dedup(np.array([1, 2, 3], dtype=np.uint64))

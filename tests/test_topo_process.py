@@ -166,6 +166,117 @@ def test_process_supervisor_kill_restart_rejoin():
         topo.close()
 
 
+def _sigkill_in_window(flag_path: str) -> None:
+    """One-shot crash probe for the dedup insert→publish window: the
+    FIRST time the (child-process) dedup tile reaches the point after
+    its journaled tcache insert but before the publish, SIGKILL
+    ourselves — the exact window the rare chaos-test flake hit.  The
+    flag file makes it once-ever across incarnations."""
+    import os as _os
+
+    try:
+        fd = _os.open(flag_path, _os.O_CREAT | _os.O_EXCL | _os.O_WRONLY)
+    except FileExistsError:
+        return
+    _os.close(fd)
+    _os.kill(_os.getpid(), signal.SIGKILL)
+
+
+def test_dedup_insert_publish_window_amnesty(tmp_path):
+    """Deterministic regression for the insert-before-publish loss
+    window (the rare lost-frag flake in the kill/restart chaos test): a
+    dedup CHILD SIGKILLed after its surviving shm tcache absorbed a
+    batch's inserts but before the publish must not lose the batch —
+    the restarted incarnation reads the insert journal, grants the
+    unpublished tags a one-shot replay amnesty, and the full survivor
+    set lands exactly once."""
+    import functools
+
+    pool_n, repeat = 256, 2
+    topo, synth, total = _relay_topo(
+        f"ta{os.getpid()}", "process", pool_n, repeat, shm_log=1 << 13
+    )
+    topo.tiles["dedup"].tile._crash_probe = functools.partial(
+        _sigkill_in_window, str(tmp_path / "window_kill_once")
+    )
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=1.0,
+            backoff_base_s=0.05,
+            replay={"dedup": 256, "sink": 256},
+        ),
+    )
+    sup.start(batch_max=16, idle_sleep_s=2e-3)
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            sigs = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+            if len(set(sigs.tolist())) >= pool_n:
+                break
+            time.sleep(0.05)
+        sigs = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+        uniq = set(sigs.tolist())
+        assert os.path.exists(tmp_path / "window_kill_once"), (
+            "crash probe never fired"
+        )
+        assert sup.restarts("dedup") >= 1
+        assert len(uniq) == pool_n, f"lost {pool_n - len(uniq)} frags"
+        assert len(sigs) == len(uniq), "duplicated frags past dedup"
+        # the recovery path actually ran: the killed batch's unpublished
+        # survivors were amnestied, not silently re-admitted
+        assert topo.metrics("dedup").counter("replay_amnesty") >= 1
+    finally:
+        sup.halt()
+        topo.close()
+
+
+def test_amnesty_survives_second_crash_before_drain():
+    """The amnesty itself must be crash-safe: a recovering incarnation
+    persists the pending set in shm BEFORE clearing the journal phase,
+    so a second kill landing before the replay drains still grants the
+    amnesty (a plain in-memory set would reopen the loss window)."""
+    import numpy as np
+
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import MuxCtx, OutLink
+    from firedancer_tpu.tango import rings as R
+    from firedancer_tpu.tiles.dedup import (
+        _B_CNT, _B_TAGS, _J_ACNT, _J_ACTIVE, _J_PHASE, _J_SEQ0, DedupTile,
+    )
+
+    mc = R.MCache(np.zeros(R.MCache.footprint(64), np.uint8), 64)
+    ded = DedupTile(depth=256)
+    ctx = MuxCtx(
+        "dedup",
+        R.CNC(np.zeros(R.CNC.footprint(), np.uint8)),
+        [],
+        [OutLink("dedup_sink", mc, None, [])],
+        Metrics(np.zeros(Metrics.footprint(ded.schema), np.uint8),
+                ded.schema),
+    )
+    ded.on_boot(ctx)
+    # crash #1: the dead incarnation journaled 3 inserted tags (2 of 3
+    # published — the out seq advanced past seq0 by 2)
+    jw, b0 = ded._jnl, ded._blk[0]
+    jw[_J_SEQ0] = mc.seq_query()
+    mc.seq_advance(int(mc.seq_query()) + 2)
+    b0[_B_TAGS : _B_TAGS + 3] = (11, 12, 13)
+    b0[_B_CNT] = 3
+    jw[_J_ACTIVE] = 0
+    jw[_J_PHASE] = 1
+    ctx.incarnation = 1
+    ded.on_boot(ctx)  # recovery (ctx.alloc is idempotent: same shm)
+    assert ded._amnesty == {13}, "only the unpublished tag is amnestied"
+    assert int(jw[_J_ACNT]) == 1 and int(jw[_J_PHASE]) == 0
+    # crash #2 BEFORE the replay drains: the next incarnation must still
+    # hold the amnesty (from the persisted shm area, phase is clean)
+    ctx.incarnation = 2
+    ded.on_boot(ctx)
+    assert ded._amnesty == {13}, "amnesty lost across a second crash"
+    assert ctx.metrics.counter("replay_amnesty") == 2  # once per recovery
+
+
 def test_process_monitor_attaches_from_third_process():
     """app/monitor.py AND scripts/fdttrace.py attach READ-ONLY from a
     genuinely separate process while the child tiles run, and see live
