@@ -532,6 +532,251 @@ def _bench_bank_exec() -> dict:
     }
 
 
+def _bench_stem() -> dict:
+    """Native-stem A/Bs (ISSUE 10): the GIL-released fdt_stem burst loop
+    vs the Python on_frags loop, publish streams asserted BIT-IDENTICAL
+    before timing is trusted.
+
+    a) stem_frags_per_s — dedup-hop service rate at the contended-regime
+       burst size (B=64: the per-iteration batches a GIL-shared
+       validator actually sees, PROFILE.md round 5b), raw rings, feeder
+       cost amortized out so the number isolates the hop itself.
+    b) bank_hop_txns_per_s — the round-10b harness (feeder -> bank tile
+       through real rings, 240 x 256-txn microblocks, thread runtime):
+       the fused decode->scan->exec pipeline vs the per-microblock
+       Python path.
+
+    Keys: stem_frags_per_s(_py), stem_speedup, bank_hop_txns_per_s(_py),
+    bank_hop_speedup."""
+    import hashlib
+
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import InLink, MuxCtx, OutLink
+    from firedancer_tpu.tango import rings as R
+    from firedancer_tpu.tiles.dedup import DedupTile
+
+    # ---- a) dedup hop service rate --------------------------------------
+    def _mk_dedup(depth=1 << 14, mtu=1248):
+        in_mc = R.MCache(
+            np.zeros(R.MCache.footprint(depth), np.uint8), depth
+        )
+        in_dc = R.DCache(
+            np.zeros(R.DCache.footprint(mtu, depth), np.uint8), mtu, depth
+        )
+        in_fs = R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))
+        out_mc = R.MCache(
+            np.zeros(R.MCache.footprint(depth), np.uint8), depth
+        )
+        out_dc = R.DCache(
+            np.zeros(R.DCache.footprint(mtu, depth), np.uint8), mtu, depth
+        )
+        cons = R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))
+        ded = DedupTile(depth=1 << 18)
+        schema = ded.schema.with_base()
+        ctx = MuxCtx(
+            "dedup", R.CNC(np.zeros(R.CNC.footprint(), np.uint8)),
+            [InLink("in", in_mc, in_dc, in_fs)],
+            [OutLink("out", out_mc, out_dc, [cons])],
+            Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema),
+        )
+        ded.on_boot(ctx)
+        return ded, ctx, cons
+
+    def _dedup_hop(native: bool, digest: bool, B=64, K=16, total=40_960):
+        """One pass over `total` frags in B-sized service rounds.
+        digest=True captures the published stream (sig, sz, payload)
+        for the bit-identical A/B assert — parity pass; digest=False is
+        the TIMED pass (same deterministic workload, no python-side
+        capture inflating the measured hop)."""
+        ded, ctx, cons = _mk_dedup()
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, 256, (K * B, 192), np.uint8).astype(
+            np.uint8
+        )
+        szs = np.full(K * B, 192, np.uint16)
+        il, ol = ctx.ins[0], ctx.outs[0]
+        stem = None
+        if native:
+            stem = R.Stem(ctx.ins, ctx.outs, ded.native_handler(ctx), cap=B)
+        base_tags = np.arange(1, K * B + 1, dtype=np.uint64)
+        h = hashlib.blake2b(digest_size=16)
+        out_seq = 0
+        t0 = time.perf_counter()
+        seqp = 0
+        done = 0
+        while done < total:
+            # unique tags per round, with a deterministic 25% dup rate
+            # against the previous round (dedup work is part of the hop)
+            tags = base_tags + np.uint64(seqp)
+            if seqp:
+                tags[:: 4] -= np.uint64(K * B)
+            chunks = il.dcache.write_batch(rows, szs)
+            il.mcache.publish_batch(seqp, tags, chunks, szs, None, 3, None)
+            seqp += K * B
+            for _ in range(K):
+                if native:
+                    stem.run(B, 5)
+                else:
+                    frags, il.seq, _ = il.mcache.drain(il.seq, B)
+                    ded.on_frags(ctx, 0, frags)
+                frags, out_seq, ovr = ol.mcache.drain(out_seq, 2 * B)
+                assert ovr == 0
+                if digest and len(frags):
+                    h.update(frags["sig"].tobytes())
+                    h.update(frags["sz"].tobytes())
+                    h.update(
+                        ol.dcache.read_batch(
+                            frags["chunk"], frags["sz"], 192
+                        ).tobytes()
+                    )
+                cons.update(out_seq)
+                done += B
+        dt = time.perf_counter() - t0
+        return total / dt, h.hexdigest()
+
+    out: dict = {}
+    _, py_dig = _dedup_hop(False, digest=True, total=8_192)
+    _, na_dig = _dedup_hop(True, digest=True, total=8_192)
+    assert na_dig == py_dig, "dedup stem publish stream diverged"
+    py_rate, _ = _dedup_hop(False, digest=False)
+    na_rate, _ = _dedup_hop(True, digest=False)
+    out["stem_frags_per_s"] = round(na_rate, 1)
+    out["stem_frags_per_s_py"] = round(py_rate, 1)
+    out["stem_speedup"] = round(na_rate / py_rate, 2)
+
+    # ---- b) bank hop through real rings ---------------------------------
+    from firedancer_tpu.ballet import txn as BT
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.disco.mux import Tile
+    from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.bank import BankTile
+    from firedancer_tpu.tiles.pack import mb_encode
+
+    rng = np.random.default_rng(23)
+    n_payers, per_mb, n_mb = 1024, 256, 240
+    payers = [
+        bytes(rng.integers(0, 256, 32, np.uint8)) for _ in range(n_payers)
+    ]
+    txns = []
+    for i in range(per_mb * n_mb):
+        p = payers[i % n_payers]
+        d = payers[(i * 7 + 3) % n_payers]
+        data = (2).to_bytes(4, "little") + int(
+            1 + rng.integers(1, 9_999)
+        ).to_bytes(8, "little")
+        txns.append(
+            BT.build(
+                [bytes(64)], [p, d, bytes(32)], bytes(32),
+                [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+            )
+        )
+    width = max(len(t) for t in txns)
+    rows = np.zeros((len(txns), width), np.uint8)
+    szs = np.zeros(len(txns), np.uint16)
+    for i, t in enumerate(txns):
+        rows[i, : len(t)] = np.frombuffer(t, np.uint8)
+        szs[i] = len(t)
+    payloads = [
+        mb_encode(
+            h, 0, rows, szs,
+            idx=np.arange(h * per_mb, (h + 1) * per_mb, dtype=np.int64),
+        )
+        for h in range(n_mb)
+    ]
+
+    class _Feeder(Tile):
+        name = "feeder"
+
+        def __init__(self):
+            self.sent = 0
+            self.released = False
+
+        def after_credit(self, ctx):
+            while self.sent < n_mb and ctx.outs[0].cr_avail():
+                # 4-microblock warmup touches every pool key (1024
+                # payers / 256 txns per microblock) so the steady
+                # stream measures the hop, not the funk resolve
+                if self.sent >= 4 and not self.released:
+                    return
+                pl = payloads[self.sent]
+                ctx.outs[0].publish(
+                    np.array([self.sent], np.uint64), pl[None, :],
+                    np.array([len(pl)], np.uint16),
+                )
+                self.sent += 1
+
+    class _Catch(Tile):
+        def __init__(self, name):
+            self.name = name
+            self.sigs: list[int] = []
+
+        def on_frags(self, ctx, i, frags):
+            self.sigs.extend(int(s) for s in frags["sig"])
+
+    def _bank_hop(stem_mode: str):
+        funk = Funk()
+        mgr = AccountMgr(funk)
+        for p in payers:
+            mgr.store(p, Account(1 << 40))
+        topo = Topology()
+        topo.link("fb", depth=512, mtu=65_535)
+        topo.link("bp", depth=512)
+        topo.link("bpoh", depth=512, mtu=65_535)
+        f = _Feeder()
+        c1, c2 = _Catch("c1"), _Catch("c2")
+        topo.tile(f, outs=["fb"])
+        topo.tile(
+            BankTile(0, funk=funk, native=True, table_slots=1 << 12),
+            ins=[("fb", True)], outs=["bp", "bpoh"],
+        )
+        topo.tile(c1, ins=[("bp", True)])
+        topo.tile(c2, ins=[("bpoh", True)])
+        topo.build()
+        # idle_sleep 1 ms: the default 50 µs sleep-spin is a bench knob
+        # that burns the 2-core host's second core on idle catchers
+        topo.start(batch_max=512, stem=stem_mode, idle_sleep_s=1e-3)
+        m = topo.metrics("bank0")
+        while len(c1.sigs) < 4:
+            topo.poll_failure()
+            time.sleep(0.002)
+        t0 = time.perf_counter()
+        f.released = True
+        deadline = time.monotonic() + 120.0
+        while True:
+            topo.poll_failure()
+            # the bank's own counters gate the stop: completions publish
+            # from inside the burst, metrics land at the burst boundary
+            if len(c1.sigs) >= n_mb and m.counter("in_frags") >= n_mb:
+                break
+            if time.monotonic() >= deadline:
+                # a silent fall-through here would publish a bogus
+                # ~120 s-clamped throughput number
+                raise TimeoutError(
+                    f"bank hop stalled: {len(c1.sigs)}/{n_mb} completions"
+                )
+            time.sleep(0.002)
+        dt = time.perf_counter() - t0
+        stem_frags = m.counter("stem_frags")
+        topo.halt()
+        topo.close()
+        state = {p: AccountMgr(funk).load(p).lamports for p in payers}
+        return (
+            (n_mb - 4) * per_mb / dt, state, list(c1.sigs), list(c2.sigs),
+            stem_frags,
+        )
+
+    py_tps, py_state, py_c, py_p, _ = _bank_hop("python")
+    na_tps, na_state, na_c, na_p, na_sf = _bank_hop("native")
+    assert py_state == na_state, "bank hop A/B diverged"
+    assert py_c == na_c and py_p == na_p, "bank publish streams diverged"
+    assert na_sf > 0, "native bank hop never engaged the stem"
+    out["bank_hop_txns_per_s"] = round(na_tps, 1)
+    out["bank_hop_txns_per_s_py"] = round(py_tps, 1)
+    out["bank_hop_speedup"] = round(na_tps / py_tps, 2)
+    return out
+
+
 def _tunnel_calibration() -> float:
     """H2D bandwidth through the axon tunnel, MB/s (best of 3).
 
@@ -598,6 +843,13 @@ def main() -> None:
             # bank executor A/B: native shared-memory batch exec vs the
             # per-txn python fast path on the same batch (ISSUE 9)
             result.update(_bench_bank_exec())
+    except Exception:
+        pass
+    try:
+        if "stem" not in skip:
+            # native-stem A/Bs: dedup-hop service rate + bank hop
+            # through real rings, python loop vs fdt_stem (ISSUE 10)
+            result.update(_bench_stem())
     except Exception:
         pass
     try:

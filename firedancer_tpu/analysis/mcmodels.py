@@ -151,20 +151,30 @@ def _producer(env: Env, mc, dc, fseqs, *, seq0: int, n: int, cr_max: int,
                     watch_objs=fseqs,
                 )
                 continue
-            sig = sig_of(seq)
-            if use_dcache:
-                payload = _pattern(sig, psz)
-                if env.mutation == "publish-before-write":
-                    chunk = dc.chunk  # the chunk write() will use
-                    mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
-                    dc.write(payload)
+            # the stem-burst-over-credit mutant models a BURST publisher
+            # (the native stem's shape) that trusts the one credit read
+            # above for cr+1 publishes instead of re-reading per sweep —
+            # CreditBound/overrun must catch it on any schedule
+            burst = (
+                cr + 1
+                if env.mutation == "stem-burst-over-credit"
+                else 1
+            )
+            for _ in range(min(burst, n - done)):
+                sig = sig_of(seq)
+                if use_dcache:
+                    payload = _pattern(sig, psz)
+                    if env.mutation == "publish-before-write":
+                        chunk = dc.chunk  # the chunk write() will use
+                        mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
+                        dc.write(payload)
+                    else:
+                        chunk = dc.write(payload)
+                        mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
                 else:
-                    chunk = dc.write(payload)
-                    mc.publish(seq=seq, sig=sig, chunk=chunk, sz=psz)
-            else:
-                mc.publish(seq=seq, sig=sig)
-            seq = U64(seq + 1)
-            done += 1
+                    mc.publish(seq=seq, sig=sig)
+                seq = U64(seq + 1)
+                done += 1
         env.scratch["prod_done"] = True
 
     def min_raw():

@@ -53,6 +53,18 @@ over the tile layer (tiles/, disco/):
                        not a statically-literal class attribute are
                        skipped (instance-built schemas like VerifyTile
                        size theirs at runtime).
+  stem-native-handler  Tile.native_handler is a DESCRIPTOR BUILDER for
+                       the GIL-released stem (tango/native/fdt_stem.c):
+                       it wires raw pointers into a StemSpec and must
+                       not touch ring or metric state itself — a
+                       publish/drain/dedup/metrics call here (or inside
+                       the ready/after_burst closures it builds) runs
+                       outside the run loop's credit gate, trace points
+                       and phase accounting, and mutates Python-side
+                       state the native burst can neither see nor
+                       replay after a crash.  Everything the handler
+                       works on must live in the args block's
+                       shared/native memory.
   hot-path-clock       tile hook bodies (on_frags/after_credit) must not
                        read the clock through bare time.* calls
                        (time.monotonic_ns / time.time / ...) — clock
@@ -251,6 +263,10 @@ MC_HOOKED_NATIVES = {
     "fdt_fseq_diag_query",
     "fdt_fseq_diag_add",
     "fdt_fctl_cr_avail",
+    # the native stem drives the same ring surface from C; its one
+    # entry point must sit behind the guard too (under fdtmc it must
+    # never run — the checker schedules the Python loop only)
+    "fdt_stem_run",
 }
 
 
@@ -301,6 +317,49 @@ def _check_mc_hooks(path: str, tree: ast.AST) -> tuple[list[Finding], int]:
         if ok:
             guarded += 1
     return findings, guarded
+
+
+#: ring/metric mutators banned inside Tile.native_handler (the
+#: stem-native-handler rule): the method builds a descriptor; the
+#: burst itself runs in C, so any Python-side mutation here is outside
+#: the loop's credit/trace/phase discipline
+_STEM_MUTATOR_ATTRS = {
+    "publish", "publish_batch", "drain", "poll", "write", "write_batch",
+    "dedup", "dedup_j", "inc", "hist_sample", "hist_sample_many",
+    "update", "diag_add", "seq_advance",
+}
+
+
+def _check_stem_handler(path: str, tree: ast.AST) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if (
+                not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or fn.name != "native_handler"
+            ):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STEM_MUTATOR_ATTRS
+                ):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "stem-native-handler",
+                            f"{_src(node.func)} inside native_handler — "
+                            "the handler is a descriptor builder; ring/"
+                            "metric mutations from it (or its ready/"
+                            "after_burst closures) bypass the run "
+                            "loop's credit gate and phase/trace "
+                            "accounting (fast-path state must live in "
+                            "the args block's shared memory)",
+                        )
+                    )
+    return findings
 
 
 #: mux-loop tile hooks that must stay host-side — they run on the loop
@@ -457,6 +516,7 @@ BASE_SCHEMA_COUNTERS = (
     "backpressure_iters",
     "housekeep_iters",
     "loop_iters",
+    "stem_frags",
     "restarts",
     "hb_misses",
     "degraded",
@@ -636,6 +696,9 @@ def check_file(
 
     # -- device-dispatch -------------------------------------------------
     findings.extend(_check_device_dispatch(disp, tree))
+
+    # -- stem-native-handler ----------------------------------------------
+    findings.extend(_check_stem_handler(disp, tree))
 
     # -- hot-path-clock ----------------------------------------------------
     findings.extend(_check_hot_clock(disp, tree))

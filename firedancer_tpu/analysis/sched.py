@@ -63,6 +63,14 @@ MUTATIONS = frozenset(
         "fseq-nonmonotone",
         # drain's overrun resync does not count the skipped frags
         "drain-uncounted",
+        # a burst publisher (the native stem's shape, fdt_stem.c) trusts
+        # ONE credit computation for a whole burst instead of re-reading
+        # consumer fseqs per sweep: publishes cr+1 frags per round
+        # (scenario-level).  Pins that the checked protocol catches
+        # exactly the bug class the C stem could introduce — the stem
+        # itself is outside fdtmc's surface and composes the verified
+        # ring ops with a per-sweep credit re-read.
+        "stem-burst-over-credit",
         # drain's overrun resync uses the pre-PR-3 clamp-to-zero formula
         # (wrong at seq wrap-around)
         "drain-resync-zero",

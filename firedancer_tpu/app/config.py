@@ -10,6 +10,7 @@ Config shape (all keys optional; defaults below):
     name = "fdt"                     # workspace name (monitor attaches)
     [topo]
     runtime = "thread"               # "process" = one OS process per tile
+    stem = "python"                  # "native" = GIL-released tile inner loop
     [tiles.quic]
     quic_port = 0                    # 0 = ephemeral
     udp_port = 0
@@ -58,6 +59,10 @@ class Config:
     #: tile runtime from `[topo] runtime = "thread"|"process"`; None
     #: defers to the FDT_RUNTIME env / the thread default (disco/topo.py)
     runtime: str | None = None
+    #: data-plane inner loop from `[topo] stem = "python"|"native"`:
+    #: "native" runs registered tile handlers (dedup/bank/pack) through
+    #: the GIL-released fdt_stem burst loop; None defers to FDT_STEM
+    stem: str | None = None
     quic_port: int = 0
     udp_port: int = 0
     verify_count: int = 1
@@ -100,6 +105,7 @@ def parse(text: str) -> Config:
     return Config(
         name=doc.get("name", "fdt"),
         runtime=doc.get("topo", {}).get("runtime"),
+        stem=doc.get("topo", {}).get("stem"),
         quic_port=q.get("quic_port", 0),
         udp_port=q.get("udp_port", 0),
         verify_count=v.get("count", 1),
@@ -161,7 +167,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     n = cfg.verify_count
     n_banks = cfg.bank_count
     verify_devs = device_assignments(cfg.verify_devices, n)
-    topo = Topology(name=cfg.name, runtime=cfg.runtime)
+    topo = Topology(name=cfg.name, runtime=cfg.runtime, stem=cfg.stem)
     # asserted SLOs ride the topology: build() allocates the shared slo
     # gauge region and the manifest carries the config to attached
     # monitors (disco/slo.py, disco/flight.py)
@@ -279,7 +285,7 @@ def build_ingress_topology(
     dedup -> sink (reference connection map, config.c:681-712)."""
     from firedancer_tpu.disco.topo import device_assignments
 
-    topo = Topology(name=cfg.name, runtime=cfg.runtime)
+    topo = Topology(name=cfg.name, runtime=cfg.runtime, stem=cfg.stem)
     topo.slo = cfg.slo
     qt = QuicIngressTile(
         identity_secret,

@@ -298,6 +298,27 @@ class TileFaults:
 
     # -- point 3: drained frags -------------------------------------------
 
+    @property
+    def has_frag_faults(self) -> bool:
+        """True when any drop/corrupt fault targets this tile.  The
+        native stem cannot route frags through mangle_frags (the bytes
+        never surface to Python), so the run loop keeps the tile on the
+        Python path whenever this is set — the injection windows stay
+        deterministic and the documented point-3 semantics exact."""
+        return bool(self._frag_faults)
+
+    def note_frags(self, il, n: int) -> None:
+        """Burst-boundary frag accounting for the native stem: n frags
+        were consumed on `il` without passing through mangle_frags (no
+        drop/corrupt faults exist for this tile — see has_frag_faults),
+        so the cumulative counters that drive on="frag" triggers keep
+        advancing and a scripted kill/stall still fires at the next
+        burst boundary (point 1 reads frags_seen)."""
+        self.frags_seen += n
+        if self._shm is not None:
+            self._shm[1] = np.uint64(self.frags_seen)
+        self._link_idx[il.name] = self._link_idx.get(il.name, 0) + n
+
     def mangle_frags(self, il, frags: np.ndarray) -> np.ndarray:
         n = len(frags)
         self.frags_seen += n

@@ -105,6 +105,11 @@ class MetricsSchema:
         "backpressure_iters",
         "housekeep_iters",
         "loop_iters",
+        # frags consumed through the native stem's GIL-released burst
+        # loop (tango/native/fdt_stem.c) — always a subset of in_frags,
+        # so stem_frags/in_frags is the native-coverage ratio a monitor
+        # or bench can read straight off the tile
+        "stem_frags",
         # supervision counters, written by disco/supervisor.py (distinct
         # slots from the tile's own, so the single-writer-per-word
         # discipline holds): crash/stall restarts, heartbeat deadline

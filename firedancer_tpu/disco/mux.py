@@ -214,6 +214,10 @@ class MuxCtx:
         #: by the topology when profiling is enabled; None keeps every
         #: profile point a single attribute check
         self.profiler = None
+        #: the live native stem handle (tango.rings.Stem) when the run
+        #: loop is driving this tile's registered native handler; None
+        #: on the Python loop (tests/monitors read it, never write)
+        self.stem = None
         self.incarnation = 0
         #: True once the current incarnation's on_boot completed — lets
         #: the topology distinguish "died during boot" (raise at start)
@@ -335,6 +339,22 @@ class Tile:
     #: fdtlint `proc-safe-tile` rule guards their ctors).
     proc_safe = True
 
+    def native_handler(self, ctx: MuxCtx) -> "R.StemSpec | None":
+        """Opt into the native stem (tango/native/fdt_stem.c): return a
+        tango.rings.StemSpec describing this tile's native frag handler
+        and the run loop will drain/handle/publish whole bursts in ONE
+        GIL-released call, returning to Python only at burst boundaries.
+        Called once, after on_boot (handler state pointers must exist).
+
+        None (the default) keeps the Python on_frags loop — which
+        remains the bit-identical reference semantics, the only loop
+        fdtmc schedules, and the path every frag the native handler
+        cannot express is handed back to.  Tiles registering a handler
+        must not mutate Python-side state from the fast path (the
+        fdtlint `stem-native-handler` rule): everything the handler
+        touches lives in the args block's shared/native memory."""
+        return None
+
     #: a manual-credit tile gates each publish on that ring's own
     #: cr_avail() instead of the loop's min-over-all-outs gate.  Needed
     #: when two tiles form a request/response ring CYCLE (shred <->
@@ -361,6 +381,65 @@ class Tile:
         resynced separately via the rejoin helpers."""
 
 
+def _stem_apply(ctx, m, stem, spec, tracer, faults, out_seq0, tspub) -> int:
+    """Burst-boundary bookkeeping for one native stem call: the stem
+    accumulated counter deltas, drained-frag metas and published-sig
+    scratch in native memory; apply them to metrics/trace/faultinj ONCE
+    per burst (the batched per-frag-update contract).  Latency hists use
+    the post-burst clock, so qwait/e2e carry up to one burst of skew —
+    the same order of skew the Python loop's per-batch sampling has.
+    Returns total frags consumed by the burst."""
+    total = 0
+    for i, il in enumerate(ctx.ins):
+        ovr = stem.overruns(i)
+        if ovr:
+            m.inc("overrun_frags", ovr)
+            il.fseq.diag_add(0, ovr)
+        n = stem.consumed(i)
+        if not n:
+            continue
+        total += n
+        m.inc("in_frags", n)
+        m.inc("in_bytes", stem.in_bytes(i))
+        m.hist_sample("batch_sz", n)
+        if faults is not None:
+            faults.note_frags(il, n)
+        frags = stem.frags(i)
+        t_cons = 0
+        if il.h_qwait is not None:
+            t_cons = now_ts()
+            m.hist_sample_many(
+                il.h_qwait,
+                np.maximum(ts_diff_arr(t_cons, frags["tspub"]), 0),
+            )
+            m.hist_sample_many(
+                il.h_e2e,
+                np.maximum(ts_diff_arr(t_cons, frags["tsorig"]), 0),
+            )
+            m.hist_sample(il.h_svc, max(ts_diff(t_cons, tspub), 0))
+        if tracer is not None:
+            tracer.ingest(il.link_id, frags, t_cons or now_ts())
+    for o, ol in enumerate(ctx.outs):
+        p = stem.published(o)
+        if not p:
+            continue
+        m.inc("out_frags", p)
+        m.inc("out_bytes", stem.out_bytes(o))
+        if ol.tracer is not None:
+            ol.tracer.publish(
+                ol.link_id, out_seq0[o], stem.out_sigs(o), tspub,
+                stem.out_tsorigs(o),
+            )
+    ctrs = stem.counters
+    for idx, name in enumerate(spec.counters):
+        v = int(ctrs[idx])
+        if v:
+            m.inc(name, v)
+    if total and spec.after_burst is not None:
+        spec.after_burst(ctx, ctrs)
+    return total
+
+
 def run_loop(
     tile: Tile,
     ctx: MuxCtx,
@@ -369,6 +448,7 @@ def run_loop(
     lazy_ns: int | None = None,
     idle_sleep_s: float = 50e-6,
     idle_before_sleep: int = 32,
+    stem: str | None = None,
 ) -> None:
     """Drive one tile until its cnc receives HALT (or on_boot/callbacks
     raise).  Mirrors the fd_mux_tile phase structure: housekeeping →
@@ -400,6 +480,26 @@ def run_loop(
         cnc.signal(R.CNC_FAIL)
         raise
     ctx.booted = True
+    # native stem (ISSUE 10): the tile may register a native frag
+    # handler; the loop then drains/handles/publishes whole bursts in
+    # one GIL-released call, falling back to the Python path per
+    # iteration whenever the handler cannot express the work (pending
+    # amnesty, fallback txns, frag-fault injection, in_budget tiles)
+    stem_obj = None
+    stem_spec = None
+    if stem == "native" and not tile.manual_credits:
+        stem_spec = tile.native_handler(ctx)
+        if stem_spec is not None:
+            try:
+                stem_obj = R.Stem(
+                    ctx.ins, ctx.outs, stem_spec, cap=batch_max
+                )
+            except ValueError:
+                # unsupported shape (> 4 ins / 8 outs / 4 reliable
+                # consumers per out): the Python loop is always correct
+                stem_obj = None
+                stem_spec = None
+    ctx.stem = stem_obj
     cnc.signal(R.CNC_RUN)
     if lazy_ns is None:
         depths = [il.mcache.depth for il in ctx.ins] + [
@@ -505,6 +605,30 @@ def run_loop(
                 else 0
             )
             absorb = tile.in_budget(ctx)
+            run_py = True
+            if (
+                stem_obj is not None
+                and absorb is None
+                and (faults is None or not faults.has_frag_faults)
+                and (stem_spec.ready is None or stem_spec.ready())
+            ):
+                # one GIL-released burst: drain + handle + publish +
+                # fseq/credit updates all native; Python resumes here
+                # at the burst boundary with the accumulated deltas
+                ts_b0 = now_ts()
+                s_got, s_stat, _s_in = stem_obj.run(cr, ts_b0)
+                got += _stem_apply(
+                    ctx, m, stem_obj, stem_spec, tracer, faults,
+                    out_seq0, ts_b0,
+                )
+                if s_got:
+                    m.inc("stem_frags", s_got)
+                # STEM_PYTHON: a pending frag needs the slow path (or a
+                # python-only in-link has traffic) — fall through to the
+                # Python drain with the remaining credit budget.  Any
+                # other status (IDLE/BUDGET/BP) already consumed
+                # everything this iteration may.
+                run_py = s_stat == R.STEM_PYTHON
             # rotate the drain order so a saturated in-link cannot starve
             # the others of the shared credit budget (e.g. pack's txn
             # firehose starving its bank-completion rings would idle
@@ -513,7 +637,7 @@ def run_loop(
             order = range(n_ins) if n_ins <= 1 else [
                 (iters + j) % n_ins for j in range(n_ins)
             ]
-            for i in order:
+            for i in order if run_py else ():
                 il = ctx.ins[i]
                 # credits are consumed across in-links: a tile republishes
                 # at most 1 out-frag per in-frag, so bounding the remaining

@@ -161,6 +161,10 @@ class Supervisor:
             topo.build()
         self._loop_kw = loop_kw
         topo._loop_kw = dict(loop_kw)
+        # same stem resolution as Topology.start: supervised tiles (and
+        # every restarted incarnation) run the same inner loop the
+        # config/env selected
+        topo._loop_kw["stem"] = topo._resolve_stem(loop_kw.get("stem"))
         self._process = topo._runtime == "process"
         if self._process and self.faults is not None:
             # process runtime: the schedule rides the spawn args so

@@ -146,6 +146,7 @@ class Topology:
         name: str | None = None,
         trace: TraceConfig | None = None,
         runtime: str | None = None,
+        stem: str | None = None,
     ):
         self.name = name
         #: tile runtime: "thread" | "process" | None (resolve from the
@@ -153,6 +154,12 @@ class Topology:
         #: build() — the process runtime adds workspace regions
         #: (per-tile arenas/pstat, per-dcache shm cursors).
         self.runtime = runtime
+        #: data-plane inner loop: "python" | "native" | None (resolve
+        #: from the FDT_STEM env at start).  "native" lets tiles with a
+        #: registered native handler (Tile.native_handler) run their
+        #: drain→handle→publish cycle in one GIL-released fdt_stem call
+        #: per burst; tiles without one keep the Python loop either way.
+        self.stem = stem
         self._runtime: str | None = None  # resolved at build()
         #: process runtime: fault-injection schedule that rides the
         #: spawn args so children reconstruct IDENTICAL injector
@@ -253,6 +260,15 @@ class Topology:
                 f"start(mode=), Topology(runtime=), or FDT_RUNTIME)"
             )
         return rt
+
+    def _resolve_stem(self, mode: str | None = None) -> str:
+        sm = mode or self.stem or os.environ.get("FDT_STEM") or "python"
+        if sm not in ("python", "native"):
+            raise ValueError(
+                f"unknown stem mode {sm!r} (python|native; from "
+                f"start(stem=), Topology(stem=), or FDT_STEM)"
+            )
+        return sm
 
     @staticmethod
     def _spawn_method() -> str:
@@ -643,6 +659,10 @@ class Topology:
                 f"workspace layout — set it before build())"
             )
         self._loop_kw = dict(loop_kw)
+        # stem mode rides the loop kwargs: the same dict reaches thread
+        # tiles, process children (spawn args) and supervisor respawns,
+        # so every incarnation runs the same inner loop
+        self._loop_kw["stem"] = self._resolve_stem(loop_kw.get("stem"))
         if runtime == "process":
             self._start_process(boot_timeout_s)
             return

@@ -1,0 +1,709 @@
+/* fdt_stem.c — implementation.  See fdt_stem.h for the design notes and
+ * reference citations.  Original implementation: the burst loop composes
+ * the SAME primitive ring ops the Python loop uses (fdt_mcache_drain /
+ * fdt_mcache_publish / fdt_fseq_update / fdt_fctl_cr_avail — the surface
+ * fdtmc model-checks), so the stem introduces no new ring protocol, only
+ * a new driver for the verified one. */
+
+#include "fdt_stem.h"
+
+#include "fdt_bank.h"
+#include "fdt_pack.h"
+#include "fdt_tango.h"
+
+#include <stdatomic.h>
+#include <string.h>
+
+/* ---- cfg word indices (fdt_stem.h documents the layout) ---------------- */
+
+#define C_MAGIC 0
+#define C_HANDLER 1
+#define C_NINS 2
+#define C_NOUTS 3
+#define C_CAP 4
+#define C_STATUS 5
+#define C_STATUS_IN 6
+#define C_ARGS 7
+#define C_CTRS 8
+#define C_TSPUB 9
+/* C-owned sweep-rotation cursor: persists ACROSS calls so a
+   budget-bounded burst cannot pin the sweep start at in 0 (the Python
+   loop rotates its drain order per iteration for the same reason — a
+   saturated in-link must not starve the others) */
+#define C_ROT 10
+
+#define IN0 16
+#define IN_STRIDE 12
+#define I_MCACHE 0
+#define I_DCACHE 1
+#define I_FSEQ 2
+#define I_SEQ 3
+#define I_FLAGS 4
+/* word 5 reserved */
+#define I_FRAGS 6
+#define I_CONSUMED 7
+#define I_BYTES 8
+#define I_OVR 9
+
+#define OUT0 64
+#define OUT_STRIDE 16
+#define O_MCACHE 0
+#define O_DCACHE 1
+#define O_CHUNKP 2
+#define O_MTU 3
+#define O_WMARK 4
+#define O_DEPTH 5
+#define O_NFSEQ 6
+#define O_FSEQ0 7
+#define O_SEQ 11
+#define O_PUBLISHED 12
+#define O_BYTES 13
+#define O_SIGS 14
+#define O_TSORIGS 15
+
+#define IN_F_NATIVE 1UL
+
+static inline int64_t seq_delta( uint64_t a, uint64_t b ) {
+  return (int64_t)( a - b ); /* signed distance mod 2^64 */
+}
+
+/* ---- parsed runtime view ----------------------------------------------- */
+
+typedef struct {
+  uint64_t * w; /* raw cfg words */
+  uint64_t handler;
+  int64_t n_ins;
+  int64_t n_outs;
+  int64_t cap;
+  uint64_t * args;
+  uint64_t * ctrs;
+  uint32_t tspub;
+  int need_python; /* set by a handler: the NEXT unhandled frag needs
+                      the Python path (fallback, eviction, assert) */
+} stem_t;
+
+static inline uint64_t * in_blk( stem_t * st, int64_t i ) {
+  return st->w + IN0 + i * IN_STRIDE;
+}
+static inline uint64_t * out_blk( stem_t * st, int64_t o ) {
+  return st->w + OUT0 + o * OUT_STRIDE;
+}
+
+/* Publish one frag on out o: payload (if any) goes into the out dcache
+   at the shared chunk cursor first (the ring-publish-order rule: bytes
+   before metadata), then the release-ordered mcache publish — the exact
+   op sequence OutLink.publish performs, so the wire stream is
+   bit-identical to the Python loop's. */
+static void stem_publish( stem_t * st, int64_t oi, uint64_t sig,
+                          uint8_t const * payload, uint64_t sz,
+                          uint32_t tsorig ) {
+  uint64_t * o = out_blk( st, oi );
+  uint32_t chunk = 0;
+  if( payload && o[ O_DCACHE ] ) {
+    uint64_t * cur = (uint64_t *)o[ O_CHUNKP ];
+    uint64_t c = *cur;
+    memcpy( (uint8_t *)o[ O_DCACHE ] + c * FDT_CHUNK_SZ, payload, sz );
+    chunk = (uint32_t)c;
+    *cur = fdt_dcache_compact_next( c, sz, o[ O_MTU ], o[ O_WMARK ] );
+  }
+  fdt_mcache_publish( (void *)o[ O_MCACHE ], o[ O_SEQ ], sig, chunk,
+                      (uint16_t)sz, (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                      tsorig, st->tspub );
+  uint64_t p = o[ O_PUBLISHED ];
+  if( (int64_t)p < st->cap ) {
+    if( o[ O_SIGS ] ) ( (uint64_t *)o[ O_SIGS ] )[ p ] = sig;
+    if( o[ O_TSORIGS ] ) ( (uint32_t *)o[ O_TSORIGS ] )[ p ] = tsorig;
+  }
+  o[ O_SEQ ] = o[ O_SEQ ] + 1UL;
+  o[ O_PUBLISHED ] = p + 1UL;
+  o[ O_BYTES ] += sz;
+}
+
+/* ==== dedup handler ===================================================== */
+
+/* args block (u64 words) */
+#define DH_TCACHE 0
+#define DH_JNL 1 /* 0 = unjournaled (multi-out dedup shape) */
+#define DH_JCAP 2
+#define DH_ISDUP 3 /* u8[cap] scratch */
+#define DH_TAGS 4  /* u64[cap] scratch */
+
+/* journal word layout — MUST match tiles/dedup.py (_J_* / _B_*) */
+#define DJ_PHASE 0
+#define DJ_SEQ0 1
+#define DJ_ACTIVE 2
+#define DJ_SLOT0 8
+#define DB_CNT 2
+#define DB_TAGS 4
+
+/* counter scratch indices (tiles/dedup.py maps these to names) */
+#define DC_DUP 0
+
+static int64_t h_dedup( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                        int64_t n ) {
+  uint64_t * a = st->args;
+  void * tc = (void *)a[ DH_TCACHE ];
+  uint64_t * jnl = (uint64_t *)a[ DH_JNL ];
+  uint64_t jcap = a[ DH_JCAP ];
+  uint8_t * isdup = (uint8_t *)a[ DH_ISDUP ];
+  uint64_t * tags = (uint64_t *)a[ DH_TAGS ];
+  uint64_t * o = out_blk( st, 0 );
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+
+  /* never outgrow the crash journal (tiles/dedup.py chunking rule): a
+     shorter return WITHOUT need_python makes the stem rewind and drain
+     the rest next sweep */
+  if( jnl && (uint64_t)n > jcap ) n = (int64_t)jcap;
+
+  for( int64_t k = 0; k < n; k++ ) tags[ k ] = f[ k ].sig;
+
+  if( jnl ) {
+    /* arm the journal BEFORE the insert mutates the shm cache: slot 0
+       zeroed + seq0 first, phase last (release), so a kill sees either
+       a clean journal or a fully-described window */
+    uint64_t * b0 = jnl + DJ_SLOT0;
+    uint64_t blk = 4UL + jcap;
+    jnl[ DJ_ACTIVE ] = 0UL;
+    b0[ DB_CNT ] = 0UL;
+    b0[ DB_CNT + 1 ] = 0UL; /* overflow flag */
+    jnl[ DJ_SEQ0 ] = o[ O_SEQ ];
+    __atomic_store_n( &jnl[ DJ_PHASE ], 1UL, __ATOMIC_RELEASE );
+    st->ctrs[ DC_DUP ] +=
+        fdt_tcache_dedup_j( tc, tags, (uint64_t)n, isdup, b0, jcap );
+    int64_t n_surv = 0;
+    int zero_tag = 0;
+    for( int64_t k = 0; k < n; k++ )
+      if( !isdup[ k ] ) {
+        n_surv++;
+        if( !f[ k ].sig ) zero_tag = 1;
+      }
+    if( !n_surv ) {
+      __atomic_store_n( &jnl[ DJ_PHASE ], 0UL, __ATOMIC_RELEASE );
+      return n;
+    }
+    if( zero_tag ) {
+      /* zero-tag survivors publish without a fresh insert, so the
+         out-seq -> journal mapping needs the FULL survivor list:
+         write it to the inactive slot and flip with one store */
+      uint64_t * b1 = jnl + DJ_SLOT0 + blk;
+      uint64_t m = 0;
+      for( int64_t k = 0; k < n; k++ )
+        if( !isdup[ k ] ) b1[ DB_TAGS + m++ ] = f[ k ].sig;
+      b1[ DB_CNT ] = m;
+      __atomic_store_n( &jnl[ DJ_ACTIVE ], 1UL, __ATOMIC_RELEASE );
+    }
+  } else {
+    st->ctrs[ DC_DUP ] +=
+        fdt_tcache_dedup( tc, tags, (uint64_t)n, isdup );
+  }
+
+  for( int64_t k = 0; k < n; k++ ) {
+    if( isdup[ k ] ) continue;
+    stem_publish( st, 0, f[ k ].sig,
+                  in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ,
+                  f[ k ].sz, f[ k ].tsorig );
+  }
+  if( jnl ) __atomic_store_n( &jnl[ DJ_PHASE ], 0UL, __ATOMIC_RELEASE );
+  return n;
+}
+
+/* ==== bank handler (fused decode -> scan -> exec pipeline) ============== */
+
+/* args block (u64 words) — decode/scan scratch + table/journal wiring */
+#define BH_ROWS 0 /* u8 (max_n, stride) decode scratch */
+#define BH_STRIDE 1
+#define BH_SZS 2 /* u32[max_n] */
+#define BH_MAXN 3
+#define BH_OK 4
+#define BH_ISVOTE 5
+#define BH_FAST 6
+#define BH_COST 7
+#define BH_REWARDS 8
+#define BH_CULIM 9
+#define BH_TAGS 10
+#define BH_LAMPORTS 11
+#define BH_PAYER 12
+#define BH_SRC 13
+#define BH_DST 14
+#define BH_FEE 15
+#define BH_IDX 16    /* i64[max_n] */
+#define BH_STATUS 17 /* u8[max_n] */
+#define BH_OFEES 18  /* u64[max_n] */
+#define BH_TABLE 19
+#define BH_JOURNAL 20
+#define BH_ZEROCHECK 21
+#define BH_BANKID 22
+
+/* counter scratch indices (tiles/bank.py maps these to names) */
+#define BC_EXEC_MB 0
+#define BC_EXEC_TXNS 1
+#define BC_FAILED 2
+#define BC_FAST 3
+#define BC_FEES 4
+#define BC_MALFORMED 5
+#define BC_NATIVE 6
+
+/* python-owned completed-seq journal word (BankTable._JW_COMPLETED) */
+#define BJ_COMPLETED 31
+/* C undo-journal words read for the resume computation (fdt_bank.c) */
+#define BJ_TAG 0
+#define BJ_DONE 1
+
+int64_t fdt_bank_pipeline( uint8_t const * mb, int64_t mb_sz,
+                           uint64_t * a, uint64_t mb_tag,
+                           uint64_t * out_stats ) {
+  memset( out_stats, 0, 8 * sizeof( uint64_t ) );
+  uint64_t * jw = (uint64_t *)a[ BH_JOURNAL ];
+
+  /* replay below the completed-seq mark was applied in full by a
+     previous incarnation: republish, never re-execute (the same
+     wrap-safe compare as BankTable.already_complete) */
+  uint64_t comp = jw[ BJ_COMPLETED ];
+  if( comp && seq_delta( mb_tag, comp ) < 0 ) {
+    out_stats[ 0 ] = 3;
+    return 3;
+  }
+
+  uint8_t * rows = (uint8_t *)a[ BH_ROWS ];
+  int64_t stride = (int64_t)a[ BH_STRIDE ];
+  uint32_t * szs = (uint32_t *)a[ BH_SZS ];
+  int64_t max_n = (int64_t)a[ BH_MAXN ];
+  /* a microblock too large for the fixed native scratch is NOT
+     malformed — Python's growable scratch handles it */
+  if( mb_sz >= 8 ) {
+    int64_t n16 = (int64_t)( (uint16_t)mb[ 6 ] |
+                             ( (uint16_t)mb[ 7 ] << 8 ) );
+    if( n16 > max_n ) {
+      out_stats[ 0 ] = 2;
+      return 2;
+    }
+  }
+  int64_t n = fdt_mb_decode( mb, mb_sz, rows, stride, szs, max_n );
+  if( n < 0 ) {
+    out_stats[ 0 ] = 1;
+    return 1;
+  }
+  out_stats[ 1 ] = (uint64_t)n;
+
+  uint8_t * ok = (uint8_t *)a[ BH_OK ];
+  uint8_t * fast = (uint8_t *)a[ BH_FAST ];
+  fdt_txn_scan( rows, stride, 0, szs, n, 0, ok, (uint8_t *)a[ BH_ISVOTE ],
+                fast, (uint32_t *)a[ BH_COST ],
+                (uint64_t *)a[ BH_REWARDS ], (uint32_t *)a[ BH_CULIM ],
+                (uint64_t *)a[ BH_TAGS ], (uint64_t *)a[ BH_LAMPORTS ],
+                (uint32_t *)a[ BH_PAYER ], (uint32_t *)a[ BH_SRC ],
+                (uint32_t *)a[ BH_DST ], (uint32_t *)a[ BH_FEE ], 0, 0, 0,
+                0, 0, 0, 0, 0, 0, 0, 0 );
+
+  /* any non-fast txn (incl. parse failures) takes the general-executor
+     path: hand the WHOLE microblock back to Python untouched — the
+     journal's (tag, done) keeps an interrupted earlier attempt's fast
+     prefix exactly-once through the Python resume */
+  for( int64_t t = 0; t < n; t++ )
+    if( !fast[ t ] ) {
+      out_stats[ 0 ] = 2;
+      return 2;
+    }
+
+  int64_t * idx = (int64_t *)a[ BH_IDX ];
+  for( int64_t t = 0; t < n; t++ ) idx[ t ] = t;
+  uint8_t * status = (uint8_t *)a[ BH_STATUS ];
+  uint64_t * ofees = (uint64_t *)a[ BH_OFEES ];
+
+  /* the effective start fdt_bank_exec's journal adoption will use
+     (needed to count only what THIS call executes) */
+  int64_t start = 0;
+  if( jw[ BJ_TAG ] == mb_tag ) {
+    start = (int64_t)jw[ BJ_DONE ];
+    if( start > n ) start = n;
+  }
+  int64_t done = fdt_bank_exec(
+      rows, stride, idx, 0, n, (uint32_t *)a[ BH_PAYER ],
+      (uint32_t *)a[ BH_SRC ], (uint32_t *)a[ BH_DST ],
+      (uint32_t *)a[ BH_FEE ], (uint64_t *)a[ BH_LAMPORTS ],
+      (uint8_t *)a[ BH_TABLE ], (uint8_t *)jw, mb_tag,
+      (int64_t)a[ BH_ZEROCHECK ], status, ofees );
+  int64_t newly = done > start ? done - start : 0;
+  uint64_t failed = 0, fees = 0;
+  for( int64_t t = start; t < done; t++ ) {
+    if( status[ t ] != FDT_BANK_OK ) failed++;
+    fees += ofees[ t ];
+  }
+  out_stats[ 2 ] = (uint64_t)newly;
+  out_stats[ 3 ] = failed;
+  out_stats[ 4 ] = fees;
+  if( done < n ) {
+    /* MISS (cold key: funk resolve) or NONTRIVIAL (general executor):
+       Python-only work — progress so far is in the journal */
+    out_stats[ 0 ] = 2;
+    return 2;
+  }
+  /* fully executed: record the completed-seq mark (mark_complete) */
+  jw[ BJ_COMPLETED ] = mb_tag + 1UL;
+  out_stats[ 0 ] = 0;
+  return 0;
+}
+
+static int64_t h_bank( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                       int64_t n ) {
+  uint64_t * a = st->args;
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+  uint64_t stats[ 8 ];
+  for( int64_t k = 0; k < n; k++ ) {
+    uint8_t const * p = in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ;
+    uint64_t sz = f[ k ].sz;
+    if( sz < 8 ) { st->need_python = 1; return k; }
+    uint64_t handle = (uint64_t)p[ 0 ] | ( (uint64_t)p[ 1 ] << 8 ) |
+                      ( (uint64_t)p[ 2 ] << 16 ) |
+                      ( (uint64_t)p[ 3 ] << 24 );
+    uint64_t bank = (uint64_t)p[ 4 ] | ( (uint64_t)p[ 5 ] << 8 );
+    if( bank != a[ BH_BANKID ] ) { st->need_python = 1; return k; }
+    uint64_t sig = ( bank << 32 ) | handle;
+    int64_t rc =
+        fdt_bank_pipeline( p, (int64_t)sz, a, f[ k ].seq, stats );
+    if( rc == 2 ) {
+      /* a fast prefix may have executed before the stop — count it
+         NOW (the Python resume counts only what IT executes, and the
+         journal's done-mark keeps the split exactly-once) */
+      st->ctrs[ BC_FAST ] += stats[ 2 ];
+      st->ctrs[ BC_FAILED ] += stats[ 3 ];
+      st->ctrs[ BC_FEES ] += stats[ 4 ];
+      st->ctrs[ BC_NATIVE ] += stats[ 2 ];
+      st->need_python = 1;
+      return k;
+    }
+    if( rc == 1 ) {
+      /* malformed microblock: metered drop that still completes at
+         pack (handle/locks never leak); nothing goes to poh */
+      st->ctrs[ BC_MALFORMED ]++;
+      stem_publish( st, 0, sig, 0, 0, st->tspub );
+      continue;
+    }
+    if( rc == 0 ) {
+      st->ctrs[ BC_EXEC_MB ]++;
+      st->ctrs[ BC_EXEC_TXNS ] += stats[ 1 ];
+      st->ctrs[ BC_FAST ] += stats[ 2 ];
+      st->ctrs[ BC_FAILED ] += stats[ 3 ];
+      st->ctrs[ BC_FEES ] += stats[ 4 ];
+      st->ctrs[ BC_NATIVE ] += stats[ 2 ];
+    }
+    /* rc == 3 (already complete): republish only, no counters —
+       the dead incarnation already counted it in the shm metrics */
+    stem_publish( st, 1, sig, p, sz, st->tspub ); /* poh first */
+    stem_publish( st, 0, sig, 0, 0, st->tspub );  /* then free the bank */
+  }
+  return n;
+}
+
+/* ==== pack insert handler =============================================== */
+
+/* args block (u64 words): engine arrays + scan scratch.  The engine's
+   dense pool arrays are numpy allocations owned by ballet/pack.Pack —
+   never reallocated after init, single-writer (the pack tile). */
+#define PH_STATE 0 /* u8[P]: 0 free, 1 pending, 2 inflight */
+#define PH_POOL 1  /* P */
+#define PH_ROWS 2
+#define PH_ROWW 3 /* engine payload width */
+#define PH_SZS 4  /* u16[P] */
+#define PH_REWARDS 5
+#define PH_COST 6
+#define PH_EXPIRES 7
+#define PH_SIGTAG 8
+#define PH_ISVOTE 9 /* u8[P] (numpy bool_) */
+#define PH_BSRW 10
+#define PH_BSW 11
+#define PH_W 12 /* bitset words per row */
+#define PH_WHASH 13
+#define PH_WCNT 14
+#define PH_MAXW 15
+#define PH_RHASH 16
+#define PH_RCNT 17
+#define PH_MAXR 18
+#define PH_NBITS 19
+#define PH_TRAILER 20 /* wire trailer bytes excluded from the scan sz */
+/* scan scratch */
+#define PH_SROWS 21
+#define PH_SW 22
+#define PH_SCAP 23
+#define PH_SSZS 24
+#define PH_SOK 25
+#define PH_SISVOTE 26
+#define PH_SFAST 27
+#define PH_SCOST 28
+#define PH_SREW 29
+#define PH_SCULIM 30
+#define PH_STAGS 31
+#define PH_SLAM 32
+#define PH_SPAYER 33
+#define PH_SSRC 34
+#define PH_SDST 35
+#define PH_SFEE 36
+#define PH_SBSRW 37
+#define PH_SBSW 38
+#define PH_SWHASH 39
+#define PH_SWCNT 40
+#define PH_SRHASH 41
+#define PH_SRCNT 42
+
+/* counter scratch indices (tiles/pack.py maps these to names) */
+#define PC_INSERTED 0
+#define PC_REJECTED 1
+
+#define PACK_ST_FREE 0
+#define PACK_ST_PENDING 1
+
+static int64_t h_pack( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                       int64_t n ) {
+  uint64_t * a = st->args;
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+  int64_t scap = (int64_t)a[ PH_SCAP ];
+  if( n > scap ) n = scap; /* chunk: the stem rewinds + re-drains */
+
+  uint8_t * srows = (uint8_t *)a[ PH_SROWS ];
+  int64_t sw = (int64_t)a[ PH_SW ];
+  uint32_t * sszs = (uint32_t *)a[ PH_SSZS ];
+  uint64_t trailer = a[ PH_TRAILER ];
+  for( int64_t k = 0; k < n; k++ ) {
+    uint64_t sz = f[ k ].sz;
+    if( sz > (uint64_t)sw ) sz = (uint64_t)sw;
+    uint8_t * row = srows + k * sw;
+    memcpy( row, in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ, sz );
+    memset( row + sz, 0, (uint64_t)sw - sz );
+    sszs[ k ] = sz > trailer ? (uint32_t)( sz - trailer ) : 0U;
+  }
+
+  uint8_t * sok = (uint8_t *)a[ PH_SOK ];
+  int64_t maxw = (int64_t)a[ PH_MAXW ];
+  int64_t maxr = (int64_t)a[ PH_MAXR ];
+  fdt_txn_scan(
+      srows, sw, 0, sszs, n, (int64_t)a[ PH_NBITS ], sok,
+      (uint8_t *)a[ PH_SISVOTE ], (uint8_t *)a[ PH_SFAST ],
+      (uint32_t *)a[ PH_SCOST ], (uint64_t *)a[ PH_SREW ],
+      (uint32_t *)a[ PH_SCULIM ], (uint64_t *)a[ PH_STAGS ],
+      (uint64_t *)a[ PH_SLAM ], (uint32_t *)a[ PH_SPAYER ],
+      (uint32_t *)a[ PH_SSRC ], (uint32_t *)a[ PH_SDST ],
+      (uint32_t *)a[ PH_SFEE ], (uint64_t *)a[ PH_SBSRW ],
+      (uint64_t *)a[ PH_SBSW ], (uint64_t *)a[ PH_SWHASH ],
+      (uint8_t *)a[ PH_SWCNT ], maxw, (uint64_t *)a[ PH_SRHASH ],
+      (uint8_t *)a[ PH_SRCNT ], maxr, 0, 0, 0 );
+
+  int64_t n_ok = 0;
+  for( int64_t k = 0; k < n; k++ )
+    if( sok[ k ] ) n_ok++;
+
+  if( n_ok ) {
+    /* free-slot scatter, ascending slot order (numpy flatnonzero
+       order, so the pool layout is bit-identical to insert_batch).
+       The priority-eviction path (pool full) is Python-only: count
+       free slots FIRST and bail before mutating anything. */
+    uint8_t * state = (uint8_t *)a[ PH_STATE ];
+    int64_t P = (int64_t)a[ PH_POOL ];
+    int64_t n_free = 0;
+    for( int64_t s = 0; s < P && n_free < n_ok; s++ )
+      if( state[ s ] == PACK_ST_FREE ) n_free++;
+    if( n_free < n_ok ) { st->need_python = 1; return 0; }
+    int64_t W = (int64_t)a[ PH_W ];
+    uint8_t * erows = (uint8_t *)a[ PH_ROWS ];
+    int64_t eroww = (int64_t)a[ PH_ROWW ];
+    int64_t cw = sw < eroww ? sw : eroww;
+    uint16_t * eszs = (uint16_t *)a[ PH_SZS ];
+    uint64_t * erew = (uint64_t *)a[ PH_REWARDS ];
+    uint32_t * ecost = (uint32_t *)a[ PH_COST ];
+    uint64_t * eexp = (uint64_t *)a[ PH_EXPIRES ];
+    uint64_t * etag = (uint64_t *)a[ PH_SIGTAG ];
+    uint8_t * evote = (uint8_t *)a[ PH_ISVOTE ];
+    uint64_t * ebsrw = (uint64_t *)a[ PH_BSRW ];
+    uint64_t * ebsw = (uint64_t *)a[ PH_BSW ];
+    uint64_t * ewh = (uint64_t *)a[ PH_WHASH ];
+    uint8_t * ewc = (uint8_t *)a[ PH_WCNT ];
+    uint64_t * erh = (uint64_t *)a[ PH_RHASH ];
+    uint8_t * erc = (uint8_t *)a[ PH_RCNT ];
+    uint32_t const * scost = (uint32_t const *)a[ PH_SCOST ];
+    uint64_t const * srew = (uint64_t const *)a[ PH_SREW ];
+    uint8_t const * sisvote = (uint8_t const *)a[ PH_SISVOTE ];
+    uint64_t const * sbsrw = (uint64_t const *)a[ PH_SBSRW ];
+    uint64_t const * sbsw = (uint64_t const *)a[ PH_SBSW ];
+    uint64_t const * swh = (uint64_t const *)a[ PH_SWHASH ];
+    uint8_t const * swc = (uint8_t const *)a[ PH_SWCNT ];
+    uint64_t const * srh = (uint64_t const *)a[ PH_SRHASH ];
+    uint8_t const * src_ = (uint8_t const *)a[ PH_SRCNT ];
+
+    int64_t slot = 0;
+    int64_t placed = 0;
+    for( int64_t k = 0; k < n && placed < n_ok; k++ ) {
+      if( !sok[ k ] ) continue;
+      while( slot < P && state[ slot ] != PACK_ST_FREE ) slot++;
+      if( slot >= P ) break; /* unreachable: n_free >= n_ok above */
+      memcpy( erows + slot * eroww, srows + k * sw, (uint64_t)cw );
+      eszs[ slot ] = (uint16_t)sszs[ k ];
+      uint64_t rw = srew[ k ];
+      erew[ slot ] = rw > 0xFFFFFFFFUL ? 0xFFFFFFFFUL : rw;
+      ecost[ slot ] = scost[ k ];
+      eexp[ slot ] = 0UL;
+      etag[ slot ] = f[ k ].sig; /* dedup tag rides the frag sig */
+      evote[ slot ] = sisvote[ k ] ? 1 : 0;
+      memcpy( ebsrw + slot * W, sbsrw + k * W, (uint64_t)W * 8UL );
+      memcpy( ebsw + slot * W, sbsw + k * W, (uint64_t)W * 8UL );
+      memcpy( ewh + slot * maxw, swh + k * maxw, (uint64_t)maxw * 8UL );
+      ewc[ slot ] = swc[ k ];
+      memcpy( erh + slot * maxr, srh + k * maxr, (uint64_t)maxr * 8UL );
+      erc[ slot ] = src_[ k ];
+      state[ slot ] = PACK_ST_PENDING;
+      slot++;
+      placed++;
+    }
+  }
+  st->ctrs[ PC_INSERTED ] += (uint64_t)n_ok;
+  st->ctrs[ PC_REJECTED ] += (uint64_t)( n - n_ok );
+  return n;
+}
+
+/* ==== the burst loop ==================================================== */
+
+uint64_t fdt_stem_cfg_words( void ) { return FDT_STEM_CFG_WORDS; }
+
+int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
+  if( cfg[ C_MAGIC ] != FDT_STEM_MAGIC ) return -1;
+  stem_t st;
+  st.w = cfg;
+  st.handler = cfg[ C_HANDLER ];
+  st.n_ins = (int64_t)cfg[ C_NINS ];
+  st.n_outs = (int64_t)cfg[ C_NOUTS ];
+  st.cap = (int64_t)cfg[ C_CAP ];
+  st.args = (uint64_t *)cfg[ C_ARGS ];
+  st.ctrs = (uint64_t *)cfg[ C_CTRS ];
+  st.tspub = (uint32_t)cfg[ C_TSPUB ];
+  st.need_python = 0;
+  if( st.n_ins > FDT_STEM_MAX_INS || st.n_outs > FDT_STEM_MAX_OUTS )
+    return -1;
+  if( max_frags > st.cap ) max_frags = st.cap;
+
+  memset( st.ctrs, 0, FDT_STEM_N_CTRS * sizeof( uint64_t ) );
+  for( int64_t i = 0; i < st.n_ins; i++ ) {
+    uint64_t * in = in_blk( &st, i );
+    in[ I_CONSUMED ] = in[ I_BYTES ] = in[ I_OVR ] = 0UL;
+  }
+  for( int64_t o = 0; o < st.n_outs; o++ ) {
+    uint64_t * ob = out_blk( &st, o );
+    ob[ O_PUBLISHED ] = ob[ O_BYTES ] = 0UL;
+  }
+
+  int64_t total = 0;
+  uint64_t status = FDT_STEM_IDLE;
+  uint64_t status_in = 0;
+
+  for( ;; ) {
+    int progressed = 0;
+    int pending_blocked = 0;
+
+    /* per-sweep credit bound: min over outs of cr_avail against the
+       slowest reliable consumer — re-read every sweep so a long burst
+       tracks consumer progress instead of trusting a stale credit
+       count (the mc_corpus stem-burst-over-credit mutant is exactly
+       this re-read skipped) */
+    int64_t cr = st.cap;
+    for( int64_t o = 0; o < st.n_outs; o++ ) {
+      uint64_t * ob = out_blk( &st, o );
+      uint64_t nf = ob[ O_NFSEQ ];
+      uint64_t avail = ob[ O_DEPTH ];
+      if( nf ) {
+        uint64_t lo = fdt_fseq_query( (void *)ob[ O_FSEQ0 ] );
+        for( uint64_t j = 1; j < nf && j < 4; j++ ) {
+          uint64_t v = fdt_fseq_query( (void *)ob[ O_FSEQ0 + j ] );
+          if( seq_delta( v, lo ) < 0 ) lo = v;
+        }
+        avail = fdt_fctl_cr_avail( ob[ O_SEQ ], lo, ob[ O_DEPTH ] );
+      }
+      if( (int64_t)avail < cr ) cr = (int64_t)avail;
+    }
+
+    uint64_t rot = cfg[ C_ROT ]++;
+    for( int64_t k = 0; k < st.n_ins; k++ ) {
+      int64_t i =
+          (int64_t)( ( rot + (uint64_t)k ) % (uint64_t)st.n_ins );
+      if( total >= max_frags ) { status = FDT_STEM_BUDGET; goto done; }
+      uint64_t * in = in_blk( &st, i );
+      uint64_t prod = fdt_mcache_seq_query( (void *)in[ I_MCACHE ] );
+      if( !( in[ I_FLAGS ] & IN_F_NATIVE ) ) {
+        /* python-only link: any pending frag hands control back */
+        if( seq_delta( in[ I_SEQ ], prod ) < 0 ) {
+          status = FDT_STEM_PYTHON;
+          status_in = (uint64_t)i;
+          goto done;
+        }
+        continue;
+      }
+      int64_t budget = max_frags - total;
+      int64_t room = st.cap - (int64_t)in[ I_CONSUMED ];
+      if( room < budget ) budget = room;
+      if( st.n_outs && budget > cr ) budget = cr;
+      if( budget <= 0 ) {
+        if( st.n_outs && cr <= 0 && seq_delta( in[ I_SEQ ], prod ) < 0 )
+          pending_blocked = 1;
+        continue;
+      }
+      fdt_frag_t * buf =
+          (fdt_frag_t *)in[ I_FRAGS ] + in[ I_CONSUMED ];
+      uint64_t seq = in[ I_SEQ ];
+      uint64_t ovr = 0;
+      int64_t n = (int64_t)fdt_mcache_drain(
+          (void *)in[ I_MCACHE ], &seq, (uint64_t)budget, buf, &ovr );
+      in[ I_OVR ] += ovr;
+      if( !n ) {
+        in[ I_SEQ ] = seq; /* overrun resync may have advanced it */
+        continue;
+      }
+      int64_t handled;
+      switch( st.handler ) {
+      case FDT_STEM_H_DEDUP:
+        handled = h_dedup( &st, i, buf, n );
+        break;
+      case FDT_STEM_H_BANK:
+        handled = h_bank( &st, i, buf, n );
+        break;
+      case FDT_STEM_H_PACK:
+        handled = h_pack( &st, i, buf, n );
+        break;
+      default:
+        return -1;
+      }
+      uint64_t bytes = 0;
+      for( int64_t j = 0; j < handled; j++ ) bytes += buf[ j ].sz;
+      in[ I_BYTES ] += bytes;
+      in[ I_CONSUMED ] += (uint64_t)handled;
+      total += handled;
+      if( handled ) progressed = 1;
+      /* consume credits on EVERY path that handled frags — a chunking
+         return that skipped this would let the next in-link publish
+         against a stale credit count (the stem-burst-over-credit bug
+         class) */
+      if( st.n_outs ) cr -= handled;
+      if( handled < n ) {
+        /* rewind the cursor to the first unhandled frag — its copy in
+           buf carries its seq; the fseq below never advances past the
+           handled prefix, so a reliable producer cannot overwrite it */
+        in[ I_SEQ ] = buf[ handled ].seq;
+        fdt_fseq_update( (void *)in[ I_FSEQ ], in[ I_SEQ ] );
+        if( st.need_python ) {
+          status = FDT_STEM_PYTHON;
+          status_in = (uint64_t)i;
+          goto done;
+        }
+        /* handler chunking (journal / scan-scratch capacity): keep
+           sweeping — the rest re-drains next round */
+        continue;
+      }
+      in[ I_SEQ ] = seq;
+      fdt_fseq_update( (void *)in[ I_FSEQ ], seq );
+    }
+    if( !progressed ) {
+      status = pending_blocked ? FDT_STEM_BP : FDT_STEM_IDLE;
+      break;
+    }
+  }
+
+done:
+  cfg[ C_STATUS ] = status;
+  cfg[ C_STATUS_IN ] = status_in;
+  return total;
+}

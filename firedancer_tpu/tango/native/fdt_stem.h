@@ -1,0 +1,155 @@
+/* fdt_stem.h — GIL-released native inner loop for data-plane tiles.
+ *
+ * Reference model (behavior contract only; implementation original):
+ * fd_stem/fd_mux run the whole tile hot loop in C — poll the in
+ * mcaches, invoke the tile callback, publish, update flow control —
+ * without ever touching an interpreter (src/disco/stem/fd_stem.c,
+ * src/disco/mux/fd_mux.c:90-707).  This build's port escaped the GIL
+ * at the process level (one process per tile) and at the bank-executor
+ * level (fdt_bank.c), but the per-burst mux bookkeeping — drain, frag
+ * handling, publish, credit/fseq updates — was still Python.  fdt_stem
+ * moves that inner loop into ONE ctypes call: Python regains control
+ * only at the BURST BOUNDARY (max_frags consumed, caught up, zero
+ * credits, or a frag that needs the Python slow path), which is where
+ * housekeeping, heartbeats, faultinj consult points, metrics flush and
+ * trace emission already live.
+ *
+ * The stem is configured through a flat u64 config block (see the
+ * FDT_STEM_* word indices below) built host-side: raw pointers to the
+ * SAME mcache/dcache/fseq/tcache/bank-table regions the Python loop
+ * uses, so the two loops are interchangeable mid-run and every ring op
+ * the stem performs is the op fdtmc model-checked (fdt_mcache_publish's
+ * invalidate→body→seq release ordering, drain's overrun resync,
+ * fdt_fctl_cr_avail's credit bound).  The stem itself is OUTSIDE the
+ * model-checked surface: fdtmc schedules the Python loop's micro-step
+ * hooks, and the mc_corpus "stem-burst-over-credit" mutant pins that
+ * the checked protocol catches exactly the class of bug a burst loop
+ * could introduce (publishing past one credit computation).
+ *
+ * Handlers (FDT_STEM_H_*) are native re-statements of the three
+ * data-plane tiles' on_frags fast paths, bit-identical by contract and
+ * by test (tests/test_fdt_stem.py golden parity):
+ *
+ *   dedup — fdt_tcache_dedup_j with the journal discipline unchanged
+ *     (arm slot 0 + out-seq BEFORE the insert, survivor-list rewrite to
+ *     the inactive slot on zero-tag pass-throughs, phase cleared after
+ *     the publish), so SIGKILL mid-burst recovers through the exact
+ *     same amnesty protocol tiles/dedup.py already implements.
+ *   bank — fdt_bank_pipeline: fdt_mb_decode + fdt_txn_scan +
+ *     fdt_bank_exec fused into one call per microblock, killing the
+ *     last per-microblock Python.  Anything the table path cannot
+ *     express (a non-fast txn, a cold key, a NONTRIVIAL account) hands
+ *     the frag back to Python UNCONSUMED — the journal's (tag, done)
+ *     resume makes the partial fast prefix exactly-once.
+ *   pack — the insert path: gather + txn_scan(+bitsets) + free-slot
+ *     scatter into the pack engine's dense arrays.  The eviction path
+ *     (pool full) bails to Python before mutating anything.
+ *
+ * A handler may also return fewer frags than drained WITHOUT raising
+ * the python flag (journal-capacity chunking); the stem rewinds the in
+ * cursor to the first unhandled frag — safe on reliable links because
+ * the consumer's fseq never advances past what was handled. */
+
+#ifndef FDT_STEM_H
+#define FDT_STEM_H
+
+#include <stdint.h>
+
+/* ---- geometry ---------------------------------------------------------- */
+
+#define FDT_STEM_MAX_INS 4
+#define FDT_STEM_MAX_OUTS 8
+#define FDT_STEM_N_CTRS 16
+
+#define FDT_STEM_MAGIC 0xf17eda2ce57e0001UL
+
+/* handler ids (cfg word 1) */
+#define FDT_STEM_H_DEDUP 1
+#define FDT_STEM_H_BANK 2
+#define FDT_STEM_H_PACK 3
+
+/* run statuses (cfg word 5, written by fdt_stem_run) */
+#define FDT_STEM_IDLE 0   /* caught up: nothing more to consume */
+#define FDT_STEM_BUDGET 1 /* max_frags consumed; more may be ready */
+#define FDT_STEM_PYTHON 2 /* frag(s) pending that need the Python path;
+                             cfg word 6 = the in-link index */
+#define FDT_STEM_BP 3     /* credits exhausted with input pending */
+
+/* ---- config block (u64 words; built host-side) -------------------------
+ *
+ * word 0  magic
+ * word 1  handler id
+ * word 2  n_ins  (<= FDT_STEM_MAX_INS)
+ * word 3  n_outs (<= FDT_STEM_MAX_OUTS)
+ * word 4  cap: per-in frag-scratch capacity (also bounds max_frags)
+ * word 5  status (out)
+ * word 6  status_in (out): in-link index for FDT_STEM_PYTHON
+ * word 7  handler args block ptr (layout per handler, see fdt_stem.c)
+ * word 8  counters ptr: u64[FDT_STEM_N_CTRS], zeroed per call; the
+ *         handler accumulates tile-counter deltas here and Python
+ *         applies them ONCE per burst (the batched-metrics contract)
+ * word 9  tspub for every publish this call (compressed u32 domain)
+ * word 10 sweep-rotation cursor (C-owned, persists across calls: the
+ *         sweep start index rotates so a saturated in-link cannot
+ *         starve the others — the Python loop's drain-order rotation,
+ *         kept across the burst boundary)
+ * words 11..15 reserved
+ *
+ * per-in block i at word 16 + 12*i:
+ *   +0 mcache ptr          +1 dcache base ptr (0 = none)
+ *   +2 fseq ptr            +3 seq cursor (in/out)
+ *   +4 flags (bit0 = native-handled; clear = python-only: a pending
+ *      frag on this link returns FDT_STEM_PYTHON)
+ *   +5 reserved (handlers address payloads by chunk * FDT_CHUNK_SZ,
+ *      never by a row width)
+ *   +6 frag scratch ptr (fdt_frag_t[cap]): drained metas, python-read
+ *      after the burst for trace ingest + latency hists
+ *   +7 consumed this call (out)   +8 bytes consumed (out)
+ *   +9 overruns this call (out)   +10,+11 reserved
+ *
+ * per-out block o at word 64 + 16*o:
+ *   +0 mcache ptr          +1 dcache base ptr (0 = none)
+ *   +2 chunk-cursor ptr (u64 word: the shm dcache cursor in the
+ *      process runtime, a host scratch word otherwise)
+ *   +3 mtu                 +4 wmark_chunks        +5 depth (= cr_max)
+ *   +6 n consumer fseqs    +7..+10 consumer fseq ptrs (<= 4)
+ *   +11 seq cursor (in/out)
+ *   +12 published this call (out)  +13 bytes published (out)
+ *   +14 published-sig scratch ptr (u64[cap], 0 = skip) — for
+ *       tracer.publish at the burst boundary
+ *   +15 published-tsorig scratch ptr (u32[cap], 0 = skip)
+ */
+
+#define FDT_STEM_CFG_WORDS 192
+
+/* Layout self-description so the Python side can assert against drift. */
+uint64_t fdt_stem_cfg_words( void );
+
+/* Run the stem until a burst boundary: consume up to max_frags frags
+   across the native-handled in-links, dispatching each drained run to
+   the configured handler (which publishes through the out blocks under
+   the per-sweep credit bound min over outs of fdt_fctl_cr_avail).
+   Consumed in-links' fseqs are updated after every sweep so upstream
+   credits keep flowing during a long burst.  Returns total frags
+   consumed (>= 0) and writes cfg status words, or -1 on a bad config
+   block. */
+int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags );
+
+/* Fused bank fast path: decode one microblock + scan-classify + execute
+   all-fast batches through fdt_bank_exec, in one call.  bargs is the
+   bank handler's args block (see fdt_stem.c FDT_BANKH_*): decode/scan
+   scratch arrays plus the shared account table, the per-bank undo
+   journal (whose python-owned word 31 carries the completed-seq mark),
+   and the zero_check feature flag.  mb_tag is the carrying frag's seq —
+   the crash-resume journal key, so a SIGKILL mid-microblock resumes
+   through the SAME (tag, txns-done) protocol the Python path uses.
+   out_stats u64[8]: [0] rc (0 executed, 1 malformed, 2 needs the
+   Python path — nothing consumed beyond the journal's own progress,
+   3 already complete: republish without re-executing), [1] txn count,
+   [2] newly executed, [3] newly failed, [4] fees collected.
+   Returns rc. */
+int64_t fdt_bank_pipeline( uint8_t const * mb, int64_t mb_sz,
+                           uint64_t * bargs, uint64_t mb_tag,
+                           uint64_t * out_stats );
+
+#endif /* FDT_STEM_H */

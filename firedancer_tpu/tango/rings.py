@@ -59,6 +59,7 @@ def _load() -> ct.CDLL:
             _HERE / "native" / "fdt_sha512.c",
             _HERE / "native" / "fdt_pack.c",
             _HERE / "native" / "fdt_bank.c",
+            _HERE / "native" / "fdt_stem.c",
         ],
     )
     lib = ct.CDLL(str(so))
@@ -167,6 +168,11 @@ def _load() -> ct.CDLL:
         "fdt_mb_decode": (
             ct.c_int64,
             [vp, ct.c_int64, vp, ct.c_int64, vp, ct.c_int64],
+        ),
+        "fdt_stem_cfg_words": (u64, []),
+        "fdt_stem_run": (ct.c_int64, [vp, ct.c_int64]),
+        "fdt_bank_pipeline": (
+            ct.c_int64, [vp, ct.c_int64, vp, u64, vp],
         ),
         "fdt_udp_recv_burst": (
             ct.c_int64,
@@ -1038,3 +1044,217 @@ class TCache:
 
     def reset(self) -> None:
         _lib.fdt_tcache_reset(_ptr(self.mem))
+
+
+# ---------------------------------------------------------------------------
+# stem: GIL-released native inner loop for data-plane tiles
+#
+# One fdt_stem_run call drains a tile's in-mcaches, dispatches the frags
+# to a registered native handler (dedup / bank pipeline / pack insert),
+# publishes to the out mcache/dcache and updates fseq/credits — Python
+# regains control only at the burst boundary (tango/native/fdt_stem.h).
+# The run loop (disco/mux.py) owns when the stem runs; tiles describe
+# their handler with a StemSpec (Tile.native_handler).
+
+#: handler ids (fdt_stem.h FDT_STEM_H_*)
+STEM_H_DEDUP, STEM_H_BANK, STEM_H_PACK = 1, 2, 3
+
+#: run statuses (fdt_stem.h FDT_STEM_*)
+STEM_IDLE, STEM_BUDGET, STEM_PYTHON, STEM_BP = 0, 1, 2, 3
+
+_STEM_MAGIC = 0xF17EDA2CE57E0001
+_STEM_WORDS = 192
+_STEM_MAX_INS, _STEM_MAX_OUTS, _STEM_N_CTRS = 4, 8, 16
+# cfg word indices (fdt_stem.c C_* / I_* / O_*)
+_SC_MAGIC, _SC_HANDLER, _SC_NINS, _SC_NOUTS, _SC_CAP = 0, 1, 2, 3, 4
+_SC_STATUS, _SC_STATUS_IN, _SC_ARGS, _SC_CTRS, _SC_TSPUB = 5, 6, 7, 8, 9
+_SI0, _SI_STRIDE = 16, 12
+# in-block word 5 is reserved (handlers address payloads by chunk)
+(_SI_MCACHE, _SI_DCACHE, _SI_FSEQ, _SI_SEQ, _SI_FLAGS, _SI_RSVD,
+ _SI_FRAGS, _SI_CONSUMED, _SI_BYTES, _SI_OVR) = range(10)
+_SO0, _SO_STRIDE = 64, 16
+(_SO_MCACHE, _SO_DCACHE, _SO_CHUNKP, _SO_MTU, _SO_WMARK, _SO_DEPTH,
+ _SO_NFSEQ, _SO_FSEQ0) = range(8)
+_SO_SEQ, _SO_PUBLISHED, _SO_BYTES, _SO_SIGS, _SO_TSORIGS = 11, 12, 13, 14, 15
+
+
+class StemSpec:
+    """A tile's native-handler descriptor (Tile.native_handler).
+
+    `args` is the handler's u64 argument block (raw pointers into
+    scratch/state the tile owns — everything referenced must be kept
+    alive via `keepalive`).  `counters` maps the stem's per-burst
+    counter-scratch indices to this tile's metric names, applied ONCE
+    per burst by the run loop.  `ready` (optional) gates the stem per
+    iteration — a tile with host-side state the fast path cannot
+    express yet (dedup's pending replay amnesty) returns False to stay
+    on the Python loop until it drains.  `after_burst` (optional) runs
+    after the deltas are applied (bank's deferred-commit cadence)."""
+
+    def __init__(self, handler: int, args: np.ndarray,
+                 counters: tuple = (), keepalive: tuple = (),
+                 native_ins: tuple | None = None,
+                 ready=None, after_burst=None, cap: int | None = None):
+        self.handler = handler
+        self.args = args
+        self.counters = counters
+        self.keepalive = keepalive
+        self.native_ins = native_ins
+        self.ready = ready
+        self.after_burst = after_burst
+        #: max frags per burst the args block's scratch supports; the
+        #: Stem clamps its own capacity to it (None = no tile bound)
+        self.cap = cap
+
+
+class Stem:
+    """Host handle on one tile's native stem config block.
+
+    Builds the flat u64 config (fdt_stem.h layout) over the SAME
+    mcache/dcache/fseq regions the tile's InLink/OutLink endpoints use,
+    so the native and Python loops are interchangeable between bursts.
+    Cursor words (in seqs, out seqs, dcache chunk cursors) are synced
+    both ways around every run() call."""
+
+    def __init__(self, ins, outs, spec: StemSpec, cap: int = 4096):
+        if len(ins) > _STEM_MAX_INS or len(outs) > _STEM_MAX_OUTS:
+            raise ValueError(
+                f"stem supports <= {_STEM_MAX_INS} ins / "
+                f"{_STEM_MAX_OUTS} outs (got {len(ins)}/{len(outs)})"
+            )
+        for o in outs:
+            if len(o.consumer_fseqs) > 4:
+                raise ValueError(
+                    f"stem out {o.name!r}: > 4 reliable consumers"
+                )
+        assert int(_lib.fdt_stem_cfg_words()) == _STEM_WORDS
+        self.ins = list(ins)
+        self.outs = list(outs)
+        self.spec = spec
+        if spec.cap is not None:
+            cap = min(int(cap), int(spec.cap))
+        self.cap = int(cap)
+        w = self._w = np.zeros(_STEM_WORDS, np.uint64)
+        self._ctrs = np.zeros(_STEM_N_CTRS, np.uint64)
+        self._in_frags = [
+            np.zeros(self.cap, FRAG_DTYPE) for _ in self.ins
+        ]
+        self._out_sigs = [np.zeros(self.cap, np.uint64) for _ in self.outs]
+        self._out_tsorigs = [
+            np.zeros(self.cap, np.uint32) for _ in self.outs
+        ]
+        #: host-side chunk-cursor words for outs whose DCache cursor is
+        #: not already shm-backed (thread runtime); synced around run()
+        self._cursors: list[np.ndarray | None] = []
+        native = (
+            set(range(len(self.ins)))
+            if spec.native_ins is None
+            else set(spec.native_ins)
+        )
+        w[_SC_MAGIC] = _STEM_MAGIC
+        w[_SC_HANDLER] = spec.handler
+        w[_SC_NINS] = len(self.ins)
+        w[_SC_NOUTS] = len(self.outs)
+        w[_SC_CAP] = self.cap
+        w[_SC_ARGS] = _ptr(spec.args)
+        w[_SC_CTRS] = _ptr(self._ctrs)
+        for i, il in enumerate(self.ins):
+            b = _SI0 + i * _SI_STRIDE
+            w[b + _SI_MCACHE] = _ptr(il.mcache.mem)
+            w[b + _SI_DCACHE] = (
+                _ptr(il.dcache.mem) if il.dcache is not None else 0
+            )
+            w[b + _SI_FSEQ] = _ptr(il.fseq.mem)
+            w[b + _SI_FLAGS] = 1 if i in native else 0
+            w[b + _SI_FRAGS] = self._in_frags[i].ctypes.data
+        for o, ol in enumerate(self.outs):
+            b = _SO0 + o * _SO_STRIDE
+            w[b + _SO_MCACHE] = _ptr(ol.mcache.mem)
+            dc = ol.dcache
+            if dc is not None:
+                w[b + _SO_DCACHE] = _ptr(dc.mem)
+                w[b + _SO_MTU] = dc.mtu
+                w[b + _SO_WMARK] = dc.wmark_chunks
+                if dc._cursor_mem is not None:
+                    # process runtime: the cursor already lives in shm —
+                    # point the stem straight at it (crash-coherent)
+                    cur = None
+                    w[b + _SO_CHUNKP] = _ptr(dc._cursor_mem)
+                else:
+                    cur = np.zeros(1, np.uint64)
+                    w[b + _SO_CHUNKP] = _ptr(cur)
+                self._cursors.append(cur)
+            else:
+                self._cursors.append(None)
+            w[b + _SO_DEPTH] = ol.mcache.depth
+            w[b + _SO_NFSEQ] = len(ol.consumer_fseqs)
+            for j, fs in enumerate(ol.consumer_fseqs[:4]):
+                w[b + _SO_FSEQ0 + j] = _ptr(fs.mem)
+            w[b + _SO_SIGS] = self._out_sigs[o].ctypes.data
+            w[b + _SO_TSORIGS] = self._out_tsorigs[o].ctypes.data
+
+    def run(self, budget: int, tspub: int) -> tuple[int, int, int]:
+        """One GIL-released burst: up to `budget` frags drained,
+        handled and published natively.  Returns (consumed, status,
+        status_in).  The stem is OUTSIDE the model-checked surface by
+        design — fdtmc schedules the Python loop's micro-step hooks
+        (the only loop it drives), and the stem composes the same
+        verified ring ops; under the checker this entry point must
+        never be reached."""
+        if _MC is not None:
+            raise RuntimeError(
+                "native stem invoked under fdtmc — model-checked "
+                "scenarios drive the Python loop only"
+            )
+        w = self._w
+        for i, il in enumerate(self.ins):
+            w[_SI0 + i * _SI_STRIDE + _SI_SEQ] = seq_u64(il.seq)
+        for o, ol in enumerate(self.outs):
+            b = _SO0 + o * _SO_STRIDE
+            w[b + _SO_SEQ] = seq_u64(ol.seq)
+            cur = self._cursors[o]
+            if cur is not None:
+                cur[0] = ol.dcache.chunk
+        w[_SC_TSPUB] = tspub & 0xFFFFFFFF
+        n = _lib.fdt_stem_run(_ptr(self._w), budget)
+        if n < 0:
+            raise RuntimeError("fdt_stem_run rejected its config block")
+        for i, il in enumerate(self.ins):
+            il.seq = int(w[_SI0 + i * _SI_STRIDE + _SI_SEQ])
+        for o, ol in enumerate(self.outs):
+            b = _SO0 + o * _SO_STRIDE
+            ol.seq = int(w[b + _SO_SEQ])
+            cur = self._cursors[o]
+            if cur is not None:
+                ol.dcache.chunk = int(cur[0])
+        return int(n), int(w[_SC_STATUS]), int(w[_SC_STATUS_IN])
+
+    # -- per-burst readbacks (applied once per burst by the run loop) --
+
+    def consumed(self, i: int) -> int:
+        return int(self._w[_SI0 + i * _SI_STRIDE + _SI_CONSUMED])
+
+    def in_bytes(self, i: int) -> int:
+        return int(self._w[_SI0 + i * _SI_STRIDE + _SI_BYTES])
+
+    def overruns(self, i: int) -> int:
+        return int(self._w[_SI0 + i * _SI_STRIDE + _SI_OVR])
+
+    def frags(self, i: int) -> np.ndarray:
+        return self._in_frags[i][: self.consumed(i)]
+
+    def published(self, o: int) -> int:
+        return int(self._w[_SO0 + o * _SO_STRIDE + _SO_PUBLISHED])
+
+    def out_bytes(self, o: int) -> int:
+        return int(self._w[_SO0 + o * _SO_STRIDE + _SO_BYTES])
+
+    def out_sigs(self, o: int) -> np.ndarray:
+        return self._out_sigs[o][: self.published(o)]
+
+    def out_tsorigs(self, o: int) -> np.ndarray:
+        return self._out_tsorigs[o][: self.published(o)]
+
+    @property
+    def counters(self) -> np.ndarray:
+        return self._ctrs

@@ -137,6 +137,91 @@ class BankTile(Tile):
                 # microblock resumes exactly once (see _execute)
                 self._table.recover(self.funk, self._executor.xid)
 
+    #: native stem decode/scan scratch rows (fixed; a microblock with
+    #: more txns hands back to the Python path's growable scratch)
+    STEM_TXN_CAP = 1024
+
+    def native_handler(self, ctx: MuxCtx):
+        """Native stem fast path (ISSUE 10): fdt_bank_pipeline fuses
+        fdt_mb_decode + fdt_txn_scan + fdt_bank_exec into one call per
+        microblock — the last per-microblock Python is gone.  Anything
+        the shared table cannot express (a non-fast txn, a cold key, a
+        NONTRIVIAL account) hands the frag back UNCONSUMED to the
+        Python on_frags path, whose journal-keyed resume keeps the
+        already-executed fast prefix exactly-once.  The deferred funk
+        commit keeps its cadence via the after-burst hook."""
+        if (
+            self._table is None
+            or len(ctx.outs) != 2
+            or ctx.outs[1].dcache is None
+            or any(il.dcache is None for il in ctx.ins)
+        ):
+            return None
+        cap = self.STEM_TXN_CAP
+        tbl = self._table
+        ex = self._executor
+        s = (
+            np.zeros((cap, T.MTU), np.uint8),  # 0 decode rows
+            np.zeros(cap, np.uint32),  # 1 szs
+            np.zeros(cap, np.uint8),  # 2 ok
+            np.zeros(cap, np.uint8),  # 3 is_vote
+            np.zeros(cap, np.uint8),  # 4 fast
+            np.zeros(cap, np.uint32),  # 5 cost
+            np.zeros(cap, np.uint64),  # 6 rewards
+            np.zeros(cap, np.uint32),  # 7 cu_limit
+            np.zeros(cap, np.uint64),  # 8 tags
+            np.zeros(cap, np.uint64),  # 9 lamports
+            np.zeros(cap, np.uint32),  # 10 payer_off
+            np.zeros(cap, np.uint32),  # 11 src_off
+            np.zeros(cap, np.uint32),  # 12 dst_off
+            np.zeros(cap, np.uint32),  # 13 fee
+            np.zeros(cap, np.int64),  # 14 idx
+            np.zeros(cap, np.uint8),  # 15 status
+            np.zeros(cap, np.uint64),  # 16 ofees
+        )
+        args = np.zeros(24, np.uint64)
+        args[0] = s[0].ctypes.data
+        args[1] = T.MTU
+        args[2] = s[1].ctypes.data
+        args[3] = cap
+        for k in range(2, 17):  # BH_OK .. BH_OFEES are contiguous
+            args[2 + k] = s[k].ctypes.data
+        args[19] = tbl.mem.ctypes.data
+        args[20] = tbl.journal.ctypes.data
+        args[22] = self.bank_id
+
+        def _refresh_features() -> bool:
+            # the Python fallback re-evaluates the feature flag per
+            # execution (flamenco/runtime.py); refresh the baked word
+            # every iteration so a slot advance / activation epoch can
+            # never diverge the native path from the fallback path
+            args[21] = int(
+                ex.features.active("system_transfer_zero_check", ex.slot)
+            )
+            return True
+
+        _refresh_features()
+        return R.StemSpec(
+            R.STEM_H_BANK, args,
+            ready=_refresh_features,
+            counters=(
+                "executed_microblocks", "executed_txns", "failed_txns",
+                "fast_txns", "fees_lamports", "malformed_microblocks",
+                "native_txns",
+            ),
+            keepalive=(s, args),
+            after_burst=self._stem_after_burst,
+        )
+
+    def _stem_after_burst(self, ctx: MuxCtx, ctrs) -> None:
+        # the deferred-commit cadence, fed by the burst's
+        # executed_microblocks delta (counter scratch slot 0)
+        n_mb = int(ctrs[0])
+        if n_mb:
+            self._mb_uncommitted += n_mb
+            if self._mb_uncommitted >= self.commit_every:
+                self._commit(ctx)
+
     def _decode(self, buf: np.ndarray):
         """Native microblock decode -> (rows view, szs view) scratch, or
         None on a malformed microblock (metered drop at the caller)."""

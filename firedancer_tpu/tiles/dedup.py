@@ -138,6 +138,41 @@ class DedupTile(Tile):
         if ctx.incarnation > 0 and amn:
             ctx.metrics.inc("replay_amnesty", len(amn))
 
+    def native_handler(self, ctx: MuxCtx):
+        """Native stem fast path (ISSUE 10): the whole drain → dedup_j →
+        gather/scatter → publish cycle runs in one GIL-released call
+        with the journal discipline UNCHANGED (slot-0 arm before the
+        insert, survivor-list rewrite on zero-tag pass-throughs, phase
+        cleared after the publish) — SIGKILL mid-burst recovers through
+        the exact amnesty protocol on_boot already implements.  The
+        handler stays off (`ready` False) while a replay amnesty is
+        pending: amnesty grants are host-side state only the Python
+        path consumes."""
+        if (
+            self._tc is None
+            or self._jnl is None
+            or len(ctx.outs) != 1
+            or ctx.outs[0].dcache is None
+            or any(il.dcache is None for il in ctx.ins)
+        ):
+            return None
+        cap = self.JOURNAL_TAGS
+        self._stem_isdup = np.zeros(cap, np.uint8)
+        self._stem_tags = np.zeros(cap, np.uint64)
+        args = np.zeros(8, np.uint64)
+        args[0] = self._tc.mem.ctypes.data
+        args[1] = self._jnl.ctypes.data
+        args[2] = self.JOURNAL_TAGS
+        args[3] = self._stem_isdup.ctypes.data
+        args[4] = self._stem_tags.ctypes.data
+        return R.StemSpec(
+            R.STEM_H_DEDUP, args,
+            counters=("dup_txns",),
+            keepalive=(self._stem_isdup, self._stem_tags, args),
+            ready=lambda: not self._amnesty and self._crash_probe is None,
+            cap=cap,
+        )
+
     def _persist_amnesty(self, ctx: MuxCtx) -> None:
         """Mirror the in-memory amnesty set into its shm area (tags
         first, count last).  Entries only ever leave the area after

@@ -140,6 +140,82 @@ class PackTile(Tile):
             # the meta field's ceiling
             self._byte_limit = min(ctx.outs[0].dcache.mtu, 0xFFFF) - MB_HDR
 
+    #: native stem scan scratch rows (frags per inner round; bigger
+    #:  drains chunk through it)
+    STEM_SCAN_CAP = 1024
+
+    def native_handler(self, ctx: MuxCtx):
+        """Native stem fast path (ISSUE 10) for the INSERT path only:
+        gather + fdt_txn_scan(+bitsets) + free-slot scatter into the
+        pack engine's dense pool arrays run in one GIL-released call.
+        The completion rings (ins[1..]) and the scheduler
+        (after_credit) stay Python — the stem hands control back the
+        moment a completion frag is pending.  The priority-eviction
+        path (pool full) also bails to Python before mutating anything,
+        so the engine state stays bit-identical to insert_batch's."""
+        if not ctx.ins or ctx.ins[0].dcache is None:
+            return None
+        eng = self.engine
+        cap = self.STEM_SCAN_CAP
+        sw = ctx.ins[0].dcache.mtu
+        W = eng.W
+        s = (
+            np.zeros((cap, sw), np.uint8),  # 0 scan rows
+            np.zeros(cap, np.uint32),  # 1 scan szs
+            np.zeros(cap, np.uint8),  # 2 ok
+            np.zeros(cap, np.uint8),  # 3 is_vote
+            np.zeros(cap, np.uint8),  # 4 fast
+            np.zeros(cap, np.uint32),  # 5 cost
+            np.zeros(cap, np.uint64),  # 6 rewards
+            np.zeros(cap, np.uint32),  # 7 cu_limit
+            np.zeros(cap, np.uint64),  # 8 tags
+            np.zeros(cap, np.uint64),  # 9 lamports
+            np.zeros(cap, np.uint32),  # 10 payer_off
+            np.zeros(cap, np.uint32),  # 11 src_off
+            np.zeros(cap, np.uint32),  # 12 dst_off
+            np.zeros(cap, np.uint32),  # 13 fee
+            np.zeros((cap, W), np.uint64),  # 14 bs_rw
+            np.zeros((cap, W), np.uint64),  # 15 bs_w
+            np.zeros((cap, P.MAX_WRITERS), np.uint64),  # 16 whash
+            np.zeros(cap, np.uint8),  # 17 w_cnt
+            np.zeros((cap, P.MAX_READERS), np.uint64),  # 18 rhash
+            np.zeros(cap, np.uint8),  # 19 r_cnt
+        )
+        args = np.zeros(43, np.uint64)
+        args[0] = eng.state.ctypes.data
+        args[1] = len(eng.state)
+        args[2] = eng.rows.ctypes.data
+        args[3] = eng.rows.shape[1]
+        args[4] = eng.szs.ctypes.data
+        args[5] = eng.rewards.ctypes.data
+        args[6] = eng.cost.ctypes.data
+        args[7] = eng.expires_at.ctypes.data
+        args[8] = eng.sig_tag.ctypes.data
+        args[9] = eng.is_vote.ctypes.data
+        args[10] = eng.bs_rw.ctypes.data
+        args[11] = eng.bs_w.ctypes.data
+        args[12] = W
+        args[13] = eng.whash.ctypes.data
+        args[14] = eng.w_cnt.ctypes.data
+        args[15] = P.MAX_WRITERS
+        args[16] = eng.rhash.ctypes.data
+        args[17] = eng.r_cnt.ctypes.data
+        args[18] = P.MAX_READERS
+        args[19] = eng.nbits
+        args[20] = wire.TRAILER_SZ
+        args[21] = s[0].ctypes.data
+        args[22] = sw
+        args[23] = cap
+        for k in range(1, 20):  # PH_SSZS .. PH_SRCNT are contiguous
+            args[23 + k] = s[k].ctypes.data
+        return R.StemSpec(
+            R.STEM_H_PACK, args,
+            counters=("inserted_txns", "insert_rejected"),
+            keepalive=(s, args),
+            native_ins=(0,),
+            cap=cap,
+        )
+
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         if in_idx == 0:
             il = ctx.ins[0]

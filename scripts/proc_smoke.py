@@ -42,6 +42,7 @@ def run_pipeline(
     repeat: int = 2,
     profile: bool = False,
     deadline_s: float = 180.0,
+    stem: str = "python",
 ) -> dict:
     """One pipeline run; returns {ok, tps, landed, unique, ...}."""
     import numpy as np  # noqa: F401  (env sanity before topology work)
@@ -77,10 +78,10 @@ def run_pipeline(
         SinkTile(shm_log=max(2 * n_txns, 1 << 12)),
         ins=[("dedup_sink", True)],
     )
-    out: dict = {"runtime": runtime, "sent": total, "ok": False}
+    out: dict = {"runtime": runtime, "stem": stem, "sent": total, "ok": False}
     topo.build()
     t0 = time.perf_counter()
-    topo.start(batch_max=512, boot_timeout_s=600.0)
+    topo.start(batch_max=512, boot_timeout_s=600.0, stem=stem)
     boot_s = time.perf_counter() - t0
     try:
         t0 = time.perf_counter()
@@ -108,6 +109,7 @@ def run_pipeline(
             landed=len(sigs),
             unique=len(uniq),
             dups_dropped=topo.metrics("dedup").counter("dup_txns"),
+            stem_frags=md.counter("stem_frags"),
             verify_fail=topo.metrics("verify").counter(
                 "verify_fail_txns"
             ),
@@ -213,6 +215,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--runtime", default="process",
                     choices=["thread", "process"])
     ap.add_argument("--txns", type=int, default=2048)
+    ap.add_argument("--stem", default="python",
+                    choices=["python", "native"],
+                    help="data-plane inner loop: native = GIL-released "
+                         "fdt_stem bursts on tiles with a registered "
+                         "handler (ISSUE 10 combined smoke)")
     ap.add_argument("--repeat", type=int, default=2)
     ap.add_argument("--ab", action="store_true",
                     help="run BOTH runtimes with profiling; print the "
@@ -244,13 +251,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0 if doc["ok"] else 1
 
     r = run_pipeline(
-        args.runtime, n_txns=args.txns, repeat=args.repeat
+        args.runtime, n_txns=args.txns, repeat=args.repeat,
+        stem=args.stem,
     )
     if args.json:
         print(json.dumps(r, sort_keys=True))
     else:
         print(
-            f"proc_smoke [{r['runtime']}]: "
+            f"proc_smoke [{r['runtime']}/{r['stem']}]: "
             f"{'ok' if r['ok'] else 'FAILED'} — landed {r['landed']} "
             f"({r['unique']} unique of {args.txns}) at {r['tps']:,.0f} "
             f"frags/s, boot {r['boot_s']}s, leak={r['shm_leak']}"

@@ -1,0 +1,49 @@
+"""known-bad: a tile whose native_handler mutates ring/metric state.
+native_handler is a DESCRIPTOR BUILDER for the GIL-released stem — a
+publish or metrics write from it (or from the ready/after_burst
+closures it builds) runs outside the run loop's credit gate and
+phase/trace accounting, and keeps fast-path state in Python memory the
+native burst can neither see nor replay after a crash.  Must trip
+stem-native-handler."""
+
+import numpy as np
+
+
+class EagerStemTile:
+    def __init__(self):
+        self._pending = []
+        self._args = np.zeros(8, np.uint64)
+
+    def native_handler(self, ctx):
+        # BAD: publishing from the descriptor builder (outside the
+        # loop's credit gate)
+        ctx.outs[0].publish(np.array([1], np.uint64))
+        # BAD: metric write from the builder (outside the per-burst
+        # delta application)
+        ctx.metrics.inc("in_frags")
+
+        def _ready():
+            # BAD: a ready() gate that drains a ring as a side effect
+            frags, seq, _ = ctx.ins[0].mcache.drain(0, 16)
+            self._pending.extend(frags)
+            return True
+
+        return {"handler": 1, "args": self._args, "ready": _ready}
+
+
+class DescriptorOnlyStemTile:
+    """control: building pointers + closures that only READ host state
+    is the sanctioned shape and must NOT trip the rule."""
+
+    def __init__(self):
+        self._amnesty = set()
+        self._scratch = np.zeros(64, np.uint8)
+
+    def native_handler(self, ctx):
+        args = np.zeros(8, np.uint64)
+        args[0] = self._scratch.ctypes.data
+        return {
+            "handler": 1,
+            "args": args,
+            "ready": lambda: not self._amnesty,
+        }

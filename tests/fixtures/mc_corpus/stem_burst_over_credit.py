@@ -1,0 +1,14 @@
+"""A burst publisher — the native stem's shape (tango/native/fdt_stem.c)
+— trusts ONE credit computation for a whole burst instead of re-reading
+the consumer fseqs every sweep, publishing cr+1 frags per round.  The
+shipped stem re-reads cr_avail per sweep over the same fdt_fseq words
+the Python loop uses; this mutant pins that the checked protocol
+catches exactly the bug class a burst loop could introduce, which is
+what lets the (unscheduled-by-fdtmc) C stem lean on the verified ring
+ops — see the model-checking-boundary note in analysis/README.md."""
+
+MUTATION = "stem-burst-over-credit"
+SCENARIO = "backpressure"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-credit-overflow", "mc-reliable-overrun"}

@@ -48,6 +48,7 @@ BAD_FIXTURES = {
     "ring_bad_publish_no_credit.py": "ring-credit",
     "ring_bad_unhooked_ringop.py": "ring-mc-hook",
     "ring_bad_device_dispatch.py": "device-dispatch",
+    "ring_bad_stem_handler.py": "stem-native-handler",
     "ring_bad_hot_clock.py": "hot-path-clock",
     "proc_bad_unsafe_tile.py": "proc-safe-tile",
     "purity_bad_host_sync.py": "purity-host-sync",
@@ -95,9 +96,10 @@ def test_abi_covers_all_six_binding_modules(repo_report):
 def test_abi_coverage_is_substantive(repo_report):
     cov = repo_report.coverage["abi"]
     assert cov["tables"] >= 1
-    # 53 pre-fdt_bank symbols + the 8 fdt_bank_* batch-executor exports
-    assert len(cov["table_symbols"]) >= 60, cov["table_symbols"]
-    assert cov["call_sites"] >= 40  # rings.py methods + the direct binders
+    # 53 pre-fdt_bank symbols + 8 fdt_bank_* batch-executor exports + 3
+    # fdt_stem exports (cfg_words / run / bank_pipeline, ISSUE 10)
+    assert len(cov["table_symbols"]) >= 63, cov["table_symbols"]
+    assert cov["call_sites"] >= 42  # rings.py methods + the direct binders
     # the native exported surface and the ctypes tables are in bijection:
     # no unbound exports, no phantom bindings
     assert set(cov["c_symbols"]) == set(cov["table_symbols"])
@@ -119,7 +121,9 @@ def test_mc_hook_coverage(repo_report):
     FSeq runtime method plus cr_avail must route through the fdtmc hook."""
     cov = repo_report.coverage
     assert "firedancer_tpu/tango/rings.py" in set(cov["ring_files"])
-    assert cov["mc_hook_fns"] >= 13, cov["mc_hook_fns"]
+    # +1: Stem.run (the native stem entry point is guarded too — under
+    # fdtmc it must never run)
+    assert cov["mc_hook_fns"] >= 14, cov["mc_hook_fns"]
 
 
 def test_device_dispatch_fixture_controls_are_clean():
@@ -213,6 +217,23 @@ def test_abi_bad_fixture_trips_every_abi_rule():
         "fdt_mini_ok" in f.msg and f.rule not in ("abi-call-arity",)
         for f in rep.findings
     )
+
+
+def test_stem_handler_fixture_controls_are_clean():
+    """The rule flags every ring/metric mutation in the eager tile's
+    native_handler (including the ready-closure drain) and NONE in the
+    descriptor-only control."""
+    rep = engine.run_paths([CORPUS / "ring_bad_stem_handler.py"])
+    hits = [f for f in rep.findings if f.rule == "stem-native-handler"]
+    assert len(hits) >= 3, [str(f) for f in rep.findings]
+    assert not any("DescriptorOnly" in f.msg for f in hits)
+    bad_lines = {f.line for f in hits}
+    src = (CORPUS / "ring_bad_stem_handler.py").read_text().splitlines()
+    # every hit lands inside the EagerStemTile class body
+    eager_end = next(
+        i for i, ln in enumerate(src, 1) if "DescriptorOnly" in ln
+    )
+    assert all(ln < eager_end for ln in bad_lines), sorted(bad_lines)
 
 
 def test_good_fixtures_scan_clean():

@@ -38,6 +38,9 @@ NATIVE_SURFACE = [
     "tests/test_tango.py",
     "tests/test_pack_native.py",
     "tests/test_bank_native.py",
+    # the fdt_stem burst loop + fused bank pipeline (ISSUE 10): the
+    # parity/fault/backpressure tests drive every stem code path
+    "tests/test_fdt_stem.py",
 ]
 
 
