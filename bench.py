@@ -777,6 +777,194 @@ def _bench_stem() -> dict:
     return out
 
 
+def _bench_pack_sched() -> dict:
+    """Native pack scheduler A/B (ISSUE 11): fdt_pack_sched inside the
+    stem's after-credit hook vs the Python after_credit path, on the
+    same synchronous schedule→complete cycle at contended-regime depth
+    (2 banks x mb_inflight 4, 64 hot payers so the exact-lock walk does
+    real conflict work).  Before timing is trusted, a digest pass
+    asserts the microblock payload stream AND the completion stream are
+    bit-identical between the two paths.
+
+    Keys: pack_sched_mbs_per_s(_py), pack_sched_speedup,
+    pack_sched_txns_per_s."""
+    import hashlib
+
+    from firedancer_tpu.ballet import txn as BT
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import InLink, MuxCtx, OutLink
+    from firedancer_tpu.tango import rings as R
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.pack import PackTile
+
+    rng = np.random.default_rng(29)
+    pool_n, n_payers, n_banks, inflight = 2048, 64, 2, 4
+    payers = [
+        bytes(rng.integers(0, 256, 32, np.uint8)) for _ in range(n_payers)
+    ]
+    rows = np.zeros((pool_n, wire.LINK_MTU), np.uint8)
+    szs = np.zeros(pool_n, np.uint16)
+    tags = np.zeros(pool_n, np.uint64)
+    for i in range(pool_n):
+        p = payers[i % n_payers]
+        d = payers[(i * 7 + 3) % n_payers]
+        data = (2).to_bytes(4, "little") + int(
+            1 + rng.integers(1, 999)
+        ).to_bytes(8, "little")
+        sig = bytes(rng.integers(0, 256, 64, np.uint8))
+        raw = BT.build(
+            [sig], [p, d, bytes(32)], bytes(32), [(2, [0, 1], data)],
+            readonly_unsigned_cnt=1,
+        )
+        pl = wire.append_trailer(raw, BT.parse(raw))
+        rows[i, : len(pl)] = np.frombuffer(pl, np.uint8)
+        szs[i] = len(pl)
+        tags[i] = int.from_bytes(raw[1:9], "little")
+
+    def mk_ctx():
+        depth = 1 << 10
+
+        def ring(mtu=None):
+            mc = R.MCache(
+                np.zeros(R.MCache.footprint(depth), np.uint8), depth
+            )
+            dc = None
+            if mtu is not None:
+                dc = R.DCache(
+                    np.zeros(R.DCache.footprint(mtu, depth), np.uint8),
+                    mtu, depth,
+                )
+            return mc, dc
+
+        in_mc, in_dc = ring(wire.LINK_MTU)
+        cp_mc, _ = ring()
+        ins = [
+            InLink("txns", in_mc, in_dc,
+                   R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))),
+            InLink("comp", cp_mc, None,
+                   R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))),
+        ]
+        outs, cons = [], []
+        for b in range(n_banks):
+            mc, dc = ring(65_535)
+            fs = R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))
+            outs.append(OutLink(f"pb{b}", mc, dc, [fs]))
+            cons.append(fs)
+        pk = PackTile(
+            n_banks, depth=1 << 12, mb_inflight=inflight,
+            microblock_ns=0, slot_ns=10**15,
+        )
+        schema = pk.schema.with_base()
+        ctx = MuxCtx(
+            "pack", R.CNC(np.zeros(R.CNC.footprint(), np.uint8)), ins,
+            outs,
+            Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema),
+        )
+        pk.on_boot(ctx)
+        return pk, ctx, cons
+
+    def run(native: bool, refills: int, digest: bool):
+        pk, ctx, cons = mk_ctx()
+        stem = spec = None
+        if native:
+            spec = pk.native_handler(ctx)
+            assert spec is not None and spec.ac_handler
+            stem = R.Stem(ctx.ins, ctx.outs, spec, cap=512)
+        h = hashlib.blake2b(digest_size=16)
+        eng = pk.engine
+        il = ctx.ins[0]
+        in_seq = 0
+        comp_seq = 0
+        n_mbs = 0
+        n_txns = 0
+
+        def step():
+            nonlocal n_mbs, n_txns
+            if native:
+                _g, stat, _i = stem.run(512, 5)
+                n_mbs += int(stem.counters[2])
+                n_txns += int(stem.counters[3])
+                if stat == R.STEM_PYTHON:
+                    py_round()
+            else:
+                py_round()
+
+        def py_round():
+            nonlocal n_mbs, n_txns
+            mb0 = ctx.metrics.counter("microblocks")
+            tx0 = ctx.metrics.counter("microblock_txns")
+            for i in range(len(ctx.ins)):
+                ilk = ctx.ins[i]
+                frags, ilk.seq, _ = ilk.mcache.drain(ilk.seq, 512)
+                if len(frags):
+                    pk.on_frags(ctx, i, frags)
+            pk.after_credit(ctx)
+            n_mbs += ctx.metrics.counter("microblocks") - mb0
+            n_txns += ctx.metrics.counter("microblock_txns") - tx0
+
+        def harvest():
+            nonlocal comp_seq
+            for b in range(n_banks):
+                ol = ctx.outs[b]
+                seq = cons[b].query()
+                frags, seq, ovr = ol.mcache.drain(seq, 512)
+                assert ovr == 0
+                cons[b].update(seq)
+                if digest and len(frags):
+                    h.update(bytes([b]))
+                    h.update(frags["sig"].tobytes())
+                    h.update(frags["sz"].tobytes())
+                    for f in frags:
+                        h.update(
+                            ol.dcache.read(
+                                int(f["chunk"]), int(f["sz"])
+                            ).tobytes()
+                        )
+                if len(frags):
+                    cin = ctx.ins[1]
+                    comp_seq = cin.mcache.publish_batch(
+                        comp_seq, frags["sig"].astype(np.uint64)
+                    )
+
+        t0 = time.perf_counter()
+        for _refill in range(refills):
+            fed = 0
+            while fed < pool_n:
+                n = min(256, pool_n - fed)
+                chunks = il.dcache.write_batch(
+                    rows[fed : fed + n], szs[fed : fed + n]
+                )
+                il.mcache.publish_batch(
+                    in_seq, tags[fed : fed + n], chunks,
+                    szs[fed : fed + n], None, 3, None,
+                )
+                in_seq += n
+                fed += n
+                step()
+                harvest()
+            guard = 0
+            while eng.pending_cnt or eng.outstanding_cnt:
+                step()
+                harvest()
+                guard += 1
+                assert guard < 100_000, "pack sched bench wedged"
+            step()  # settle the last completion echo
+        dt = time.perf_counter() - t0
+        return n_mbs / dt, n_txns / dt, h.hexdigest()
+
+    out: dict = {}
+    _, _, py_dig = run(False, refills=1, digest=True)
+    _, _, na_dig = run(True, refills=1, digest=True)
+    assert na_dig == py_dig, "pack sched A/B streams diverged"
+    py_rate, _py_tps, _ = run(False, refills=4, digest=False)
+    na_rate, na_tps, _ = run(True, refills=4, digest=False)
+    out["pack_sched_mbs_per_s"] = round(na_rate, 1)
+    out["pack_sched_mbs_per_s_py"] = round(py_rate, 1)
+    out["pack_sched_speedup"] = round(na_rate / py_rate, 2)
+    out["pack_sched_txns_per_s"] = round(na_tps, 1)
+    return out
+
+
 def _tunnel_calibration() -> float:
     """H2D bandwidth through the axon tunnel, MB/s (best of 3).
 
@@ -850,6 +1038,14 @@ def main() -> None:
             # native-stem A/Bs: dedup-hop service rate + bank hop
             # through real rings, python loop vs fdt_stem (ISSUE 10)
             result.update(_bench_stem())
+    except Exception:
+        pass
+    try:
+        if "pack_sched" not in skip:
+            # native pack scheduler A/B: fdt_pack_sched in the stem's
+            # after-credit hook vs the Python after_credit, microblock +
+            # completion streams digest-asserted identical (ISSUE 11)
+            result.update(_bench_pack_sched())
     except Exception:
         pass
     try:

@@ -142,6 +142,14 @@ def _producer(env: Env, mc, dc, fseqs, *, seq0: int, n: int, cr_max: int,
             for fs in fseqs[1:]:
                 lo = R.seq_min(lo, fs.query())
             cr = R.cr_avail(seq, lo, cr_max)
+            # the pack-sched-stale-credit mutant models an AFTER-CREDIT
+            # publisher (fdt_pack_sched's shape) that trusts its FIRST
+            # cr_avail read across every later hook boundary instead of
+            # re-deriving it from the live fseqs — the reads above still
+            # happen (hooked), their result is ignored, which is
+            # exactly the fault
+            if env.mutation == "pack-sched-stale-credit":
+                cr = env.scratch.setdefault("pack_stale_cr", cr)
             if cr == 0:
                 # scheduling hint only; credits are re-read through the
                 # hooked ops above once runnable (a leak-mutated cr_avail

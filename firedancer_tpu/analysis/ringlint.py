@@ -267,6 +267,10 @@ MC_HOOKED_NATIVES = {
     # entry point must sit behind the guard too (under fdtmc it must
     # never run — the checker schedules the Python loop only)
     "fdt_stem_run",
+    # the pack after-credit scheduler publishes through the same ring
+    # surface (fseq query + cr_avail + mcache publish) — any direct
+    # Python call site must sit behind the guard like fdt_stem_run's
+    "fdt_pack_sched",
 }
 
 
@@ -517,6 +521,8 @@ BASE_SCHEMA_COUNTERS = (
     "housekeep_iters",
     "loop_iters",
     "stem_frags",
+    "py_frags",
+    "py_credit",
     "restarts",
     "hb_misses",
     "degraded",

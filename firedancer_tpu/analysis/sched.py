@@ -71,6 +71,15 @@ MUTATIONS = frozenset(
         # itself is outside fdtmc's surface and composes the verified
         # ring ops with a per-sweep credit re-read.
         "stem-burst-over-credit",
+        # an after-credit publisher (the native pack scheduler's shape,
+        # fdt_pack.c fdt_pack_sched) trusts ONE cr_avail read ACROSS
+        # hook boundaries instead of re-reading the consumer fseqs
+        # before each publish: the stale first read admits a publish
+        # every round regardless of consumer progress
+        # (scenario-level).  The shipped hook re-derives per-bank
+        # cr_avail from the live fseqs immediately before every
+        # publish.
+        "pack-sched-stale-credit",
         # drain's overrun resync uses the pre-PR-3 clamp-to-zero formula
         # (wrong at seq wrap-around)
         "drain-resync-zero",

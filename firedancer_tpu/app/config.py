@@ -26,6 +26,13 @@ Config shape (all keys optional; defaults below):
     count = 2                        # bank shards (processes under PR 7)
     native = true                    # fdt_bank shared-memory executor
     table_slots = 16384              # shared account-table slots (pow2)
+    [tiles.pack]
+    depth = 4096                     # pending-txn pool slots
+    mb_inflight = 1                  # outstanding microblocks per bank
+    microblock_ns = 2000000          # per-bank cadence (fd_pack.c:26)
+    txn_limit = 31                   # txns per microblock
+    slot_ns = 400000000              # block-budget rollover period
+    device_select = false            # TPU conflict prefilter (python loop)
     [links]
     depth = 1024
     [slo]                            # asserted SLOs (disco/slo.py)
@@ -87,6 +94,10 @@ class Config:
     pack_mb_inflight: int = 1
     pack_microblock_ns: int = 2_000_000
     pack_txn_limit: int = 31
+    #: block-budget rollover period (mainnet slot duration); the native
+    #: after-credit hook reads the derived deadline word, so the knob
+    #: applies identically to both loop modes
+    pack_slot_ns: int = 400_000_000
     ticks_per_slot: int = 64
     shred_version: int = 1
     metrics_port: int = 0
@@ -129,6 +140,7 @@ def parse(text: str) -> Config:
         # scheduling bound (~10x the reference's 2 ms), so proportionally
         # larger microblocks preserve the reference's duty cycle
         pack_txn_limit=t.get("pack", {}).get("txn_limit", 31),
+        pack_slot_ns=t.get("pack", {}).get("slot_ns", 400_000_000),
         ticks_per_slot=t.get("poh", {}).get("ticks_per_slot", 64),
         shred_version=t.get("shred", {}).get("version", 1),
         metrics_port=t.get("metric", {}).get("port", 0),
@@ -223,6 +235,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
             mb_inflight=cfg.pack_mb_inflight,
             microblock_ns=cfg.pack_microblock_ns,
             txn_limit=cfg.pack_txn_limit,
+            slot_ns=cfg.pack_slot_ns,
         ),
         ins=[("dedup_pack", True)]
         + [(f"bank{i}_pack", True) for i in range(n_banks)],

@@ -278,13 +278,32 @@ class Pack:
         self.wc_keys = np.zeros(map_cnt, dtype=np.uint64)
         self.wc_vals = np.zeros(map_cnt, dtype=np.int64)
 
-        self.cumulative_block_cost = 0
-        self.cumulative_vote_cost = 0
         self.vote_cost_limit = MAX_VOTE_COST_PER_BLOCK
-        self.outstanding: dict[int, list[_Microblock]] = {
-            b: [] for b in range(max_banks)
-        }
-        self._next_handle = 0
+
+        # shared scheduler words (i64) — the native after-credit hook
+        # (fdt_pack_sched, ISSUE 11) and the Python schedule/complete
+        # path mutate the SAME state, so the two loops stay
+        # interchangeable mid-run:
+        #   [0] cumulative block cost   [1] cumulative vote cost
+        #   [2] next microblock handle  [3] outstanding microblock count
+        # [3] is also the O(1) answer to "any outstanding?" the block-
+        # boundary check needs (the old dict scan was O(banks + mbs)
+        # per after_credit call).
+        self._sched_words = np.zeros(4, np.int64)
+
+        # outstanding-microblock registry, dense + native-visible: one
+        # entry per in-flight microblock (capacity P: every microblock
+        # holds >= 1 distinct pool slot, so the registry can never
+        # fill), with the pick-ORDERED txn list stored as a linked
+        # chain through the pool slots themselves (mb_next) — exact
+        # release order is part of the lock-table bit-parity contract.
+        self.mb_used = np.zeros(P, np.uint8)
+        self.mb_bank = np.zeros(P, np.int64)
+        self.mb_handle = np.zeros(P, np.uint64)
+        self.mb_head = np.full(P, -1, np.int64)
+        self.mb_cnt = np.zeros(P, np.int64)
+        self.mb_cost = np.zeros(P, np.int64)
+        self.mb_next = np.full(P, -1, np.int64)
 
     # ---- queries --------------------------------------------------------
 
@@ -295,6 +314,58 @@ class Pack:
     @property
     def inflight_cnt(self) -> int:
         return int((self.state == _INFLIGHT).sum())
+
+    # -- shared scheduler words (native/Python interchangeable state) --
+
+    @property
+    def cumulative_block_cost(self) -> int:
+        return int(self._sched_words[0])
+
+    @cumulative_block_cost.setter
+    def cumulative_block_cost(self, v: int) -> None:
+        self._sched_words[0] = v
+
+    @property
+    def cumulative_vote_cost(self) -> int:
+        return int(self._sched_words[1])
+
+    @cumulative_vote_cost.setter
+    def cumulative_vote_cost(self, v: int) -> None:
+        self._sched_words[1] = v
+
+    @property
+    def outstanding_cnt(self) -> int:
+        """O(1) outstanding-microblock count, maintained by schedule /
+        complete — the block-boundary check reads this every
+        after_credit call (it used to scan the whole per-bank dict)."""
+        return int(self._sched_words[3])
+
+    def _mb_txns(self, m: int) -> np.ndarray:
+        """Pick-ordered pool slots of registry entry m (chain walk)."""
+        cnt = int(self.mb_cnt[m])
+        idx = np.empty(cnt, np.int64)
+        s = int(self.mb_head[m])
+        for k in range(cnt):
+            idx[k] = s
+            s = int(self.mb_next[s])
+        return idx
+
+    @property
+    def outstanding(self) -> dict[int, list[_Microblock]]:
+        """Compat view of the registry: {bank: [_Microblock, ...]}.
+        Materialized per access (registry-slot order); the O(1)
+        existence check is `outstanding_cnt`."""
+        obs: dict[int, list[_Microblock]] = {
+            b: [] for b in range(self.max_banks)
+        }
+        for m in np.flatnonzero(self.mb_used != 0):
+            obs[int(self.mb_bank[m])].append(
+                _Microblock(
+                    int(self.mb_handle[m]), self._mb_txns(int(m)),
+                    int(self.mb_cost[m]),
+                )
+            )
+        return obs
 
     def lock_table_load(self) -> float:
         """Occupancy of the fuller exact-lock table (0..1); near 1.0
@@ -566,22 +637,43 @@ class Pack:
         total = vote_used + nv_used
         self.cumulative_block_cost += total
         self.state[picks] = _INFLIGHT
-        mb = _Microblock(self._next_handle, picks, total)
-        self._next_handle += 1
-        self.outstanding[bank].append(mb)
-        return mb
+        # handles live in the u32 domain end to end: the completion sig
+        # carries only 32 bits ((bank << 32) | handle), so the registry
+        # stores and matches MASKED handles — a wrap can never strand an
+        # outstanding microblock as unmatchable (collision would need
+        # 2^32 simultaneous outstanding handles; the registry holds at
+        # most P)
+        handle = int(self._sched_words[2]) & 0xFFFFFFFF
+        self._sched_words[2] += 1
+        # registry record: lowest free entry (the order fdt_pack_sched
+        # reproduces), pick-ordered slot chain
+        m = int(np.flatnonzero(self.mb_used == 0)[0])
+        self.mb_bank[m] = bank
+        self.mb_handle[m] = np.uint64(handle)
+        self.mb_head[m] = picks[0]
+        self.mb_cnt[m] = len(picks)
+        self.mb_cost[m] = total
+        if len(picks) > 1:
+            self.mb_next[picks[:-1]] = picks[1:]
+        self.mb_next[picks[-1]] = -1
+        self.mb_used[m] = 1
+        self._sched_words[3] += 1
+        return _Microblock(handle, picks, total)
 
     def microblock_complete(self, bank: int, handle: int) -> None:
         """Bank finished executing a microblock: release account locks and
         free the slots (fd_pack_microblock_complete, fd_pack.c:956)."""
-        obs = self.outstanding[bank]
-        for i, mb in enumerate(obs):
-            if mb.handle == handle:
-                break
-        else:
+        m = np.flatnonzero(
+            (self.mb_used != 0)
+            & (self.mb_bank == bank)
+            & (self.mb_handle == np.uint64(handle & 0xFFFFFFFF))
+        )
+        if not len(m):
             raise KeyError(f"no outstanding microblock {handle} on bank {bank}")
-        obs.pop(i)
-        idx = np.ascontiguousarray(mb.txn_idx, np.int64)
+        m = int(m[0])
+        idx = self._mb_txns(m)
+        self.mb_used[m] = 0
+        self._sched_words[3] -= 1
         R._lib.fdt_pack_release_x(
             idx.ctypes.data, len(idx),
             self.whash.ctypes.data, self.w_cnt.ctypes.data, MAX_WRITERS,
@@ -591,7 +683,7 @@ class Pack:
             self.lr_keys.ctypes.data, self.lr_vals.ctypes.data,
             self._lock_mask,
         )
-        self._release_slots(mb.txn_idx)
+        self._release_slots(idx)
 
     def _release_slots(self, idx: np.ndarray) -> None:
         self.state[idx] = _FREE
@@ -600,7 +692,7 @@ class Pack:
         """Slot boundary: reset block budgets and per-account write costs
         (fd_pack_end_block).  Outstanding microblocks must be completed
         first; pending txns carry over."""
-        assert all(not v for v in self.outstanding.values())
+        assert self.outstanding_cnt == 0
         self.wc_keys.fill(0)
         self.wc_vals.fill(0)
         self.cumulative_block_cost = 0

@@ -110,6 +110,13 @@ class MetricsSchema:
         # so stem_frags/in_frags is the native-coverage ratio a monitor
         # or bench can read straight off the tile
         "stem_frags",
+        # the Python-side complements (ISSUE 11 zero-Python steady-state
+        # contract): frags the Python on_frags callback handled, and
+        # Python after_credit invocations.  A fully native data-plane
+        # tile shows both FLAT across a measured window while
+        # stem_frags/microblocks advance.
+        "py_frags",
+        "py_credit",
         # supervision counters, written by disco/supervisor.py (distinct
         # slots from the tile's own, so the single-writer-per-word
         # discipline holds): crash/stall restarts, heartbeat deadline

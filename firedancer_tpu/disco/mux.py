@@ -606,6 +606,14 @@ def run_loop(
             )
             absorb = tile.in_budget(ctx)
             run_py = True
+            # run_ac: whether THIS iteration calls the Python
+            # after_credit.  A spec with a native after-credit hook
+            # (pack's fdt_pack_sched) schedules inside the burst, so
+            # the Python slot is skipped except on PYTHON handbacks
+            # (end_block, eviction, unknown completion) — that skip is
+            # what makes the tile zero-Python per microblock at steady
+            # state (asserted via the py_credit counter).
+            run_ac = True
             if (
                 stem_obj is not None
                 and absorb is None
@@ -629,6 +637,8 @@ def run_loop(
                 # other status (IDLE/BUDGET/BP) already consumed
                 # everything this iteration may.
                 run_py = s_stat == R.STEM_PYTHON
+                if stem_spec.ac_handler:
+                    run_ac = run_py
             # rotate the drain order so a saturated in-link cannot starve
             # the others of the shared credit budget (e.g. pack's txn
             # firehose starving its bank-completion rings would idle
@@ -687,6 +697,10 @@ def run_loop(
                         tracer.ingest(
                             il.link_id, frags, t_cons or now_ts()
                         )
+                    # py_frags counts frags the PYTHON callback handled
+                    # (vs stem_frags): stem coverage and the zero-
+                    # Python-per-frag steady-state assert both read it
+                    m.inc("py_frags", len(frags))
                     tile.on_frags(ctx, i, frags)
                     if il.h_svc is not None:
                         m.hist_sample(
@@ -706,7 +720,9 @@ def run_loop(
                             t_credit0 - t_frag0,
                             p_cpu_credit0 - p_cpu_frag0,
                         )
-                tile.after_credit(ctx)
+                if run_ac:
+                    m.inc("py_credit")
+                    tile.after_credit(ctx)
                 t_end = time.monotonic_ns()
                 m.hist_sample("credit_ns", t_end - t_credit0)
                 m.hist_sample("loop_ns", t_end - now)
@@ -717,7 +733,9 @@ def run_loop(
                         time.thread_time_ns() - p_cpu_credit0,
                     )
             else:
-                tile.after_credit(ctx)
+                if run_ac:
+                    m.inc("py_credit")
+                    tile.after_credit(ctx)
 
             produced = any(o.seq != s0 for o, s0 in zip(ctx.outs, out_seq0))
             if got == 0 and not produced:

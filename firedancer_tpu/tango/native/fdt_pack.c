@@ -7,6 +7,10 @@
 #define _GNU_SOURCE
 #include "fdt_pack.h"
 
+#include "fdt_stem.h"  /* out-block layout the after-credit hook
+                          publishes through (FDT_STEM_O_*) */
+#include "fdt_tango.h" /* the verified ring ops the hook composes */
+
 #include <errno.h>
 #include <netinet/in.h>
 #include <string.h>
@@ -718,6 +722,273 @@ int64_t fdt_mb_decode( uint8_t const * buf, int64_t sz,
     off += tsz;
   }
   return n;
+}
+
+/* ==== native pack scheduler (after-credit hook) ========================= */
+
+/* pool slot states (ballet/pack.py _FREE/_PENDING/_INFLIGHT) */
+#define PACK_ST_PENDING_ 1
+#define PACK_ST_INFLIGHT_ 2
+
+/* Stable bottom-up mergesort of pool-slot indices by DESCENDING
+   priority, ties keeping original order — the exact semantics of
+   numpy's argsort(-pr, kind="stable") over an ascending candidate
+   list, so the native candidate order is bit-identical to
+   ballet/pack.Pack._order's. */
+static void sched_sort( int64_t * idx, int64_t n, double const * pr,
+                        int64_t * tmp ) {
+  for( int64_t w = 1; w < n; w <<= 1 ) {
+    for( int64_t lo = 0; lo < n; lo += 2 * w ) {
+      int64_t mid = lo + w < n ? lo + w : n;
+      int64_t hi = lo + 2 * w < n ? lo + 2 * w : n;
+      int64_t i = lo, j = mid, k = lo;
+      while( i < mid && j < hi )
+        tmp[ k++ ] = pr[ idx[ j ] ] > pr[ idx[ i ] ] ? idx[ j++ ]
+                                                     : idx[ i++ ];
+      while( i < mid ) tmp[ k++ ] = idx[ i++ ];
+      while( j < hi ) tmp[ k++ ] = idx[ j++ ];
+      memcpy( idx + lo, tmp + lo, (size_t)( hi - lo ) * 8 );
+    }
+  }
+}
+
+/* priority = rewards / max(cost, 1) in f64 — the same IEEE division
+   numpy performs, so ordering ties break identically */
+static inline double sched_pr( uint64_t rewards, uint32_t cost ) {
+  return (double)rewards / ( cost ? (double)cost : 1.0 );
+}
+
+int64_t fdt_pack_sched( uint64_t * a, uint64_t * outs, int64_t n_outs,
+                        int64_t sig_cap, int64_t now_ns, uint64_t tspub,
+                        uint64_t * ctrs ) {
+  int64_t * sw = (int64_t *)a[ FDT_PACK_SS_WORDS ];
+  int64_t * deadline = (int64_t *)a[ FDT_PACK_SS_DEADLINE ];
+
+  /* block boundary (tiles/pack.py after_credit): first call arms the
+     deadline; past it, wait for in-flight microblocks to complete
+     (completions keep draining natively), then hand back — end_block
+     and the `blocks` metric are Python control plane */
+  if( !deadline[ 0 ] ) {
+    deadline[ 0 ] = now_ns + (int64_t)a[ FDT_PACK_SS_SLOT_NS ];
+  } else if( now_ns >= deadline[ 0 ] ) {
+    if( !sw[ 3 ] ) return -1; /* zero outstanding: Python end_block */
+    return 0;
+  }
+
+  uint8_t * state = (uint8_t *)a[ FDT_PACK_SS_STATE ];
+  int64_t P = (int64_t)a[ FDT_PACK_SS_POOL ];
+  uint8_t const * rows = (uint8_t const *)a[ FDT_PACK_SS_ROWS ];
+  int64_t roww = (int64_t)a[ FDT_PACK_SS_ROWW ];
+  uint16_t const * szs = (uint16_t const *)a[ FDT_PACK_SS_SZS ];
+  uint64_t const * rewards = (uint64_t const *)a[ FDT_PACK_SS_REWARDS ];
+  uint32_t const * cost = (uint32_t const *)a[ FDT_PACK_SS_COST ];
+  uint8_t const * isvote = (uint8_t const *)a[ FDT_PACK_SS_ISVOTE ];
+  uint64_t const * whash = (uint64_t const *)a[ FDT_PACK_SS_WHASH ];
+  uint8_t const * wcnt = (uint8_t const *)a[ FDT_PACK_SS_WCNT ];
+  int64_t maxw = (int64_t)a[ FDT_PACK_SS_MAXW ];
+  uint64_t const * rhash = (uint64_t const *)a[ FDT_PACK_SS_RHASH ];
+  uint8_t const * rcnt = (uint8_t const *)a[ FDT_PACK_SS_RCNT ];
+  int64_t maxr = (int64_t)a[ FDT_PACK_SS_MAXR ];
+  uint64_t * lwk = (uint64_t *)a[ FDT_PACK_SS_LWKEYS ];
+  int64_t * lwv = (int64_t *)a[ FDT_PACK_SS_LWVALS ];
+  int64_t lmask = (int64_t)a[ FDT_PACK_SS_LMASK ];
+  uint64_t * lrk = (uint64_t *)a[ FDT_PACK_SS_LRKEYS ];
+  int64_t * lrv = (int64_t *)a[ FDT_PACK_SS_LRVALS ];
+  uint64_t * wck = (uint64_t *)a[ FDT_PACK_SS_WCKEYS ];
+  int64_t * wcv = (int64_t *)a[ FDT_PACK_SS_WCVALS ];
+  int64_t wcmask = (int64_t)a[ FDT_PACK_SS_WCMASK ];
+  int64_t wcap = (int64_t)a[ FDT_PACK_SS_WCAP ];
+  int64_t block_limit = (int64_t)a[ FDT_PACK_SS_BLOCK_LIMIT ];
+  int64_t vote_limit = (int64_t)a[ FDT_PACK_SS_VOTE_LIMIT ];
+  uint8_t * mb_used = (uint8_t *)a[ FDT_PACK_SS_MB_USED ];
+  int64_t * mb_bank = (int64_t *)a[ FDT_PACK_SS_MB_BANK ];
+  uint64_t * mb_handle = (uint64_t *)a[ FDT_PACK_SS_MB_HANDLE ];
+  int64_t * mb_head = (int64_t *)a[ FDT_PACK_SS_MB_HEAD ];
+  int64_t * mb_cnt = (int64_t *)a[ FDT_PACK_SS_MB_CNT ];
+  int64_t * mb_cost = (int64_t *)a[ FDT_PACK_SS_MB_COST ];
+  int64_t * mb_next = (int64_t *)a[ FDT_PACK_SS_MB_NEXT ];
+  int64_t mb_cap = (int64_t)a[ FDT_PACK_SS_MB_CAP ];
+  int64_t n_banks = (int64_t)a[ FDT_PACK_SS_NBANKS ];
+  int64_t * bank_busy = (int64_t *)a[ FDT_PACK_SS_BANK_BUSY ];
+  int64_t * bank_ready = (int64_t *)a[ FDT_PACK_SS_BANK_READY ];
+  int64_t mb_inflight = (int64_t)a[ FDT_PACK_SS_MB_INFLIGHT ];
+  int64_t mb_ns = (int64_t)a[ FDT_PACK_SS_MB_NS ];
+  int64_t cu_limit0 = (int64_t)a[ FDT_PACK_SS_CU_LIMIT ];
+  int64_t txn_limit = (int64_t)a[ FDT_PACK_SS_TXN_LIMIT ];
+  int64_t byte_limit = (int64_t)a[ FDT_PACK_SS_BYTE_LIMIT ];
+  double vf;
+  memcpy( &vf, &a[ FDT_PACK_SS_VOTE_FRAC ], 8 );
+  int64_t scan_limit = (int64_t)a[ FDT_PACK_SS_SCAN_LIMIT ];
+  int64_t * order = (int64_t *)a[ FDT_PACK_SS_ORDER ];
+  int64_t * tmp = (int64_t *)a[ FDT_PACK_SS_TMP ];
+  double * pr = (double *)a[ FDT_PACK_SS_PR ];
+  int64_t * picks = (int64_t *)a[ FDT_PACK_SS_PICKS ];
+
+  if( n_banks > n_outs ) n_banks = n_outs;
+
+  int64_t n_mbs = 0;
+  for( int64_t bank = 0; bank < n_banks; bank++ ) {
+    if( now_ns < bank_ready[ bank ] ) continue;
+    if( bank_busy[ bank ] >= mb_inflight ) continue;
+    uint64_t * o = outs + bank * FDT_STEM_OUT_STRIDE;
+
+    /* per-bank cr_avail RE-READ immediately before scheduling work for
+       this ring — never a credit count carried across the hook
+       boundary (the pack-sched-stale-credit mutant is exactly this
+       re-read skipped) */
+    int64_t avail = (int64_t)o[ FDT_STEM_O_DEPTH ];
+    uint64_t nf = o[ FDT_STEM_O_NFSEQ ];
+    if( nf ) {
+      uint64_t lo = fdt_fseq_query( (void *)o[ FDT_STEM_O_FSEQ0 ] );
+      for( uint64_t j = 1; j < nf && j < 4; j++ ) {
+        uint64_t v = fdt_fseq_query( (void *)o[ FDT_STEM_O_FSEQ0 + j ] );
+        if( (int64_t)( v - lo ) < 0 ) lo = v;
+      }
+      avail = (int64_t)fdt_fctl_cr_avail( o[ FDT_STEM_O_SEQ ], lo,
+                                          o[ FDT_STEM_O_DEPTH ] );
+    }
+    if( avail < 1 ) continue;
+
+    /* block CU budget (schedule_microblock's entry gate) */
+    if( sw[ 0 ] >= block_limit ) continue;
+    int64_t cu_limit = cu_limit0;
+    if( cu_limit > block_limit - sw[ 0 ] ) cu_limit = block_limit - sw[ 0 ];
+
+    /* candidate split: pending votes / nonvotes, ascending slot order
+       (numpy flatnonzero order) */
+    int64_t nv_total = 0;
+    for( int64_t s = 0; s < P; s++ )
+      if( state[ s ] == PACK_ST_PENDING_ && !isvote[ s ] ) nv_total++;
+
+    /* votes-first lane: vote_fraction of the CU budget capped by the
+       per-block vote cost limit, and a vote_fraction share of the txn
+       slots while non-votes are pending */
+    int64_t v_cnt = 0;
+    for( int64_t s = 0; s < P; s++ )
+      if( state[ s ] == PACK_ST_PENDING_ && isvote[ s ] ) {
+        pr[ s ] = sched_pr( rewards[ s ], cost[ s ] );
+        order[ v_cnt++ ] = s;
+      }
+    int64_t vote_budget = (int64_t)( (double)cu_limit * vf );
+    if( vote_budget > vote_limit - sw[ 1 ] )
+      vote_budget = vote_limit - sw[ 1 ];
+    int64_t vtl = txn_limit;
+    if( nv_total ) {
+      vtl = (int64_t)( (double)txn_limit * vf );
+      if( vtl < 1 ) vtl = 1;
+    }
+    int64_t n_vote = 0;
+    int64_t vote_used = 0;
+    if( v_cnt && vote_budget > 0 && vtl > 0 ) {
+      sched_sort( order, v_cnt, pr, tmp );
+      if( v_cnt > scan_limit ) v_cnt = scan_limit;
+      n_vote = fdt_pack_select_x(
+          order, v_cnt, whash, wcnt, maxw, rhash, rcnt, maxr, lwk, lwv,
+          lmask, lrk, lrv, lmask, cost, szs, byte_limit, wck, wcv,
+          wcmask, wcap, vote_budget, vtl, picks, &vote_used );
+    }
+
+    /* nonvote lane with whatever CU / txn slots / bytes the votes left */
+    int64_t nv_bl = byte_limit;
+    if( byte_limit > 0 && n_vote ) {
+      int64_t used_bytes = 2 * n_vote;
+      for( int64_t k = 0; k < n_vote; k++ )
+        used_bytes += (int64_t)szs[ picks[ k ] ];
+      nv_bl = byte_limit - used_bytes;
+      if( nv_bl < 1 ) nv_bl = 1;
+    }
+    int64_t nv_cnt = 0;
+    for( int64_t s = 0; s < P; s++ )
+      if( state[ s ] == PACK_ST_PENDING_ && !isvote[ s ] ) {
+        pr[ s ] = sched_pr( rewards[ s ], cost[ s ] );
+        order[ nv_cnt++ ] = s;
+      }
+    int64_t n_nv = 0;
+    int64_t nv_used = 0;
+    if( nv_cnt && cu_limit - vote_used > 0 && txn_limit - n_vote > 0 ) {
+      sched_sort( order, nv_cnt, pr, tmp );
+      if( nv_cnt > scan_limit ) nv_cnt = scan_limit;
+      n_nv = fdt_pack_select_x(
+          order, nv_cnt, whash, wcnt, maxw, rhash, rcnt, maxr, lwk, lwv,
+          lmask, lrk, lrv, lmask, cost, szs, nv_bl, wck, wcv, wcmask,
+          wcap, cu_limit - vote_used, txn_limit - n_vote, picks + n_vote,
+          &nv_used );
+    }
+    int64_t n = n_vote + n_nv;
+    if( !n ) continue;
+
+    /* commit: budgets, pool state, outstanding registry (lowest free
+       entry — numpy flatnonzero[0] order), pick-order slot chain */
+    sw[ 1 ] += vote_used;
+    int64_t total_cost = vote_used + nv_used;
+    sw[ 0 ] += total_cost;
+    for( int64_t k = 0; k < n; k++ )
+      state[ picks[ k ] ] = PACK_ST_INFLIGHT_;
+    /* u32 handle domain (the completion sig carries only 32 bits) —
+       stored masked so a wrap never strands an outstanding microblock
+       as unmatchable; matches ballet/pack.py's registry discipline */
+    uint64_t handle = (uint64_t)sw[ 2 ] & 0xFFFFFFFFUL;
+    sw[ 2 ]++;
+    int64_t m = 0;
+    while( m < mb_cap && mb_used[ m ] ) m++;
+    if( m < mb_cap ) { /* never full: one mb holds >= 1 of P slots */
+      mb_bank[ m ] = bank;
+      mb_handle[ m ] = handle;
+      mb_head[ m ] = picks[ 0 ];
+      mb_cnt[ m ] = n;
+      mb_cost[ m ] = total_cost;
+      for( int64_t k = 0; k + 1 < n; k++ )
+        mb_next[ picks[ k ] ] = picks[ k + 1 ];
+      mb_next[ picks[ n - 1 ] ] = -1;
+      mb_used[ m ] = 1;
+      sw[ 3 ]++;
+    }
+
+    /* encode straight from the pool into the out dcache at the shared
+       chunk cursor, then the release-ordered publish (bytes before
+       metadata — the ring-publish-order rule) */
+    uint64_t * cur = (uint64_t *)o[ FDT_STEM_O_CHUNKP ];
+    uint64_t c = *cur;
+    uint8_t * dst = (uint8_t *)o[ FDT_STEM_O_DCACHE ] + c * FDT_CHUNK_SZ;
+    int64_t sz = fdt_mb_encode( rows, roww, szs, picks, n,
+                                (uint32_t)( handle & 0xFFFFFFFFUL ),
+                                (uint32_t)bank, dst,
+                                (int64_t)o[ FDT_STEM_O_MTU ] );
+    /* byte_limit (select_x-enforced) keeps 8 + sum(sz+2) <= mtu, so
+       encode cannot overflow when the host enabled the hook (it
+       requires byte_limit > 0); a defensive 0-sz publish would reach
+       the bank as a metered malformed drop that still completes the
+       handle, so locks can never leak even if that invariant broke */
+    if( sz < 0 ) sz = 0;
+    *cur = fdt_dcache_compact_next( c, (uint64_t)sz,
+                                    o[ FDT_STEM_O_MTU ],
+                                    o[ FDT_STEM_O_WMARK ] );
+    uint64_t sig = ( (uint64_t)bank << 32 ) | ( handle & 0xFFFFFFFFUL );
+    fdt_mcache_publish( (void *)o[ FDT_STEM_O_MCACHE ],
+                        o[ FDT_STEM_O_SEQ ], sig, (uint32_t)c,
+                        (uint16_t)sz,
+                        (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                        (uint32_t)tspub, (uint32_t)tspub );
+    uint64_t p = o[ FDT_STEM_O_PUBLISHED ];
+    if( (int64_t)p < sig_cap ) {
+      if( o[ FDT_STEM_O_SIGS ] )
+        ( (uint64_t *)o[ FDT_STEM_O_SIGS ] )[ p ] = sig;
+      if( o[ FDT_STEM_O_TSORIGS ] )
+        ( (uint32_t *)o[ FDT_STEM_O_TSORIGS ] )[ p ] = (uint32_t)tspub;
+    }
+    o[ FDT_STEM_O_SEQ ] = o[ FDT_STEM_O_SEQ ] + 1UL;
+    o[ FDT_STEM_O_PUBLISHED ] = p + 1UL;
+    o[ FDT_STEM_O_BYTES ] += (uint64_t)sz;
+
+    bank_busy[ bank ]++;
+    bank_ready[ bank ] = now_ns + mb_ns;
+    if( ctrs ) {
+      ctrs[ 0 ]++;
+      ctrs[ 1 ] += (uint64_t)n;
+    }
+    n_mbs++;
+  }
+  return n_mbs;
 }
 
 /* ==== burst UDP I/O ===================================================== */

@@ -114,6 +114,95 @@ void fdt_pack_release_x( int64_t const * idx, int64_t n,
                          int64_t lw_mask, uint64_t * lr_keys,
                          int64_t * lr_vals, int64_t lr_mask );
 
+/* ---- native pack scheduler (ISSUE 11) ---------------------------------
+ *
+ * fdt_pack_sched runs ONE after-credit scheduling pass — the native
+ * re-statement of tiles/pack.PackTile.after_credit over
+ * ballet/pack.Pack.schedule_microblock, bit-identical by contract and
+ * by test: per-bank cadence gating (bank_ready_at / bank_busy <
+ * mb_inflight), a PER-BANK cr_avail re-read against the bank ring's
+ * consumer fseqs immediately before each publish (the stale-credit
+ * discipline the pack-sched-stale-credit corpus mutant pins), block /
+ * vote CU budgeting, votes-first candidate ordering (stable sort by
+ * rewards/cost priority, the numpy argsort's exact tie semantics), the
+ * fdt_pack_select_x exact-lock greedy walk, fdt_mb_encode straight
+ * into the out dcache at the shared chunk cursor, the release-ordered
+ * mcache publish, and busy/ready/outstanding bookkeeping.
+ *
+ * `a` is the FDT_PACK_SS_* u64 args block below — raw pointers into
+ * the SAME engine arrays and shared scheduler words the Python path
+ * mutates, so the two paths are interchangeable mid-run.  `outs` is
+ * the stem's out-block region (fdt_stem.h FDT_STEM_O_* layout, one
+ * block per bank, bank i publishes on out i); sig_cap bounds the
+ * published-sig scratch.  The block-boundary end_block and the
+ * eviction path remain Python slow paths: past the block deadline
+ * with zero outstanding microblocks the call returns -1 (hand back to
+ * Python, which runs end_block); with outstanding microblocks it
+ * schedules nothing and lets completions drain.  ctrs[0] accumulates
+ * microblocks published, ctrs[1] their txns.  Returns microblocks
+ * published (>= 0) or -1 for the Python handback. */
+
+/* args block u64 word indices (built host-side by tiles/pack.py) */
+#define FDT_PACK_SS_STATE 0     /* u8[P] pool state (0 free/1 pending/2 inflight) */
+#define FDT_PACK_SS_POOL 1      /* P */
+#define FDT_PACK_SS_ROWS 2      /* u8 (P, roww) payload rows */
+#define FDT_PACK_SS_ROWW 3
+#define FDT_PACK_SS_SZS 4       /* u16[P] */
+#define FDT_PACK_SS_REWARDS 5   /* u64[P] */
+#define FDT_PACK_SS_COST 6      /* u32[P] */
+#define FDT_PACK_SS_ISVOTE 7    /* u8[P] */
+#define FDT_PACK_SS_WHASH 8
+#define FDT_PACK_SS_WCNT 9
+#define FDT_PACK_SS_MAXW 10
+#define FDT_PACK_SS_RHASH 11
+#define FDT_PACK_SS_RCNT 12
+#define FDT_PACK_SS_MAXR 13
+#define FDT_PACK_SS_LWKEYS 14   /* exact lock tables (select_x/release_x) */
+#define FDT_PACK_SS_LWVALS 15
+#define FDT_PACK_SS_LMASK 16
+#define FDT_PACK_SS_LRKEYS 17
+#define FDT_PACK_SS_LRVALS 18
+#define FDT_PACK_SS_WCKEYS 19   /* writer-cost map */
+#define FDT_PACK_SS_WCVALS 20
+#define FDT_PACK_SS_WCMASK 21
+#define FDT_PACK_SS_WCAP 22
+#define FDT_PACK_SS_WORDS 23    /* i64[4]: [0] cumulative block cost,
+                                   [1] cumulative vote cost, [2] next
+                                   handle, [3] outstanding mb count —
+                                   ballet/pack.Pack._sched_words */
+#define FDT_PACK_SS_BLOCK_LIMIT 24
+#define FDT_PACK_SS_VOTE_LIMIT 25
+#define FDT_PACK_SS_MB_USED 26  /* outstanding-microblock registry: */
+#define FDT_PACK_SS_MB_BANK 27  /*   u8 used, i64 bank, u64 handle,  */
+#define FDT_PACK_SS_MB_HANDLE 28/*   i64 head slot + per-slot next   */
+#define FDT_PACK_SS_MB_HEAD 29  /*   chain (pick order), i64 cnt,    */
+#define FDT_PACK_SS_MB_CNT 30   /*   i64 cost — Pack.mb_* arrays     */
+#define FDT_PACK_SS_MB_COST 31
+#define FDT_PACK_SS_MB_NEXT 32  /* i64[P] slot chain */
+#define FDT_PACK_SS_MB_CAP 33   /* registry entries (= P: one mb holds
+                                   >= 1 pool slot, so never full) */
+#define FDT_PACK_SS_NBANKS 34
+#define FDT_PACK_SS_BANK_BUSY 35 /* i64[n_banks] */
+#define FDT_PACK_SS_BANK_READY 36/* i64[n_banks] ready_at (tickcount ns) */
+#define FDT_PACK_SS_MB_INFLIGHT 37
+#define FDT_PACK_SS_MB_NS 38    /* microblock cadence */
+#define FDT_PACK_SS_CU_LIMIT 39
+#define FDT_PACK_SS_TXN_LIMIT 40
+#define FDT_PACK_SS_BYTE_LIMIT 41
+#define FDT_PACK_SS_VOTE_FRAC 42 /* f64 bit pattern */
+#define FDT_PACK_SS_SCAN_LIMIT 43
+#define FDT_PACK_SS_DEADLINE 44 /* ptr to i64[1] block deadline (0 = unset) */
+#define FDT_PACK_SS_SLOT_NS 45
+#define FDT_PACK_SS_ORDER 46    /* i64[P] candidate-order scratch */
+#define FDT_PACK_SS_TMP 47      /* i64[P] merge scratch */
+#define FDT_PACK_SS_PR 48       /* f64[P] priority scratch */
+#define FDT_PACK_SS_PICKS 49    /* i64[P] pick / chain-walk scratch */
+#define FDT_PACK_SCHED_WORDS 50
+
+int64_t fdt_pack_sched( uint64_t * a, uint64_t * outs, int64_t n_outs,
+                        int64_t sig_cap, int64_t now_ns, uint64_t tspub,
+                        uint64_t * ctrs );
+
 /* Microblock wire codec (tiles/pack.py format:
    u32 handle | u16 bank | u16 txn_cnt | txn_cnt * ( u16 sz | sz bytes )).
    Encode gathers pool rows[idx[i]]; returns total bytes (or -1 if > cap).

@@ -5,6 +5,11 @@
  * fdtmc model-checks), so the stem introduces no new ring protocol, only
  * a new driver for the verified one. */
 
+/* clock_gettime(CLOCK_MONOTONIC) under -std=c11: the after-credit
+   hook's cadence clock — the same clock source Python's
+   time.monotonic_ns / tango.tempo.tickcount reads */
+#define _POSIX_C_SOURCE 199309L
+
 #include "fdt_stem.h"
 
 #include "fdt_bank.h"
@@ -13,6 +18,7 @@
 
 #include <stdatomic.h>
 #include <string.h>
+#include <time.h>
 
 /* ---- cfg word indices (fdt_stem.h documents the layout) ---------------- */
 
@@ -31,6 +37,9 @@
    loop rotates its drain order per iteration for the same reason — a
    saturated in-link must not starve the others) */
 #define C_ROT 10
+/* after-credit hook: id + args block (fdt_stem.h word 11/12) */
+#define C_AC 11
+#define C_AC_ARGS 12
 
 #define IN0 16
 #define IN_STRIDE 12
@@ -45,21 +54,23 @@
 #define I_BYTES 8
 #define I_OVR 9
 
-#define OUT0 64
-#define OUT_STRIDE 16
-#define O_MCACHE 0
-#define O_DCACHE 1
-#define O_CHUNKP 2
-#define O_MTU 3
-#define O_WMARK 4
-#define O_DEPTH 5
-#define O_NFSEQ 6
-#define O_FSEQ0 7
-#define O_SEQ 11
-#define O_PUBLISHED 12
-#define O_BYTES 13
-#define O_SIGS 14
-#define O_TSORIGS 15
+/* out-block layout is shared with fdt_pack_sched (fdt_stem.h is the
+   single source of truth) */
+#define OUT0 FDT_STEM_OUT0
+#define OUT_STRIDE FDT_STEM_OUT_STRIDE
+#define O_MCACHE FDT_STEM_O_MCACHE
+#define O_DCACHE FDT_STEM_O_DCACHE
+#define O_CHUNKP FDT_STEM_O_CHUNKP
+#define O_MTU FDT_STEM_O_MTU
+#define O_WMARK FDT_STEM_O_WMARK
+#define O_DEPTH FDT_STEM_O_DEPTH
+#define O_NFSEQ FDT_STEM_O_NFSEQ
+#define O_FSEQ0 FDT_STEM_O_FSEQ0
+#define O_SEQ FDT_STEM_O_SEQ
+#define O_PUBLISHED FDT_STEM_O_PUBLISHED
+#define O_BYTES FDT_STEM_O_BYTES
+#define O_SIGS FDT_STEM_O_SIGS
+#define O_TSORIGS FDT_STEM_O_TSORIGS
 
 #define IN_F_NATIVE 1UL
 
@@ -78,6 +89,8 @@ typedef struct {
   uint64_t * args;
   uint64_t * ctrs;
   uint32_t tspub;
+  uint64_t ac;        /* after-credit hook id (0 = none) */
+  uint64_t * ac_args; /* hook args block (pack: FDT_PACK_SS_*) */
   int need_python; /* set by a handler: the NEXT unhandled frag needs
                       the Python path (fallback, eviction, assert) */
 } stem_t;
@@ -448,12 +461,85 @@ static int64_t h_bank( stem_t * st, int64_t ii, fdt_frag_t const * f,
 /* counter scratch indices (tiles/pack.py maps these to names) */
 #define PC_INSERTED 0
 #define PC_REJECTED 1
+#define PC_MICROBLOCKS 2
+#define PC_MB_TXNS 3
+#define PC_COMPLETIONS 4
+#define PC_STALE 5
 
 #define PACK_ST_FREE 0
 #define PACK_ST_PENDING 1
 
+/* Completion-ring handler (ins[1..], ISSUE 11): sig carries
+   (bank << 32) | handle; look the microblock up in the outstanding
+   registry (first match, the numpy flatnonzero[0] order the Python
+   path uses), release its exact account locks via fdt_pack_release_x
+   walking the pick-order slot chain, free the pool slots, and drop
+   busy/outstanding counts — so a pending completion no longer ejects
+   the stem.  A completion with no registry entry is a METERED drop
+   (stale_completions), never a crash: a restarted bank replays its
+   ring window and re-publishes completions this tile already
+   released (exactly-once lives in the bank journal). */
+static int64_t h_pack_comp( stem_t * st, fdt_frag_t const * f,
+                            int64_t n ) {
+  uint64_t * a = st->ac_args;
+  if( !a ) { st->need_python = 1; return 0; }
+  uint8_t * state = (uint8_t *)a[ FDT_PACK_SS_STATE ];
+  uint64_t const * whash = (uint64_t const *)a[ FDT_PACK_SS_WHASH ];
+  uint8_t const * wcnt = (uint8_t const *)a[ FDT_PACK_SS_WCNT ];
+  int64_t maxw = (int64_t)a[ FDT_PACK_SS_MAXW ];
+  uint64_t const * rhash = (uint64_t const *)a[ FDT_PACK_SS_RHASH ];
+  uint8_t const * rcnt = (uint8_t const *)a[ FDT_PACK_SS_RCNT ];
+  int64_t maxr = (int64_t)a[ FDT_PACK_SS_MAXR ];
+  uint64_t * lwk = (uint64_t *)a[ FDT_PACK_SS_LWKEYS ];
+  int64_t * lwv = (int64_t *)a[ FDT_PACK_SS_LWVALS ];
+  int64_t lmask = (int64_t)a[ FDT_PACK_SS_LMASK ];
+  uint64_t * lrk = (uint64_t *)a[ FDT_PACK_SS_LRKEYS ];
+  int64_t * lrv = (int64_t *)a[ FDT_PACK_SS_LRVALS ];
+  int64_t * sw = (int64_t *)a[ FDT_PACK_SS_WORDS ];
+  uint8_t * mb_used = (uint8_t *)a[ FDT_PACK_SS_MB_USED ];
+  int64_t * mb_bank = (int64_t *)a[ FDT_PACK_SS_MB_BANK ];
+  uint64_t * mb_handle = (uint64_t *)a[ FDT_PACK_SS_MB_HANDLE ];
+  int64_t * mb_head = (int64_t *)a[ FDT_PACK_SS_MB_HEAD ];
+  int64_t * mb_cnt = (int64_t *)a[ FDT_PACK_SS_MB_CNT ];
+  int64_t * mb_next = (int64_t *)a[ FDT_PACK_SS_MB_NEXT ];
+  int64_t mb_cap = (int64_t)a[ FDT_PACK_SS_MB_CAP ];
+  int64_t n_banks = (int64_t)a[ FDT_PACK_SS_NBANKS ];
+  int64_t * bank_busy = (int64_t *)a[ FDT_PACK_SS_BANK_BUSY ];
+  int64_t * idx = (int64_t *)a[ FDT_PACK_SS_PICKS ];
+
+  for( int64_t k = 0; k < n; k++ ) {
+    uint64_t sig = f[ k ].sig;
+    int64_t bank = (int64_t)( sig >> 32 );
+    uint64_t handle = sig & 0xFFFFFFFFUL;
+    int64_t m = -1;
+    if( bank < n_banks )
+      for( int64_t i = 0; i < mb_cap; i++ )
+        if( mb_used[ i ] && mb_bank[ i ] == bank
+            && mb_handle[ i ] == handle ) { m = i; break; }
+    if( m < 0 ) {
+      st->ctrs[ PC_STALE ]++;
+      continue;
+    }
+    int64_t cnt = mb_cnt[ m ];
+    int64_t s = mb_head[ m ];
+    for( int64_t j = 0; j < cnt && s >= 0; j++ ) {
+      idx[ j ] = s;
+      s = mb_next[ s ];
+    }
+    fdt_pack_release_x( idx, cnt, whash, wcnt, maxw, rhash, rcnt, maxr,
+                        lwk, lwv, lmask, lrk, lrv, lmask );
+    for( int64_t j = 0; j < cnt; j++ ) state[ idx[ j ] ] = PACK_ST_FREE;
+    mb_used[ m ] = 0;
+    sw[ 3 ]--;
+    bank_busy[ bank ]--;
+    st->ctrs[ PC_COMPLETIONS ]++;
+  }
+  return n;
+}
+
 static int64_t h_pack( stem_t * st, int64_t ii, fdt_frag_t const * f,
                        int64_t n ) {
+  if( ii > 0 ) return h_pack_comp( st, f, n );
   uint64_t * a = st->args;
   uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
   int64_t scap = (int64_t)a[ PH_SCAP ];
@@ -560,6 +646,28 @@ static int64_t h_pack( stem_t * st, int64_t ii, fdt_frag_t const * f,
 
 /* ==== the burst loop ==================================================== */
 
+/* min over outs of cr_avail against the slowest reliable consumer —
+   re-read from the live fseqs at every call site (per sweep AND before
+   the after-credit hook), never carried across a boundary */
+static int64_t stem_min_cr( stem_t * st ) {
+  int64_t cr = st->cap;
+  for( int64_t o = 0; o < st->n_outs; o++ ) {
+    uint64_t * ob = out_blk( st, o );
+    uint64_t nf = ob[ O_NFSEQ ];
+    uint64_t avail = ob[ O_DEPTH ];
+    if( nf ) {
+      uint64_t lo = fdt_fseq_query( (void *)ob[ O_FSEQ0 ] );
+      for( uint64_t j = 1; j < nf && j < 4; j++ ) {
+        uint64_t v = fdt_fseq_query( (void *)ob[ O_FSEQ0 + j ] );
+        if( seq_delta( v, lo ) < 0 ) lo = v;
+      }
+      avail = fdt_fctl_cr_avail( ob[ O_SEQ ], lo, ob[ O_DEPTH ] );
+    }
+    if( (int64_t)avail < cr ) cr = (int64_t)avail;
+  }
+  return cr;
+}
+
 uint64_t fdt_stem_cfg_words( void ) { return FDT_STEM_CFG_WORDS; }
 
 int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
@@ -573,6 +681,8 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
   st.args = (uint64_t *)cfg[ C_ARGS ];
   st.ctrs = (uint64_t *)cfg[ C_CTRS ];
   st.tspub = (uint32_t)cfg[ C_TSPUB ];
+  st.ac = cfg[ C_AC ];
+  st.ac_args = (uint64_t *)cfg[ C_AC_ARGS ];
   st.need_python = 0;
   if( st.n_ins > FDT_STEM_MAX_INS || st.n_outs > FDT_STEM_MAX_OUTS )
     return -1;
@@ -601,21 +711,7 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
        tracks consumer progress instead of trusting a stale credit
        count (the mc_corpus stem-burst-over-credit mutant is exactly
        this re-read skipped) */
-    int64_t cr = st.cap;
-    for( int64_t o = 0; o < st.n_outs; o++ ) {
-      uint64_t * ob = out_blk( &st, o );
-      uint64_t nf = ob[ O_NFSEQ ];
-      uint64_t avail = ob[ O_DEPTH ];
-      if( nf ) {
-        uint64_t lo = fdt_fseq_query( (void *)ob[ O_FSEQ0 ] );
-        for( uint64_t j = 1; j < nf && j < 4; j++ ) {
-          uint64_t v = fdt_fseq_query( (void *)ob[ O_FSEQ0 + j ] );
-          if( seq_delta( v, lo ) < 0 ) lo = v;
-        }
-        avail = fdt_fctl_cr_avail( ob[ O_SEQ ], lo, ob[ O_DEPTH ] );
-      }
-      if( (int64_t)avail < cr ) cr = (int64_t)avail;
-    }
+    int64_t cr = stem_min_cr( &st );
 
     uint64_t rot = cfg[ C_ROT ]++;
     for( int64_t k = 0; k < st.n_ins; k++ ) {
@@ -703,6 +799,30 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
   }
 
 done:
+  /* after-credit hook at the burst boundary — the native analog of the
+     Python loop's tile.after_credit slot (where producer tiles
+     generate work).  Skipped when the burst ends in PYTHON (the Python
+     after_credit runs this iteration, so the hook would double-fire)
+     and on zero-credit boundaries (the Python loop skips after_credit
+     on backpressure iterations — the gate is RE-DERIVED from the live
+     consumer fseqs here, never a credit value carried across the hook
+     boundary: the pack-sched-stale-credit mutant class). */
+  if( st.ac == FDT_STEM_AC_PACK && status != FDT_STEM_PYTHON
+      && st.ac_args ) {
+    if( !st.n_outs || stem_min_cr( &st ) > 0 ) {
+      struct timespec ts;
+      clock_gettime( CLOCK_MONOTONIC, &ts );
+      int64_t now = (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
+      int64_t rc = fdt_pack_sched( st.ac_args, cfg + OUT0, st.n_outs,
+                                   st.cap, now, (uint64_t)st.tspub,
+                                   st.ctrs + PC_MICROBLOCKS );
+      if( rc < 0 ) {
+        /* block boundary with zero outstanding: end_block is Python */
+        status = FDT_STEM_PYTHON;
+        status_in = FDT_STEM_IN_AC;
+      }
+    }
+  }
   cfg[ C_STATUS ] = status;
   cfg[ C_STATUS_IN ] = status_in;
   return total;

@@ -57,7 +57,10 @@
 
 /* ---- geometry ---------------------------------------------------------- */
 
-#define FDT_STEM_MAX_INS 4
+/* 8 in-links: the pack tile consumes one txn ring plus one completion
+   ring per bank, so 4 was too small the moment pack's completion
+   handling went native (ISSUE 11) */
+#define FDT_STEM_MAX_INS 8
 #define FDT_STEM_MAX_OUTS 8
 #define FDT_STEM_N_CTRS 16
 
@@ -68,12 +71,49 @@
 #define FDT_STEM_H_BANK 2
 #define FDT_STEM_H_PACK 3
 
+/* after-credit hook ids (cfg word 11): invoked ONCE per fdt_stem_run
+   call at the burst boundary — the native analog of the Python loop's
+   tile.after_credit slot, which is where producer tiles generate work.
+   The hook publishes through the SAME out blocks the frag handlers use
+   and must re-read per-out cr_avail itself (the stale-credit bug class
+   the pack-sched-stale-credit corpus mutant pins). */
+#define FDT_STEM_AC_PACK 1
+
 /* run statuses (cfg word 5, written by fdt_stem_run) */
 #define FDT_STEM_IDLE 0   /* caught up: nothing more to consume */
 #define FDT_STEM_BUDGET 1 /* max_frags consumed; more may be ready */
 #define FDT_STEM_PYTHON 2 /* frag(s) pending that need the Python path;
-                             cfg word 6 = the in-link index */
+                             cfg word 6 = the in-link index (or
+                             FDT_STEM_IN_AC when the after-credit hook
+                             requested the handback) */
 #define FDT_STEM_BP 3     /* credits exhausted with input pending */
+
+/* status_in sentinel: the PYTHON handback came from the after-credit
+   hook (block-boundary end_block), not from a pending frag */
+#define FDT_STEM_IN_AC 0xFFFFFFFFUL
+
+/* ---- out-block word layout (shared with fdt_pack_sched) ----------------
+ *
+ * The after-credit hook lives in fdt_pack.c but publishes through the
+ * stem's out blocks; these indices are the single source of truth for
+ * that layout (fdt_stem.c aliases them, fdt_pack.c includes this
+ * header).  One block per out at word FDT_STEM_OUT0 + o * STRIDE. */
+
+#define FDT_STEM_OUT0 112
+#define FDT_STEM_OUT_STRIDE 16
+#define FDT_STEM_O_MCACHE 0
+#define FDT_STEM_O_DCACHE 1
+#define FDT_STEM_O_CHUNKP 2
+#define FDT_STEM_O_MTU 3
+#define FDT_STEM_O_WMARK 4
+#define FDT_STEM_O_DEPTH 5
+#define FDT_STEM_O_NFSEQ 6
+#define FDT_STEM_O_FSEQ0 7
+#define FDT_STEM_O_SEQ 11
+#define FDT_STEM_O_PUBLISHED 12
+#define FDT_STEM_O_BYTES 13
+#define FDT_STEM_O_SIGS 14
+#define FDT_STEM_O_TSORIGS 15
 
 /* ---- config block (u64 words; built host-side) -------------------------
  *
@@ -93,7 +133,14 @@
  *         sweep start index rotates so a saturated in-link cannot
  *         starve the others — the Python loop's drain-order rotation,
  *         kept across the burst boundary)
- * words 11..15 reserved
+ * word 11 after-credit hook id (FDT_STEM_AC_*, 0 = none): invoked once
+ *         per call at the burst boundary, unless the burst ended in
+ *         PYTHON (the Python after_credit will run) or with zero
+ *         credits (the Python loop skips after_credit on backpressure
+ *         iterations — same gate)
+ * word 12 after-credit args block ptr (layout per hook; the pack hook
+ *         is fdt_pack.h's FDT_PACK_SS_* block)
+ * words 13..15 reserved
  *
  * per-in block i at word 16 + 12*i:
  *   +0 mcache ptr          +1 dcache base ptr (0 = none)
@@ -107,7 +154,7 @@
  *   +7 consumed this call (out)   +8 bytes consumed (out)
  *   +9 overruns this call (out)   +10,+11 reserved
  *
- * per-out block o at word 64 + 16*o:
+ * per-out block o at word FDT_STEM_OUT0 + 16*o (FDT_STEM_O_* indices):
  *   +0 mcache ptr          +1 dcache base ptr (0 = none)
  *   +2 chunk-cursor ptr (u64 word: the shm dcache cursor in the
  *      process runtime, a host scratch word otherwise)
@@ -120,7 +167,7 @@
  *   +15 published-tsorig scratch ptr (u32[cap], 0 = skip)
  */
 
-#define FDT_STEM_CFG_WORDS 192
+#define FDT_STEM_CFG_WORDS 256
 
 /* Layout self-description so the Python side can assert against drift. */
 uint64_t fdt_stem_cfg_words( void );

@@ -144,6 +144,10 @@ def _load() -> ct.CDLL:
             [vp, ct.c_int64, vp, vp, ct.c_int64, vp, vp, ct.c_int64,
              vp, vp, ct.c_int64, vp, vp, ct.c_int64],
         ),
+        "fdt_pack_sched": (
+            ct.c_int64,
+            [vp, vp, ct.c_int64, ct.c_int64, ct.c_int64, u64, vp],
+        ),
         "fdt_bank_tab_footprint": (u64, [u64]),
         "fdt_bank_tab_new": (i32, [vp, u64]),
         "fdt_bank_tab_slots": (u64, [vp]),
@@ -1059,20 +1063,33 @@ class TCache:
 #: handler ids (fdt_stem.h FDT_STEM_H_*)
 STEM_H_DEDUP, STEM_H_BANK, STEM_H_PACK = 1, 2, 3
 
+#: after-credit hook ids (fdt_stem.h FDT_STEM_AC_*): invoked once per
+#: fdt_stem_run call at the burst boundary — the native analog of the
+#: Python loop's tile.after_credit slot
+STEM_AC_PACK = 1
+
 #: run statuses (fdt_stem.h FDT_STEM_*)
 STEM_IDLE, STEM_BUDGET, STEM_PYTHON, STEM_BP = 0, 1, 2, 3
 
+#: status_in sentinel: the PYTHON handback came from the after-credit
+#: hook (block-boundary end_block), not a pending frag
+STEM_IN_AC = 0xFFFFFFFF
+
+#: fdt_pack_sched args-block word count (fdt_pack.h FDT_PACK_SS_*)
+PACK_SCHED_WORDS = 50
+
 _STEM_MAGIC = 0xF17EDA2CE57E0001
-_STEM_WORDS = 192
-_STEM_MAX_INS, _STEM_MAX_OUTS, _STEM_N_CTRS = 4, 8, 16
+_STEM_WORDS = 256
+_STEM_MAX_INS, _STEM_MAX_OUTS, _STEM_N_CTRS = 8, 8, 16
 # cfg word indices (fdt_stem.c C_* / I_* / O_*)
 _SC_MAGIC, _SC_HANDLER, _SC_NINS, _SC_NOUTS, _SC_CAP = 0, 1, 2, 3, 4
 _SC_STATUS, _SC_STATUS_IN, _SC_ARGS, _SC_CTRS, _SC_TSPUB = 5, 6, 7, 8, 9
+_SC_AC, _SC_AC_ARGS = 11, 12
 _SI0, _SI_STRIDE = 16, 12
 # in-block word 5 is reserved (handlers address payloads by chunk)
 (_SI_MCACHE, _SI_DCACHE, _SI_FSEQ, _SI_SEQ, _SI_FLAGS, _SI_RSVD,
  _SI_FRAGS, _SI_CONSUMED, _SI_BYTES, _SI_OVR) = range(10)
-_SO0, _SO_STRIDE = 64, 16
+_SO0, _SO_STRIDE = 112, 16
 (_SO_MCACHE, _SO_DCACHE, _SO_CHUNKP, _SO_MTU, _SO_WMARK, _SO_DEPTH,
  _SO_NFSEQ, _SO_FSEQ0) = range(8)
 _SO_SEQ, _SO_PUBLISHED, _SO_BYTES, _SO_SIGS, _SO_TSORIGS = 11, 12, 13, 14, 15
@@ -1094,7 +1111,8 @@ class StemSpec:
     def __init__(self, handler: int, args: np.ndarray,
                  counters: tuple = (), keepalive: tuple = (),
                  native_ins: tuple | None = None,
-                 ready=None, after_burst=None, cap: int | None = None):
+                 ready=None, after_burst=None, cap: int | None = None,
+                 ac_handler: int = 0, ac_args: np.ndarray | None = None):
         self.handler = handler
         self.args = args
         self.counters = counters
@@ -1105,6 +1123,12 @@ class StemSpec:
         #: max frags per burst the args block's scratch supports; the
         #: Stem clamps its own capacity to it (None = no tile bound)
         self.cap = cap
+        #: native after-credit hook (STEM_AC_*, 0 = none): runs once per
+        #: burst at its boundary; when set, the run loop SKIPS the
+        #: Python after_credit except on PYTHON handbacks — that is what
+        #: makes the tile zero-Python per microblock at steady state
+        self.ac_handler = ac_handler
+        self.ac_args = ac_args
 
 
 class Stem:
@@ -1158,6 +1182,9 @@ class Stem:
         w[_SC_CAP] = self.cap
         w[_SC_ARGS] = _ptr(spec.args)
         w[_SC_CTRS] = _ptr(self._ctrs)
+        if spec.ac_handler:
+            w[_SC_AC] = spec.ac_handler
+            w[_SC_AC_ARGS] = _ptr(spec.ac_args)
         for i, il in enumerate(self.ins):
             b = _SI0 + i * _SI_STRIDE
             w[b + _SI_MCACHE] = _ptr(il.mcache.mem)

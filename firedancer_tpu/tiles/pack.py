@@ -86,6 +86,11 @@ class PackTile(Tile):
             "microblock_txns",
             "completions",
             "blocks",
+            # completion whose (bank, handle) is no longer outstanding:
+            # a restarted bank replays its ring window and re-publishes
+            # completions this tile already released — a metered drop,
+            # never a crash (exactly-once lives in the bank journal)
+            "stale_completions",
         ),
     )
 
@@ -119,14 +124,23 @@ class PackTile(Tile):
         self.microblock_ns = microblock_ns
         self.slot_ns = slot_ns
         self.engine = P.Pack(depth, max_banks=n_banks)
-        self.bank_busy = [0] * n_banks
+        #: scheduling policy knobs shared verbatim with the native
+        #: after-credit hook (schedule_microblock defaults)
+        self.vote_fraction = 0.25
+        self.scan_limit = 1024
+        # per-bank busy counts and cadence gates live in native-visible
+        # i64 arrays: the fdt_pack_sched hook and the Python after_credit
+        # mutate the SAME words, so the two loops are interchangeable
+        # mid-run.  Per-BANK cadence, as in the reference (fd_pack.c:193
+        # sets bank_ready_at[i] = now + MICROBLOCK_DURATION_NS per
+        # bank) — a global gate would cap the whole tile at 1/cadence
+        # regardless of bank count.
+        self.bank_busy = np.zeros(n_banks, np.int64)
+        self._bank_ready_at = np.zeros(n_banks, np.int64)
+        #: block-budget rollover deadline (0 = unarmed); armed on first
+        #: use by whichever loop runs first
+        self._block_deadline = np.zeros(1, np.int64)
         self._byte_limit = 0  # derived from the out-ring MTU at boot
-        # per-BANK cadence, as in the reference (fd_pack.c:193 sets
-        # bank_ready_at[i] = now + MICROBLOCK_DURATION_NS per bank) — a
-        # global gate would cap the whole tile at 1/cadence regardless
-        # of bank count
-        self._bank_ready_at = [0] * n_banks
-        self._block_started_ns = 0
         self._dev_select = None
         if use_device_select:
             from firedancer_tpu.ops import pack_select
@@ -145,14 +159,26 @@ class PackTile(Tile):
     STEM_SCAN_CAP = 1024
 
     def native_handler(self, ctx: MuxCtx):
-        """Native stem fast path (ISSUE 10) for the INSERT path only:
-        gather + fdt_txn_scan(+bitsets) + free-slot scatter into the
-        pack engine's dense pool arrays run in one GIL-released call.
-        The completion rings (ins[1..]) and the scheduler
-        (after_credit) stay Python — the stem hands control back the
-        moment a completion frag is pending.  The priority-eviction
-        path (pool full) also bails to Python before mutating anything,
-        so the engine state stays bit-identical to insert_batch's."""
+        """Native stem fast path: the full data-plane tile (ISSUE 11).
+
+        * INSERT (ins[0], ISSUE 10): gather + fdt_txn_scan(+bitsets) +
+          free-slot scatter into the engine's dense pool arrays in one
+          GIL-released call.  The priority-eviction path (pool full)
+          bails to Python before mutating anything.
+        * COMPLETIONS (ins[1..]): decode (bank << 32) | handle sigs,
+          microblock_complete slot release + exact lock release via
+          fdt_pack_release_x — a pending completion no longer ejects
+          the stem.
+        * SCHEDULING (after-credit hook, fdt_pack_sched): per-bank
+          cadence gating, per-bank cr_avail re-read, votes-first
+          priority ordering + the fdt_pack_select_x greedy conflict
+          walk, CU/byte/txn budgeting, fdt_mb_encode straight into the
+          out dcache, publish, busy/ready bookkeeping — all inside the
+          GIL-released burst.
+
+        Block-boundary end_block, the eviction path, and device_select
+        remain Python slow paths handed back unconsumed (device_select
+        keeps the PR 9 insert-only shape entirely)."""
         if not ctx.ins or ctx.ins[0].dcache is None:
             return None
         eng = self.engine
@@ -208,12 +234,94 @@ class PackTile(Tile):
         args[23] = cap
         for k in range(1, 20):  # PH_SSZS .. PH_SRCNT are contiguous
             args[23 + k] = s[k].ctypes.data
+
+        # native scheduler + completion handling: only when every bank
+        # has its own dcache-backed out ring and the policy has no
+        # Python-only piece on the hot path (device_select keeps the
+        # insert-only shape; a zero byte_limit would let an encoded
+        # microblock outgrow the out MTU inside C)
+        sched_ok = (
+            self._dev_select is None
+            and self._byte_limit > 0
+            and len(ctx.outs) == self.n_banks
+            and all(o.dcache is not None for o in ctx.outs)
+        )
+        if not sched_ok:
+            return R.StemSpec(
+                R.STEM_H_PACK, args,
+                counters=("inserted_txns", "insert_rejected"),
+                keepalive=(s, args),
+                native_ins=(0,),
+                cap=cap,
+            )
+
+        eng_p = len(eng.state)
+        sscr = (
+            np.zeros(eng_p, np.int64),  # candidate order
+            np.zeros(eng_p, np.int64),  # merge scratch
+            np.zeros(eng_p, np.float64),  # priorities
+            np.zeros(eng_p, np.int64),  # picks / chain walk
+        )
+        sa = np.zeros(R.PACK_SCHED_WORDS, np.uint64)
+        sa[0] = eng.state.ctypes.data
+        sa[1] = eng_p
+        sa[2] = eng.rows.ctypes.data
+        sa[3] = eng.rows.shape[1]
+        sa[4] = eng.szs.ctypes.data
+        sa[5] = eng.rewards.ctypes.data
+        sa[6] = eng.cost.ctypes.data
+        sa[7] = eng.is_vote.ctypes.data
+        sa[8] = eng.whash.ctypes.data
+        sa[9] = eng.w_cnt.ctypes.data
+        sa[10] = P.MAX_WRITERS
+        sa[11] = eng.rhash.ctypes.data
+        sa[12] = eng.r_cnt.ctypes.data
+        sa[13] = P.MAX_READERS
+        sa[14] = eng.lw_keys.ctypes.data
+        sa[15] = eng.lw_vals.ctypes.data
+        sa[16] = eng._lock_mask
+        sa[17] = eng.lr_keys.ctypes.data
+        sa[18] = eng.lr_vals.ctypes.data
+        sa[19] = eng.wc_keys.ctypes.data
+        sa[20] = eng.wc_vals.ctypes.data
+        sa[21] = eng._wc_mask
+        sa[22] = eng.writer_cost_cap
+        sa[23] = eng._sched_words.ctypes.data
+        sa[24] = eng.block_cost_limit
+        sa[25] = eng.vote_cost_limit
+        sa[26] = eng.mb_used.ctypes.data
+        sa[27] = eng.mb_bank.ctypes.data
+        sa[28] = eng.mb_handle.ctypes.data
+        sa[29] = eng.mb_head.ctypes.data
+        sa[30] = eng.mb_cnt.ctypes.data
+        sa[31] = eng.mb_cost.ctypes.data
+        sa[32] = eng.mb_next.ctypes.data
+        sa[33] = len(eng.mb_used)
+        sa[34] = self.n_banks
+        sa[35] = self.bank_busy.ctypes.data
+        sa[36] = self._bank_ready_at.ctypes.data
+        sa[37] = self.mb_inflight
+        sa[38] = self.microblock_ns
+        sa[39] = self.cu_limit
+        sa[40] = self.txn_limit
+        sa[41] = self._byte_limit
+        sa[42] = np.float64(self.vote_fraction).view(np.uint64)
+        sa[43] = self.scan_limit
+        sa[44] = self._block_deadline.ctypes.data
+        sa[45] = self.slot_ns
+        sa[46] = sscr[0].ctypes.data
+        sa[47] = sscr[1].ctypes.data
+        sa[48] = sscr[2].ctypes.data
+        sa[49] = sscr[3].ctypes.data
         return R.StemSpec(
             R.STEM_H_PACK, args,
-            counters=("inserted_txns", "insert_rejected"),
-            keepalive=(s, args),
-            native_ins=(0,),
+            counters=("inserted_txns", "insert_rejected", "microblocks",
+                      "microblock_txns", "completions",
+                      "stale_completions"),
+            keepalive=(s, args, sa, sscr),
             cap=cap,
+            ac_handler=R.STEM_AC_PACK,
+            ac_args=sa,
         )
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
@@ -239,7 +347,11 @@ class PackTile(Tile):
             for sig in frags["sig"]:
                 bank = int(sig) >> 32
                 handle = int(sig) & 0xFFFFFFFF
-                self.engine.microblock_complete(bank, handle)
+                try:
+                    self.engine.microblock_complete(bank, handle)
+                except KeyError:
+                    ctx.metrics.inc("stale_completions")
+                    continue
                 self.bank_busy[bank] -= 1
                 ctx.metrics.inc("completions")
 
@@ -247,16 +359,18 @@ class PackTile(Tile):
         # hot-path-clock discipline: loop-body clock reads go through
         # the sanctioned tempo tick source, never bare time.* calls
         now = tempo.tickcount()
-        if self._block_started_ns == 0:
-            self._block_started_ns = now
-        elif now - self._block_started_ns >= self.slot_ns:
+        if self._block_deadline[0] == 0:
+            self._block_deadline[0] = now + self.slot_ns
+        elif now >= self._block_deadline[0]:
             # block boundary: stop scheduling and let in-flight
             # microblocks complete, then reset the block budgets
-            # (end_block requires no outstanding microblocks)
-            if any(v for v in self.engine.outstanding.values()):
+            # (end_block requires no outstanding microblocks — the O(1)
+            # counter, maintained by schedule/complete, replaces the
+            # old per-call dict scan)
+            if self.engine.outstanding_cnt:
                 return
             self.engine.end_block()
-            self._block_started_ns = now
+            self._block_deadline[0] = now + self.slot_ns
             ctx.metrics.inc("blocks")
         for bank in range(self.n_banks):
             if now < self._bank_ready_at[bank]:
@@ -270,6 +384,8 @@ class PackTile(Tile):
                 bank,
                 cu_limit=self.cu_limit,
                 txn_limit=self.txn_limit,
+                vote_fraction=self.vote_fraction,
+                scan_limit=self.scan_limit,
                 byte_limit=self._byte_limit,
                 device_select=self._dev_select,
             )

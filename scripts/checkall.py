@@ -117,6 +117,8 @@ def _stage_proc(timeout_s: float) -> dict:
             sub["shm_leak"] = doc.get("shm_leak")
             if stem == "native":
                 sub["stem_frags"] = doc.get("stem_frags")
+                sub["pack_stem_frags"] = doc.get("pack_stem_frags")
+            sub["pack_mbs"] = doc.get("pack_mbs")
         except Exception:  # noqa: BLE001 — non-JSON tail ok on rc != 0
             sub["tail"] = out[-2000:]
         stage[stem] = sub

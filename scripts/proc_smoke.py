@@ -134,6 +134,112 @@ def run_pipeline(
     return out
 
 
+from firedancer_tpu.disco.mux import Tile as _Tile  # noqa: E402
+
+
+class _CompletionEcho(_Tile):
+    """Consumes pack's microblocks, echoes (bank, handle) sigs back on
+    the completion ring — a zero-work stand-in for the bank.  Module
+    level (not nested in the harness) so the process runtime's spawn
+    pickle can resolve the class in tile children."""
+
+    name = "echo"
+
+    def on_frags(self, ctx, i, frags):
+        ctx.outs[0].publish(frags["sig"].copy())
+
+
+def run_pack_pipeline(
+    runtime: str,
+    n_txns: int = 1024,
+    deadline_s: float = 180.0,
+    stem: str = "python",
+) -> dict:
+    """Pack-scheduler smoke (ISSUE 11): synth → pack → completion echo
+    under the chosen runtime/stem.  Every unique txn must be inserted
+    AND scheduled exactly once (microblock_txns == inserted_txns), and
+    every scheduled microblock completed (completions == microblocks) —
+    end-to-end through child processes when runtime=process, with the
+    native after-credit hook doing the scheduling when stem=native."""
+    import numpy as np
+
+    from firedancer_tpu.ballet import txn as BT
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.pack import PackTile
+    from firedancer_tpu.tiles.synth import SynthTile
+
+    rng = np.random.default_rng(19)
+    payers = [bytes(rng.integers(0, 256, 32, np.uint8)) for _ in range(32)]
+    rows = np.zeros((n_txns, wire.LINK_MTU), np.uint8)
+    szs = np.zeros(n_txns, np.uint16)
+    for i in range(n_txns):
+        data = (2).to_bytes(4, "little") + int(
+            1 + rng.integers(1, 999)
+        ).to_bytes(8, "little")
+        raw = BT.build(
+            [bytes(rng.integers(0, 256, 64, np.uint8))],
+            [payers[i % 32], payers[(i * 7 + 3) % 32], bytes(32)],
+            bytes(32), [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+        )
+        pl = wire.append_trailer(raw, BT.parse(raw))
+        rows[i, : len(pl)] = np.frombuffer(pl, np.uint8)
+        szs[i] = len(pl)
+
+    topo = Topology(
+        name=f"psmoke{os.getpid()}_{runtime[:4]}", runtime=runtime,
+    )
+    topo.link("synth_pack", depth=1 << 10, mtu=wire.LINK_MTU)
+    topo.link("pack_bank0", depth=256, mtu=65_535)
+    topo.link("bank0_pack", depth=256)
+    topo.tile(SynthTile(rows, szs, total=n_txns, repeat=1),
+              outs=["synth_pack"])
+    topo.tile(
+        PackTile(1, depth=1 << 12, mb_inflight=4, microblock_ns=0,
+                 slot_ns=10**15),
+        ins=[("synth_pack", True), ("bank0_pack", True)],
+        outs=["pack_bank0"],
+    )
+    topo.tile(_CompletionEcho(), ins=[("pack_bank0", True)],
+              outs=["bank0_pack"])
+    out: dict = {"runtime": runtime, "stem": stem, "ok": False}
+    topo.build()
+    topo.start(batch_max=256, boot_timeout_s=600.0, stem=stem)
+    try:
+        mp = topo.metrics("pack")
+        deadline = time.perf_counter() + deadline_s
+        while time.perf_counter() < deadline:
+            topo.poll_failure()
+            if (
+                mp.counter("microblock_txns") >= n_txns
+                and mp.counter("completions") >= mp.counter("microblocks")
+            ):
+                break
+            time.sleep(0.02)
+        topo.halt()
+        out.update(
+            pack_inserted=mp.counter("inserted_txns"),
+            pack_mbs=mp.counter("microblocks"),
+            pack_mb_txns=mp.counter("microblock_txns"),
+            pack_completions=mp.counter("completions"),
+            pack_stem_frags=mp.counter("stem_frags"),
+            ok=(
+                mp.counter("inserted_txns") == n_txns
+                and mp.counter("microblock_txns") == n_txns
+                and mp.counter("completions") == mp.counter("microblocks")
+                and mp.counter("microblocks") > 0
+                and (stem != "native" or mp.counter("stem_frags") > 0)
+            ),
+        )
+    finally:
+        topo.close()
+    leaked = glob.glob(f"/dev/shm/fdt_wksp_{topo.name}*")
+    out["shm_leak"] = leaked
+    if leaked:
+        out["ok"] = False
+    return out
+
+
 def run_relay_ab(
     runtime: str,
     n_chains: int = 2,
@@ -254,6 +360,16 @@ def main(argv: list[str] | None = None) -> int:
         args.runtime, n_txns=args.txns, repeat=args.repeat,
         stem=args.stem,
     )
+    # pack-scheduler leg (ISSUE 11): insert -> schedule -> complete,
+    # exactly once, under the same runtime/stem combination
+    pr = run_pack_pipeline(args.runtime, stem=args.stem)
+    for k in ("pack_inserted", "pack_mbs", "pack_mb_txns",
+              "pack_completions", "pack_stem_frags"):
+        r[k] = pr.get(k)
+    r["pack_ok"] = pr["ok"]
+    r["ok"] = r["ok"] and pr["ok"]
+    if pr["shm_leak"]:
+        r["shm_leak"] = r["shm_leak"] + pr["shm_leak"]
     if args.json:
         print(json.dumps(r, sort_keys=True))
     else:
@@ -261,7 +377,9 @@ def main(argv: list[str] | None = None) -> int:
             f"proc_smoke [{r['runtime']}/{r['stem']}]: "
             f"{'ok' if r['ok'] else 'FAILED'} — landed {r['landed']} "
             f"({r['unique']} unique of {args.txns}) at {r['tps']:,.0f} "
-            f"frags/s, boot {r['boot_s']}s, leak={r['shm_leak']}"
+            f"frags/s, pack {r['pack_mbs']} mbs/"
+            f"{r['pack_completions']} comp, boot {r['boot_s']}s, "
+            f"leak={r['shm_leak']}"
         )
     return 0 if r["ok"] else 1
 

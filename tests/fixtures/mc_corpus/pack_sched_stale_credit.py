@@ -1,0 +1,18 @@
+"""An after-credit publisher — the native pack scheduler's shape
+(tango/native/fdt_pack.c fdt_pack_sched) — trusts ONE cr_avail read
+across every later hook boundary instead of re-deriving credits from
+the live consumer fseqs immediately before each publish.  The stale
+first read (ring empty: cr_max) then admits a publish every round
+regardless of consumer progress.  The shipped hook re-reads per-bank
+cr_avail inside fdt_pack_sched right before each microblock publish —
+over the same fdt_fseq words the Python after_credit's
+OutLink.cr_avail() reads — so the checked protocol catches exactly the
+bug class the hook boundary could introduce (the stale-credit sibling
+of stem-burst-over-credit; see the model-checking-boundary note in
+analysis/README.md)."""
+
+MUTATION = "pack-sched-stale-credit"
+SCENARIO = "backpressure"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-credit-overflow", "mc-reliable-overrun"}

@@ -549,6 +549,451 @@ def test_pack_stem_pool_full_hands_eviction_to_python():
 
 
 # ---------------------------------------------------------------------------
+# pack: native after-credit scheduler (ISSUE 11) — synchronous raw-ring
+# harness so the microblock stream comparison is deterministic to the byte
+
+
+def _transfer_pool(n, n_payers=24, seed=13):
+    """Fast-transfer txns with unique signatures + wire trailers, the
+    shape the pack tile sees from dedup."""
+    from firedancer_tpu.ballet import txn as BT
+
+    rng = np.random.default_rng(seed)
+    payers = [
+        bytes(rng.integers(0, 256, 32, np.uint8)) for _ in range(n_payers)
+    ]
+    raws = []
+    for i in range(n):
+        p = payers[i % n_payers]
+        d = payers[(i * 7 + 3) % n_payers]
+        data = (2).to_bytes(4, "little") + int(
+            1 + rng.integers(1, 999)
+        ).to_bytes(8, "little")
+        sig = bytes(rng.integers(0, 256, 64, np.uint8))
+        raws.append(
+            BT.build(
+                [sig], [p, d, bytes(32)], bytes(32),
+                [(2, [0, 1], data)], readonly_unsigned_cnt=1,
+            )
+        )
+    from firedancer_tpu.ballet import txn as T
+
+    rows = np.zeros((n, wire.LINK_MTU), np.uint8)
+    szs = np.zeros(n, np.uint16)
+    tags = np.zeros(n, np.uint64)
+    for i, raw in enumerate(raws):
+        pl = wire.append_trailer(raw, T.parse(raw))
+        rows[i, : len(pl)] = np.frombuffer(pl, np.uint8)
+        szs[i] = len(pl)
+        tags[i] = int.from_bytes(raw[1:9], "little")
+    return rows, szs, tags, payers
+
+
+def _mk_pack_sched_ctx(n_banks=2, depth=512, mb_inflight=2,
+                       slot_ns=10**15, ring_depth=1 << 9):
+    from firedancer_tpu.tiles.pack import PackTile
+
+    def ring(mtu=None):
+        mc = R.MCache(
+            np.zeros(R.MCache.footprint(ring_depth), np.uint8), ring_depth
+        )
+        dc = None
+        if mtu is not None:
+            dc = R.DCache(
+                np.zeros(R.DCache.footprint(mtu, ring_depth), np.uint8),
+                mtu, ring_depth,
+            )
+        return mc, dc
+
+    in_mc, in_dc = ring(wire.LINK_MTU)
+    cp_mc, _ = ring()  # completion ring: metadata only
+    ins = [
+        InLink("txns", in_mc, in_dc,
+               R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))),
+        InLink("comp", cp_mc, None,
+               R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))),
+    ]
+    outs, cons = [], []
+    for b in range(n_banks):
+        mc, dc = ring(65_535)
+        fs = R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))
+        outs.append(OutLink(f"pb{b}", mc, dc, [fs]))
+        cons.append(fs)
+    pk = PackTile(
+        n_banks, depth=depth, mb_inflight=mb_inflight, microblock_ns=0,
+        slot_ns=slot_ns,
+    )
+    schema = pk.schema.with_base()
+    ctx = MuxCtx(
+        "pack", R.CNC(np.zeros(R.CNC.footprint(), np.uint8)), ins, outs,
+        Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema),
+    )
+    pk.on_boot(ctx)
+    return pk, ctx, cons
+
+
+def _run_pack_sched(native, pool_n=400, depth=512, n_banks=2,
+                    mb_inflight=2, slot_ns=10**15, max_rounds=4000):
+    """Drive the pack tile synchronously: Phase A feeds + inserts (and
+    schedules — banks fill to mb_inflight), Phase B echoes completions
+    one round at a time until the pool drains.  Native mode follows the
+    run_loop contract exactly: a PYTHON status falls back to the Python
+    on_frags/after_credit for that round."""
+    rows, szs, tags, _ = _transfer_pool(pool_n)
+    pk, ctx, cons = _mk_pack_sched_ctx(
+        n_banks=n_banks, depth=depth, mb_inflight=mb_inflight,
+        slot_ns=slot_ns,
+    )
+    stem = None
+    spec = None
+    ctr_tot: dict[str, int] = {}
+    if native:
+        spec = pk.native_handler(ctx)
+        assert spec is not None and spec.ac_handler, "scheduler not native"
+        stem = R.Stem(ctx.ins, ctx.outs, spec, cap=256)
+        ctr_tot = dict.fromkeys(spec.counters, 0)
+
+    def py_round():
+        for i in range(len(ctx.ins)):
+            il = ctx.ins[i]
+            frags, il.seq, _ = il.mcache.drain(il.seq, 256)
+            if len(frags):
+                pk.on_frags(ctx, i, frags)
+        pk.after_credit(ctx)
+
+    def step():
+        if stem is None:
+            py_round()
+            return
+        _got, stat, _sin = stem.run(256, 7)
+        for i, name in enumerate(spec.counters):
+            ctr_tot[name] += int(stem.counters[i])
+        if stat == R.STEM_PYTHON:
+            py_round()
+
+    stream = []
+    comp_seq = [0]
+    held: list[int] = []  # completions withheld during phase A
+
+    def echo_sigs(sigs):
+        if len(sigs):
+            cin = ctx.ins[1]
+            comp_seq[0] = cin.mcache.publish_batch(
+                comp_seq[0], np.asarray(sigs, np.uint64)
+            )
+
+    def harvest(echo):
+        for b in range(n_banks):
+            ol = ctx.outs[b]
+            seq = cons[b].query()
+            frags, seq, ovr = ol.mcache.drain(seq, 256)
+            assert ovr == 0
+            for f in frags:
+                stream.append(
+                    (
+                        b, int(f["sig"]), int(f["sz"]),
+                        bytes(ol.dcache.read(int(f["chunk"]), int(f["sz"]))),
+                    )
+                )
+            cons[b].update(seq)
+            if echo:
+                echo_sigs(frags["sig"])
+            else:
+                held.extend(int(s) for s in frags["sig"])
+
+    # phase A: feed + insert; scheduling fills the banks but completions
+    # are withheld so insert/complete never share a round (the loop's
+    # drain-order rotation makes same-round interleaving orderless)
+    il = ctx.ins[0]
+    fed = 0
+    rounds = 0
+    while fed < pool_n or R.seq_diff(il.mcache.seq_query(), il.seq) > 0:
+        n = min(128, pool_n - fed)
+        if n:
+            chunks = il.dcache.write_batch(
+                rows[fed : fed + n], szs[fed : fed + n]
+            )
+            il.mcache.publish_batch(
+                fed, tags[fed : fed + n], chunks, szs[fed : fed + n],
+                None, 3, None,
+            )
+            fed += n
+        step()
+        harvest(echo=False)
+        rounds += 1
+        assert rounds < max_rounds, "phase A did not converge"
+
+    # phase B: release the withheld completions, then echo round by
+    # round until the pool drains
+    echo_sigs(held)
+    held.clear()
+    harvest(echo=True)
+    eng = pk.engine
+    while eng.pending_cnt or eng.outstanding_cnt:
+        before = len(stream)
+        step()
+        harvest(echo=True)
+        rounds += 1
+        if len(stream) == before and not eng.outstanding_cnt \
+                and eng.pending_cnt:
+            # pending txns that can never schedule (conflict-starved
+            # forever is impossible here: completions released all locks)
+            step()
+        assert rounds < max_rounds, "phase B did not converge"
+    # drain the last completion echoes so bank_busy settles
+    for _ in range(4):
+        step()
+
+    counters = {
+        k: ctx.metrics.counter(k) + ctr_tot.get(k, 0)
+        for k in (
+            "inserted_txns", "insert_rejected", "microblocks",
+            "microblock_txns", "completions", "stale_completions",
+            "blocks",
+        )
+    }
+    arrays = tuple(
+        a.copy()
+        for a in (
+            eng.state, eng.szs, eng.sig_tag, eng.rewards, eng.cost,
+            eng.is_vote, eng.whash, eng.w_cnt, eng.rhash, eng.r_cnt,
+            eng.lw_keys, eng.lw_vals, eng.lr_keys, eng.lr_vals,
+            eng.wc_keys, eng.wc_vals, eng._sched_words, eng.mb_used,
+            pk.bank_busy,
+        )
+    )
+    return stream, counters, arrays, pk
+
+
+def test_pack_sched_stem_bit_identical_on_raw_rings():
+    """The ISSUE 11 parity bar, deterministically: the native
+    after-credit scheduler + completion handler must produce a
+    microblock payload stream BIT-IDENTICAL to the Python
+    after_credit's — same banks, same sigs, same encoded bytes — and
+    leave every engine array (pool, exact lock tables, writer-cost
+    map, shared scheduler words, registry) byte-equal."""
+    g_stream, g_c, g_a, _ = _run_pack_sched(False)
+    n_stream, n_c, n_a, _ = _run_pack_sched(True)
+    assert g_stream == n_stream, "microblock streams diverged"
+    assert g_c == n_c, (g_c, n_c)
+    for i, (ga, na) in enumerate(zip(g_a, n_a)):
+        assert np.array_equal(ga, na), f"engine array {i} diverged"
+    assert n_c["microblocks"] > 0 and n_c["completions"] == n_c["microblocks"]
+    assert n_c["microblock_txns"] == n_c["inserted_txns"]
+
+
+def test_pack_sched_stem_pool_full_eviction_parity():
+    """Scheduling active while the pool overflows: the insert fast path
+    bails pre-mutation, Python's priority eviction decides, and the
+    stream still matches (the eviction pairing is batch-size
+    invariant)."""
+    g_stream, g_c, g_a, _ = _run_pack_sched(False, pool_n=400, depth=128)
+    n_stream, n_c, n_a, _ = _run_pack_sched(True, pool_n=400, depth=128)
+    assert g_stream == n_stream
+    assert g_c == n_c
+    for i, (ga, na) in enumerate(zip(g_a, n_a)):
+        assert np.array_equal(ga, na), f"engine array {i} diverged"
+
+
+def test_pack_sched_stem_end_block_hands_back_to_python():
+    """Past the block deadline the native hook must (a) keep draining
+    completions while microblocks are outstanding and (b) hand back to
+    Python with ZERO outstanding so end_block — a Python slow path —
+    resets the budgets.  Both loop modes land identical budget words
+    and block counts."""
+    outs = []
+    for native in (False, True):
+        _s, c, a, pk = _run_pack_sched(
+            native, pool_n=96, depth=128, slot_ns=1
+        )
+        outs.append((c, a, pk))
+    (g_c, g_a, g_pk), (n_c, n_a, n_pk) = outs
+    assert g_c["blocks"] >= 1 and n_c["blocks"] == g_c["blocks"]
+    assert g_c == n_c
+    # budgets reset by end_block in both modes
+    assert int(g_pk.engine._sched_words[0]) == int(
+        n_pk.engine._sched_words[0]
+    )
+
+
+def test_pack_sched_stem_stale_completion_is_metered_drop():
+    """A completion whose (bank, handle) is no longer outstanding — a
+    restarted bank replaying its ring window — must be a metered drop
+    in BOTH loop modes, never a KeyError crash or a double lock
+    release."""
+    for native in (True, False):
+        pk, ctx, cons = _mk_pack_sched_ctx(n_banks=1)
+        stem = spec = None
+        if native:
+            spec = pk.native_handler(ctx)
+            stem = R.Stem(ctx.ins, ctx.outs, spec, cap=64)
+        # no outstanding microblock: every completion is stale
+        cin = ctx.ins[1]
+        cin.mcache.publish_batch(
+            0, np.array([(0 << 32) | 7, (5 << 32) | 9], np.uint64)
+        )
+        if native:
+            got, stat, _ = stem.run(64, 5)
+            assert got == 2 and stat in (R.STEM_IDLE, R.STEM_BUDGET)
+            stale = int(stem.counters[list(spec.counters).index(
+                "stale_completions"
+            )])
+        else:
+            il = ctx.ins[1]
+            frags, il.seq, _ = il.mcache.drain(il.seq, 64)
+            pk.on_frags(ctx, 1, frags)
+            stale = ctx.metrics.counter("stale_completions")
+        assert stale == 2
+        assert pk.engine.outstanding_cnt == 0
+        assert int(pk.bank_busy[0]) == 0
+
+
+def test_pack_stem_zero_python_steady_state():
+    """The acceptance counter-assert: with the native scheduler active,
+    a steady scheduling window executes ZERO Python per frag and per
+    microblock — py_frags/py_credit stay flat while stem_frags and
+    microblocks advance (run_loop skips tile.after_credit when the
+    burst scheduled natively)."""
+    from firedancer_tpu.tiles.pack import PackTile
+
+    rows, szs, tags = _transfer_pool(512)[:3]
+    topo = Topology()
+    topo.link("s", depth=1 << 9, mtu=wire.LINK_MTU)
+    topo.link("pb0", depth=256, mtu=65_535)
+    topo.link("b0p", depth=256)
+    topo.tile(SynthTile(rows, szs, total=4096, repeat=8), outs=["s"])
+    pk = PackTile(1, depth=1 << 12, mb_inflight=4, microblock_ns=0,
+                  slot_ns=10**15)
+    topo.tile(pk, ins=[("s", True), ("b0p", True)], outs=["pb0"])
+
+    class _Echo(Tile):
+        name = "echo"
+
+        def on_frags(self, ctx, i, frags):
+            ctx.outs[0].publish(frags["sig"].copy())
+
+    topo.tile(_Echo(), ins=[("pb0", True)], outs=["b0p"])
+    topo.build()
+    topo.start(batch_max=128, stem="native")
+    try:
+        mp = topo.metrics("pack")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if mp.counter("microblocks") >= 8:
+                break
+            time.sleep(0.02)
+        assert mp.counter("microblocks") >= 8, "scheduler never engaged"
+        base = {
+            k: mp.counter(k)
+            for k in ("py_frags", "py_credit", "stem_frags", "microblocks")
+        }
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            cur = {
+                k: mp.counter(k)
+                for k in ("stem_frags", "microblocks")
+            }
+            if (
+                cur["stem_frags"] > base["stem_frags"]
+                and cur["microblocks"] > base["microblocks"]
+            ):
+                break
+            time.sleep(0.02)
+        after = {
+            k: mp.counter(k)
+            for k in ("py_frags", "py_credit", "stem_frags", "microblocks")
+        }
+        assert after["stem_frags"] > base["stem_frags"]
+        assert after["microblocks"] > base["microblocks"]
+        assert after["py_frags"] == base["py_frags"], (base, after)
+        assert after["py_credit"] == base["py_credit"], (base, after)
+    finally:
+        topo.halt()
+        topo.close()
+
+
+def test_pack_sched_sigkill_bank_mid_burst_exactly_once():
+    """ISSUE 11 chaos bar: SIGKILL the BANK child while the pack tile's
+    native scheduler is hot.  The bank's journal + completed-seq
+    discipline makes every microblock execute exactly once across the
+    replay; at pack, replayed completions for already-released handles
+    are metered drops — so zero microblocks are lost (every scheduled
+    txn completes) and zero are duplicated (microblock_txns ==
+    inserted_txns, completions == microblocks)."""
+    from firedancer_tpu.flamenco.accounts import Account, AccountMgr
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.bank import BankTile
+    from firedancer_tpu.tiles.pack import PackTile
+
+    pool_n = 3072
+    rows, szs, tags, payers = _transfer_pool(pool_n, n_payers=64, seed=21)
+    funk = Funk()
+    mgr = AccountMgr(funk)
+    for p in payers:
+        mgr.store(p, Account(1 << 40))
+    topo = Topology(name=f"packk{os.getpid()}", runtime="process")
+    topo.link("synth_pack", depth=256, mtu=wire.LINK_MTU)
+    topo.link("pack_bank0", depth=128, mtu=65_535)
+    topo.link("bank0_pack", depth=128)
+    topo.link("bank0_poh", depth=128, mtu=65_535)
+    topo.tile(SynthTile(rows, szs, total=pool_n, repeat=1),
+              outs=["synth_pack"])
+    pk = PackTile(1, depth=1 << 13, mb_inflight=2, microblock_ns=0,
+                  slot_ns=10**15, txn_limit=16)
+    topo.tile(pk, ins=[("synth_pack", True), ("bank0_pack", True)],
+              outs=["pack_bank0"])
+    topo.tile(
+        BankTile(0, funk=funk, native=True, table_slots=1 << 12),
+        ins=[("pack_bank0", True)], outs=["bank0_pack", "bank0_poh"],
+    )
+    topo.tile(SinkTile(shm_log=1 << 14), ins=[("bank0_poh", True)])
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=1.0, backoff_base_s=0.05,
+            replay={"bank0": 128, "pack": 128, "sink": 128},
+        ),
+    )
+    sup.start(batch_max=64, idle_sleep_s=2e-3, stem="native")
+    try:
+        mp = topo.metrics("pack")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if mp.counter("completions") >= 4:
+                break
+            time.sleep(0.02)
+        assert mp.counter("completions") >= 4, "pipeline never started"
+        pid = topo.tile_pid("bank0")
+        assert pid is not None
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if (
+                sup.restarts("bank0") >= 1
+                and mp.counter("microblock_txns") >= pool_n
+                and mp.counter("completions") >= mp.counter("microblocks")
+            ):
+                break
+            time.sleep(0.1)
+        assert sup.restarts("bank0") >= 1
+        assert mp.counter("inserted_txns") == pool_n
+        assert mp.counter("insert_rejected") == 0
+        # zero lost / zero duplicated microblocks: every inserted txn
+        # scheduled exactly once, every scheduled microblock completed
+        # exactly once (stale re-deliveries dropped, not double-freed)
+        assert mp.counter("microblock_txns") == pool_n, (
+            mp.counter("microblock_txns")
+        )
+        assert mp.counter("completions") == mp.counter("microblocks")
+        assert mp.counter("stem_frags") > 0
+    finally:
+        sup.halt()
+        topo.close()
+
+
+# ---------------------------------------------------------------------------
 # faultinj fires at the burst boundary
 
 

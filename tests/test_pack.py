@@ -117,6 +117,41 @@ def test_insert_and_schedule_nonconflicting():
     assert pk.in_use_rw.sum() == 0 and pk.in_use_w.sum() == 0
 
 
+def test_outstanding_count_is_o1_and_matches_registry():
+    """ISSUE 11 satellite: `outstanding_cnt` is an O(1) counter
+    maintained by schedule/complete; it must track the registry (and
+    the legacy dict view) through interleaved schedule/complete churn,
+    and end_block must key off it."""
+    pk = _pack()
+    for i in range(24):
+        tx = _mk_txn(_acct(10 + i), [_acct(100 + i)], [_acct(200)])
+        assert pk.insert(tx, sig_tag=i + 1) == "ok"
+    mbs = []
+    for bank in range(3):
+        mb = pk.schedule_microblock(
+            bank, cu_limit=10_000_000, txn_limit=4
+        )
+        assert mb is not None
+        mbs.append((bank, mb))
+        assert pk.outstanding_cnt == len(mbs)
+        assert sum(len(v) for v in pk.outstanding.values()) == len(mbs)
+    # complete out of order; the counter tracks exactly
+    for bank, mb in (mbs[1], mbs[0]):
+        pk.microblock_complete(bank, mb.handle)
+    assert pk.outstanding_cnt == 1
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        pk.end_block()  # one still outstanding
+    with _pytest.raises(KeyError):
+        pk.microblock_complete(mbs[0][0], mbs[0][1].handle)  # already done
+    pk.microblock_complete(mbs[2][0], mbs[2][1].handle)
+    assert pk.outstanding_cnt == 0
+    assert (pk.mb_used == 0).all()
+    pk.end_block()
+    assert pk.cumulative_block_cost == 0
+
+
 def test_schedule_write_conflicts_serialize():
     pk = _pack()
     hot = _acct(50)

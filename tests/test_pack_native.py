@@ -234,6 +234,66 @@ def test_mb_codec_native_matches_python():
     ) == -1
 
 
+def test_mb_codec_differential_edges():
+    """ISSUE 11 differential pin: tiles/pack.mb_encode, the Python
+    mb_decode, and native fdt_mb_decode must agree on the edge shapes
+    the scheduler can emit — sz=0 txns, txn_cnt at the txn limit, and a
+    payload at EXACTLY the dcache-MTU/0xFFFF frag-size ceiling."""
+    rng = np.random.default_rng(17)
+
+    def roundtrip(rows, szs, idx, handle, bank, stride=None):
+        szs16 = np.ascontiguousarray(szs, np.uint16)
+        enc = mb_encode(handle, bank, rows, szs16, idx=idx)
+        h, b, txns = mb_decode(enc)
+        assert h == handle and b == bank and len(txns) == len(idx)
+        stride = stride or rows.shape[1]
+        drows = np.zeros((len(idx), stride), np.uint8)
+        dszs = np.zeros(len(idx), np.uint32)
+        cnt = R._lib.fdt_mb_decode(
+            np.ascontiguousarray(enc).ctypes.data, len(enc),
+            drows.ctypes.data, stride, dszs.ctypes.data, len(idx),
+        )
+        assert cnt == len(idx)
+        for i, s in enumerate(idx):
+            assert dszs[i] == szs16[s]
+            assert (
+                drows[i, : dszs[i]].tobytes() == txns[i].tobytes()
+                == rows[s, : szs16[s]].tobytes()
+            )
+        return enc
+
+    # sz=0 txns interleaved with normal ones (a 0-length row encodes a
+    # bare 2-byte length prefix; decode must not skid)
+    rows = rng.integers(0, 256, (8, 128), np.uint8)
+    szs = np.array([0, 64, 0, 128, 17, 0, 1, 33], np.uint16)
+    roundtrip(rows, szs, np.arange(8, dtype=np.int64), 9, 2)
+
+    # txn_cnt at the scheduler's txn_limit (31), gathered via a pool-
+    # slot idx permutation like the scheduler's picks array
+    n = 31
+    rows = rng.integers(0, 256, (n, 200), np.uint8)
+    szs = rng.integers(1, 200, n).astype(np.uint16)
+    idx = np.ascontiguousarray(rng.permutation(n), np.int64)
+    roundtrip(rows, szs, idx, 0xFFFFFFFF, 61)
+
+    # payload at EXACTLY the 0xFFFF frag-size ceiling (the byte_limit
+    # the pack tile derives: min(mtu, 0xFFFF) - MB_HDR)
+    one = 0xFFFF - 8 - 2  # one txn: header + len prefix + sz == 0xFFFF
+    rows = rng.integers(0, 256, (1, one), np.uint8)
+    szs = np.array([one], np.uint16)
+    enc = roundtrip(
+        rows, szs, np.arange(1, dtype=np.int64), 1, 0, stride=one
+    )
+    assert len(enc) == 0xFFFF
+    # native decode with max_n == txn_cnt exactly; max_n - 1 refuses
+    drows = np.zeros((1, one), np.uint8)
+    dszs = np.zeros(1, np.uint32)
+    assert R._lib.fdt_mb_decode(
+        np.ascontiguousarray(enc).ctypes.data, len(enc),
+        drows.ctypes.data, one, dszs.ctypes.data, 0,
+    ) == -1
+
+
 def _acct(i: int) -> bytes:
     return bytes([i]) + bytes(31)
 

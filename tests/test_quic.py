@@ -338,3 +338,78 @@ def test_migration_unknown_dcid_ignored():
     assert server.on_datagram(fake, ("6.6.6.6", 6)) is None
     assert server.migrations == 0
     assert ("6.6.6.6", 6) not in server.by_addr
+
+
+def test_quic_tile_batch_ingest_matches_per_txn_path():
+    """ISSUE 11 satellite: `_ingest_batch` parses + trailers a whole
+    ingest batch in ONE native fdt_txn_scan call; the backlog bytes and
+    counters must be bit-identical to the old per-txn
+    T.parse/append_trailer path — including the reject split (parse
+    failures drop, compute-budget estimate failures still flow)."""
+    from firedancer_tpu.ballet import compute_budget as CB
+    from firedancer_tpu.ballet import txn as T
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import MuxCtx
+    from firedancer_tpu.tango import rings as R
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.quic import QuicIngressTile
+
+    rng = np.random.default_rng(41)
+
+    def build_txn(extra_instr=()):
+        payer = bytes(rng.integers(0, 256, 32, np.uint8))
+        dst = bytes(rng.integers(0, 256, 32, np.uint8))
+        sig = bytes(rng.integers(0, 256, 64, np.uint8))
+        data = (2).to_bytes(4, "little") + (777).to_bytes(8, "little")
+        keys = [payer, dst, bytes(32)] + [
+            k for k, _d in extra_instr
+        ]
+        instrs = [(2, [0, 1], data)] + [
+            (3 + i, [0], d) for i, (_k, d) in enumerate(extra_instr)
+        ]
+        return T.build(
+            [sig], keys, bytes(32), instrs, readonly_unsigned_cnt=1
+        )
+
+    good = [build_txn() for _ in range(6)]
+    # estimate-fail: duplicate SetComputeUnitLimit instructions — parses
+    # clean (T.parse) but the scan's compute-budget model rejects it
+    cb = CB.COMPUTE_BUDGET_PROGRAM_ID
+    est_fail = build_txn(
+        extra_instr=[
+            (cb, bytes([2]) + (1000).to_bytes(4, "little")),
+            (cb, bytes([2]) + (2000).to_bytes(4, "little")),
+        ]
+    )
+    assert T.parse(est_fail) is not None
+    garbage = b"\x01" + bytes(20)  # parse failure
+    raws = good[:3] + [garbage, est_fail] + good[3:]
+
+    def run(batched: bool):
+        qt = QuicIngressTile(b"\x07" * 32)
+        schema = qt.schema.with_base()
+        ctx = MuxCtx(
+            "quic", R.CNC(np.zeros(R.CNC.footprint(), np.uint8)), [], [],
+            Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema),
+        )
+        if batched:
+            qt._ingest_batch(ctx, raws, "rx_txns_udp")
+        else:
+            for raw in raws:  # the old per-txn reference semantics
+                desc = T.parse(raw)
+                if desc is None:
+                    ctx.metrics.inc("parse_fail_txns")
+                    continue
+                qt._backlog.append(wire.append_trailer(raw, desc))
+                ctx.metrics.inc("rx_txns_udp")
+        return qt._backlog, {
+            k: ctx.metrics.counter(k)
+            for k in ("rx_txns_udp", "parse_fail_txns")
+        }
+
+    g_log, g_c = run(False)
+    n_log, n_c = run(True)
+    assert g_c == n_c == {"rx_txns_udp": 7, "parse_fail_txns": 1}
+    assert len(g_log) == len(n_log) == 7
+    for a, b in zip(g_log, n_log):
+        assert bytes(a) == bytes(b), "trailer bytes diverged"
