@@ -38,6 +38,10 @@ BINDING_MODULES = [
     "firedancer_tpu/tiles/pack.py",
     "firedancer_tpu/tiles/bank.py",
     "firedancer_tpu/flamenco/runtime.py",  # fdt_bank_* batch executor
+    # block-egress natives (ISSUE 12): route-cache seeding + the
+    # batched-datagram egress syscall
+    "firedancer_tpu/tiles/net.py",
+    "firedancer_tpu/tiles/quic.py",
 ]
 
 #: directories the ring-discipline linter covers (the tile layer)

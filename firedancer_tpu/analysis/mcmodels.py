@@ -150,6 +150,12 @@ def _producer(env: Env, mc, dc, fseqs, *, seq0: int, n: int, cr_max: int,
             # exactly the fault
             if env.mutation == "pack-sched-stale-credit":
                 cr = env.scratch.setdefault("pack_stale_cr", cr)
+            # the shred-outq-stale-credit mutant models a QUEUE-DRAIN
+            # publisher (fdt_shred_drain's shape) that trusts its first
+            # cr_avail read across every later drain round — the same
+            # stale-credit fault through a different hook boundary
+            if env.mutation == "shred-outq-stale-credit":
+                cr = env.scratch.setdefault("shred_stale_cr", cr)
             if cr == 0:
                 # scheduling hint only; credits are re-read through the
                 # hooked ops above once runnable (a leak-mutated cr_avail
@@ -162,10 +168,16 @@ def _producer(env: Env, mc, dc, fseqs, *, seq0: int, n: int, cr_max: int,
             # the stem-burst-over-credit mutant models a BURST publisher
             # (the native stem's shape) that trusts the one credit read
             # above for cr+1 publishes instead of re-reading per sweep —
-            # CreditBound/overrun must catch it on any schedule
+            # CreditBound/overrun must catch it on any schedule.  The
+            # poh-emit-over-credit mutant is the same fault through the
+            # after-credit EMITTER boundary (fdt_poh_tick publishes a
+            # tick entry plus slot-boundary entries against one gate
+            # check) — modeled identically: cr+1 publishes per round.
             burst = (
                 cr + 1
-                if env.mutation == "stem-burst-over-credit"
+                if env.mutation in (
+                    "stem-burst-over-credit", "poh-emit-over-credit"
+                )
                 else 1
             )
             for _ in range(min(burst, n - done)):

@@ -271,6 +271,15 @@ MC_HOOKED_NATIVES = {
     # surface (fseq query + cr_avail + mcache publish) — any direct
     # Python call site must sit behind the guard like fdt_stem_run's
     "fdt_pack_sched",
+    # block-egress hook/handler bodies (ISSUE 12): each publishes to an
+    # out mcache / reads consumer fseqs, so a direct Python call site
+    # would hide shared-memory ring ops from the fdtmc scheduler
+    "fdt_poh_tick",
+    "fdt_poh_mixins",
+    "fdt_shred_drain",
+    "fdt_net_rx",
+    "fdt_stem_out_emit",
+    "fdt_stem_out_cr",
 }
 
 

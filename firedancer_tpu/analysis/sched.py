@@ -80,6 +80,22 @@ MUTATIONS = frozenset(
         # cr_avail from the live fseqs immediately before every
         # publish.
         "pack-sched-stale-credit",
+        # a multi-entry emitter (the native poh hook's shape, fdt_poh.c
+        # fdt_poh_tick: one tick entry plus slot-boundary entries per
+        # hook firing) publishes its whole emission against one credit
+        # read taken BEFORE the burst instead of gating the hook on a
+        # live re-derive at the boundary: publishes cr+1 entries per
+        # round (scenario-level).  The shipped stem re-derives the hook
+        # gate from the live consumer fseqs at every burst boundary.
+        "poh-emit-over-credit",
+        # a queue-drain publisher (the native shred hook's shape,
+        # fdt_shred.c fdt_shred_drain: the pick-ordered _outq drain)
+        # trusts ONE cr_avail read across every later drain round
+        # instead of re-reading per round: the stale first read admits
+        # a publish every round regardless of consumer progress
+        # (scenario-level).  The shipped drain re-reads
+        # fdt_stem_out_cr before every publish round.
+        "shred-outq-stale-credit",
         # drain's overrun resync uses the pre-PR-3 clamp-to-zero formula
         # (wrong at seq wrap-around)
         "drain-resync-zero",

@@ -381,6 +381,53 @@ class Tile:
         resynced separately via the rejoin helpers."""
 
 
+def drain_straggler_ins(
+    tile: "Tile",
+    ctx: "MuxCtx",
+    *,
+    only: tuple | None = None,
+    budget: int | None = None,
+    deadline_s: float | None = None,
+    default_budget: int = 4096,
+) -> int:
+    """Post-HALT straggler drain shared by egress tiles (poh, shred):
+    sweep the in-links through tile.on_frags with the standard overrun
+    accounting (metered + fseq-diag'd, the fdtlint ring-overrun
+    discipline), bounded per sweep by the outs' credit headroom.
+
+    `only` restricts the sweep to those in-link indices (shred's halt
+    loop drains just the sign-response ring); `budget` overrides the
+    credit-derived bound.  With `deadline_s` the sweep repeats until a
+    full pass drains nothing or the deadline passes; without it one
+    sweep runs.  Returns frags drained by the final sweep."""
+    deadline = (
+        time.monotonic() + deadline_s if deadline_s is not None else None
+    )
+    got = 0
+    while True:
+        got = 0
+        idxs = range(len(ctx.ins)) if only is None else only
+        for i in idxs:
+            il = ctx.ins[i]
+            b = budget
+            if b is None:
+                b = min(
+                    (o.cr_avail() for o in ctx.outs),
+                    default=default_budget,
+                )
+            if b <= 0:
+                break
+            frags, il.seq, ovr = il.mcache.drain(il.seq, b)
+            if ovr:
+                ctx.metrics.inc("overrun_frags", ovr)
+                il.fseq.diag_add(0, ovr)
+            if len(frags):
+                got += len(frags)
+                tile.on_frags(ctx, i, frags)
+        if deadline is None or got == 0 or time.monotonic() >= deadline:
+            return got
+
+
 def _stem_apply(ctx, m, stem, spec, tracer, faults, out_seq0, tspub) -> int:
     """Burst-boundary bookkeeping for one native stem call: the stem
     accumulated counter deltas, drained-frag metas and published-sig
@@ -487,15 +534,25 @@ def run_loop(
     # amnesty, fallback txns, frag-fault injection, in_budget tiles)
     stem_obj = None
     stem_spec = None
-    if stem == "native" and not tile.manual_credits:
+    if stem == "native":
         stem_spec = tile.native_handler(ctx)
+        # a manual-credit tile (shred <-> keyguard ring cycle) may run
+        # the stem ONLY when its spec declares the manual discipline:
+        # handlers never publish from the frag path, and the after-
+        # credit hook gates each ring on its own cr_avail
+        if (
+            stem_spec is not None
+            and tile.manual_credits
+            and not stem_spec.manual
+        ):
+            stem_spec = None
         if stem_spec is not None:
             try:
                 stem_obj = R.Stem(
                     ctx.ins, ctx.outs, stem_spec, cap=batch_max
                 )
             except ValueError:
-                # unsupported shape (> 4 ins / 8 outs / 4 reliable
+                # unsupported shape (> 8 ins / 8 outs / 4 reliable
                 # consumers per out): the Python loop is always correct
                 stem_obj = None
                 stem_spec = None

@@ -1013,7 +1013,11 @@ int64_t fdt_udp_recv_burst( int fd, uint8_t * rows, int64_t stride,
       msgs[ i ].msg_hdr.msg_name = &addrs[ i ];
       msgs[ i ].msg_hdr.msg_namelen = sizeof( struct sockaddr_in );
     }
-    int got = recvmmsg( fd, msgs, (unsigned)want, MSG_DONTWAIT, 0 );
+    /* MSG_TRUNC: msg_len reports the REAL datagram length even past
+       the iov budget, so callers can meter oversize drops instead of
+       silently forwarding a truncated packet (tiles/net.py parity) */
+    int got = recvmmsg( fd, msgs, (unsigned)want,
+                        MSG_DONTWAIT | MSG_TRUNC, 0 );
     if( got <= 0 ) break;
     for( int i = 0; i < got; i++ ) {
       uint8_t * row = rows + ( total + i ) * stride;

@@ -217,7 +217,10 @@ int64_t fdt_mb_decode( uint8_t const * buf, int64_t sz,
 
 /* Burst UDP I/O over recvmmsg/sendmmsg (one syscall per burst).
    recv: writes [4B ip | 2B port LE | payload] at rows[i*stride]; szs[i] =
-   6 + payload len.  send: addrs == NULL reads the same 6-byte prefix per
+   6 + payload len — MSG_TRUNC semantics: a datagram larger than the
+   per-row budget reports its REAL length (szs[i] > mtu), so callers
+   meter it as an oversize drop instead of forwarding a truncated
+   packet.  send: addrs == NULL reads the same 6-byte prefix per
    row (payload follows); else addrs is one 6-byte destination for all
    rows (payload at offset 0).  Both return packets moved (0 on EAGAIN). */
 int64_t fdt_udp_recv_burst( int fd, uint8_t * rows, int64_t stride,

@@ -1,0 +1,158 @@
+/* fdt_poh.c — implementation.  See fdt_poh.h for the design notes and
+   crash discipline.  Original implementation: the two loop halves of
+   tiles/poh.py restated over fdt_sha256 primitives, publishing through
+   the stem's shared out-block helpers so the ring discipline cannot
+   fork from the other native handlers. */
+
+#include "fdt_poh.h"
+
+#include "fdt_sha256.h"
+#include "fdt_stem.h"
+#include "fdt_tango.h"
+
+#include <stdatomic.h>
+#include <string.h>
+
+/* ctrs indices (PohTile.native_handler maps these to counter names) */
+#define PC_HASHCNT 0
+#define PC_MIXINS 1
+#define PC_ENTRIES 2
+#define PC_SLOTS 3
+#define PC_LEADER 4
+#define PC_REPLAYED 5
+
+static inline int64_t sdelta( uint64_t a, uint64_t b ) {
+  return (int64_t)( a - b );
+}
+
+/* build one 104-byte entry into scratch: prev | hashcnt u64 LE | mix |
+   state (tiles/poh.py ENTRY layout, byte-identical) */
+static void entry_build( uint8_t * scratch, uint8_t const * prev,
+                         uint64_t hashcnt, uint8_t const * mix,
+                         uint8_t const * state ) {
+  memcpy( scratch, prev, 32 );
+  for( int i = 0; i < 8; i++ )
+    scratch[ 32 + i ] = (uint8_t)( hashcnt >> ( 8 * i ) );
+  if( mix ) memcpy( scratch + 40, mix, 32 );
+  else memset( scratch + 40, 0, 32 );
+  memcpy( scratch + 72, state, 32 );
+}
+
+int64_t fdt_poh_mixins( uint64_t * args, uint64_t * outs,
+                        int64_t sig_cap, uint64_t tspub, uint64_t * ctrs,
+                        uint8_t const * in_dc, void const * frags,
+                        int64_t n, int64_t in_idx ) {
+  uint8_t * state = (uint8_t *)args[ FDT_POH_A_STATE ];
+  int64_t * w = (int64_t *)args[ FDT_POH_A_WORDS ];
+  uint64_t * j = (uint64_t *)args[ FDT_POH_A_JNL ];
+  uint8_t * scratch = (uint8_t *)args[ FDT_POH_A_SCRATCH ];
+  uint8_t * jprev = (uint8_t *)( j + FDT_POH_J_PREV );
+  uint8_t * jmix = (uint8_t *)( j + FDT_POH_J_MIX );
+  fdt_frag_t const * f = (fdt_frag_t const *)frags;
+
+  for( int64_t k = 0; k < n; k++ ) {
+    /* supervisor replay below the consumed high-water mark: this
+       microblock was mixed (and its entry published) by a previous
+       incarnation — exactly-once means skip, metered */
+    uint64_t hw = (uint64_t)w[ FDT_POH_W_HW0 + in_idx ];
+    if( hw && sdelta( f[ k ].seq + 1UL, hw ) <= 0 ) {
+      ctrs[ PC_REPLAYED ]++;
+      continue;
+    }
+    uint8_t const * mb = in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ;
+    /* arm the journal BEFORE mutating the chain: a kill anywhere past
+       this point recovers by re-deriving the emission from (prev, mix)
+       and comparing the out seq (PohTile._recover) */
+    fdt_sha256( mb, f[ k ].sz, jmix );
+    memcpy( jprev, state, 32 );
+    j[ FDT_POH_J_INIDX ] = (uint64_t)in_idx;
+    j[ FDT_POH_J_INSEQ ] = f[ k ].seq;
+    j[ FDT_POH_J_OUTSEQ0 ] = outs[ FDT_STEM_O_SEQ ];
+    j[ FDT_POH_J_HASHCNT ] = (uint64_t)w[ FDT_POH_W_HASHCNT ];
+    __atomic_store_n( &j[ FDT_POH_J_PHASE ], 1UL, __ATOMIC_RELEASE );
+
+    fdt_sha256_mix( jprev, jmix, state );
+    w[ FDT_POH_W_HASHCNT ]++;
+    entry_build( scratch, jprev, 1UL, jmix, state );
+    fdt_stem_out_emit( outs, 1UL, scratch, FDT_POH_ENTRY_SZ,
+                       (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                       (uint32_t)tspub, (uint32_t)tspub, sig_cap );
+    w[ FDT_POH_W_HW0 + in_idx ] = (int64_t)( f[ k ].seq + 1UL );
+    __atomic_store_n( &j[ FDT_POH_J_PHASE ], 0UL, __ATOMIC_RELEASE );
+    ctrs[ PC_HASHCNT ]++;
+    ctrs[ PC_MIXINS ]++;
+    ctrs[ PC_ENTRIES ]++;
+  }
+  return n;
+}
+
+int64_t fdt_poh_tick( uint64_t * args, uint64_t * outs, int64_t sig_cap,
+                      int64_t now_ns, uint64_t tspub, uint64_t * ctrs ) {
+  uint8_t * state = (uint8_t *)args[ FDT_POH_A_STATE ];
+  int64_t * w = (int64_t *)args[ FDT_POH_A_WORDS ];
+  uint64_t * j = (uint64_t *)args[ FDT_POH_A_JNL ];
+  uint8_t * scratch = (uint8_t *)args[ FDT_POH_A_SCRATCH ];
+  uint8_t * jprev = (uint8_t *)( j + FDT_POH_J_PREV );
+
+  int64_t interval = w[ FDT_POH_W_INTERVAL ];
+  int64_t tb = w[ FDT_POH_W_TICK_BATCH ];
+  int64_t tps = w[ FDT_POH_W_TICKS_PER_SLOT ];
+  if( interval && now_ns < w[ FDT_POH_W_NEXT_NS ] ) return 0;
+  /* one firing emits the tick entry PLUS every slot-boundary entry the
+     batch crosses: gate on the whole emission against a LIVE credit
+     read, or a boundary firing at cr==1 would overrun a reliable
+     consumer (the poh-emit-over-credit mutant class).  The pacing
+     deadline is only re-armed once the firing is admitted, so a
+     credit-starved tick retries next boundary instead of skipping. */
+  int64_t needed = 1 + ( w[ FDT_POH_W_TICKS ] + tb ) / tps;
+  if( fdt_stem_out_cr( outs ) < needed ) return 0;
+  if( interval ) {
+    /* the Python pacing rule bit-for-bit: late by > 1 s re-anchors to
+       now, else the cadence stays phase-locked */
+    int64_t next = w[ FDT_POH_W_NEXT_NS ];
+    w[ FDT_POH_W_NEXT_NS ] =
+        ( now_ns - next > 1000000000LL ) ? now_ns + interval
+                                         : next + interval;
+  }
+
+  memcpy( jprev, state, 32 );
+  j[ FDT_POH_J_OUTSEQ0 ] = outs[ FDT_STEM_O_SEQ ];
+  j[ FDT_POH_J_HASHCNT ] = (uint64_t)w[ FDT_POH_W_HASHCNT ];
+  j[ FDT_POH_J_TICKS ] = (uint64_t)w[ FDT_POH_W_TICKS ];
+  j[ FDT_POH_J_SLOT ] = (uint64_t)w[ FDT_POH_W_SLOT ];
+  j[ FDT_POH_J_TB ] = (uint64_t)tb;
+  j[ FDT_POH_J_TPS ] = (uint64_t)tps;
+  __atomic_store_n( &j[ FDT_POH_J_PHASE ], 2UL, __ATOMIC_RELEASE );
+
+  fdt_sha256_append( state, (uint64_t)tb );
+  w[ FDT_POH_W_HASHCNT ] += tb;
+  ctrs[ PC_HASHCNT ] += (uint64_t)tb;
+  entry_build( scratch, jprev, (uint64_t)tb, 0, state );
+  fdt_stem_out_emit( outs, (uint64_t)( tb ? tb : 1 ), scratch,
+                     FDT_POH_ENTRY_SZ,
+                     (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                     (uint32_t)tspub, (uint32_t)tspub, sig_cap );
+  ctrs[ PC_ENTRIES ]++;
+  int64_t published = 1;
+
+  int64_t ticks = w[ FDT_POH_W_TICKS ] + tb;
+  int64_t slot = w[ FDT_POH_W_SLOT ];
+  while( ticks >= tps ) {
+    ticks -= tps;
+    slot++;
+    ctrs[ PC_SLOTS ]++;
+    ctrs[ PC_LEADER ]++; /* always-leader (native requirement) */
+    entry_build( scratch, state, 0UL, 0, state );
+    fdt_stem_out_emit( outs,
+                       FDT_POH_BOUNDARY_TAG | (uint64_t)slot, scratch,
+                       FDT_POH_ENTRY_SZ,
+                       (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                       (uint32_t)tspub, (uint32_t)tspub, sig_cap );
+    ctrs[ PC_ENTRIES ]++;
+    published++;
+  }
+  w[ FDT_POH_W_TICKS ] = ticks;
+  w[ FDT_POH_W_SLOT ] = slot;
+  __atomic_store_n( &j[ FDT_POH_J_PHASE ], 0UL, __ATOMIC_RELEASE );
+  return published;
+}

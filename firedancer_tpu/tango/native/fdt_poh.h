@@ -1,0 +1,97 @@
+/* fdt_poh.h — native PoH block-egress backend (ISSUE 12).
+ *
+ * Reference model (behavior contract; implementation original):
+ * src/app/fdctl/run/tiles/fd_poh.c — the validator's one sequential
+ * component iterates state = SHA256(state) on a dedicated core, mixes
+ * executed microblocks into the chain while leader, and tracks the
+ * slot boundary every ticks_per_slot ticks.  This build's PohTile ran
+ * that ladder through per-row Python hashlib calls; these entry points
+ * are the tile's two loop halves restated in C, bit-identical to
+ * tiles/poh.py by contract and by test:
+ *
+ *   fdt_poh_mixins — the on_frags path: per microblock frag, mix =
+ *     SHA256(mb), state = SHA256(prev || mix), emit a 104-byte entry
+ *     (prev | hashcnt u64 | mix | state) with sig 1.  Invoked by the
+ *     stem's FDT_STEM_H_POH frag handler.
+ *   fdt_poh_tick — the after_credit path as a stem after-credit hook
+ *     (the fdt_pack_sched shape): pace on the monotonic clock, advance
+ *     the ladder tick_batch steps, emit the tick entry, then run the
+ *     slot state machine (slot-boundary entries with sig =
+ *     SLOT_BOUNDARY_TAG | slot).
+ *
+ * Crash discipline (the chaos bar): the chain state, pacing words and
+ * per-in consumed high-water marks live in SHARED memory (the tile's
+ * workspace arena in the process runtime), and every emission arms a
+ * small journal — pre-state, mix, in-seq, out-seq — with release
+ * ordering BEFORE mutating the chain.  A SIGKILL anywhere inside the
+ * window is recovered by PohTile.on_boot: restore the pre-state,
+ * re-derive the emission deterministically, skip the publishes the out
+ * mcache already carries (producer_rejoin completed any interrupted
+ * one), and advance the high-water mark — so a supervisor replay
+ * re-mixes nothing (exactly-once per microblock, entry stream gapless).
+ *
+ * The native path asserts always-leader (words[W_LEADER]): a leader
+ * schedule is host-side Python state, so topologies with one keep the
+ * Python loop (PohTile.native_handler returns None). */
+
+#ifndef FDT_POH_H
+#define FDT_POH_H
+
+#include <stdint.h>
+
+/* args block u64 word indices (built by PohTile.native_handler) */
+#define FDT_POH_A_STATE 0   /* u8[32] chain state (shm) */
+#define FDT_POH_A_WORDS 1   /* i64[FDT_POH_W_CNT] shared words (shm) */
+#define FDT_POH_A_JNL 2     /* u64[24] journal block (shm) */
+#define FDT_POH_A_SCRATCH 3 /* u8[104] entry build scratch */
+
+/* shared words (i64, shm — both loop modes mutate the SAME words) */
+#define FDT_POH_W_HASHCNT 0
+#define FDT_POH_W_SLOT 1
+#define FDT_POH_W_TICKS 2      /* ticks_in_slot */
+#define FDT_POH_W_NEXT_NS 3    /* next tick-batch deadline (0 = now) */
+#define FDT_POH_W_INTERVAL 4   /* ns between tick batches (0 = unpaced) */
+#define FDT_POH_W_TICK_BATCH 5
+#define FDT_POH_W_TICKS_PER_SLOT 6
+#define FDT_POH_W_LEADER 7 /* 1 = always-leader (native requirement) */
+#define FDT_POH_W_HW0 8    /* per-in consumed seq high-water + 1, 8..15 */
+/* word 16 is the Python-side init magic (never read by C) */
+#define FDT_POH_W_CNT 24
+
+/* journal words (u64; prev/mix bytes from word 8) */
+#define FDT_POH_J_PHASE 0 /* 0 idle, 1 mixin, 2 tick batch */
+#define FDT_POH_J_INIDX 1
+#define FDT_POH_J_INSEQ 2
+#define FDT_POH_J_OUTSEQ0 3
+#define FDT_POH_J_HASHCNT 4 /* pre-emission hashcnt */
+#define FDT_POH_J_TICKS 5   /* pre-emission ticks_in_slot */
+#define FDT_POH_J_SLOT 6    /* pre-emission slot */
+#define FDT_POH_J_PREV 8    /* u8[32] at word 8 */
+#define FDT_POH_J_MIX 12    /* u8[32] at word 12 */
+#define FDT_POH_J_TB 16  /* tick_batch AT ARM TIME: recovery re-derives
+                            with the dead incarnation's config, not the
+                            (possibly changed) restart's */
+#define FDT_POH_J_TPS 17 /* ticks_per_slot at arm time */
+#define FDT_POH_J_WORDS 24
+
+#define FDT_POH_ENTRY_SZ 104
+#define FDT_POH_BOUNDARY_TAG 0x8000000000000000UL
+
+/* Frag handler body: drain-run of n microblock frags from in_dc.
+   Returns frags handled (always n; replays below the high-water mark
+   are counted into ctrs and skipped).  ctrs layout (mapped to tile
+   counter names by PohTile.native_handler): 0 hashcnt, 1 mixins,
+   2 entries, 3 slots, 4 leader_slots, 5 replayed_mixins. */
+int64_t fdt_poh_mixins( uint64_t * args, uint64_t * outs,
+                        int64_t sig_cap, uint64_t tspub, uint64_t * ctrs,
+                        uint8_t const * in_dc, void const * frags,
+                        int64_t n, int64_t in_idx );
+
+/* After-credit hook body: one paced tick batch + slot state machine.
+   Returns entries published (0 when the pacing deadline has not
+   arrived).  The caller gates on credit exactly like the Python loop
+   gates after_credit (cr re-derived at the hook boundary). */
+int64_t fdt_poh_tick( uint64_t * args, uint64_t * outs, int64_t sig_cap,
+                      int64_t now_ns, uint64_t tspub, uint64_t * ctrs );
+
+#endif /* FDT_POH_H */

@@ -1,0 +1,127 @@
+/* fdt_sha256.c — implementation.  See fdt_sha256.h for why this exists.
+   Plain FIPS 180-4 compression written fresh (like fdt_sha512.c); the
+   fused/iterated entry points exist because the PoH chain's inputs are
+   fixed-shape (32- and 64-byte) messages whose padding blocks are
+   known at compile time. */
+
+#include "fdt_sha256.h"
+
+#include <string.h>
+
+static uint32_t SHA256_K[ 64 ];
+static uint32_t SHA256_H0[ 8 ];
+
+void fdt_sha256_init_consts( uint32_t const * k64, uint32_t const * h8 ) {
+  memcpy( SHA256_K, k64, sizeof( SHA256_K ) );
+  memcpy( SHA256_H0, h8, sizeof( SHA256_H0 ) );
+}
+
+static inline uint32_t ror32( uint32_t x, int n ) {
+  return ( x >> n ) | ( x << ( 32 - n ) );
+}
+
+static inline uint32_t be32( uint8_t const * p ) {
+  return ( (uint32_t)p[ 0 ] << 24 ) | ( (uint32_t)p[ 1 ] << 16 ) |
+         ( (uint32_t)p[ 2 ] << 8 ) | (uint32_t)p[ 3 ];
+}
+
+static inline void st32be( uint8_t * p, uint32_t v ) {
+  p[ 0 ] = (uint8_t)( v >> 24 );
+  p[ 1 ] = (uint8_t)( v >> 16 );
+  p[ 2 ] = (uint8_t)( v >> 8 );
+  p[ 3 ] = (uint8_t)v;
+}
+
+static void sha256_compress( uint32_t st[ 8 ], uint8_t const blk[ 64 ] ) {
+  uint32_t w[ 64 ];
+  for( int t = 0; t < 16; t++ ) w[ t ] = be32( blk + 4 * t );
+  for( int t = 16; t < 64; t++ ) {
+    uint32_t s0 = ror32( w[ t - 15 ], 7 ) ^ ror32( w[ t - 15 ], 18 ) ^
+                  ( w[ t - 15 ] >> 3 );
+    uint32_t s1 = ror32( w[ t - 2 ], 17 ) ^ ror32( w[ t - 2 ], 19 ) ^
+                  ( w[ t - 2 ] >> 10 );
+    w[ t ] = w[ t - 16 ] + s0 + w[ t - 7 ] + s1;
+  }
+  uint32_t a = st[ 0 ], b = st[ 1 ], c = st[ 2 ], d = st[ 3 ];
+  uint32_t e = st[ 4 ], f = st[ 5 ], g = st[ 6 ], h = st[ 7 ];
+  for( int t = 0; t < 64; t++ ) {
+    uint32_t S1 = ror32( e, 6 ) ^ ror32( e, 11 ) ^ ror32( e, 25 );
+    uint32_t ch = ( e & f ) ^ ( ~e & g );
+    uint32_t t1 = h + S1 + ch + SHA256_K[ t ] + w[ t ];
+    uint32_t S0 = ror32( a, 2 ) ^ ror32( a, 13 ) ^ ror32( a, 22 );
+    uint32_t mj = ( a & b ) ^ ( a & c ) ^ ( b & c );
+    uint32_t t2 = S0 + mj;
+    h = g;
+    g = f;
+    f = e;
+    e = d + t1;
+    d = c;
+    c = b;
+    b = a;
+    a = t1 + t2;
+  }
+  st[ 0 ] += a;
+  st[ 1 ] += b;
+  st[ 2 ] += c;
+  st[ 3 ] += d;
+  st[ 4 ] += e;
+  st[ 5 ] += f;
+  st[ 6 ] += g;
+  st[ 7 ] += h;
+}
+
+void fdt_sha256( uint8_t const * msg, uint64_t sz, uint8_t * out32 ) {
+  uint32_t st[ 8 ];
+  memcpy( st, SHA256_H0, sizeof( st ) );
+  uint64_t off = 0;
+  while( sz - off >= 64 ) {
+    sha256_compress( st, msg + off );
+    off += 64;
+  }
+  uint8_t blk[ 128 ];
+  uint64_t rem = sz - off;
+  memcpy( blk, msg + off, rem );
+  memset( blk + rem, 0, sizeof( blk ) - rem );
+  blk[ rem ] = 0x80;
+  uint64_t bits = sz * 8;
+  uint64_t last = ( rem < 56 ) ? 64 : 128;
+  for( int i = 0; i < 8; i++ )
+    blk[ last - 1 - i ] = (uint8_t)( bits >> ( 8 * i ) );
+  sha256_compress( st, blk );
+  if( last == 128 ) sha256_compress( st, blk + 64 );
+  for( int i = 0; i < 8; i++ ) st32be( out32 + 4 * i, st[ i ] );
+}
+
+void fdt_sha256_mix( uint8_t const * prev32, uint8_t const * mix32,
+                     uint8_t * out32 ) {
+  /* message = prev || mix (64 bytes): one full block + the fixed
+     padding block 0x80 0...0 len=512bits */
+  uint32_t st[ 8 ];
+  memcpy( st, SHA256_H0, sizeof( st ) );
+  uint8_t blk[ 64 ];
+  memcpy( blk, prev32, 32 );
+  memcpy( blk + 32, mix32, 32 );
+  sha256_compress( st, blk );
+  memset( blk, 0, 64 );
+  blk[ 0 ] = 0x80;
+  blk[ 62 ] = 0x02; /* 512 bits, big-endian */
+  sha256_compress( st, blk );
+  for( int i = 0; i < 8; i++ ) st32be( out32 + 4 * i, st[ i ] );
+}
+
+void fdt_sha256_append( uint8_t * state32, uint64_t n ) {
+  /* each step hashes exactly 32 bytes: one padded block */
+  uint8_t blk[ 64 ];
+  memset( blk + 33, 0, 29 );
+  blk[ 32 ] = 0x80;
+  blk[ 62 ] = 0x01; /* 256 bits, big-endian */
+  blk[ 63 ] = 0x00;
+  memcpy( blk, state32, 32 );
+  for( uint64_t i = 0; i < n; i++ ) {
+    uint32_t st[ 8 ];
+    memcpy( st, SHA256_H0, sizeof( st ) );
+    sha256_compress( st, blk );
+    for( int j = 0; j < 8; j++ ) st32be( blk + 4 * j, st[ j ] );
+  }
+  memcpy( state32, blk, 32 );
+}

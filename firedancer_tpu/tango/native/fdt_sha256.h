@@ -1,0 +1,36 @@
+/* fdt_sha256.h — host-side SHA-256 for the PoH chain (ISSUE 12).
+ *
+ * The PoH tile's ladder is the validator's one strictly sequential
+ * component (reference: src/app/fdctl/run/tiles/fd_poh.c burns a
+ * dedicated core on it; src/ballet/sha256/ is its SHA-NI hasher).  On
+ * this build the chain ran through per-row Python hashlib calls —
+ * interpreter dispatch dominating a ~100 ns hash.  These entry points
+ * give the native poh stem handler (fdt_poh.c) its three shapes:
+ *
+ *   fdt_sha256        — one-shot streaming hash (microblock -> mixin)
+ *   fdt_sha256_mix    — fused two-block hash of prev32 || mix32 (the
+ *                       64-byte mix-in is exactly one message block
+ *                       plus the padding block; no buffering)
+ *   fdt_sha256_append — state = SHA256(state), n times in place (the
+ *                       tick ladder; each 32-byte input is one padded
+ *                       block, so the whole batch stays in registers)
+ *
+ * Round constants are injected at load time by the Python binding
+ * (utils/shaconst.py derives them from prime roots) — no constant
+ * block exists in C, matching the fdt_sha512.c convention. */
+
+#ifndef FDT_SHA256_H
+#define FDT_SHA256_H
+
+#include <stdint.h>
+
+void fdt_sha256_init_consts( uint32_t const * k64, uint32_t const * h8 );
+
+void fdt_sha256( uint8_t const * msg, uint64_t sz, uint8_t * out32 );
+
+void fdt_sha256_mix( uint8_t const * prev32, uint8_t const * mix32,
+                     uint8_t * out32 );
+
+void fdt_sha256_append( uint8_t * state32, uint64_t n );
+
+#endif /* FDT_SHA256_H */

@@ -1,0 +1,164 @@
+/* fdt_shred.c — implementation.  See fdt_shred.h for the design notes.
+   Original implementation: tiles/shred.py's per-frag paths restated
+   over the stem's shared out-block helpers; ring/queue state lives in
+   the shared words block so the Python loop and this code are two
+   drivers of ONE set of queues. */
+
+#include "fdt_shred.h"
+
+#include "fdt_stem.h"
+#include "fdt_tango.h"
+
+#include <stdatomic.h>
+#include <string.h>
+
+static inline int64_t sdelta( uint64_t a, uint64_t b ) {
+  return (int64_t)( a - b );
+}
+
+int64_t fdt_shred_entries( uint64_t * args, uint8_t const * in_dc,
+                          void const * frags, int64_t n,
+                          uint64_t * ctrs ) {
+  int64_t * w = (int64_t *)args[ FDT_SHRED_A_WORDS ];
+  uint8_t * batch = (uint8_t *)args[ FDT_SHRED_A_BATCH ];
+  int64_t cap = (int64_t)args[ FDT_SHRED_A_BATCH_CAP ];
+  fdt_frag_t const * f = (fdt_frag_t const *)frags;
+
+  for( int64_t k = 0; k < n; k++ ) {
+    uint64_t hw = (uint64_t)w[ FDT_SHRED_W_HW_ENT ];
+    if( hw && sdelta( f[ k ].seq + 1UL, hw ) <= 0 ) {
+      /* supervisor replay of an already-appended entry */
+      ctrs[ FDT_SHRED_C_REPLAYED ]++;
+      continue;
+    }
+    if( f[ k ].sig & 0x8000000000000000UL )
+      return ~k; /* slot boundary: Python runs the shredder */
+    int64_t len = w[ FDT_SHRED_W_BATCH_LEN ];
+    if( len + (int64_t)f[ k ].sz > cap )
+      return ~k; /* batch overflow: Python spills */
+    if( w[ FDT_SHRED_W_SLOT ] < 0 ) w[ FDT_SHRED_W_SLOT ] = 0;
+    /* append journal: a kill between the byte copy and the len/hw
+       stores is resolved by ShredTile._recover comparing len against
+       the journaled pre-append length */
+    w[ FDT_SHRED_W_J_SEQ ] = (int64_t)f[ k ].seq;
+    w[ FDT_SHRED_W_J_LEN ] = len;
+    __atomic_store_n( (int64_t *)&w[ FDT_SHRED_W_J_PHASE ], 1L,
+                      __ATOMIC_RELEASE );
+    memcpy( batch + len,
+            in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ, f[ k ].sz );
+    w[ FDT_SHRED_W_BATCH_LEN ] = len + (int64_t)f[ k ].sz;
+    w[ FDT_SHRED_W_HW_ENT ] = (int64_t)( f[ k ].seq + 1UL );
+    __atomic_store_n( (int64_t *)&w[ FDT_SHRED_W_J_PHASE ], 0L,
+                      __ATOMIC_RELEASE );
+  }
+  return n;
+}
+
+int64_t fdt_shred_sign( uint64_t * args, uint8_t const * in_dc,
+                        void const * frags, int64_t n, uint64_t * ctrs ) {
+  int64_t * w = (int64_t *)args[ FDT_SHRED_A_WORDS ];
+  uint64_t * oq_tag = (uint64_t *)args[ FDT_SHRED_A_OQ_TAG ];
+  uint64_t * oq_sz = (uint64_t *)args[ FDT_SHRED_A_OQ_SZ ];
+  uint8_t * oq_rows = (uint8_t *)args[ FDT_SHRED_A_OQ_ROWS ];
+  int64_t q = (int64_t)args[ FDT_SHRED_A_OQ_CAP ];
+  uint64_t * pd_tag = (uint64_t *)args[ FDT_SHRED_A_PD_TAG ];
+  int64_t * pd_cnt = (int64_t *)args[ FDT_SHRED_A_PD_CNT ];
+  uint64_t * pd_tags = (uint64_t *)args[ FDT_SHRED_A_PD_TAGS ];
+  uint64_t * pd_szs = (uint64_t *)args[ FDT_SHRED_A_PD_SZS ];
+  uint8_t * pd_rows = (uint8_t *)args[ FDT_SHRED_A_PD_ROWS ];
+  int64_t pcap = (int64_t)args[ FDT_SHRED_A_PD_CAP ];
+  int64_t m = (int64_t)args[ FDT_SHRED_A_PD_MAX ];
+  int64_t row_w = (int64_t)args[ FDT_SHRED_A_ROW_W ];
+  fdt_frag_t const * f = (fdt_frag_t const *)frags;
+
+  for( int64_t k = 0; k < n; k++ ) {
+    uint64_t tag = f[ k ].sig;
+    int64_t p = -1;
+    for( int64_t i = 0; i < pcap; i++ )
+      if( pd_cnt[ i ] > 0 && pd_tag[ i ] == tag ) { p = i; break; }
+    if( p < 0 ) return ~k; /* Python-held set (or stale tag: ignored) */
+    int64_t cnt = pd_cnt[ p ];
+    int64_t used = w[ FDT_SHRED_W_OQ_TAIL ] - w[ FDT_SHRED_W_OQ_HEAD ];
+    if( q - used < cnt ) return k; /* out queue full: retry after drain */
+    uint8_t const * sig =
+        in_dc + (uint64_t)f[ k ].chunk * FDT_CHUNK_SZ; /* first 64B */
+    int64_t tail = w[ FDT_SHRED_W_OQ_TAIL ];
+    for( int64_t s = 0; s < cnt; s++ ) {
+      int64_t slot = tail & ( q - 1 );
+      uint8_t * row = oq_rows + slot * row_w;
+      memcpy( row, pd_rows + ( p * m + s ) * row_w, (uint64_t)row_w );
+      memcpy( row, sig, 64 ); /* the signature patch */
+      oq_tag[ slot ] = pd_tags[ p * m + s ];
+      oq_sz[ slot ] = pd_szs[ p * m + s ];
+      tail++;
+    }
+    __atomic_store_n( (int64_t *)&w[ FDT_SHRED_W_OQ_TAIL ], tail,
+                      __ATOMIC_RELEASE );
+    pd_cnt[ p ] = 0;
+    ctrs[ FDT_SHRED_C_SIGN_RESP ]++;
+  }
+  return n;
+}
+
+int64_t fdt_shred_drain( uint64_t * args, uint64_t * outs,
+                         int64_t n_outs, int64_t sig_cap, uint64_t tspub,
+                         uint64_t * ctrs ) {
+  int64_t * w = (int64_t *)args[ FDT_SHRED_A_WORDS ];
+  int64_t published = 0;
+
+  /* sign requests -> outs[1], within THAT ring's own credits (the
+     manual-credit discipline: the keyguard cycle must keep flowing
+     even when the shred ring is full) */
+  int64_t sq_head = w[ FDT_SHRED_W_SQ_HEAD ];
+  int64_t sq_tail = w[ FDT_SHRED_W_SQ_TAIL ];
+  if( sq_tail != sq_head && n_outs >= 2 ) {
+    uint64_t * ob = outs + FDT_STEM_OUT_STRIDE;
+    int64_t scap = (int64_t)args[ FDT_SHRED_A_SQ_CAP ];
+    uint64_t * sq_tag = (uint64_t *)args[ FDT_SHRED_A_SQ_TAG ];
+    uint8_t * sq_root = (uint8_t *)args[ FDT_SHRED_A_SQ_ROOT ];
+    uint64_t * sq_sz = (uint64_t *)args[ FDT_SHRED_A_SQ_SZ ];
+    int64_t cr = fdt_stem_out_cr( ob );
+    int64_t take = sq_tail - sq_head;
+    if( take > cr ) take = cr;
+    for( int64_t i = 0; i < take; i++ ) {
+      int64_t slot = sq_head & ( scap - 1 );
+      fdt_stem_out_emit( ob, sq_tag[ slot ], sq_root + slot * 32,
+                         sq_sz[ slot ],
+                         (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                         (uint32_t)tspub, (uint32_t)tspub, sig_cap );
+      sq_head++;
+    }
+    if( take > 0 ) {
+      w[ FDT_SHRED_W_SQ_HEAD ] = sq_head;
+      ctrs[ FDT_SHRED_C_SIGN_REQ ] += (uint64_t)take;
+      published += take;
+    }
+  }
+
+  /* signed shreds -> outs[0], per-round credit RE-READ (the
+     shred-outq-stale-credit mutant class: one stale cr_avail read
+     trusted across the whole drain) */
+  uint64_t * oq_tag = (uint64_t *)args[ FDT_SHRED_A_OQ_TAG ];
+  uint64_t * oq_sz = (uint64_t *)args[ FDT_SHRED_A_OQ_SZ ];
+  uint8_t * oq_rows = (uint8_t *)args[ FDT_SHRED_A_OQ_ROWS ];
+  int64_t q = (int64_t)args[ FDT_SHRED_A_OQ_CAP ];
+  int64_t row_w = (int64_t)args[ FDT_SHRED_A_ROW_W ];
+  int64_t head = w[ FDT_SHRED_W_OQ_HEAD ];
+  while( w[ FDT_SHRED_W_OQ_TAIL ] != head ) {
+    int64_t cr = fdt_stem_out_cr( outs );
+    if( cr <= 0 ) break;
+    int64_t take = w[ FDT_SHRED_W_OQ_TAIL ] - head;
+    if( take > cr ) take = cr;
+    for( int64_t i = 0; i < take; i++ ) {
+      int64_t slot = head & ( q - 1 );
+      fdt_stem_out_emit( outs, oq_tag[ slot ], oq_rows + slot * row_w,
+                         oq_sz[ slot ],
+                         (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                         (uint32_t)tspub, (uint32_t)tspub, sig_cap );
+      head++;
+    }
+    w[ FDT_SHRED_W_OQ_HEAD ] = head;
+    published += take;
+  }
+  return published;
+}

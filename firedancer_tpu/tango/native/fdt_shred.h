@@ -1,0 +1,92 @@
+/* fdt_shred.h — native shred-tile frag paths + queue drain (ISSUE 12).
+ *
+ * Reference model (behavior contract; implementation original):
+ * src/app/fdctl/run/tiles/fd_shred.c — while leader, turn the PoH
+ * entry stream into entry batches, shred each batch, sign every FEC
+ * set's merkle root, emit the signed shreds.  This build keeps the
+ * actual Reed-Solomon + merkle shredding a PYTHON slow path at slot
+ * boundaries (the PR 9 handback contract — it happens once per slot);
+ * what these entry points make native is everything per-frag:
+ *
+ *   fdt_shred_entries — ins[0]: append entry payloads to the batch
+ *     buffer (a slot-boundary tag hands the frag back to Python, which
+ *     runs the shredder and refills the pending store / sign queue).
+ *   fdt_shred_sign — ins[1]: keyguard sign responses — look the
+ *     request tag up in the dense pending store, patch the 64-byte
+ *     signature over every shred of the set (the merkle proof never
+ *     covers the signature, so late patching is sound — fd_shred.c's
+ *     own trick), and push the patched shreds onto the out queue.
+ *   fdt_shred_drain — the after-credit hook: publish queued sign
+ *     requests (outs[1]) and queued shreds (outs[0]), each gated on
+ *     that ring's OWN cr_avail re-read per round — the tile is
+ *     manual-credit (the shred <-> keyguard request/response cycle
+ *     would deadlock under a global gate, tiles/shred.py).
+ *
+ * The batch buffer, both queues and the pending store are dense shared
+ * arrays (the tile's workspace arena in the process runtime): the
+ * Python loop pushes/pops the SAME rings, so the two loop modes are
+ * interchangeable mid-run and a killed child's queues survive into the
+ * restarted incarnation.  Capacity overflows spill to Python-side
+ * state, which gates the stem off until drained (the dedup-amnesty
+ * pattern). */
+
+#ifndef FDT_SHRED_H
+#define FDT_SHRED_H
+
+#include <stdint.h>
+
+/* args block u64 word indices (built by ShredTile.native_handler) */
+#define FDT_SHRED_A_WORDS 0     /* i64[FDT_SHRED_W_CNT] (shm) */
+#define FDT_SHRED_A_BATCH 1     /* u8[batch_cap] (shm) */
+#define FDT_SHRED_A_BATCH_CAP 2
+#define FDT_SHRED_A_OQ_TAG 3    /* u64[Q] */
+#define FDT_SHRED_A_OQ_SZ 4     /* u64[Q] */
+#define FDT_SHRED_A_OQ_ROWS 5   /* u8[Q][row_w] */
+#define FDT_SHRED_A_OQ_CAP 6    /* Q, power of two */
+#define FDT_SHRED_A_SQ_TAG 7    /* u64[S] */
+#define FDT_SHRED_A_SQ_ROOT 8   /* u8[S][32] */
+#define FDT_SHRED_A_SQ_CAP 9    /* S, power of two */
+#define FDT_SHRED_A_PD_TAG 10   /* u64[P] request tags */
+#define FDT_SHRED_A_PD_CNT 11   /* i64[P], 0 = slot free */
+#define FDT_SHRED_A_PD_TAGS 12  /* u64[P][M] per-shred publish sigs */
+#define FDT_SHRED_A_PD_SZS 13   /* u64[P][M] */
+#define FDT_SHRED_A_PD_ROWS 14  /* u8[P][M][row_w] unsigned shreds */
+#define FDT_SHRED_A_PD_CAP 15   /* P */
+#define FDT_SHRED_A_PD_MAX 16   /* M, max shreds per FEC set */
+#define FDT_SHRED_A_ROW_W 17    /* shred row width (ballet MAX_SZ) */
+#define FDT_SHRED_A_SQ_SZ 18    /* u64[S] root sizes (bmtree roots are
+                                   20-byte nodes; wide nodes 32) */
+
+/* shared words (i64, shm; single writer = the shred tile) */
+#define FDT_SHRED_W_BATCH_LEN 0
+#define FDT_SHRED_W_SLOT 1 /* -1 = no slot yet (Python None) */
+#define FDT_SHRED_W_OQ_HEAD 2
+#define FDT_SHRED_W_OQ_TAIL 3
+#define FDT_SHRED_W_SQ_HEAD 4
+#define FDT_SHRED_W_SQ_TAIL 5
+#define FDT_SHRED_W_HW_ENT 6  /* entries-in consumed seq hw + 1 */
+#define FDT_SHRED_W_J_PHASE 7 /* append journal: armed during append */
+#define FDT_SHRED_W_J_SEQ 8
+#define FDT_SHRED_W_J_LEN 9 /* pre-append batch_len */
+#define FDT_SHRED_W_CNT 16
+
+/* ctrs indices (ShredTile.native_handler maps these to counters) */
+#define FDT_SHRED_C_SIGN_REQ 0
+#define FDT_SHRED_C_SIGN_RESP 1
+#define FDT_SHRED_C_REPLAYED 2
+
+/* Both frag-path bodies return the count of frags fully handled; a
+   NEGATIVE return ~k means "k handled, frag k needs the Python path"
+   (slot boundary / batch overflow / unknown tag).  A short POSITIVE
+   return (sign path, out-queue full) is plain chunking: the stem
+   rewinds and the after-credit drain frees space. */
+int64_t fdt_shred_entries( uint64_t * args, uint8_t const * in_dc,
+                           void const * frags, int64_t n,
+                           uint64_t * ctrs );
+int64_t fdt_shred_sign( uint64_t * args, uint8_t const * in_dc,
+                        void const * frags, int64_t n, uint64_t * ctrs );
+int64_t fdt_shred_drain( uint64_t * args, uint64_t * outs,
+                         int64_t n_outs, int64_t sig_cap, uint64_t tspub,
+                         uint64_t * ctrs );
+
+#endif /* FDT_SHRED_H */

@@ -13,7 +13,10 @@
 #include "fdt_stem.h"
 
 #include "fdt_bank.h"
+#include "fdt_net.h"
 #include "fdt_pack.h"
+#include "fdt_poh.h"
+#include "fdt_shred.h"
 #include "fdt_tango.h"
 
 #include <stdatomic.h>
@@ -40,6 +43,8 @@
 /* after-credit hook: id + args block (fdt_stem.h word 11/12) */
 #define C_AC 11
 #define C_AC_ARGS 12
+/* stem flags (fdt_stem.h word 13): FDT_STEM_F_* */
+#define C_FLAGS 13
 
 #define IN0 16
 #define IN_STRIDE 12
@@ -91,6 +96,8 @@ typedef struct {
   uint32_t tspub;
   uint64_t ac;        /* after-credit hook id (0 = none) */
   uint64_t * ac_args; /* hook args block (pack: FDT_PACK_SS_*) */
+  int manual;      /* manual-credit tile: skip the global credit gate
+                      (handlers never publish from the frag path) */
   int need_python; /* set by a handler: the NEXT unhandled frag needs
                       the Python path (fallback, eviction, assert) */
 } stem_t;
@@ -102,15 +109,16 @@ static inline uint64_t * out_blk( stem_t * st, int64_t o ) {
   return st->w + OUT0 + o * OUT_STRIDE;
 }
 
-/* Publish one frag on out o: payload (if any) goes into the out dcache
-   at the shared chunk cursor first (the ring-publish-order rule: bytes
-   before metadata), then the release-ordered mcache publish — the exact
-   op sequence OutLink.publish performs, so the wire stream is
-   bit-identical to the Python loop's. */
-static void stem_publish( stem_t * st, int64_t oi, uint64_t sig,
-                          uint8_t const * payload, uint64_t sz,
-                          uint32_t tsorig ) {
-  uint64_t * o = out_blk( st, oi );
+/* Publish one frag on an out block: payload (if any) goes into the out
+   dcache at the shared chunk cursor first (the ring-publish-order rule:
+   bytes before metadata), then the release-ordered mcache publish — the
+   exact op sequence OutLink.publish performs, so the wire stream is
+   bit-identical to the Python loop's.  Exported: the block-egress
+   handlers (fdt_poh.c / fdt_shred.c) publish through this one body. */
+void fdt_stem_out_emit( uint64_t * o, uint64_t sig,
+                        uint8_t const * payload, uint64_t sz,
+                        uint16_t ctl, uint32_t tsorig, uint32_t tspub,
+                        int64_t sig_cap ) {
   uint32_t chunk = 0;
   if( payload && o[ O_DCACHE ] ) {
     uint64_t * cur = (uint64_t *)o[ O_CHUNKP ];
@@ -120,16 +128,40 @@ static void stem_publish( stem_t * st, int64_t oi, uint64_t sig,
     *cur = fdt_dcache_compact_next( c, sz, o[ O_MTU ], o[ O_WMARK ] );
   }
   fdt_mcache_publish( (void *)o[ O_MCACHE ], o[ O_SEQ ], sig, chunk,
-                      (uint16_t)sz, (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
-                      tsorig, st->tspub );
+                      (uint16_t)sz, ctl, tsorig, tspub );
   uint64_t p = o[ O_PUBLISHED ];
-  if( (int64_t)p < st->cap ) {
+  if( (int64_t)p < sig_cap ) {
     if( o[ O_SIGS ] ) ( (uint64_t *)o[ O_SIGS ] )[ p ] = sig;
     if( o[ O_TSORIGS ] ) ( (uint32_t *)o[ O_TSORIGS ] )[ p ] = tsorig;
   }
   o[ O_SEQ ] = o[ O_SEQ ] + 1UL;
   o[ O_PUBLISHED ] = p + 1UL;
   o[ O_BYTES ] += sz;
+}
+
+/* cr_avail for one out block against its slowest reliable consumer —
+   exported so the after-credit hooks gate every publish round on a
+   LIVE fseq read (the stale-credit mutant class). */
+int64_t fdt_stem_out_cr( uint64_t const * ob ) {
+  uint64_t nf = ob[ O_NFSEQ ];
+  uint64_t avail = ob[ O_DEPTH ];
+  if( nf ) {
+    uint64_t lo = fdt_fseq_query( (void *)ob[ O_FSEQ0 ] );
+    for( uint64_t j = 1; j < nf && j < 4; j++ ) {
+      uint64_t v = fdt_fseq_query( (void *)ob[ O_FSEQ0 + j ] );
+      if( seq_delta( v, lo ) < 0 ) lo = v;
+    }
+    avail = fdt_fctl_cr_avail( ob[ O_SEQ ], lo, ob[ O_DEPTH ] );
+  }
+  return (int64_t)avail;
+}
+
+static void stem_publish( stem_t * st, int64_t oi, uint64_t sig,
+                          uint8_t const * payload, uint64_t sz,
+                          uint32_t tsorig ) {
+  fdt_stem_out_emit( out_blk( st, oi ), sig, payload, sz,
+                     (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ), tsorig,
+                     st->tspub, st->cap );
 }
 
 /* ==== dedup handler ===================================================== */
@@ -644,6 +676,48 @@ static int64_t h_pack( stem_t * st, int64_t ii, fdt_frag_t const * f,
   return n;
 }
 
+/* ==== block-egress handlers (ISSUE 12) ================================== */
+
+/* poh — mixin ladder (fdt_poh.c): every drained microblock frag mixes
+   into the chain and emits one entry on outs[0].  The stem's per-sweep
+   credit bound already caps n at cr, so each emit is credit-backed. */
+static int64_t h_poh( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                      int64_t n ) {
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+  return fdt_poh_mixins( st->args, out_blk( st, 0 ), st->cap, st->tspub,
+                         st->ctrs, in_dc, f, n, ii );
+}
+
+/* shred — batch append (ins[0]) / signature patch (ins[1]); a negative
+   return from either body names a frag that needs the Python path
+   (slot-boundary shredding, batch spill, a Python-held pending set). */
+static int64_t h_shred( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                        int64_t n ) {
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+  int64_t r = ii == 0
+                  ? fdt_shred_entries( st->args, in_dc, f, n, st->ctrs )
+                  : fdt_shred_sign( st->args, in_dc, f, n, st->ctrs );
+  if( r < 0 ) {
+    st->need_python = 1;
+    return ~r;
+  }
+  return r;
+}
+
+/* net — tx burst (fdt_net.c): sendmmsg straight from the in dcache; a
+   destination missing from the route cache hands back to Python (the
+   IpStack lookup + fdt_net_route_put slow path). */
+static int64_t h_net( stem_t * st, int64_t ii, fdt_frag_t const * f,
+                      int64_t n ) {
+  uint8_t const * in_dc = (uint8_t const *)in_blk( st, ii )[ I_DCACHE ];
+  int64_t r = fdt_net_tx( st->args, in_dc, f, n, st->ctrs );
+  if( r < 0 ) {
+    st->need_python = 1;
+    return ~r;
+  }
+  return r;
+}
+
 /* ==== the burst loop ==================================================== */
 
 /* min over outs of cr_avail against the slowest reliable consumer —
@@ -652,18 +726,8 @@ static int64_t h_pack( stem_t * st, int64_t ii, fdt_frag_t const * f,
 static int64_t stem_min_cr( stem_t * st ) {
   int64_t cr = st->cap;
   for( int64_t o = 0; o < st->n_outs; o++ ) {
-    uint64_t * ob = out_blk( st, o );
-    uint64_t nf = ob[ O_NFSEQ ];
-    uint64_t avail = ob[ O_DEPTH ];
-    if( nf ) {
-      uint64_t lo = fdt_fseq_query( (void *)ob[ O_FSEQ0 ] );
-      for( uint64_t j = 1; j < nf && j < 4; j++ ) {
-        uint64_t v = fdt_fseq_query( (void *)ob[ O_FSEQ0 + j ] );
-        if( seq_delta( v, lo ) < 0 ) lo = v;
-      }
-      avail = fdt_fctl_cr_avail( ob[ O_SEQ ], lo, ob[ O_DEPTH ] );
-    }
-    if( (int64_t)avail < cr ) cr = (int64_t)avail;
+    int64_t avail = fdt_stem_out_cr( out_blk( st, o ) );
+    if( avail < cr ) cr = avail;
   }
   return cr;
 }
@@ -683,6 +747,7 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
   st.tspub = (uint32_t)cfg[ C_TSPUB ];
   st.ac = cfg[ C_AC ];
   st.ac_args = (uint64_t *)cfg[ C_AC_ARGS ];
+  st.manual = ( cfg[ C_FLAGS ] & FDT_STEM_F_MANUAL ) ? 1 : 0;
   st.need_python = 0;
   if( st.n_ins > FDT_STEM_MAX_INS || st.n_outs > FDT_STEM_MAX_OUTS )
     return -1;
@@ -710,8 +775,10 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
        slowest reliable consumer — re-read every sweep so a long burst
        tracks consumer progress instead of trusting a stale credit
        count (the mc_corpus stem-burst-over-credit mutant is exactly
-       this re-read skipped) */
-    int64_t cr = stem_min_cr( &st );
+       this re-read skipped).  Manual-credit tiles skip the global gate
+       (their handlers never publish from the frag path; every publish
+       is per-ring gated in the after-credit hook). */
+    int64_t cr = st.manual ? st.cap : stem_min_cr( &st );
 
     uint64_t rot = cfg[ C_ROT ]++;
     for( int64_t k = 0; k < st.n_ins; k++ ) {
@@ -760,6 +827,15 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
       case FDT_STEM_H_PACK:
         handled = h_pack( &st, i, buf, n );
         break;
+      case FDT_STEM_H_POH:
+        handled = h_poh( &st, i, buf, n );
+        break;
+      case FDT_STEM_H_SHRED:
+        handled = h_shred( &st, i, buf, n );
+        break;
+      case FDT_STEM_H_NET:
+        handled = h_net( &st, i, buf, n );
+        break;
       default:
         return -1;
       }
@@ -806,21 +882,49 @@ done:
      and on zero-credit boundaries (the Python loop skips after_credit
      on backpressure iterations — the gate is RE-DERIVED from the live
      consumer fseqs here, never a credit value carried across the hook
-     boundary: the pack-sched-stale-credit mutant class). */
-  if( st.ac == FDT_STEM_AC_PACK && status != FDT_STEM_PYTHON
-      && st.ac_args ) {
-    if( !st.n_outs || stem_min_cr( &st ) > 0 ) {
-      struct timespec ts;
-      clock_gettime( CLOCK_MONOTONIC, &ts );
-      int64_t now = (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
-      int64_t rc = fdt_pack_sched( st.ac_args, cfg + OUT0, st.n_outs,
-                                   st.cap, now, (uint64_t)st.tspub,
-                                   st.ctrs + PC_MICROBLOCKS );
-      if( rc < 0 ) {
-        /* block boundary with zero outstanding: end_block is Python */
-        status = FDT_STEM_PYTHON;
-        status_in = FDT_STEM_IN_AC;
+     boundary: the pack-sched-stale-credit mutant class).  Manual-
+     credit hooks (shred) run unconditionally and gate per ring inside,
+     exactly like the Python manual_credits contract. */
+  if( st.ac && status != FDT_STEM_PYTHON && st.ac_args ) {
+    int gate = st.manual || !st.n_outs || stem_min_cr( &st ) > 0;
+    switch( st.ac ) {
+    case FDT_STEM_AC_PACK:
+      if( gate ) {
+        struct timespec ts;
+        clock_gettime( CLOCK_MONOTONIC, &ts );
+        int64_t now =
+            (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
+        int64_t rc = fdt_pack_sched( st.ac_args, cfg + OUT0, st.n_outs,
+                                     st.cap, now, (uint64_t)st.tspub,
+                                     st.ctrs + PC_MICROBLOCKS );
+        if( rc < 0 ) {
+          /* block boundary with zero outstanding: end_block is Python */
+          status = FDT_STEM_PYTHON;
+          status_in = FDT_STEM_IN_AC;
+        }
       }
+      break;
+    case FDT_STEM_AC_POH:
+      if( gate ) {
+        struct timespec ts;
+        clock_gettime( CLOCK_MONOTONIC, &ts );
+        int64_t now =
+            (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
+        fdt_poh_tick( st.ac_args, cfg + OUT0, st.cap, now,
+                      (uint64_t)st.tspub, st.ctrs );
+      }
+      break;
+    case FDT_STEM_AC_SHRED:
+      fdt_shred_drain( st.ac_args, cfg + OUT0, st.n_outs, st.cap,
+                       (uint64_t)st.tspub, st.ctrs );
+      break;
+    case FDT_STEM_AC_NET:
+      if( gate )
+        fdt_net_rx( st.ac_args, cfg + OUT0, st.n_outs, st.cap,
+                    (uint64_t)st.tspub, st.ctrs );
+      break;
+    default:
+      break;
     }
   }
   cfg[ C_STATUS ] = status;

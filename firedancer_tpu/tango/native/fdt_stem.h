@@ -70,6 +70,9 @@
 #define FDT_STEM_H_DEDUP 1
 #define FDT_STEM_H_BANK 2
 #define FDT_STEM_H_PACK 3
+#define FDT_STEM_H_POH 4
+#define FDT_STEM_H_SHRED 5
+#define FDT_STEM_H_NET 6
 
 /* after-credit hook ids (cfg word 11): invoked ONCE per fdt_stem_run
    call at the burst boundary — the native analog of the Python loop's
@@ -78,6 +81,16 @@
    and must re-read per-out cr_avail itself (the stale-credit bug class
    the pack-sched-stale-credit corpus mutant pins). */
 #define FDT_STEM_AC_PACK 1
+#define FDT_STEM_AC_POH 2
+#define FDT_STEM_AC_SHRED 3
+#define FDT_STEM_AC_NET 4
+
+/* cfg word 13: stem flags */
+#define FDT_STEM_F_MANUAL 1UL /* manual-credit tile (shred <-> keyguard
+   ring cycle): SKIP the global min-over-outs credit gate — valid only
+   for handlers that never publish from the frag path; every publish
+   happens in the after-credit hook behind that ring's OWN cr_avail
+   (the Python manual_credits contract, disco/mux.py) */
 
 /* run statuses (cfg word 5, written by fdt_stem_run) */
 #define FDT_STEM_IDLE 0   /* caught up: nothing more to consume */
@@ -140,7 +153,8 @@
  *         iterations — same gate)
  * word 12 after-credit args block ptr (layout per hook; the pack hook
  *         is fdt_pack.h's FDT_PACK_SS_* block)
- * words 13..15 reserved
+ * word 13 stem flags (FDT_STEM_F_*: bit0 = manual-credit tile)
+ * words 14..15 reserved
  *
  * per-in block i at word 16 + 12*i:
  *   +0 mcache ptr          +1 dcache base ptr (0 = none)
@@ -171,6 +185,27 @@
 
 /* Layout self-description so the Python side can assert against drift. */
 uint64_t fdt_stem_cfg_words( void );
+
+/* ---- shared out-block primitives (fdt_poh.c / fdt_shred.c / fdt_net.c)
+ *
+ * The block-egress handlers and hooks live in their own translation
+ * units but publish through the stem's out blocks; these two helpers
+ * are the one publish/credit implementation so the ring-publish-order
+ * (payload bytes before release-ordered meta) and the credit bound
+ * cannot fork per handler. */
+
+/* cr_avail for one out block, re-read from the LIVE consumer fseqs —
+   never cache the result across a publish (the stale-credit mutant
+   class: pack-sched-stale-credit / shred-outq-stale-credit). */
+int64_t fdt_stem_out_cr( uint64_t const * ob );
+
+/* Publish one frag on an out block: payload into the out dcache at the
+   shared chunk cursor first, then the release-ordered mcache publish —
+   the exact op sequence OutLink.publish performs. */
+void fdt_stem_out_emit( uint64_t * ob, uint64_t sig,
+                        uint8_t const * payload, uint64_t sz,
+                        uint16_t ctl, uint32_t tsorig, uint32_t tspub,
+                        int64_t sig_cap );
 
 /* Run the stem until a burst boundary: consume up to max_frags frags
    across the native-handled in-links, dispatching each drained run to

@@ -57,9 +57,13 @@ def _load() -> ct.CDLL:
         [
             _HERE / "native" / "fdt_tango.c",
             _HERE / "native" / "fdt_sha512.c",
+            _HERE / "native" / "fdt_sha256.c",
             _HERE / "native" / "fdt_pack.c",
             _HERE / "native" / "fdt_bank.c",
             _HERE / "native" / "fdt_stem.c",
+            _HERE / "native" / "fdt_poh.c",
+            _HERE / "native" / "fdt_shred.c",
+            _HERE / "native" / "fdt_net.c",
         ],
     )
     lib = ct.CDLL(str(so))
@@ -190,14 +194,51 @@ def _load() -> ct.CDLL:
         "fdt_sha512_rpm": (None, [vp, vp, vp, u64, vp]),
         "fdt_sha512_batch": (None, [vp, vp, u64, u64, vp]),
         "fdt_xxh64": (u64, [vp, u64, u64]),
+        # block-egress natives (ISSUE 12): the PoH SHA-256 primitives,
+        # the poh/shred frag+hook bodies, and the net datagram paths —
+        # dispatched from fdt_stem_run; the direct bindings exist for
+        # differential tests and ABI coverage
+        "fdt_sha256_init_consts": (None, [vp, vp]),
+        "fdt_sha256": (None, [vp, u64, vp]),
+        "fdt_sha256_mix": (None, [vp, vp, vp]),
+        "fdt_sha256_append": (None, [vp, u64]),
+        "fdt_poh_mixins": (
+            ct.c_int64,
+            [vp, vp, ct.c_int64, u64, vp, vp, vp, ct.c_int64, ct.c_int64],
+        ),
+        "fdt_poh_tick": (
+            ct.c_int64, [vp, vp, ct.c_int64, ct.c_int64, u64, vp],
+        ),
+        "fdt_shred_entries": (
+            ct.c_int64, [vp, vp, vp, ct.c_int64, vp],
+        ),
+        "fdt_shred_sign": (
+            ct.c_int64, [vp, vp, vp, ct.c_int64, vp],
+        ),
+        "fdt_shred_drain": (
+            ct.c_int64, [vp, vp, ct.c_int64, ct.c_int64, u64, vp],
+        ),
+        "fdt_net_tx": (ct.c_int64, [vp, vp, vp, ct.c_int64, vp]),
+        "fdt_net_rx": (
+            ct.c_int64, [vp, vp, ct.c_int64, ct.c_int64, u64, vp],
+        ),
+        "fdt_net_route_put": (None, [vp, u32, ct.c_int64]),
+        "fdt_stem_out_cr": (ct.c_int64, [vp]),
+        "fdt_stem_out_emit": (
+            None, [vp, u64, vp, u64, u16, u32, u32, ct.c_int64],
+        ),
     }
     _bind(lib, sigs)
-    # inject the derived SHA-512 constant tables (no constant block in C)
-    from firedancer_tpu.utils.shaconst import H64, K64
+    # inject the derived SHA-512/SHA-256 constant tables (no constant
+    # blocks in C)
+    from firedancer_tpu.utils.shaconst import H64, H256, K64, K256
 
     k = np.array(K64, dtype=np.uint64)
     h = np.array(H64, dtype=np.uint64)
     lib.fdt_sha512_init_consts(k.ctypes.data, h.ctypes.data)
+    k2 = np.array(K256, dtype=np.uint32)
+    h2 = np.array(H256, dtype=np.uint32)
+    lib.fdt_sha256_init_consts(k2.ctypes.data, h2.ctypes.data)
     # inject the pack cost-model consensus constants (the Python tables in
     # ballet/compute_budget.py stay authoritative; C never duplicates them)
     from firedancer_tpu.ballet import compute_budget as _CB
@@ -1062,11 +1103,17 @@ class TCache:
 
 #: handler ids (fdt_stem.h FDT_STEM_H_*)
 STEM_H_DEDUP, STEM_H_BANK, STEM_H_PACK = 1, 2, 3
+STEM_H_POH, STEM_H_SHRED, STEM_H_NET = 4, 5, 6
 
 #: after-credit hook ids (fdt_stem.h FDT_STEM_AC_*): invoked once per
 #: fdt_stem_run call at the burst boundary — the native analog of the
 #: Python loop's tile.after_credit slot
-STEM_AC_PACK = 1
+STEM_AC_PACK, STEM_AC_POH, STEM_AC_SHRED, STEM_AC_NET = 1, 2, 3, 4
+
+#: stem flags (cfg word 13): manual-credit tile — skip the global
+#: credit gate; every publish happens in the after-credit hook behind
+#: that ring's OWN cr_avail (the Python manual_credits contract)
+STEM_F_MANUAL = 1
 
 #: run statuses (fdt_stem.h FDT_STEM_*)
 STEM_IDLE, STEM_BUDGET, STEM_PYTHON, STEM_BP = 0, 1, 2, 3
@@ -1084,7 +1131,7 @@ _STEM_MAX_INS, _STEM_MAX_OUTS, _STEM_N_CTRS = 8, 8, 16
 # cfg word indices (fdt_stem.c C_* / I_* / O_*)
 _SC_MAGIC, _SC_HANDLER, _SC_NINS, _SC_NOUTS, _SC_CAP = 0, 1, 2, 3, 4
 _SC_STATUS, _SC_STATUS_IN, _SC_ARGS, _SC_CTRS, _SC_TSPUB = 5, 6, 7, 8, 9
-_SC_AC, _SC_AC_ARGS = 11, 12
+_SC_AC, _SC_AC_ARGS, _SC_FLAGS = 11, 12, 13
 _SI0, _SI_STRIDE = 16, 12
 # in-block word 5 is reserved (handlers address payloads by chunk)
 (_SI_MCACHE, _SI_DCACHE, _SI_FSEQ, _SI_SEQ, _SI_FLAGS, _SI_RSVD,
@@ -1112,7 +1159,8 @@ class StemSpec:
                  counters: tuple = (), keepalive: tuple = (),
                  native_ins: tuple | None = None,
                  ready=None, after_burst=None, cap: int | None = None,
-                 ac_handler: int = 0, ac_args: np.ndarray | None = None):
+                 ac_handler: int = 0, ac_args: np.ndarray | None = None,
+                 manual: bool = False):
         self.handler = handler
         self.args = args
         self.counters = counters
@@ -1129,6 +1177,12 @@ class StemSpec:
         #: makes the tile zero-Python per microblock at steady state
         self.ac_handler = ac_handler
         self.ac_args = ac_args
+        #: manual-credit stem (shred <-> keyguard ring cycle): the
+        #: tile's handlers never publish from the frag path, so the
+        #: stem skips its global credit gate and the after-credit hook
+        #: gates each ring on its OWN cr_avail.  Required for the run
+        #: loop to engage the stem on a Tile with manual_credits.
+        self.manual = manual
 
 
 class Stem:
@@ -1185,6 +1239,8 @@ class Stem:
         if spec.ac_handler:
             w[_SC_AC] = spec.ac_handler
             w[_SC_AC_ARGS] = _ptr(spec.ac_args)
+        if spec.manual:
+            w[_SC_FLAGS] = STEM_F_MANUAL
         for i, il in enumerate(self.ins):
             b = _SI0 + i * _SI_STRIDE
             w[b + _SI_MCACHE] = _ptr(il.mcache.mem)

@@ -9,7 +9,17 @@ address as a 6-byte prefix (ip4 + port), so protocol tiles stay sans-IO.
 Ring layout: outs[0] = rx ring (to the quic tile, QUIC port + legacy
 port datagrams alike; the ctl field distinguishes: CTL_QUIC/CTL_LEGACY);
 ins[0] = tx ring (addr-prefixed datagrams to put on the wire).
-"""
+
+ISSUE 12 (native block egress): both directions run as native stem
+bodies (tango/native/fdt_net.c) — tx drains the ring with sendmmsg
+iovecs pointing straight into the in dcache, rx recvmmsg-writes
+addr-prefixed rows DIRECTLY into the out dcache as the after-credit
+hook — one syscall per burst, zero Python per datagram at steady
+state.  The egress route-classification metrics (the fd_ip mirror) ride
+a native route cache: a destination not yet classified hands the frag
+back to this file's Python loop, which does the IpStack lookup and
+seeds the native cache (the bank-tile MISS -> resolve -> retry
+pattern)."""
 
 from __future__ import annotations
 
@@ -20,6 +30,7 @@ import numpy as np
 
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.tango import rings as R
 from firedancer_tpu.waltz.udpsock import UdpSock
 
 ADDR_SZ = 6
@@ -29,6 +40,10 @@ CTL_LEGACY = 16
 
 #: dcache MTU for net rings: addr prefix + a full UDP payload
 NET_MTU = ADDR_SZ + 1500
+
+#: native route-cache geometry: twice the Python dict's 4096-entry
+#: bound so open addressing stays sparse
+_RC_CAP = 8192
 
 
 def addr_pack(addr: tuple[str, int]) -> bytes:
@@ -84,6 +99,61 @@ class NetTile(Tile):
         except OSError:
             self._ip = IpStack()
         self._route_cache: dict[str, bool] = {}
+        # native route cache + args block (host memory; the cache is a
+        # metrics mirror, rebuilt from scratch on restart)
+        self._nwords = np.zeros(8, np.int64)
+        self._rc_keys = np.zeros(_RC_CAP, np.uint32)
+        self._rc_vals = np.zeros(_RC_CAP, np.uint8)
+        self._rx_szs = np.zeros(max(self.burst, 16), np.uint32)
+        self._nargs = np.zeros(4, np.uint64)
+        self._nargs[0] = self._nwords.ctypes.data
+        self._nargs[1] = self._rc_keys.ctypes.data
+        self._nargs[2] = self._rc_vals.ctypes.data
+        self._nargs[3] = self._rx_szs.ctypes.data
+        self._nwords[0] = self.quic_sock.sock.fileno()  # tx rides quic
+        self._nwords[1] = self.quic_sock.sock.fileno()
+        self._nwords[2] = self.udp_sock.sock.fileno()
+        self._nwords[3] = self.burst
+        self._nwords[4] = NET_MTU
+        self._nwords[5] = _RC_CAP - 1
+
+    def _route_classify(self, ip_str: str) -> bool:
+        """IpStack lookup with the Python-side cache; seeds the native
+        cache so the stem's next burst stays native (MISS -> resolve ->
+        retry)."""
+        hit = self._route_cache.get(ip_str)
+        if hit is None:
+            hit = self._ip.lookup_route(ip_str) is not None
+            if len(self._route_cache) < 4096:
+                self._route_cache[ip_str] = hit
+                ip_u32 = struct.unpack(
+                    "<I", socket.inet_aton(ip_str)
+                )[0]
+                R._lib.fdt_net_route_put(
+                    self._nargs.ctypes.data, ip_u32, int(hit)
+                )
+        return hit
+
+    def native_handler(self, ctx: MuxCtx):
+        """Native fast path: fdt_net_tx (sendmmsg straight from the in
+        dcache, route metrics off the native cache) plus fdt_net_rx as
+        the after-credit hook (recvmmsg straight into the out dcache,
+        credit-gated)."""
+        if (
+            len(ctx.outs) != 1
+            or ctx.outs[0].dcache is None
+            or any(il.dcache is None for il in ctx.ins)
+        ):
+            return None
+        return R.StemSpec(
+            R.STEM_H_NET, self._nargs,
+            counters=("rx_dgrams", "tx_dgrams", "rx_bytes", "tx_bytes",
+                      "oversize_drops", "tx_routed", "tx_unrouted"),
+            keepalive=(self._nargs, self._nwords, self._rc_keys,
+                       self._rc_vals, self._rx_szs),
+            ac_handler=R.STEM_AC_NET,
+            ac_args=self._nargs,
+        )
 
     def on_halt(self, ctx: MuxCtx) -> None:
         for s in (self.quic_sock, self.udp_sock):
@@ -104,11 +174,7 @@ class NetTile(Tile):
         # bursts (EAGAIN drops)
         routed = unrouted = 0
         for _, addr in pkts[:n]:
-            hit = self._route_cache.get(addr[0])
-            if hit is None:
-                hit = self._ip.lookup_route(addr[0]) is not None
-                if len(self._route_cache) < 4096:
-                    self._route_cache[addr[0]] = hit
+            hit = self._route_classify(addr[0])
             routed += hit
             unrouted += not hit
         if routed:

@@ -29,7 +29,7 @@ import numpy as np
 
 from firedancer_tpu.ballet import pack as P
 from firedancer_tpu.disco.metrics import MetricsSchema
-from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.disco.mux import MuxCtx, Tile, drain_straggler_ins
 from firedancer_tpu.tango import rings as R
 from firedancer_tpu.tango import tempo
 
@@ -354,6 +354,27 @@ class PackTile(Tile):
                     continue
                 self.bank_busy[bank] -= 1
                 ctx.metrics.inc("completions")
+
+    def on_halt(self, ctx: MuxCtx) -> None:
+        # drain straggler bank completions so a run's final microblocks
+        # release their locks and the completion counters settle (banks
+        # publish their last completions right up to HALT — the
+        # completions == microblocks invariant raced the halt before)
+        import time as _t
+
+        if len(ctx.ins) <= 1:
+            return
+        comp_ins = tuple(range(1, len(ctx.ins)))
+        deadline = _t.monotonic() + 1.0
+        while True:
+            got = drain_straggler_ins(self, ctx, only=comp_ins,
+                                      budget=4096)
+            if self.engine.outstanding_cnt == 0:
+                break
+            if got == 0:
+                if _t.monotonic() >= deadline:
+                    break
+                _t.sleep(1e-3)
 
     def after_credit(self, ctx: MuxCtx) -> None:
         # hot-path-clock discipline: loop-body clock reads go through

@@ -19,7 +19,20 @@ Ring layout: ins[0] = poh entries; ins[1] (optional) = sign responses.
 outs[0] = shreds (one frag per shred, payload = raw wire bytes,
 sig = slot<<32 | code_bit<<31 | shred idx); outs[1] (optional) = sign
 requests (32-byte merkle roots, sig = request tag).
-"""
+
+ISSUE 12 (native block egress): the per-frag paths — entry append,
+sign-response signature patch — and the credit-gated `_outq`/`_signq`
+drains run as native stem handlers (tango/native/fdt_shred.c).  The
+batch buffer, both queues and the FEC pending store are DENSE SHARED
+ARRAYS (the tile's workspace arena in the process runtime) that this
+file's Python loop pushes/pops identically, so the two loop modes are
+interchangeable mid-run, a killed child's queues survive into the
+restarted incarnation, and the supervisor's entry replay is collapsed
+back to exactly-once by a consumed high-water mark + append journal.
+The actual Reed-Solomon/merkle shredding stays a Python slow path at
+slot boundaries (the PR 9 handback contract — once per slot, not per
+frag).  Capacity overflows spill to Python-side state, which gates the
+stem off until drained (the dedup-amnesty pattern)."""
 
 from __future__ import annotations
 
@@ -29,9 +42,21 @@ import numpy as np
 
 from firedancer_tpu.ballet import shred as SH
 from firedancer_tpu.disco.metrics import MetricsSchema
-from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.disco.mux import MuxCtx, Tile, drain_straggler_ins
 from firedancer_tpu.disco.shredder import EntryBatchMeta, Shredder
+from firedancer_tpu.tango import rings as R
 from firedancer_tpu.tiles.poh import SLOT_BOUNDARY_TAG
+
+#: shared words (i64) — layout pinned to tango/native/fdt_shred.h
+_W_BATCH_LEN, _W_SLOT, _W_OQ_HEAD, _W_OQ_TAIL = 0, 1, 2, 3
+_W_SQ_HEAD, _W_SQ_TAIL, _W_HW_ENT = 4, 5, 6
+_W_J_PHASE, _W_J_SEQ, _W_J_LEN = 7, 8, 9
+#: next sign-request tag (never read by C; crash-surviving so a
+#: restarted incarnation can never reuse a tag that still names a live
+#: pre-crash set in the surviving pending store)
+_W_NEXT_TAG = 10
+_W_MAGIC = 15  # host-side init flag (never read by C)
+_W_CNT = 16
 
 
 def _null_signer(root) -> bytes:
@@ -61,8 +86,21 @@ class ShredTile(Tile):
             "sign_requests",
             "sign_responses",
             "turbine_dests",
+            # supervisor replay of entries a previous incarnation
+            # already appended (skipped below the consumed high-water
+            # mark — the exactly-once discipline, not an anomaly)
+            "replayed_entries",
         ),
     )
+
+    #: shared-structure capacities (native dense arrays; overflows past
+    #: them spill to Python state and gate the stem off)
+    ROW_W = SH.MAX_SZ
+    OQ_CAP = 1 << 14
+    SQ_CAP = 1 << 10
+    PD_CAP = 64  #: FEC sets awaiting signature in the native store
+    PD_MAX = 64  #: max shreds per stored set (32 data + 32 parity)
+    BATCH_CAP = 1 << 20
 
     def __init__(
         self,
@@ -87,16 +125,227 @@ class ShredTile(Tile):
         # lambda: the tile object must survive the process runtime's
         # spawn pickle (fdtlint proc-safe-tile)
         self._shredder = Shredder(shred_version, signer=_null_signer)
-        self._slot: int | None = None
-        self._batch = bytearray()
-        #: FEC sets waiting for their root signature: tag -> (slot, FecSet)
+        #: FEC sets the NATIVE store could not hold (store full or an
+        #: oversized set): tag -> (slot, FecSet), Python-released
         self._pending: dict[int, tuple[int, object]] = {}
-        self._next_tag = 1
-        #: signed shreds waiting for downstream credits
-        self._outq: collections.deque = collections.deque()
-        #: sign requests waiting for keyguard-ring credits (a slot boundary
-        #: can shred into more FEC sets than one frag's worth of credits)
-        self._signq: collections.deque = collections.deque()
+        #: Python-side spill for the shared rings (normally empty; the
+        #: stem stays off while any spill is pending)
+        self._oq_overflow: collections.deque = collections.deque()
+        self._sq_overflow: collections.deque = collections.deque()
+        self._batch_overflow = bytearray()
+        #: shared-structure views, bound in on_boot (ctx.alloc: the
+        #: workspace arena in the process runtime, local memory in
+        #: standalone tests) — NOT allocated here so the spawn pickle
+        #: stays small
+        self._w = None
+
+    # ---- shared-structure layout -----------------------------------------
+
+    def _seg_sizes(self) -> list[tuple[str, int]]:
+        return [
+            ("words", _W_CNT * 8),
+            ("batch", self.BATCH_CAP),
+            ("oq_tag", self.OQ_CAP * 8),
+            ("oq_sz", self.OQ_CAP * 8),
+            ("oq_rows", self.OQ_CAP * self.ROW_W),
+            ("sq_tag", self.SQ_CAP * 8),
+            ("sq_root", self.SQ_CAP * 32),
+            ("sq_sz", self.SQ_CAP * 8),
+            ("pd_tag", self.PD_CAP * 8),
+            ("pd_cnt", self.PD_CAP * 8),
+            ("pd_tags", self.PD_CAP * self.PD_MAX * 8),
+            ("pd_szs", self.PD_CAP * self.PD_MAX * 8),
+            ("pd_rows", self.PD_CAP * self.PD_MAX * self.ROW_W),
+        ]
+
+    def wksp_footprint(self) -> int:
+        return sum(sz for _, sz in self._seg_sizes()) + 4096
+
+    def _alloc_views(self, mem: np.ndarray | None) -> None:
+        segs = self._seg_sizes()
+        total = sum(sz for _, sz in segs)
+        if mem is None:
+            mem = np.zeros(total, np.uint8)
+        off = 0
+        v = {}
+        for name, sz in segs:
+            v[name] = mem[off : off + sz]
+            off += sz
+        self._w = v["words"].view(np.int64)
+        self._batch_buf = v["batch"]
+        self._oq_tag = v["oq_tag"].view(np.uint64)
+        self._oq_sz = v["oq_sz"].view(np.uint64)
+        self._oq_rows = v["oq_rows"].reshape(self.OQ_CAP, self.ROW_W)
+        self._sq_tag = v["sq_tag"].view(np.uint64)
+        self._sq_root = v["sq_root"].reshape(self.SQ_CAP, 32)
+        self._sq_sz = v["sq_sz"].view(np.uint64)
+        self._pd_tag = v["pd_tag"].view(np.uint64)
+        self._pd_cnt = v["pd_cnt"].view(np.int64)
+        self._pd_tags = v["pd_tags"].view(np.uint64).reshape(
+            self.PD_CAP, self.PD_MAX
+        )
+        self._pd_szs = v["pd_szs"].view(np.uint64).reshape(
+            self.PD_CAP, self.PD_MAX
+        )
+        self._pd_rows = v["pd_rows"].reshape(
+            self.PD_CAP, self.PD_MAX, self.ROW_W
+        )
+
+    def on_boot(self, ctx: MuxCtx) -> None:
+        segs = self._seg_sizes()
+        mem = ctx.alloc("shred_egress", sum(sz for _, sz in segs))
+        self._alloc_views(mem)
+        if int(self._w[_W_MAGIC]) == 0:
+            self._w[_W_SLOT] = -1
+            self._w[_W_NEXT_TAG] = 1
+            self._w[_W_MAGIC] = 1
+        self._recover(ctx)
+
+    def _recover(self, ctx: MuxCtx) -> None:
+        """Resolve an append a dead incarnation left mid-window: the
+        journaled pre-append length tells whether the byte copy landed
+        before the high-water store did."""
+        w = self._w
+        if int(w[_W_J_PHASE]):
+            if int(w[_W_BATCH_LEN]) > int(w[_W_J_LEN]):
+                hw = R.seq_u64(int(w[_W_J_SEQ]) + 1)
+                if R.seq_diff(hw, int(w[_W_HW_ENT])) > 0:
+                    w[_W_HW_ENT] = hw
+            w[_W_J_PHASE] = 0
+
+    # ---- slot / queue views ----------------------------------------------
+
+    @property
+    def _slot(self) -> int | None:
+        s = int(self._w[_W_SLOT])
+        return None if s < 0 else s
+
+    @_slot.setter
+    def _slot(self, v: int | None) -> None:
+        self._w[_W_SLOT] = -1 if v is None else v
+
+    @property
+    def outq_len(self) -> int:
+        return (
+            int(self._w[_W_OQ_TAIL]) - int(self._w[_W_OQ_HEAD])
+            + len(self._oq_overflow)
+        )
+
+    @property
+    def signq_len(self) -> int:
+        return (
+            int(self._w[_W_SQ_TAIL]) - int(self._w[_W_SQ_HEAD])
+            + len(self._sq_overflow)
+        )
+
+    @property
+    def pending_cnt(self) -> int:
+        """FEC sets awaiting their root signature (native store +
+        Python-held)."""
+        return int((self._pd_cnt > 0).sum()) + len(self._pending)
+
+    def _batch_len(self) -> int:
+        return int(self._w[_W_BATCH_LEN]) + len(self._batch_overflow)
+
+    def _oq_put(self, tag: int, raw: bytes) -> None:
+        """Store one entry at the out-ring tail (caller checked room)."""
+        slot = int(self._w[_W_OQ_TAIL]) & (self.OQ_CAP - 1)
+        self._oq_rows[slot, : len(raw)] = np.frombuffer(raw, np.uint8)
+        self._oq_tag[slot] = tag
+        self._oq_sz[slot] = len(raw)
+        self._w[_W_OQ_TAIL] += 1
+
+    def _sq_put(self, tag: int, root: bytes) -> None:
+        slot = int(self._w[_W_SQ_TAIL]) & (self.SQ_CAP - 1)
+        self._sq_root[slot, : len(root)] = np.frombuffer(root, np.uint8)
+        self._sq_tag[slot] = tag
+        self._sq_sz[slot] = len(root)
+        self._w[_W_SQ_TAIL] += 1
+
+    def _outq_push(self, tag: int, raw: bytes) -> None:
+        w = self._w
+        used = int(w[_W_OQ_TAIL]) - int(w[_W_OQ_HEAD])
+        if self._oq_overflow or used >= self.OQ_CAP:
+            self._oq_overflow.append((tag, raw))
+            return
+        self._oq_put(tag, raw)
+
+    def _signq_push(self, tag: int, root: bytes) -> None:
+        w = self._w
+        used = int(w[_W_SQ_TAIL]) - int(w[_W_SQ_HEAD])
+        if self._sq_overflow or used >= self.SQ_CAP:
+            self._sq_overflow.append((tag, root))
+            return
+        self._sq_put(tag, root)
+
+    def _refill_rings(self) -> None:
+        """Move Python spill back into the shared rings as space frees
+        (FIFO preserved: spill only drains from the front)."""
+        w = self._w
+        while self._oq_overflow and (
+            int(w[_W_OQ_TAIL]) - int(w[_W_OQ_HEAD]) < self.OQ_CAP
+        ):
+            self._oq_put(*self._oq_overflow.popleft())
+        while self._sq_overflow and (
+            int(w[_W_SQ_TAIL]) - int(w[_W_SQ_HEAD]) < self.SQ_CAP
+        ):
+            self._sq_put(*self._sq_overflow.popleft())
+
+    # ---- native stem (ISSUE 12) ------------------------------------------
+
+    def native_handler(self, ctx: MuxCtx):
+        """Native fast path: fdt_shred_entries (batch append, slot
+        boundaries handed back), fdt_shred_sign (signature patch over
+        the pending store into the out queue), and fdt_shred_drain (the
+        after-credit hook: per-ring credit-gated `_signq`/`_outq`
+        publish — the manual-credit discipline).  Python spill state
+        (ring overflow, Python-held pending sets in `_pending` are fine
+        — an unknown tag hands back) gates the stem off until drained.
+        Turbine fan-out metrics are per-shred Python work, so a
+        shred_dest keeps the Python loop."""
+        if (
+            self.shred_dest is not None
+            or not ctx.ins
+            or any(il.dcache is None for il in ctx.ins)
+            or not ctx.outs
+            or ctx.outs[0].dcache is None
+            or (self.signer is None and len(ctx.outs) < 2)
+        ):
+            return None
+        args = np.zeros(19, np.uint64)
+        args[0] = self._w.ctypes.data
+        args[1] = self._batch_buf.ctypes.data
+        args[2] = self.BATCH_CAP
+        args[3] = self._oq_tag.ctypes.data
+        args[4] = self._oq_sz.ctypes.data
+        args[5] = self._oq_rows.ctypes.data
+        args[6] = self.OQ_CAP
+        args[7] = self._sq_tag.ctypes.data
+        args[8] = self._sq_root.ctypes.data
+        args[9] = self.SQ_CAP
+        args[10] = self._pd_tag.ctypes.data
+        args[11] = self._pd_cnt.ctypes.data
+        args[12] = self._pd_tags.ctypes.data
+        args[13] = self._pd_szs.ctypes.data
+        args[14] = self._pd_rows.ctypes.data
+        args[15] = self.PD_CAP
+        args[16] = self.PD_MAX
+        args[17] = self.ROW_W
+        args[18] = self._sq_sz.ctypes.data
+        return R.StemSpec(
+            R.STEM_H_SHRED, args,
+            counters=("sign_requests", "sign_responses",
+                      "replayed_entries"),
+            keepalive=(args,),
+            ready=lambda: (
+                not self._oq_overflow
+                and not self._sq_overflow
+                and not self._batch_overflow
+            ),
+            ac_handler=R.STEM_AC_SHRED,
+            ac_args=args,
+            manual=True,
+        )
 
     # ---- ingress ---------------------------------------------------------
 
@@ -106,24 +355,63 @@ class ShredTile(Tile):
             return
         il = ctx.ins[in_idx]
         rows = il.gather(frags)
+        w = self._w
         for i in range(len(rows)):
+            seq = int(frags["seq"][i])
+            hw = int(w[_W_HW_ENT])
+            if hw and R.seq_diff(R.seq_u64(seq + 1), hw) <= 0:
+                ctx.metrics.inc("replayed_entries")
+                continue
             tag = int(frags["sig"][i])
             if tag & SLOT_BOUNDARY_TAG:
                 new_slot = tag & 0xFFFFFFFF
                 self._finish_slot(ctx, block_complete=True)
                 self._slot = new_slot
+                w[_W_HW_ENT] = R.seq_u64(seq + 1)
                 continue
             if self._slot is None:
                 self._slot = 0
-            self._batch += rows[i, : frags["sz"][i]].tobytes()
+            payload = rows[i, : frags["sz"][i]].tobytes()
+            length = int(w[_W_BATCH_LEN])
+            if length + len(payload) <= self.BATCH_CAP and (
+                not self._batch_overflow
+            ):
+                # append journal: the crash window between the byte
+                # copy and the hw store (fdt_shred.h discipline)
+                w[_W_J_SEQ] = seq
+                w[_W_J_LEN] = length
+                w[_W_J_PHASE] = 1
+                self._batch_buf[length : length + len(payload)] = (
+                    np.frombuffer(payload, np.uint8)
+                )
+                w[_W_BATCH_LEN] = length + len(payload)
+                w[_W_HW_ENT] = R.seq_u64(seq + 1)
+                w[_W_J_PHASE] = 0
+            else:
+                # shared-buffer overflow: Python spill (gates the stem
+                # off; drains at the next slot boundary)
+                self._batch_overflow += payload
+                w[_W_HW_ENT] = R.seq_u64(seq + 1)
 
     def _finish_slot(self, ctx: MuxCtx, *, block_complete: bool) -> None:
-        if self._slot is None or not self._batch:
+        if self._slot is None or self._batch_len() == 0:
             return
+        batch = (
+            bytes(self._batch_buf[: int(self._w[_W_BATCH_LEN])])
+            + bytes(self._batch_overflow)
+        )
         self._shredder.start_slot(self._slot)
         meta = EntryBatchMeta(block_complete=block_complete)
-        sets = self._shredder.shred_batch(bytes(self._batch), meta)
-        self._batch.clear()
+        sets = self._shredder.shred_batch(batch, meta)
+        # clear the (crash-surviving) batch length only AFTER the long
+        # shredder call: a SIGKILL mid-shred leaves the length word and
+        # the boundary frag's high-water mark intact, so the supervisor
+        # replay re-runs this slot identically instead of dropping the
+        # whole batch.  (The remaining window — a kill between this
+        # store and the last queue push below — is the microseconds of
+        # parking, not the milliseconds of Reed-Solomon/merkle work.)
+        self._w[_W_BATCH_LEN] = 0
+        self._batch_overflow = bytearray()
         ctx.metrics.inc("batches")
         for fec in sets:
             ctx.metrics.inc("fec_sets")
@@ -132,24 +420,65 @@ class ShredTile(Tile):
             if self.signer is not None:
                 self._release(ctx, self._slot, fec,
                               self.signer(fec.merkle_root))
-            else:
-                tag = self._next_tag
-                self._next_tag += 1
+                continue
+            tag = int(self._w[_W_NEXT_TAG])
+            self._w[_W_NEXT_TAG] = tag + 1
+            if not self._pd_store(tag, self._slot, fec):
+                # native store full or oversized set: Python-held (the
+                # sign response for it hands the stem back)
                 self._pending[tag] = (self._slot, fec)
-                self._signq.append((tag, fec.merkle_root))
+            self._signq_push(tag, fec.merkle_root)
+
+    def _pd_store(self, tag: int, slot: int, fec) -> bool:
+        """Park one FEC set in the native pending store (unsigned
+        shreds + precomputed publish sigs); False = does not fit."""
+        raws = fec.data_shreds + fec.parity_shreds
+        if len(raws) > self.PD_MAX:
+            return False
+        free = np.flatnonzero(self._pd_cnt == 0)
+        if not len(free):
+            return False
+        p = int(free[0])
+        for s, raw in enumerate(raws):
+            sh = SH.parse(raw)
+            assert sh is not None
+            self._pd_rows[p, s, : len(raw)] = np.frombuffer(raw, np.uint8)
+            self._pd_tags[p, s] = shred_tag(slot, sh.idx, not sh.is_data)
+            self._pd_szs[p, s] = len(raw)
+        self._pd_tag[p] = tag
+        self._pd_cnt[p] = len(raws)
+        return True
 
     # ---- keyguard responses ----------------------------------------------
+
+    def _pd_release(self, ctx: MuxCtx, tag: int, sig: bytes) -> bool:
+        """Release a native-store set through the shared out queue (the
+        Python twin of fdt_shred_sign's patch loop)."""
+        hit = np.flatnonzero((self._pd_tag == tag) & (self._pd_cnt > 0))
+        if not len(hit):
+            return False
+        p = int(hit[0])
+        cnt = int(self._pd_cnt[p])
+        for s in range(cnt):
+            sz = int(self._pd_szs[p, s])
+            raw = sig + self._pd_rows[p, s, 64:sz].tobytes()
+            self._outq_push(int(self._pd_tags[p, s]), raw)
+        self._pd_cnt[p] = 0
+        ctx.metrics.inc("sign_responses")
+        return True
 
     def _on_sign_responses(self, ctx: MuxCtx, frags: np.ndarray) -> None:
         il = ctx.ins[1]
         rows = il.gather(frags)
         for i in range(len(rows)):
             tag = int(frags["sig"][i])
+            sig = rows[i, :64].tobytes()
+            if self._pd_release(ctx, tag, sig):
+                continue
             entry = self._pending.pop(tag, None)
             if entry is None:
                 continue
             slot, fec = entry
-            sig = rows[i, :64].tobytes()
             ctx.metrics.inc("sign_responses")
             self._release(ctx, slot, fec, sig)
 
@@ -162,7 +491,7 @@ class ShredTile(Tile):
             patched = sig + raw[64:]
             s = SH.parse(patched)
             assert s is not None
-            self._outq.append((slot, s.idx, not s.is_data, patched))
+            self._outq_push(shred_tag(slot, s.idx, not s.is_data), patched)
             if self.shred_dest is not None and self.identity is not None:
                 order = self.shred_dest.shuffle(
                     slot, s.idx, 0 if s.is_data else 1, self.identity
@@ -173,46 +502,56 @@ class ShredTile(Tile):
     # ---- egress ----------------------------------------------------------
 
     def _drain_signq(self, ctx: MuxCtx) -> None:
-        if not self._signq:
+        self._refill_rings()
+        w = self._w
+        pending = int(w[_W_SQ_TAIL]) - int(w[_W_SQ_HEAD])
+        if not pending:
             return
         if len(ctx.outs) < 2:
             raise RuntimeError(
                 "shred tile: keyguard signing requires outs[1] (sign ring)"
             )
-        n = min(len(self._signq), ctx.outs[1].cr_avail())
+        n = min(pending, ctx.outs[1].cr_avail())
         if n <= 0:
             return
-        items = [self._signq.popleft() for _ in range(n)]
-        tags = np.array([t for t, _ in items], np.uint64)
-        rows = np.stack(
-            [np.frombuffer(r, np.uint8) for _, r in items]
+        idxs = (
+            np.arange(int(w[_W_SQ_HEAD]), int(w[_W_SQ_HEAD]) + n)
+            & (self.SQ_CAP - 1)
         )
-        ctx.outs[1].publish(
-            tags, rows, np.full(n, rows.shape[1], np.uint16)
-        )
+        # fancy indexing already materializes fresh contiguous copies
+        tags = self._sq_tag[idxs]
+        rows = self._sq_root[idxs]
+        szs = self._sq_sz[idxs].astype(np.uint16)
+        w[_W_SQ_HEAD] += n
+        ctx.outs[1].publish(tags, rows, szs)
         ctx.metrics.inc("sign_requests", n)
 
     def in_budget(self, ctx: MuxCtx) -> int | None:
         """Bound the internal queues (manual-credit contract): stop
         absorbing entries while the signed-shred backlog is deep."""
-        return 0 if len(self._outq) > 8192 else None
+        return 0 if self.outq_len > 8192 else None
 
     def after_credit(self, ctx: MuxCtx) -> None:
         self._drain_signq(ctx)
-        while self._outq:
+        w = self._w
+        while True:
+            self._refill_rings()
+            pending = int(w[_W_OQ_TAIL]) - int(w[_W_OQ_HEAD])
+            if not pending:
+                break
             budget = ctx.outs[0].cr_avail()
             if budget <= 0:
                 break
-            n = min(len(self._outq), budget)
-            items = [self._outq.popleft() for _ in range(n)]
-            w = max(len(it[3]) for it in items)
-            rows = np.zeros((n, w), np.uint8)
-            szs = np.zeros(n, np.uint16)
-            tags = np.zeros(n, np.uint64)
-            for i, (slot, idx, is_code, raw) in enumerate(items):
-                rows[i, : len(raw)] = np.frombuffer(raw, np.uint8)
-                szs[i] = len(raw)
-                tags[i] = shred_tag(slot, idx, is_code)
+            n = min(pending, budget)
+            idxs = (
+                np.arange(int(w[_W_OQ_HEAD]), int(w[_W_OQ_HEAD]) + n)
+                & (self.OQ_CAP - 1)
+            )
+            # fancy indexing already materializes fresh copies
+            tags = self._oq_tag[idxs]
+            szs = self._oq_sz[idxs].astype(np.uint16)
+            rows = self._oq_rows[idxs]
+            w[_W_OQ_HEAD] += n
             ctx.outs[0].publish(tags, rows, szs)
 
     def on_halt(self, ctx: MuxCtx) -> None:
@@ -223,15 +562,11 @@ class ShredTile(Tile):
         import time as _t
 
         deadline = _t.monotonic() + 10.0
-        while (self._outq or self._pending or self._signq) and _t.monotonic() < deadline:
-            if len(ctx.ins) > 1 and self._pending:
-                il = ctx.ins[1]
-                frags, il.seq, ovr = il.mcache.drain(il.seq, 256)
-                if ovr:
-                    ctx.metrics.inc("overrun_frags", ovr)
-                    il.fseq.diag_add(0, ovr)
-                if len(frags):
-                    self._on_sign_responses(ctx, frags)
+        while (
+            self.outq_len or self.pending_cnt or self.signq_len
+        ) and _t.monotonic() < deadline:
+            if len(ctx.ins) > 1 and self.pending_cnt:
+                drain_straggler_ins(self, ctx, only=(1,), budget=256)
             ctx.credits = ctx.outs[0].cr_avail()
             self.after_credit(ctx)
             _t.sleep(100e-6)

@@ -34,5 +34,19 @@ def gen_sha512_constants() -> tuple[list[int], list[int]]:
     return k, h
 
 
+def gen_sha256_constants() -> tuple[list[int], list[int]]:
+    """SHA-256 K/H0 by the same fractional-root derivation, 32-bit
+    domain.  Shared by the JAX kernel (ops/sha256.py) and the native
+    PoH hasher (tango/native/fdt_sha256.c, constants injected at load
+    time so no constant block exists in C)."""
+    ps = _primes(64)
+    k = [_icbrt(p << 96) & ((1 << 32) - 1) for p in ps]
+    h = [math.isqrt(p << 64) & ((1 << 32) - 1) for p in ps[:8]]
+    return k, h
+
+
 K64, H64 = gen_sha512_constants()
 assert K64[0] == 0x428A2F98D728AE22 and H64[0] == 0x6A09E667F3BCC908
+
+K256, H256 = gen_sha256_constants()
+assert K256[0] == 0x428A2F98 and H256[0] == 0x6A09E667

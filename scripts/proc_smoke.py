@@ -240,6 +240,125 @@ def run_pack_pipeline(
     return out
 
 
+class _MbFeeder(_Tile):
+    """Publishes deterministic microblock payloads, credit-gated.
+    Module level so the process runtime's spawn pickle resolves it."""
+
+    name = "feeder"
+
+    def __init__(self, payloads):
+        self.payloads = payloads
+        self.sent = 0
+
+    def after_credit(self, ctx):
+        import numpy as np
+
+        while self.sent < len(self.payloads) and ctx.outs[0].cr_avail():
+            pl = self.payloads[self.sent]
+            ctx.outs[0].publish(
+                np.array([self.sent], np.uint64), pl[None, :],
+                np.array([len(pl)], np.uint16),
+            )
+            self.sent += 1
+
+
+def _egress_signer(root) -> bytes:
+    """Deterministic local signer (module level: spawn-picklable)."""
+    import hashlib
+
+    return (hashlib.sha256(root).digest()
+            + hashlib.sha256(root + b"s").digest())
+
+
+def run_egress_pipeline(
+    runtime: str,
+    n_mbs: int = 256,
+    deadline_s: float = 180.0,
+    stem: str = "python",
+) -> dict:
+    """Block-egress smoke (ISSUE 12): microblock feeder → poh → shred
+    (local signer) → sink under the chosen runtime/stem.  Every
+    microblock mixes into the chain exactly once, slot boundaries shred
+    into signed shreds, and every published shred lands downstream with
+    a unique (slot, idx) tag — with the mixin ladder and queue drains
+    running as native stem bursts when stem=native."""
+    import numpy as np
+
+    from firedancer_tpu.ballet import shred as SH
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.tiles.poh import ENTRY_SZ, PohTile
+    from firedancer_tpu.tiles.shred import ShredTile
+    from firedancer_tpu.tiles.sink import SinkTile, read_siglog
+
+    rng = np.random.default_rng(29)
+    payloads = [
+        np.frombuffer(
+            bytes(rng.integers(0, 256, 160, np.uint8)), np.uint8
+        ).copy()
+        for _ in range(n_mbs)
+    ]
+    topo = Topology(
+        name=f"esmoke{os.getpid()}_{runtime[:4]}", runtime=runtime,
+    )
+    topo.link("fb", depth=256, mtu=256)
+    topo.link("poh_shred", depth=1 << 12, mtu=ENTRY_SZ)
+    topo.link("shred_sink", depth=1 << 12, mtu=SH.MAX_SZ)
+    topo.tile(_MbFeeder(payloads), outs=["fb"])
+    # free-running clock with short slots so boundaries (and therefore
+    # FEC sets) occur continuously during the smoke window
+    topo.tile(
+        PohTile(tick_batch=8, ticks_per_slot=32, slot_ms=0),
+        ins=[("fb", True)], outs=["poh_shred"],
+    )
+    topo.tile(
+        ShredTile(signer=_egress_signer),
+        ins=[("poh_shred", True)], outs=["shred_sink"],
+    )
+    topo.tile(SinkTile(shm_log=1 << 14), ins=[("shred_sink", True)])
+    out: dict = {"runtime": runtime, "stem": stem, "ok": False}
+    topo.build()
+    topo.start(batch_max=256, boot_timeout_s=600.0, stem=stem)
+    try:
+        mpoh = topo.metrics("poh")
+        msh = topo.metrics("shred")
+        deadline = time.perf_counter() + deadline_s
+        while time.perf_counter() < deadline:
+            topo.poll_failure()
+            if (
+                mpoh.counter("mixins") >= n_mbs
+                and topo.metrics("sink").counter("in_frags") >= 40
+            ):
+                break
+            time.sleep(0.02)
+        topo.halt()
+        tags = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+        out.update(
+            egress_mixins=mpoh.counter("mixins"),
+            egress_entries=mpoh.counter("entries"),
+            egress_shreds=len(tags),
+            egress_stem_frags=(
+                mpoh.counter("stem_frags") + msh.counter("stem_frags")
+            ),
+            ok=(
+                mpoh.counter("mixins") == n_mbs
+                and len(tags) >= 40
+                # exactly-once at the shred layer: no duplicate
+                # (slot, idx) tag ever lands
+                and len(set(tags.tolist())) == len(tags)
+                and (stem != "native"
+                     or (mpoh.counter("stem_frags") > 0
+                         and msh.counter("stem_frags") > 0))
+            ),
+        )
+    finally:
+        topo.close()
+    leaked = glob.glob(f"/dev/shm/fdt_wksp_{topo.name}*")
+    out["shm_leak"] = leaked
+    if leaked:
+        out["ok"] = False
+    return out
+
+
 def run_relay_ab(
     runtime: str,
     n_chains: int = 2,
@@ -370,6 +489,16 @@ def main(argv: list[str] | None = None) -> int:
     r["ok"] = r["ok"] and pr["ok"]
     if pr["shm_leak"]:
         r["shm_leak"] = r["shm_leak"] + pr["shm_leak"]
+    # block-egress leg (ISSUE 12): feeder -> poh -> shred -> sink,
+    # exactly-once mixins + unique shred tags, same runtime/stem combo
+    er = run_egress_pipeline(args.runtime, stem=args.stem)
+    for k in ("egress_mixins", "egress_entries", "egress_shreds",
+              "egress_stem_frags"):
+        r[k] = er.get(k)
+    r["egress_ok"] = er["ok"]
+    r["ok"] = r["ok"] and er["ok"]
+    if er["shm_leak"]:
+        r["shm_leak"] = r["shm_leak"] + er["shm_leak"]
     if args.json:
         print(json.dumps(r, sort_keys=True))
     else:
@@ -378,8 +507,9 @@ def main(argv: list[str] | None = None) -> int:
             f"{'ok' if r['ok'] else 'FAILED'} — landed {r['landed']} "
             f"({r['unique']} unique of {args.txns}) at {r['tps']:,.0f} "
             f"frags/s, pack {r['pack_mbs']} mbs/"
-            f"{r['pack_completions']} comp, boot {r['boot_s']}s, "
-            f"leak={r['shm_leak']}"
+            f"{r['pack_completions']} comp, egress "
+            f"{r['egress_mixins']} mixins/{r['egress_shreds']} shreds, "
+            f"boot {r['boot_s']}s, leak={r['shm_leak']}"
         )
     return 0 if r["ok"] else 1
 

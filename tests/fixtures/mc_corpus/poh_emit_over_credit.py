@@ -1,0 +1,16 @@
+"""A multi-entry after-credit emitter — the native poh hook's shape
+(tango/native/fdt_poh.c fdt_poh_tick: one tick entry plus slot-boundary
+entries per firing) — publishes its whole emission against ONE credit
+read taken before the burst instead of re-deriving the gate from the
+live consumer fseqs at the boundary, publishing cr+1 entries per round.
+The shipped stem re-derives the hook gate (stem_min_cr over the same
+fdt_fseq words the Python loop reads) at every burst boundary; this
+mutant pins that the checked protocol catches exactly the bug class a
+multi-entry emitter could introduce — see the model-checking-boundary
+note in analysis/README.md."""
+
+MUTATION = "poh-emit-over-credit"
+SCENARIO = "backpressure"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-credit-overflow", "mc-reliable-overrun"}

@@ -1,0 +1,17 @@
+"""A queue-drain publisher — the native shred hook's shape
+(tango/native/fdt_shred.c fdt_shred_drain: the pick-ordered `_outq`
+drain) — trusts ONE cr_avail read across every later drain round
+instead of re-reading the consumer fseqs per round.  The stale first
+read (ring empty: cr_max) then admits a publish every round regardless
+of consumer progress.  The shipped drain re-reads fdt_stem_out_cr —
+over the same fdt_fseq words OutLink.cr_avail() reads — immediately
+before each publish round, so the checked protocol catches exactly the
+bug class the drain boundary could introduce (the queue-drain sibling
+of pack-sched-stale-credit; see the model-checking-boundary note in
+analysis/README.md)."""
+
+MUTATION = "shred-outq-stale-credit"
+SCENARIO = "backpressure"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-credit-overflow", "mc-reliable-overrun"}

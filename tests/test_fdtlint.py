@@ -37,6 +37,9 @@ SIX_BINDING_MODULES = {
     "firedancer_tpu/tiles/wire.py",
     "firedancer_tpu/tiles/bench.py",
     "firedancer_tpu/flamenco/runtime.py",
+    # block-egress call-site binders (ISSUE 12)
+    "firedancer_tpu/tiles/net.py",
+    "firedancer_tpu/tiles/quic.py",
 }
 
 #: known-bad fixture -> the rule it must trip
@@ -98,9 +101,11 @@ def test_abi_coverage_is_substantive(repo_report):
     assert cov["tables"] >= 1
     # 53 pre-fdt_bank symbols + 8 fdt_bank_* batch-executor exports + 3
     # fdt_stem exports (cfg_words / run / bank_pipeline, ISSUE 10) + the
-    # fdt_pack_sched after-credit scheduler (ISSUE 11)
-    assert len(cov["table_symbols"]) >= 64, cov["table_symbols"]
-    assert cov["call_sites"] >= 42  # rings.py methods + the direct binders
+    # fdt_pack_sched after-credit scheduler (ISSUE 11) + the 14
+    # block-egress exports (4 fdt_sha256_*, 2 fdt_poh_*, 3
+    # fdt_shred_*, 3 fdt_net_*, 2 fdt_stem_out_* — ISSUE 12)
+    assert len(cov["table_symbols"]) >= 78, cov["table_symbols"]
+    assert cov["call_sites"] >= 50  # rings.py methods + the direct binders
     # the native exported surface and the ctypes tables are in bijection:
     # no unbound exports, no phantom bindings
     assert set(cov["c_symbols"]) == set(cov["table_symbols"])

@@ -413,3 +413,57 @@ def test_quic_tile_batch_ingest_matches_per_txn_path():
     assert len(g_log) == len(n_log) == 7
     for a, b in zip(g_log, n_log):
         assert bytes(a) == bytes(b), "trailer bytes diverged"
+
+
+def test_quic_backlog_deque_publish_matches_slice_path():
+    """ISSUE 12 satellite: the txn backlog is a deque drained into a
+    preallocated publish buffer (the old list sliced
+    `self._backlog[credits:]` — an O(backlog) copy per burst under
+    backpressure).  The published frag stream across credit-limited
+    bursts must be identical to slicing the same payload list."""
+    from firedancer_tpu.disco.metrics import Metrics
+    from firedancer_tpu.disco.mux import InLink, MuxCtx, OutLink
+    from firedancer_tpu.tango import rings as R
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.quic import QuicIngressTile
+    from firedancer_tpu.tiles.synth import make_txn_pool
+
+    n = 40
+    rows, szs, _ = make_txn_pool(n, seed=8)
+    payloads = [bytes(rows[i, : szs[i]]) for i in range(n)]
+    depth = 256
+    out_mc = R.MCache(np.zeros(R.MCache.footprint(depth), np.uint8), depth)
+    out_dc = R.DCache(
+        np.zeros(R.DCache.footprint(wire.LINK_MTU, depth), np.uint8),
+        wire.LINK_MTU, depth,
+    )
+    cons = R.FSeq(np.zeros(R.FSeq.footprint(), np.uint8))
+    qt = QuicIngressTile(b"\x07" * 32)
+    qt.on_boot(None)
+    schema = qt.schema.with_base()
+    ctx = MuxCtx(
+        "quic", R.CNC(np.zeros(R.CNC.footprint(), np.uint8)), [],
+        [OutLink("txns", out_mc, out_dc, [cons])],
+        Metrics(np.zeros(Metrics.footprint(schema), np.uint8), schema),
+    )
+    qt._backlog.extend(payloads)
+    got = []
+    # credit-starved bursts: 7 at a time
+    while qt._backlog:
+        ctx.credits = 7
+        qt.after_credit(ctx)
+        seq = cons.query()
+        frags, seq, ovr = out_mc.drain(seq, depth)
+        assert ovr == 0 and len(frags) <= 7
+        for f in frags:
+            got.append(
+                (int(f["sig"]), int(f["sz"]),
+                 bytes(out_dc.read(int(f["chunk"]), int(f["sz"]))))
+            )
+        cons.update(seq)
+    assert len(got) == n
+    # order + content identical to the straight payload list, and the
+    # sig is the first 8 signature bytes of each txn
+    for (sig, sz, payload), raw in zip(got, payloads):
+        assert payload[: len(raw)] == raw
+        assert sig == int.from_bytes(raw[1:9], "little")

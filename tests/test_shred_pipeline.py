@@ -64,10 +64,10 @@ def test_shred_store_pipeline(tmp_path):
         assert ms.counter("completed_slots") >= 2
         assert topo.metrics("shred").counter("sign_requests") > 0
         # published requests == responses + in flight at the keyguard
-        # (_pending also counts queued-but-unsent requests in _signq)
+        # (pending_cnt also counts queued-but-unsent requests in _signq)
         assert topo.metrics("shred").counter("sign_requests") == topo.metrics(
             "shred"
-        ).counter("sign_responses") + len(shred._pending) - len(shred._signq)
+        ).counter("sign_responses") + shred.pending_cnt - shred.signq_len
         assert topo.metrics("sign").counter("refused") == 0
         bs = store.store
 
