@@ -45,7 +45,13 @@ BINDING_MODULES = [
 ]
 
 #: directories the ring-discipline linter covers (the tile layer)
-RING_DIRS = ["firedancer_tpu/tiles", "firedancer_tpu/disco"]
+RING_DIRS = [
+    "firedancer_tpu/tiles",
+    "firedancer_tpu/disco",
+    # the wire edge: QUIC + ingress admission policy (ISSUE 13) — the
+    # hot-path-clock rule polices admission/shed classes here too
+    "firedancer_tpu/waltz",
+]
 
 
 @dataclass

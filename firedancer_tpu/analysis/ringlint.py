@@ -75,7 +75,13 @@ over the tile layer (tiles/, disco/):
                        source).  A bare call silently forks the tile
                        off the loop's phase-sampling discipline and the
                        u32 wrap handling the latency attribution
-                       depends on.
+                       depends on.  Coverage extends to every method of
+                       an admission-policy class (Admission / Shedder /
+                       TokenBucket / StakeTable, waltz/admission.py and
+                       anything shaped like it): those methods run
+                       INSIDE the wire-edge hooks, so they take `now`
+                       from the caller's tickcount domain rather than
+                       reading any clock themselves (ISSUE 13).
 
 Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
 `*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
@@ -459,21 +465,65 @@ _CLOCK_ATTRS = {
 }
 
 
+#: ingress admission-policy classes (waltz/admission.py and anything
+#: shaped like it): their methods run INSIDE on_frags/after_credit of
+#: the wire-edge tiles, so the hot-path-clock ban extends to every
+#: method body — admission/shed decisions take `now` from the caller's
+#: tickcount domain, never read the clock themselves
+_ADMISSION_OWNER_RE = ("Admission", "Shedder", "TokenBucket", "StakeTable")
+
+
+def _iter_admission_methods(tree: ast.AST):
+    """Yield (class_name, method) for every method of an admission-
+    policy class — the hot-path-clock rule's ISSUE 13 coverage
+    extension.  A class matching BOTH an admission tag and a
+    Worker/Pool/Policy tag stays admission-policed (the device carve-
+    out is about owning a thread; admission state never does)."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if not any(tag in cls.name for tag in _ADMISSION_OWNER_RE):
+            continue
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls.name, fn
+
+
+def _bare_clock_calls(fn: ast.AST):
+    for call in ast.walk(fn):
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in _CLOCK_ATTRS
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "time"
+        ):
+            yield call
+
+
 def _check_hot_clock(path: str, tree: ast.AST) -> list[Finding]:
     """hot-path-clock: no bare time.* clock reads in tile
     on_frags/after_credit bodies (the Worker/Pool/Policy carve-out is
-    _iter_tile_hooks', shared with device-dispatch)."""
+    _iter_tile_hooks', shared with device-dispatch), nor anywhere in an
+    admission-policy class (Admission/Shedder/TokenBucket/StakeTable —
+    their methods run inside those hooks at the wire edge)."""
     findings: list[Finding] = []
+    for cls_name, fn in _iter_admission_methods(tree):
+        for call in _bare_clock_calls(fn):
+            findings.append(
+                Finding(
+                    path, call.lineno, "hot-path-clock",
+                    f"bare clock read time.{call.func.attr}() in "
+                    f"admission-policy method {cls_name}.{fn.name} — "
+                    "admission/shed decisions run inside the wire-edge "
+                    "tile's on_frags/after_credit: take `now` from the "
+                    "caller (tango.tempo.tickcount domain) instead of "
+                    "reading the clock, so the policy stays replayable "
+                    "and off the loop's phase-sampling path",
+                )
+            )
     for fn in _iter_tile_hooks(tree):
-        for call in ast.walk(fn):
-            if not (
-                isinstance(call, ast.Call)
-                and isinstance(call.func, ast.Attribute)
-                and call.func.attr in _CLOCK_ATTRS
-                and isinstance(call.func.value, ast.Name)
-                and call.func.value.id == "time"
-            ):
-                continue
+        for call in _bare_clock_calls(fn):
             findings.append(
                 Finding(
                     path, call.lineno, "hot-path-clock",

@@ -14,6 +14,26 @@ Config shape (all keys optional; defaults below):
     [tiles.quic]
     quic_port = 0                    # 0 = ephemeral
     udp_port = 0
+    # ingress admission (waltz/admission.py AdmissionConfig; all
+    # optional — omitted keys take the permissive defaults: every
+    # limit off except the pre-existing global connection cap):
+    max_conns = 4096                 # global live-connection cap
+    max_conns_per_source = 0         # per-source-IP cap, 0 = off
+    handshake_rate = 0               # handshakes/s, 0 = unlimited
+    handshake_burst = 32
+    txn_rate = 0                     # per-connection txns/s, 0 = off
+    txn_burst = 64
+    idle_timeout_s = 0.0             # idle-churn eviction, 0 = off
+    handshake_timeout_s = 0.0        # slow-loris eviction, 0 = off
+    backlog_cap = 8192               # txn backlog across stake classes
+    shed_hi = 0.75                   # shed escalation occupancy
+    shed_lo = 0.25                   # shed de-escalation occupancy
+    shed_cooldown_s = 1.0
+    shed_dwell_s = 0.1               # min time between level raises
+    low_stake = 1000                 # weight under this = low-stake
+    [stakes]                         # identity -> stake weight (QoS);
+    "0xdeadbeef..." = 500000         # 0x-prefixed = hex TLS identity
+    "127.0.0.1:9000" = 1000000       # else a literal addr identity
     [tiles.verify]
     count = 1                        # horizontal seq-sharded replicas
     max_lanes = 4096
@@ -66,6 +86,14 @@ class Config:
     #: tile runtime from `[topo] runtime = "thread"|"process"`; None
     #: defers to the FDT_RUNTIME env / the thread default (disco/topo.py)
     runtime: str | None = None
+    #: ingress admission policy (waltz/admission.py AdmissionConfig)
+    #: from the `[tiles.quic]` admission keys; None = permissive
+    #: defaults (bit-compatible with the pre-hardening build)
+    quic_admission: object | None = None
+    #: `[stakes]` section: source identity -> stake weight (the
+    #: quic->verify QoS gate input); raw dict, StakeTable-parsed by the
+    #: topology builders
+    stakes: dict = field(default_factory=dict)
     #: data-plane inner loop from `[topo] stem = "python"|"native"`:
     #: "native" runs registered tile handlers (dedup/bank/pack) through
     #: the GIL-released fdt_stem burst loop; None defers to FDT_STEM
@@ -113,10 +141,20 @@ def parse(text: str) -> Config:
     q = t.get("quic", {})
     v = t.get("verify", {})
     d = t.get("dedup", {})
+    from firedancer_tpu.waltz.admission import AdmissionConfig
+    import dataclasses as _dc
+
+    admission_keys = {
+        f.name for f in _dc.fields(AdmissionConfig)
+    } & set(q)
     return Config(
         name=doc.get("name", "fdt"),
         runtime=doc.get("topo", {}).get("runtime"),
         stem=doc.get("topo", {}).get("stem"),
+        quic_admission=(
+            AdmissionConfig.from_dict(q) if admission_keys else None
+        ),
+        stakes=dict(doc.get("stakes", {})),
         quic_port=q.get("quic_port", 0),
         udp_port=q.get("udp_port", 0),
         verify_count=v.get("count", 1),
@@ -148,6 +186,15 @@ def parse(text: str) -> Config:
         slo=SloConfig.from_dict(doc["slo"]) if "slo" in doc else None,
         raw=doc,
     )
+
+
+def _quic_policy(cfg: Config):
+    """(AdmissionConfig, StakeTable) for the ingress tile from the
+    parsed config — one place so both topology shapes agree."""
+    from firedancer_tpu.waltz.admission import AdmissionConfig, StakeTable
+
+    adm = cfg.quic_admission or AdmissionConfig()
+    return adm, StakeTable.from_config(cfg.stakes, low_stake=adm.low_stake)
 
 
 def build_validator_topology(cfg: Config, identity_secret: bytes,
@@ -189,7 +236,10 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
         quic_addr=("0.0.0.0", cfg.quic_port),
         udp_addr=("0.0.0.0", cfg.udp_port),
     )
-    qt = QuicIngressTile(identity_secret, via_net=True)
+    adm, stakes = _quic_policy(cfg)
+    qt = QuicIngressTile(
+        identity_secret, via_net=True, admission=adm, stakes=stakes
+    )
     topo.link("net_quic", depth=depth, mtu=NET_MTU)
     topo.link("quic_net", depth=depth, mtu=NET_MTU)
     topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
@@ -300,10 +350,13 @@ def build_ingress_topology(
 
     topo = Topology(name=cfg.name, runtime=cfg.runtime, stem=cfg.stem)
     topo.slo = cfg.slo
+    adm, stakes = _quic_policy(cfg)
     qt = QuicIngressTile(
         identity_secret,
         quic_addr=("0.0.0.0", cfg.quic_port),
         udp_addr=("0.0.0.0", cfg.udp_port),
+        admission=adm,
+        stakes=stakes,
     )
     depth = cfg.link_depth
     topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)

@@ -179,6 +179,27 @@ class Monitor:
                     f"NOTE {name}: {c['fallback_batches']} batches on the "
                     f"host fallback path"
                 )
+            # ingress load-shed state (hardened quic tiles): emergency
+            # staked-only is an alarm; any active shedding is a note
+            lvl = c.get("shed_level")
+            if lvl:
+                from firedancer_tpu.waltz.admission import LoadShedder
+
+                label = LoadShedder.LEVEL_NAMES[
+                    min(int(lvl), LoadShedder.MAX_LEVEL)
+                ]
+                line = (
+                    f"{name}: ingress shed level {lvl} ({label}) after "
+                    f"{c.get('shed_transitions', 0)} transitions"
+                )
+                out.append(
+                    f"ALARM {line}" if int(lvl) >= 3 else f"NOTE {line}"
+                )
+            if c.get("tx_eagain_drops"):
+                out.append(
+                    f"NOTE {name}: {c['tx_eagain_drops']} egress datagrams "
+                    f"dropped on EAGAIN (socket send buffer pressure)"
+                )
             # per-device fault domains (the verify pool): a quarantined /
             # stalled / dead device alarms as `verify0_dev3_degraded`
             # style lines — one device degrading is NOT tile degradation
@@ -282,6 +303,30 @@ class Monitor:
                     f"{prof['credit_frac'] * 100:.0f}% bp "
                     f"{prof.get('bp_frac', 0) * 100:.0f}% | sched_lag "
                     f"p99={prof['sched_lag_p99_us']:,.0f}us"
+                )
+            # ingress-defense sub-row (hardened quic tiles): shed level
+            # + the drop ledger by reason, so "where did the flood die"
+            # is answerable from the monitor alone
+            if "shed_level" in c and (
+                c.get("gate_txns") or c.get("shed_level")
+            ):
+                drops = {
+                    "conn": c.get("drop_conn_cap", 0)
+                    + c.get("drop_source_cap", 0)
+                    + c.get("drop_emergency", 0),
+                    "hs": c.get("drop_handshake_rate", 0),
+                    "rate": c.get("drop_txn_rate", 0),
+                    "shed": c.get("shed_unstaked", 0)
+                    + c.get("shed_lowstake", 0)
+                    + c.get("shed_backlog", 0),
+                    "evict": c.get("conns_evicted_idle", 0)
+                    + c.get("conns_evicted_handshake", 0),
+                }
+                lines.append(
+                    f"{'':>10}   ingress: level={c.get('shed_level', 0)} "
+                    f"staked={c.get('admit_staked', 0):,} "
+                    f"unstaked={c.get('admit_unstaked', 0):,} | drops "
+                    + " ".join(f"{k}={v:,}" for k, v in drops.items())
                 )
             # device-pool health sub-rows (tiles exporting dev{i}_*
             # counters — the multi-device verify scale-out)

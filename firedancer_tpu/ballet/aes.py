@@ -145,11 +145,26 @@ class Ghash:
 
     def __init__(self, h: bytes):
         hi = int.from_bytes(h, "big")
+        # The table entry for (pos, b) is H * (b << 8*(15-pos)) in
+        # GF(2^128) — LINEAR in the bits of the integer operand.  So
+        # instead of 4096 bit-serial multiplies (the per-AesGcm cost
+        # that made a pure handshake flood expensive for US, not the
+        # attacker), precompute the 128 single-bit products with one
+        # conditional reduction step each, then build every row by
+        # subset-xor.  Bit j of the operand contributes H halved
+        # (127-j) times (the _gf128_mul loop order), so:
+        p = [0] * 128
+        v = hi
+        for j in range(127, -1, -1):
+            p[j] = v
+            v = (v >> 1) ^ _R if v & 1 else v >> 1
         self.table = []
         for pos in range(16):
-            row = []
-            for b in range(256):
-                row.append(_gf128_mul(hi, b << (8 * (15 - pos))))
+            base = 8 * (15 - pos)
+            row = [0] * 256
+            for b in range(1, 256):
+                low = b & -b
+                row[b] = row[b ^ low] ^ p[base + low.bit_length() - 1]
             self.table.append(row)
 
     def _mul_h(self, x: int) -> int:

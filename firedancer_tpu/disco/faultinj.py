@@ -53,18 +53,39 @@ class Fault:
     """One scheduled fault.
 
     tile:  target tile name.
-    kind:  kill | stall | backpressure | drop | corrupt | device_error.
-    at:    trigger index — loop-iteration tick (kill/stall/backpressure
-           with on="tick"), cumulative in-frag count (on="frag", and
-           always for drop/corrupt), or device-batch index
-           (device_error).  All indices are cumulative across restarts.
-    on:    "tick" or "frag" trigger domain for kill/stall/backpressure.
+    kind:  kill | stall | backpressure | drop | corrupt | device_error
+           | flood | conn_churn.
+    at:    trigger index — loop-iteration tick (kill/stall/backpressure/
+           flood/conn_churn with on="tick"), cumulative in-frag count
+           (on="frag", and always for drop/corrupt), or device-batch
+           index (device_error).  All indices are cumulative across
+           restarts.
+    on:    "tick" or "frag" trigger domain for kill/stall/backpressure/
+           flood/conn_churn.
     count: frags affected (drop/corrupt), iterations squeezed
-           (backpressure), or device batches failed (device_error).
+           (backpressure), device batches failed (device_error), or
+           hostile items synthesized (flood/conn_churn).
     frac:  per-frag probability within the [at, at+count) window for
            drop/corrupt (seeded hash, batch-boundary independent).
     duration_s: stall length (heartbeat starvation time).
     link:  restrict drop/corrupt to one in-link name (None = all).
+           For flood faults the field doubles as the ATTACK PROFILE the
+           consuming tile synthesizes ("garbage" | "handshake" |
+           "loris" | "malformed" | "smallorder" | "dup"; None = the
+           tile default).
+
+    flood / conn_churn are INJECTED-TRAFFIC faults (ISSUE 13): when one
+    fires (point 1, same trigger domains as kill/stall) it is
+    canonical-record'd like every other kind, then parked on the view's
+    pending-injection list; a tile that understands hostile ingress
+    (tiles/quic.py synthesizes connection floods / churn storms / txn
+    spam, tiles/synth.py synthesizes duplicate storms) drains it via
+    `take_injected()` and generates the traffic IN-PROCESS from the
+    injector's seed — one injection path shared by chaos_soak.py and
+    scripts/adversary.py, identical under the thread and process
+    runtimes.  A kill between fire and consumption loses that pending
+    injection for the dead incarnation (the fired flag is durable, so
+    it never re-fires — the canonical record stays exact).
     device: restrict device_error to one device-pool domain (None = the
            tile's merged batch stream).  A targeted fault's `at` indexes
            THAT device's own batch sequence, which stays deterministic
@@ -123,6 +144,58 @@ class FaultInjector:
             (i, f) for i, f in enumerate(self.faults) if f.tile == tile_name
         ]
         return TileFaults(self, tile_name, mine)
+
+    def fold_shm_fired(self, tile: str, mem_u8) -> None:
+        """Parent-side restore of a CHILD's durable fired flags
+        (TileFaults.bind_shm layout) into this injector's event log.
+
+        Under process isolation the child's `log()` calls land in the
+        child's reconstructed injector, so the parent's canonical
+        record — what incident bundles embed and fdtincident classifies
+        against — would read empty.  The fired FLAGS survive in the
+        tile's fstat workspace region, and every tick-domain fault's
+        log detail is schedule-derivable, so the parent can synthesize
+        the exact event (kill/stall/backpressure/flood/conn_churn).
+        Frag-domain kinds (drop/corrupt) and device_error fire with
+        per-frag / per-batch detail only the child saw; they synthesize
+        with EMPTY detail — kind and window are canonical, the frag
+        list is not (classification keys off kinds, never the list)."""
+        mine = [(i, f) for i, f in enumerate(self.faults) if f.tile == tile]
+        if not mine:
+            return
+        w = mem_u8[: (len(mem_u8) // 8) * 8].view(np.uint64)
+        if len(w) < 2 + len(mine):
+            return
+        with self._lock:
+            have = {(e[0], e[1], e[2]) for e in self.events}
+        for k, (_, f) in enumerate(mine):
+            if not w[2 + k]:
+                continue
+            f.fired = True
+            if f.kind in ("flood", "conn_churn"):
+                ev = (tile, f.kind, f.at, (f.count, f.link))
+            elif f.kind == "kill":
+                ev = (tile, "kill", f.at, None)
+            elif f.kind == "stall":
+                ev = (tile, "stall", f.at, f.duration_s)
+            elif f.kind == "backpressure":
+                ev = (tile, "backpressure", f.at, f.count)
+            else:  # drop / corrupt / device_error: detail is child-only
+                ev = (tile, f.kind, f.at, [])
+            if (tile, f.kind, f.at) not in have:
+                self.log(*ev)
+
+    def fold_topology(self, topo) -> None:
+        """fold_shm_fired over every tile with an fstat region (the
+        process runtime); a no-op for thread topologies, where the
+        shared injector already holds the events."""
+        wksp = getattr(topo, "wksp", None)
+        if wksp is None:
+            return
+        for name in topo.tiles:
+            key = f"fstat_{name}"
+            if key in getattr(wksp, "_allocs", {}):
+                self.fold_shm_fired(name, wksp.view(key))
 
     def fired(self) -> list[tuple]:
         """Canonical record of everything that fired: drop/corrupt
@@ -198,10 +271,17 @@ class TileFaults:
         #: breaks the injector's determinism contract)
         self._dev_lock = threading.Lock()
         self._squeeze = 0
+        #: fired-but-unconsumed injected-traffic faults, drained by the
+        #: owning tile via take_injected(): (fault_idx, kind, count,
+        #: profile) tuples
+        self._injected: list[tuple[int, str, int, str | None]] = []
         self._tick_faults = [
             (i, f)
             for i, f in faults
             if f.kind in ("kill", "stall", "backpressure")
+        ]
+        self._inj_faults = [
+            (i, f) for i, f in faults if f.kind in ("flood", "conn_churn")
         ]
         self._frag_faults = [
             (i, f) for i, f in faults if f.kind in ("drop", "corrupt")
@@ -246,6 +326,20 @@ class TileFaults:
         self.ticks += 1
         if self._shm is not None:
             self._shm[0] = np.uint64(self.ticks)
+        # injected-traffic faults fire first (a same-tick kill must not
+        # swallow a scheduled flood's canonical record)
+        for i, f in self._inj_faults:
+            if f.fired:
+                continue
+            ref = self.ticks if f.on == "tick" else self.frags_seen
+            if ref < f.at:
+                continue
+            f.fired = True
+            self._persist_fired(f)
+            self.inj.log(self.tile, f.kind, f.at, (f.count, f.link))
+            if self.tracer is not None:
+                self.tracer.fault(f.kind, seq=f.at, aux64=f.count)
+            self._injected.append((i, f.kind, f.count, f.link))
         for _, f in self._tick_faults:
             if f.fired:
                 continue
@@ -287,6 +381,14 @@ class TileFaults:
                     f"{self.tile}: stall abandoned by supervisor"
                 )
             time.sleep(2e-3)
+
+    def take_injected(self) -> list[tuple[int, str, int, str | None]]:
+        """Drain fired-but-unconsumed flood/conn_churn injections (the
+        owning tile synthesizes the hostile traffic; see Fault docs)."""
+        if not self._injected:
+            return []
+        out, self._injected = self._injected, []
+        return out
 
     # -- point 2: credit gate ---------------------------------------------
 

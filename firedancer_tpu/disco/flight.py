@@ -252,6 +252,12 @@ class FlightRecorder:
         self._dev_degraded: dict[tuple[str, int], int] = {}
         self._tile_degraded: dict[str, int] = {}
         self._slo_breached: dict[str, bool] = {}
+        #: ingress load-shed level per tile (escalation-edge detector)
+        self._shed_level: dict[str, int] = {}
+        #: shared `shed` region (waltz/admission.py layout) — resolved
+        #: lazily; the SLO engine's recommended level is written there
+        #: as the quic tile's commanded floor
+        self._shed_words = None
         os.makedirs(out_dir, exist_ok=True)
 
     # -- trigger wiring ---------------------------------------------------
@@ -321,12 +327,14 @@ class FlightRecorder:
         snap = snapshot_topology(self.topo)
         self._write_boxes(snap)
         self._detect_quarantine(snap)
+        self._detect_shed(snap)
         if self._sup is None:
             self._detect_degraded(snap)
         if self.slo is not None:
             self.slo.observe(snap)
             self.slo.evaluate()
             self._export_slo_gauges()
+            self._command_shed(self.slo.recommended_shed_level())
             for name, breached in self.slo.breached_now.items():
                 was = self._slo_breached.get(name, False)
                 if breached and not was:
@@ -395,6 +403,56 @@ class FlightRecorder:
                          "failed": dev.get("failed", 0)},
                     )
                 self._dev_degraded[(name, i)] = cur
+
+    def _detect_shed(self, snap: dict) -> None:
+        """Ingress load-shed escalation edges (ISSUE 13): every UPWARD
+        `shed_level` transition of a hardened ingress tile freezes an
+        incident bundle — a flood that forced degradation is an
+        incident with evidence attached, not just a counter blip.
+        De-escalations are silent (recovery is the desired path)."""
+        for name, row in snap.items():
+            if name == "_links":
+                continue
+            c = row["counters"]
+            if "shed_level" not in c:
+                continue
+            cur = int(c["shed_level"])
+            was = self._shed_level.get(name, 0)
+            if cur > was:
+                self._incident(
+                    "shed", name,
+                    {"level": cur, "from": was,
+                     "transitions": c.get("shed_transitions", 0)},
+                )
+            self._shed_level[name] = cur
+
+    def _command_shed(self, level: int) -> None:
+        """Write the SLO engine's recommended shed level into the shared
+        `shed` region (the quic tile's commanded floor).  Words 0/1 are
+        the recorder's; the tile owns words 2/3 (waltz/admission.py
+        layout)."""
+        from firedancer_tpu.waltz.admission import (
+            SHED_FOOTPRINT, SHED_W_BURN, SHED_W_COMMANDED,
+        )
+
+        if self._shed_words is False:
+            return  # no tile budgeted the region: latched off
+        if self._shed_words is None:
+            try:
+                mem = self.topo.wksp.alloc("shared_shed", SHED_FOOTPRINT)
+            except Exception:  # noqa: BLE001 — attached wksp cannot alloc
+                # latch: the region will never appear mid-run, and
+                # raising+catching once per poll is an exception storm
+                self._shed_words = False
+                return
+            self._shed_words = mem[: (len(mem) // 8) * 8].view(np.uint64)
+        self._shed_words[SHED_W_COMMANDED] = np.uint64(max(level, 0))
+        burn = max(
+            (s.burn_fast for s in self.slo._last), default=0.0
+        )
+        self._shed_words[SHED_W_BURN] = np.uint64(
+            int(min(max(burn, 0.0), 1e6) * 1000)
+        )
 
     def _detect_degraded(self, snap: dict) -> None:
         """Fallback breaker detection via the shared degraded gauge,
@@ -475,6 +533,10 @@ class FlightRecorder:
             },
         }
         if self.faults is not None:
+            # process runtime: the children's durable fired flags fold
+            # into the parent record first, so a bundle frozen by the
+            # parent classifies identically under both runtimes
+            self.faults.fold_topology(self.topo)
             bundle["faultinj"] = {
                 "seed": self.faults.seed,
                 "fired": [list(e) for e in self.faults.fired()],

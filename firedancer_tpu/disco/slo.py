@@ -368,6 +368,38 @@ class SloEngine:
             ),
         )
 
+    def recommended_shed_level(self) -> int:
+        """Map the last evaluation's burn rates to a commanded ingress
+        load-shed level (waltz/admission.py LoadShedder semantics; the
+        flight recorder writes it into the shared `shed` region and the
+        quic tile treats it as a FLOOR under its local backpressure
+        view):
+
+            0  no latency/throughput SLO burning
+            1  budget burning (fast burn >= 1): shed unstaked
+            2  fast burn at alert threshold: shed low-stake too
+            3  confirmed breach: emergency staked-only
+
+        Only the tail-LATENCY SLOs drive shedding.  drop_rate_max is
+        excluded (shedding RAISES the drop rate by design) and so is
+        landed_tps_min (shedding LOWERS landed throughput): feeding
+        either back would be positive feedback — a benign traffic lull
+        burns the throughput floor, commands a shed, which lowers
+        landed TPS further and latches the shedder at max forever.
+        Shedding is judged right only if it protects the latency tail,
+        so only the latency tail may command it."""
+        lvl = 0
+        for s in self._last:
+            if s.name not in ("e2e_p99_us", "verify_hop_p99_us"):
+                continue
+            if s.breached:
+                lvl = max(lvl, 3)
+            elif s.burn_fast >= self.cfg.burn_fast:
+                lvl = max(lvl, 2)
+            elif s.burn_fast >= 1.0:
+                lvl = max(lvl, 1)
+        return lvl
+
     # -- surfacing --------------------------------------------------------
 
     def alarm_rows(self) -> list[str]:

@@ -62,7 +62,7 @@ KIND_NAMES = {
 #: are visible (and assertable) in the assembled timeline
 FAULT_CODES = {
     "kill": 1, "stall": 2, "backpressure": 3, "drop": 4, "corrupt": 5,
-    "device_error": 6, "restart": 7,
+    "device_error": 6, "restart": 7, "flood": 8, "conn_churn": 9,
 }
 FAULT_NAMES = {v: k for k, v in FAULT_CODES.items()}
 

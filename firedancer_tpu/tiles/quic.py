@@ -9,9 +9,48 @@ waltz.udpsock burst interface; stream reassembly lives inside
 waltz.quic.Connection and the txn parse/trailer format is shared with the
 synth tile (tiles/wire.py), so downstream tiles cannot tell wire ingress
 from synthetic ingress.
+
+Hostile-ingress hardening (ISSUE 13): this tile is the front line
+against the open internet, so every admission decision is explicit and
+every rejection is a METERED DROP with a reason code — never an
+exception out of the tile loop:
+
+  * connection admission (waltz/admission.py ConnAdmission wired into
+    the QuicServer): global + per-source caps, handshake-rate limiting
+    with stateless-Retry backoff signaling, idle-churn and
+    slow-loris (handshake-deadline) eviction;
+  * a per-connection txn-rate token bucket at drain time;
+  * a stake-weighted QoS gate at quic->verify: a StakeTable classes
+    each source (TLS identity when the handshake completed, address
+    identity otherwise) into unstaked / low-stake / high-stake; the
+    txn backlog is one bounded priority queue PER CLASS, drained
+    high-first, with preemption — at capacity an arriving staked txn
+    evicts the oldest queued lower-class txn instead of being refused;
+  * SLO-driven load shedding (LoadShedder): explicit degradation
+    levels (admit-all -> shed-unstaked -> shed-lowstake ->
+    emergency-staked-only) driven by live backlog occupancy AND the
+    burn-rate engine's commanded level from the shared `shed` region
+    (disco/slo.py recommended_shed_level, written by the flight
+    recorder); transitions are metered (`shed_level` gauge,
+    `shed_transitions`) and each escalation freezes an fdtflight
+    incident bundle.
+
+The txn ledger closes by construction: gate_txns (txns presented to
+the QoS gate) == admit_staked + admit_unstaked + drop_txn_rate +
+shed_unstaked + shed_lowstake; the adversarial harness
+(scripts/adversary.py) asserts it.  `shed_backlog` meters BOTH
+refused enqueues and preemption victims — a preempted txn was already
+admitted and counted toward rx_txns_* when first enqueued — so it is
+a drop counter, not a term of the admit identity.
+
+All admission/shed decisions run in the tango.tempo.tickcount clock
+domain — the fdtlint `hot-path-clock` rule bans bare time.* reads from
+this hot path and from every Admission/Shed class.
 """
 
 from __future__ import annotations
+
+import collections
 
 import numpy as np
 
@@ -19,10 +58,23 @@ from firedancer_tpu.ballet import pack as P
 from firedancer_tpu.ballet import txn as T
 from firedancer_tpu.disco.metrics import MetricsSchema
 from firedancer_tpu.disco.mux import MuxCtx, Tile
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.waltz import admission as ADM
 from firedancer_tpu.waltz import quic as Q
+from firedancer_tpu.waltz.admission import (
+    AdmissionConfig,
+    ConnAdmission,
+    LoadShedder,
+    StakeTable,
+)
 from firedancer_tpu.waltz.udpsock import UdpSock
 
 from . import wire
+
+#: stake classes drained high-priority-first by the publish path
+_N_CLASSES = 3
+#: gate-shed counter per class (CLASS_HI is never level-shed)
+_SHED_COUNTER = ("shed_unstaked", "shed_lowstake", None)
 
 
 class QuicIngressTile(Tile):
@@ -37,6 +89,34 @@ class QuicIngressTile(Tile):
             "rx_txns_udp",
             "parse_fail_txns",
             "conns_opened",
+            # ---- hostile-ingress hardening (ISSUE 13) ----
+            # txn-level QoS gate ledger: gate_txns == admit_staked +
+            # admit_unstaked + drop_txn_rate + shed_unstaked +
+            # shed_lowstake (checked by scripts/adversary.py)
+            "gate_txns",
+            "admit_staked",
+            "admit_unstaked",
+            "drop_txn_rate",
+            "shed_unstaked",
+            "shed_lowstake",
+            "shed_backlog",
+            # connection admission refusals (waltz/admission.py REASONS)
+            "drop_conn_cap",
+            "drop_source_cap",
+            "drop_handshake_rate",
+            "drop_emergency",
+            "retry_sent",
+            # eviction sweeps: idle churn / never-completed handshakes
+            "conns_evicted_idle",
+            "conns_evicted_handshake",
+            # load-shed controller state (gauge + cumulative edges)
+            "shed_level",
+            "shed_transitions",
+            # hostile traffic synthesized by injected flood/conn_churn
+            # faults (disco/faultinj.py take_injected)
+            "adv_injected",
+            # egress-burst tail dropped on EAGAIN (was a silent drop)
+            "tx_eagain_drops",
         ),
     )
 
@@ -48,28 +128,64 @@ class QuicIngressTile(Tile):
         udp_addr=("127.0.0.1", 0),
         burst: int = 256,
         via_net: bool = False,
+        admission: AdmissionConfig | None = None,
+        stakes: StakeTable | None = None,
     ):
         """via_net=True: sans-IO mode behind a NetTile — ins[0] carries
         addr-prefixed datagram frags, outs[-1] is the tx ring back to the
-        net tile (reference topology: net -> quic -> net)."""
+        net tile (reference topology: net -> quic -> net).
+
+        admission / stakes: the ingress-defense policy (plain dataclass /
+        dict state, so the tile stays spawn-picklable for the process
+        runtime).  None = permissive defaults, bit-compatible with the
+        pre-hardening build at shed level 0."""
         self.identity_secret = identity_secret
         self._quic_addr_req = quic_addr
         self._udp_addr_req = udp_addr
         self.burst = burst
         self.via_net = via_net
+        self.admission_cfg = admission or AdmissionConfig()
+        self.stakes = stakes or StakeTable(
+            low_stake=self.admission_cfg.low_stake
+        )
         self.quic_sock: UdpSock | None = None
         self.udp_sock: UdpSock | None = None
         self.server: Q.QuicServer | None = None
-        import collections
+        self.admission_ctl: ConnAdmission | None = None
+        self.shedder: LoadShedder | None = None
+        self._shed_words: np.ndarray | None = None
 
-        # parsed txn+trailer payloads: a deque + preallocated publish
-        # buffer — the old list sliced `self._backlog[credits:]` every
-        # burst, an O(backlog) copy per iteration under backpressure
-        self._backlog: collections.deque = collections.deque()
+        # parsed txn+trailer payloads: one bounded deque per stake
+        # class, drained high-class-first by the publish path (staked
+        # traffic preempts unstaked when verify credits are scarce).
+        # Deques + a preallocated publish buffer — the old list sliced
+        # `self._backlog[credits:]` every burst, an O(backlog) copy per
+        # iteration under backpressure
+        self._backlogs: list[collections.deque] = [
+            collections.deque() for _ in range(_N_CLASSES)
+        ]
+        self._backlog_total = 0
         self._tx_backlog: collections.deque = collections.deque()
         self._pub_rows: np.ndarray | None = None
         self._tx_rows: np.ndarray | None = None
         self._tx_szs: np.ndarray | None = None
+        # recently gate-admitted raw txns: the duplicate-storm pool the
+        # injected `flood` fault replays copies from
+        self._recent_raws: collections.deque = collections.deque(maxlen=64)
+        # fired-but-unsynthesized injected-attack chunks: (fault_idx,
+        # kind, profile, next_offset, end) — drained a bounded slice per
+        # iteration so a huge wave can never starve the heartbeat
+        self._inj_pending: collections.deque = collections.deque()
+        self._churn_n = 0
+        self._smallorder_tmpl: bytes | None = None
+
+    @property
+    def _backlog(self) -> collections.deque:
+        """Compat view: the unstaked-class queue (everything, when no
+        stake table is configured).  NOTE: direct appends bypass the
+        `_backlog_total` accounting the shed controller reads — tests
+        only; production paths go through `_enqueue`."""
+        return self._backlogs[ADM.CLASS_UNSTAKED]
 
     # bound addresses, available after on_boot (ports may be ephemeral)
     @property
@@ -80,6 +196,12 @@ class QuicIngressTile(Tile):
     def udp_addr(self):
         return self.udp_sock.addr
 
+    def shared_wksp_footprints(self) -> dict[str, int]:
+        # the SLO-engine -> shed-controller backchannel: the flight
+        # recorder writes the commanded minimum level, this tile writes
+        # the live level (disjoint words; waltz/admission.py layout)
+        return {"shed": ADM.SHED_FOOTPRINT}
+
     def on_boot(self, ctx: MuxCtx) -> None:
         if not self.via_net and self.quic_sock is None:
             # restart-safe: a supervised re-incarnation keeps the bound
@@ -87,8 +209,20 @@ class QuicIngressTile(Tile):
             # opens them
             self.quic_sock = UdpSock(self._quic_addr_req)
             self.udp_sock = UdpSock(self._udp_addr_req)
+        if self.admission_ctl is None:
+            self.admission_ctl = ConnAdmission(
+                self.admission_cfg, self.stakes
+            )
+            self.shedder = LoadShedder(self.admission_cfg)
         if self.server is None:
-            self.server = Q.QuicServer(self.identity_secret)
+            self.server = Q.QuicServer(
+                self.identity_secret,
+                max_conns=self.admission_cfg.max_conns,
+                admission=self.admission_ctl,
+            )
+        if ctx is not None and self._shed_words is None:
+            mem = ctx.shared("shed", ADM.SHED_FOOTPRINT)
+            self._shed_words = mem[: (len(mem) // 8) * 8].view(np.uint64)
 
     def on_halt(self, ctx: MuxCtx) -> None:
         if self.quic_sock:
@@ -107,7 +241,14 @@ class QuicIngressTile(Tile):
         if not out_pkts:
             return
         if not self.via_net:
-            ctx.metrics.inc("tx_dgrams", self._send_burst_native(out_pkts))
+            sent = self._send_burst_native(out_pkts)
+            ctx.metrics.inc("tx_dgrams", sent)
+            if sent < len(out_pkts):
+                # EAGAIN dropped the burst tail — a declared, metered
+                # drop (monitor NOTE row), not a silent one (ISSUE 13
+                # satellite; the tail is unrecoverable either way:
+                # QUIC loss recovery retransmits what mattered)
+                ctx.metrics.inc("tx_eagain_drops", len(out_pkts) - sent)
             return
         self._tx_backlog.extend(out_pkts)
         self._flush_tx(ctx)
@@ -115,7 +256,8 @@ class QuicIngressTile(Tile):
     def _send_burst_native(self, pkts) -> int:
         """One batched-datagram syscall per burst instead of a Python
         sendto per packet; oversize payloads (never produced by our
-        QUIC encoder) fall back to the per-packet path."""
+        QUIC encoder) fall back to the per-packet path.  Returns the
+        count actually sent; the caller meters any EAGAIN tail."""
         from firedancer_tpu.tiles.net import NET_MTU, addr_pack
         from firedancer_tpu.tango import rings as R
 
@@ -141,7 +283,7 @@ class QuicIngressTile(Tile):
             )
             sent += max(int(got), 0)
             if got < len(chunk):
-                break  # EAGAIN: drop the tail (send_burst semantics)
+                break  # EAGAIN: the caller meters the dropped tail
         return sent
 
     def _flush_tx(self, ctx: MuxCtx) -> None:
@@ -169,6 +311,19 @@ class QuicIngressTile(Tile):
         ctx.metrics.inc("tx_dgrams", n)
 
     def during_housekeeping(self, ctx: MuxCtx) -> None:
+        now = tempo.tickcount()
+        # idle-churn + slow-loris eviction sweep: connections silent
+        # past idle_timeout, or still handshaking past the handshake
+        # deadline (trickled garbage keeps a loris conn "active", so
+        # activity alone must not grant residency)
+        if self.admission_ctl is not None and self.server is not None:
+            idle, loris = self.admission_ctl.sweep(self.server, now)
+            for addr in idle:
+                if self.server.evict(addr):
+                    ctx.metrics.inc("conns_evicted_idle")
+            for addr in loris:
+                if self.server.evict(addr):
+                    ctx.metrics.inc("conns_evicted_handshake")
         # loss-recovery probe timers: retransmit when acks stall
         out_pkts = []
         for addr, conn in list(self.server.by_addr.items()):
@@ -177,15 +332,108 @@ class QuicIngressTile(Tile):
                 out_pkts.append((d, addr))
         self._tx(ctx, out_pkts)
 
+    # ---- stake-weighted txn gate ----------------------------------------
+
+    def _conn_identity(self, conn, addr) -> bytes:
+        """Stake identity: the TLS peer identity once the handshake
+        completed (a staked validator proves its key), else the address
+        identity (legacy UDP / pre-handshake sources are at best
+        address-staked)."""
+        pid = getattr(conn, "peer_identity", None) if conn else None
+        return bytes(pid) if pid else ADM.addr_identity(addr)
+
+    def _gate_raws(
+        self, ctx: MuxCtx, raws: list[bytes], identity: bytes,
+        key: bytes, now: int, admitted: list[list[bytes]],
+    ) -> None:
+        """Txn-level admission for one source's drained burst: rate
+        bucket -> shed-level gate -> class queue.  Every outcome is a
+        counter; the ledger gate_txns == admit_* + drop_txn_rate +
+        shed_{unstaked,lowstake} closes per call."""
+        if not raws:
+            return
+        m = ctx.metrics
+        m.inc("gate_txns", len(raws))
+        ok = self.admission_ctl.admit_txns(key, identity, now, len(raws))
+        if ok < len(raws):
+            m.inc("drop_txn_rate", len(raws) - ok)
+            raws = raws[:ok]
+        if not raws:
+            return
+        cls_ = self.stakes.cls_of(identity)
+        if not LoadShedder.admits(cls_, self.shedder.level):
+            m.inc(_SHED_COUNTER[cls_], len(raws))
+            return
+        m.inc("admit_staked" if cls_ else "admit_unstaked", len(raws))
+        admitted[cls_].extend(raws)
+        if cls_ == ADM.CLASS_UNSTAKED:
+            # duplicate-storm pool for the injected flood fault: replay
+            # fodder must itself have passed the gate once
+            self._recent_raws.extend(raws[:4])
+
+    def _enqueue(self, ctx: MuxCtx, payload: bytes, cls_: int) -> bool:
+        """Bounded-backlog append with stake preemption: at capacity an
+        arriving higher-class txn evicts the OLDEST queued lower-class
+        txn (metered shed_backlog) instead of being refused; same-or-
+        higher-class incoming at capacity is the refused side."""
+        qs = self._backlogs
+        if self._backlog_total < self.admission_cfg.backlog_cap:
+            qs[cls_].append(payload)
+            self._backlog_total += 1
+            return True
+        for victim in range(cls_):
+            if qs[victim]:
+                qs[victim].popleft()
+                ctx.metrics.inc("shed_backlog")
+                qs[cls_].append(payload)
+                return True
+        ctx.metrics.inc("shed_backlog")
+        return False
+
+    def _shed_update(self, ctx: MuxCtx, now: int) -> None:
+        """One load-shed controller step: live backlog occupancy, with
+        the SLO engine's commanded level (shared `shed` region, written
+        by the flight recorder) as a floor.  Level transitions are
+        metered and mirrored to shared memory; the flight recorder
+        freezes an incident bundle on every escalation edge."""
+        frac = self._backlog_total / max(self.admission_cfg.backlog_cap, 1)
+        commanded = 0
+        if self._shed_words is not None:
+            commanded = int(self._shed_words[ADM.SHED_W_COMMANDED])
+        before = self.shedder.level
+        level = self.shedder.update(now, frac, commanded)
+        if level != before:
+            ctx.metrics.set("shed_level", level)
+            ctx.metrics.inc("shed_transitions")
+            if self._shed_words is not None:
+                self._shed_words[ADM.SHED_W_LEVEL] = np.uint64(level)
+                self._shed_words[ADM.SHED_W_TRANSITIONS] = np.uint64(
+                    self.shedder.transitions
+                )
+            self.admission_ctl.level = level
+
+    def _drain_admit_drops(self, ctx: MuxCtx) -> None:
+        """Mirror the server's refusal tally into the shared metrics."""
+        drops = self.server.admit_drops
+        if not drops:
+            return
+        for reason, n in drops.items():
+            ctx.metrics.inc(reason, n)
+        drops.clear()
+
+    # ---- ingress ---------------------------------------------------------
+
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         """via_net mode: datagram frags from the net tile."""
         from firedancer_tpu.tiles.net import ADDR_SZ, CTL_LEGACY, addr_unpack
 
+        now = tempo.tickcount()
+        self.server.now_tick = now
         il = ctx.ins[in_idx]
         rows = il.gather(frags)
         out_pkts = []
-        udp_raws: list[bytes] = []
-        quic_raws: list[bytes] = []
+        udp_by_src: dict = {}
+        touched: list = []
         n_conns = len(self.server.conns)
         for i in range(len(rows)):
             row = rows[i, : frags["sz"][i]]
@@ -193,27 +441,57 @@ class QuicIngressTile(Tile):
             data = row[ADDR_SZ:].tobytes()
             ctx.metrics.inc("rx_dgrams")
             if frags["ctl"][i] & CTL_LEGACY:
-                udp_raws.append(data)
+                udp_by_src.setdefault(addr, []).append(data)
                 continue
             conn = self.server.on_datagram(data, addr)
             if conn is not None:
                 for d in conn.datagrams_out():
                     out_pkts.append((d, addr))
                 if conn.txns:
-                    quic_raws.extend(conn.txns)
-                    conn.txns.clear()
-        # one native parse+trailer call per drained batch, not per txn
-        self._ingest_batch(ctx, udp_raws, "rx_txns_udp")
-        self._ingest_batch(ctx, quic_raws, "rx_txns_quic")
+                    touched.append((conn, addr))
+        self._ingest_sources(ctx, udp_by_src, touched, now)
         for pkt, addr in self.server.stateless_out:
             out_pkts.append((pkt, addr))
         self.server.stateless_out.clear()
+        self._drain_admit_drops(ctx)
         if len(self.server.conns) > n_conns:
             ctx.metrics.inc("conns_opened", len(self.server.conns) - n_conns)
         self._tx(ctx, out_pkts)
 
+    def _ingest_sources(
+        self, ctx: MuxCtx, udp_by_src: dict, touched: list, now: int
+    ) -> None:
+        """Run every source's drained txns through the QoS gate, then
+        parse each stake class as ONE batched scan (class-ordered within
+        the burst; pipeline order across txns carries no semantics)."""
+        if udp_by_src:
+            admitted: list[list[bytes]] = [[] for _ in range(_N_CLASSES)]
+            for addr, raws in udp_by_src.items():
+                ident = ADM.addr_identity(addr)
+                self._gate_raws(ctx, raws, ident, ident, now, admitted)
+            for cls_ in range(_N_CLASSES - 1, -1, -1):
+                self._ingest_batch(
+                    ctx, admitted[cls_], "rx_txns_udp", cls_
+                )
+        if touched:
+            admitted = [[] for _ in range(_N_CLASSES)]
+            seen = set()
+            for conn, addr in touched:
+                if id(conn) in seen or not conn.txns:
+                    continue
+                seen.add(id(conn))
+                raws, conn.txns = conn.txns, []
+                self._gate_raws(
+                    ctx, raws, self._conn_identity(conn, addr),
+                    bytes(conn.scid), now, admitted,
+                )
+            for cls_ in range(_N_CLASSES - 1, -1, -1):
+                self._ingest_batch(
+                    ctx, admitted[cls_], "rx_txns_quic", cls_
+                )
+
     def _ingest_batch(
-        self, ctx: MuxCtx, raws: list[bytes], counter: str
+        self, ctx: MuxCtx, raws: list[bytes], counter: str, cls_: int = 0
     ) -> None:
         """Parse + trailer a whole ingest batch in ONE native call
         (fdt_txn_scan's wire-trailer output) instead of a per-txn
@@ -224,11 +502,7 @@ class QuicIngressTile(Tile):
         only dropped parse failures — so rejects take a per-txn Python
         fallback that keeps estimate-fail txns flowing (pack drops them
         later under its own reject metric).  Rejects are rare on real
-        traffic, so the fallback stays off the hot path.  (Within one
-        drained datagram batch, legacy-UDP and QUIC txns now ingest as
-        two class-ordered batches instead of interleaved by arrival —
-        pipeline order across txns carries no semantics; dedup and pack
-        are order-insensitive.)"""
+        traffic, so the fallback stays off the hot path."""
         if not raws:
             return
         n = len(raws)
@@ -245,36 +519,214 @@ class QuicIngressTile(Tile):
         n_fail = 0
         for i in range(n):
             if scan.ok[i]:
-                self._backlog.append(bytes(rows[i, : scan.tszs[i]]))
-                n_ok += 1
+                if self._enqueue(ctx, bytes(rows[i, : scan.tszs[i]]), cls_):
+                    n_ok += 1
                 continue
             desc = T.parse(raws[i])
             if desc is None:
                 n_fail += 1
-            else:
-                self._backlog.append(wire.append_trailer(raws[i], desc))
+            elif self._enqueue(
+                ctx, wire.append_trailer(raws[i], desc), cls_
+            ):
                 n_ok += 1
         if n_ok:
             ctx.metrics.inc(counter, n_ok)
         if n_fail:
             ctx.metrics.inc("parse_fail_txns", n_fail)
 
+    # ---- injected hostile traffic (disco/faultinj.py flood/conn_churn) --
+
+    #: injected items synthesized per loop iteration — bounds the
+    #: per-iteration attack work so a wave can never starve the
+    #: heartbeat (the traffic spreads over consecutive bursts, which is
+    #: also what a real flood looks like from a polled socket)
+    _INJECT_BUDGET = 48
+
+    def _pump_injected(self, ctx: MuxCtx, now: int) -> None:
+        """Synthesize the scheduled hostile traffic IN-PROCESS (works
+        identically under the thread and process runtimes, since the
+        fault schedule rides the injector into the child): connection
+        floods, churn storms, slow-loris handshakes, and txn spam
+        (garbage, malformed, small-order, duplicate storms) — all
+        derived from the injector's seed via the same splitmix hash the
+        drop/corrupt faults use, so a replayed seed offers byte-
+        identical attack traffic."""
+        for fi, kind, count, profile in ctx.faults.take_injected():
+            prof = profile or (
+                "churn" if kind == "conn_churn" else "garbage"
+            )
+            self._inj_pending.append((fi, kind, prof, 0, max(count, 0)))
+        budget = self._INJECT_BUDGET
+        while self._inj_pending and budget > 0:
+            fi, kind, prof, lo, end = self._inj_pending[0]
+            hi = min(lo + budget, end)
+            self._do_inject(ctx, fi, prof, lo, hi, now)
+            budget -= hi - lo
+            if hi >= end:
+                self._inj_pending.popleft()
+            else:
+                self._inj_pending[0] = (fi, kind, prof, hi, end)
+
+    def _do_inject(
+        self, ctx: MuxCtx, fi: int, prof: str, lo: int, hi: int, now: int
+    ) -> None:
+        from firedancer_tpu.disco.faultinj import _hash_u64
+
+        if hi <= lo:
+            return
+        seed = ctx.faults.inj.seed
+        h = _hash_u64(seed, fi, np.arange(lo, hi, dtype=np.uint64))
+        if prof in ("churn", "handshake", "loris"):
+            self._inject_conns(ctx, h, prof, now)
+        elif prof in ("malformed", "smallorder", "dup"):
+            self._inject_txns(ctx, seed, fi, h, prof, now)
+        else:  # garbage datagrams: parser/robustness pressure
+            for i in range(hi - lo):
+                n = 24 + int(h[i] % 200)
+                data = (
+                    _hash_u64(
+                        seed, fi ^ 0x77,
+                        np.arange((n + 7) // 8, dtype=np.uint64)
+                        + np.uint64(lo + i),
+                    ).tobytes()[:n]
+                )
+                self.server.on_datagram(data, self._adv_addr(h[i]))
+            ctx.metrics.inc("adv_injected", hi - lo)
+
+    @staticmethod
+    def _adv_addr(h) -> tuple[str, int]:
+        """Deterministic loopback-net source address from a hash word
+        (127/8 is all local, so even real-socket Retry responses to a
+        synthetic attacker stay on-host)."""
+        v = int(h)
+        return (
+            f"127.{1 + (v >> 8) % 200}.{(v >> 16) % 256}.{1 + (v >> 24) % 200}",
+            1024 + v % 60000,
+        )
+
+    def _inject_conns(
+        self, ctx: MuxCtx, h: np.ndarray, prof: str, now: int
+    ) -> None:
+        """Connection-opening Initial floods.  churn: every Initial from
+        a globally-fresh source (table churn; LRU + idle eviction must
+        absorb it).  handshake: a 4-address pool hammers the per-source
+        cap + handshake-rate bucket.  loris: fresh conns that never
+        complete their handshake but keep trickling bytes — only the
+        handshake-deadline eviction clears them."""
+        self.server.now_tick = now
+        count = len(h)
+        for i in range(count):
+            v = int(h[i])
+            if prof == "handshake":
+                addr = (f"127.250.0.{1 + v % 4}", 4000 + (v >> 8) % 2000)
+            else:
+                self._churn_n += 1
+                addr = self._adv_addr(
+                    np.uint64(v) ^ np.uint64(self._churn_n << 32)
+                )
+            dcid = v.to_bytes(8, "little")
+            scid = (v ^ 0xA5A5A5A5).to_bytes(8, "little")
+            pkt = (
+                bytes([0xC0])
+                + (1).to_bytes(4, "big")
+                + bytes([8]) + dcid
+                + bytes([8]) + scid
+                + b"\x00"  # empty token
+                + Q.vi_enc(40) + bytes(40)
+            )
+            self.server.on_datagram(pkt, addr)
+            if prof == "loris":
+                # keep previously-opened loris conns "active" with
+                # trickled garbage so idle eviction alone cannot clear
+                # them (the handshake deadline must)
+                for prev in list(self.server.by_addr)[-4:]:
+                    self.server.on_datagram(b"\x40" + bytes(24), prev)
+        ctx.metrics.inc("adv_injected", count)
+
+    def _inject_txns(
+        self, ctx: MuxCtx, seed: int, fi: int, h: np.ndarray,
+        prof: str, now: int,
+    ) -> None:
+        """Txn spam through the SAME gate real traffic takes, from a
+        deterministic unstaked attacker identity."""
+        from firedancer_tpu.disco.faultinj import _hash_u64
+
+        count = len(h)
+        raws: list[bytes] = []
+        if prof == "dup":
+            pool = list(self._recent_raws)
+            if pool:
+                raws = [pool[int(h[i]) % len(pool)] for i in range(count)]
+            else:
+                # nothing gate-admitted yet to replay (e.g. shedding
+                # already stopped unstaked admits): degrade to
+                # malformed spam so the canonical record's scheduled
+                # count still equals traffic actually injected — a
+                # fired flood that injected nothing would make the
+                # replay artifact lie
+                prof = "malformed"
+        if prof == "smallorder":
+            tmpl = self._smallorder_txn()
+            for i in range(count):
+                sig = _hash_u64(
+                    seed, fi ^ 0x50, np.arange(8, dtype=np.uint64) + h[i]
+                ).tobytes()
+                raws.append(tmpl[:1] + sig + tmpl[65:])
+        elif prof == "malformed":  # random bytes that fail T.parse
+            for i in range(count):
+                n = 40 + int(h[i] % 120)
+                raws.append(
+                    _hash_u64(
+                        seed, fi ^ 0x33,
+                        np.arange((n + 7) // 8, dtype=np.uint64) + h[i],
+                    ).tobytes()[:n]
+                )
+        if not raws:
+            return
+        ident = ADM.addr_identity(("127.66.0.1", 6666 + fi))
+        admitted: list[list[bytes]] = [[] for _ in range(_N_CLASSES)]
+        self._gate_raws(ctx, raws, ident, ident, now, admitted)
+        for cls_ in range(_N_CLASSES - 1, -1, -1):
+            self._ingest_batch(ctx, admitted[cls_], "rx_txns_udp", cls_)
+        ctx.metrics.inc("adv_injected", len(raws))
+
+    def _smallorder_txn(self) -> bytes:
+        """A parseable txn whose payer pubkey is the identity point
+        (order 1, the canonical small-order encoding): structurally
+        valid, cryptographically poison — verify must reject it without
+        disturbing the surrounding batch."""
+        if self._smallorder_tmpl is None:
+            small_pk = b"\x01" + bytes(31)  # identity point, y = 1
+            self._smallorder_tmpl = T.build(
+                [bytes(64)],
+                [small_pk, bytes(32), b"\x02" * 32],
+                bytes(32),
+                [(2, [0, 1], b"\x00" * 12)],
+                readonly_unsigned_cnt=1,
+            )
+        return self._smallorder_tmpl
+
+    # ---- publish ---------------------------------------------------------
+
     def after_credit(self, ctx: MuxCtx) -> None:
+        now = tempo.tickcount()
         n_conns = len(self.server.conns)
+        self.server.now_tick = now
+        self._shed_update(ctx, now)
+        if ctx.faults is not None:
+            self._pump_injected(ctx, now)
         if not self.via_net:
             # legacy UDP: one datagram = one txn (fd_quic.c legacy path);
-            # the whole burst goes through ONE native parse+trailer call
-            udp_raws = [
-                data for data, _addr in self.udp_sock.recv_burst(self.burst)
-            ]
-            if udp_raws:
-                ctx.metrics.inc("rx_dgrams", len(udp_raws))
-                self._ingest_batch(ctx, udp_raws, "rx_txns_udp")
+            # gated per source, then ONE native parse+trailer call per
+            # stake class
+            udp_by_src: dict = {}
+            for data, addr in self.udp_sock.recv_burst(self.burst):
+                ctx.metrics.inc("rx_dgrams")
+                udp_by_src.setdefault(addr, []).append(data)
 
             # QUIC datagrams
             out_pkts = []
             touched = []
-            quic_raws: list[bytes] = []
             for data, addr in self.quic_sock.recv_burst(self.burst):
                 ctx.metrics.inc("rx_dgrams")
                 conn = self.server.on_datagram(data, addr)
@@ -283,51 +735,58 @@ class QuicIngressTile(Tile):
             for conn, addr in touched:
                 for d in conn.datagrams_out():
                     out_pkts.append((d, addr))
-                if conn.txns:
-                    quic_raws.extend(conn.txns)
-                    conn.txns.clear()
-            self._ingest_batch(ctx, quic_raws, "rx_txns_quic")
-            # stateless Retry responses (server retry mode)
+            self._ingest_sources(ctx, udp_by_src, touched, now)
+            # stateless Retry responses (server retry mode + the
+            # handshake-rate backoff signal)
             for pkt, addr in self.server.stateless_out:
                 out_pkts.append((pkt, addr))
             self.server.stateless_out.clear()
             self._tx(ctx, out_pkts)
+        self._drain_admit_drops(ctx)
         if len(self.server.conns) > n_conns:
             ctx.metrics.inc("conns_opened", len(self.server.conns) - n_conns)
 
         if self.via_net:
             self._flush_tx(ctx)  # drain tx held back by net-ring credits
         # publish backlog within credit budget (txn ring = outs[0] only;
-        # in via_net mode outs[-1] is the net tx ring).  The backlog is
-        # a deque drained into a preallocated row buffer: the old list
-        # slice (`self._backlog[credits:]`) copied the WHOLE remaining
-        # backlog every iteration under backpressure — O(n) per burst.
-        if not self._backlog or ctx.credits <= 0:
+        # in via_net mode outs[-1] is the net tx ring).  The backlogs
+        # are per-stake-class deques drained HIGH CLASS FIRST into a
+        # preallocated row buffer — staked traffic preempts unstaked
+        # when verify credits are scarce, and the old list slice
+        # (`self._backlog[credits:]`) that copied the WHOLE remaining
+        # backlog every iteration under backpressure is gone.
+        if ctx.credits <= 0 or not any(self._backlogs):
             return
         if self._pub_rows is None:
             self._pub_rows = np.zeros(
                 (self._TX_ROWS, wire.LINK_MTU), np.uint8
             )
         credits = ctx.credits
-        while self._backlog and credits > 0:
-            # chunked through the preallocated buffer: the WHOLE credit
-            # budget drains per firing (matching the old slice path's
-            # throughput), just _TX_ROWS rows at a time
-            n = min(len(self._backlog), credits, self._TX_ROWS)
-            rows = self._pub_rows
-            szs = np.zeros(n, np.uint16)
-            for i in range(n):
-                payload = self._backlog.popleft()
-                rows[i, : len(payload)] = np.frombuffer(payload, np.uint8)
-                szs[i] = len(payload)
-            tr = wire.parse_trailers(rows[:n], szs.astype(np.int64))
-            sig0 = rows[
-                np.arange(n)[:, None], tr["sig_off"][:, None] + np.arange(8)
-            ]
-            tags = sig0.astype(np.uint64) @ (
-                np.uint64(1)
-                << (np.uint64(8) * np.arange(8, dtype=np.uint64))
-            )
-            ctx.outs[0].publish(tags, rows[:n], szs)
-            ctx.metrics.inc("out_frags", n)
-            credits -= n
+        for cls_ in range(_N_CLASSES - 1, -1, -1):
+            q = self._backlogs[cls_]
+            while q and credits > 0:
+                # chunked through the preallocated buffer: the WHOLE
+                # credit budget drains per firing, _TX_ROWS rows at a
+                # time
+                n = min(len(q), credits, self._TX_ROWS)
+                rows = self._pub_rows
+                szs = np.zeros(n, np.uint16)
+                for i in range(n):
+                    payload = q.popleft()
+                    rows[i, : len(payload)] = np.frombuffer(
+                        payload, np.uint8
+                    )
+                    szs[i] = len(payload)
+                self._backlog_total = max(self._backlog_total - n, 0)
+                tr = wire.parse_trailers(rows[:n], szs.astype(np.int64))
+                sig0 = rows[
+                    np.arange(n)[:, None],
+                    tr["sig_off"][:, None] + np.arange(8),
+                ]
+                tags = sig0.astype(np.uint64) @ (
+                    np.uint64(1)
+                    << (np.uint64(8) * np.arange(8, dtype=np.uint64))
+                )
+                ctx.outs[0].publish(tags, rows[:n], szs)
+                ctx.metrics.inc("out_frags", n)
+                credits -= n

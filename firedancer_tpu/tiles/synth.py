@@ -82,7 +82,9 @@ def make_txn_pool(
 class SynthTile(Tile):
     """Streams a pre-signed txn pool; sig field = pool index tag."""
 
-    schema = MetricsSchema(counters=("published_txns",))
+    schema = MetricsSchema(
+        counters=("published_txns", "flood_dup_txns"),
+    )
 
     def __init__(
         self,
@@ -101,6 +103,12 @@ class SynthTile(Tile):
         self.repeat = repeat
         self.total = total
         self.sent = 0
+        # injected duplicate-storm queue (faultinj flood faults, ISSUE
+        # 13): pool indices re-published verbatim — dedup must collapse
+        # them, exactly-once at the sink is the invariant under storm
+        import collections
+
+        self._dups: collections.deque = collections.deque()
         # the dedup tag downstream tiles key on: first 8B of the ed25519
         # signature (reference: fd_verify.c publishes with this sig field)
         tr = wire.parse_trailers(rows, szs.astype(np.int64))
@@ -113,9 +121,34 @@ class SynthTile(Tile):
         )
 
     def after_credit(self, ctx: MuxCtx) -> None:
+        if ctx.faults is not None:
+            for fi, kind, count, _prof in ctx.faults.take_injected():
+                if kind != "flood":
+                    continue  # conn_churn is wire-edge-only; ignore
+                # deterministic duplicate storm: pool indices from the
+                # injector's seeded hash — a replayed seed re-publishes
+                # the SAME duplicates (disco/faultinj.py contract)
+                from firedancer_tpu.disco.faultinj import _hash_u64
+
+                pool = len(self.rows)
+                h = _hash_u64(
+                    ctx.faults.inj.seed, fi,
+                    np.arange(count, dtype=np.uint64),
+                )
+                self._dups.extend(int(x) for x in h % np.uint64(pool))
         budget = ctx.credits
         if budget <= 0:
             return
+        if self._dups:
+            take = min(len(self._dups), budget)
+            idx = np.array(
+                [self._dups.popleft() for _ in range(take)], dtype=np.int64
+            )
+            ctx.publish(self.tags[idx], self.rows[idx], self.szs[idx])
+            ctx.metrics.inc("flood_dup_txns", take)
+            budget -= take
+            if budget <= 0:
+                return
         if self.total is not None:
             budget = min(budget, self.total - self.sent)
             if budget <= 0:

@@ -872,6 +872,15 @@ class VerifyTile(Tile):
 
         self._interrupt = ctx.interrupt
         self._tracer = ctx.tracer
+        # warm the strict host path once per process: its first call
+        # pays field-table setup (~100 ms on this host) that must not
+        # land inside the first production batch's tail latency — the
+        # device path warms its compiled shape the same way below, and
+        # the host path is every fallback's last resort
+        hostpath.verify_batch_digest_host(
+            np.zeros((1, 64), np.uint8), np.zeros((1, 64), np.uint8),
+            np.zeros((1, 32), np.uint8),
+        )
         if self.pre_dedup:
             depth = PRE_DEDUP_DEPTH
             map_cnt = R.TCache.map_cnt_for(depth)

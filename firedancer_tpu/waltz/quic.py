@@ -65,6 +65,19 @@ INITIAL_RTT = 0.1  # conservative for LAN/tests; RFC suggests 0.333
 _RETRY_KEY = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
 _RETRY_NONCE = bytes.fromhex("461599d35d632bf2239825bb")
 
+#: the Retry integrity key is a spec CONSTANT, so its AEAD (key
+#: schedule + GHASH table) is built once — a Retry is the cheap backoff
+#: signal the handshake-rate limiter answers floods with, and
+#: rebuilding the key schedule per Retry made the defense cost more
+#: than the attack
+_retry_aead_cache: list = []
+
+
+def _retry_aead() -> "A.AesGcm":
+    if not _retry_aead_cache:
+        _retry_aead_cache.append(A.AesGcm(_RETRY_KEY))
+    return _retry_aead_cache[0]
+
 
 # ---------------------------------------------------------------------------
 # varints
@@ -311,6 +324,10 @@ class Connection:
         #: unvalidated arriving path
         self._divert_path_response = False
         self._path_response_out: list[bytes] = []
+        #: last datagram arrival in the owner's tickcount domain, stamped
+        #: by QuicServer.on_datagram from its now_tick — the idle-churn
+        #: eviction input (waltz/admission.py ConnAdmission.sweep)
+        self.last_rx_tick = 0
 
     # -- key install ---------------------------------------------------------
 
@@ -848,7 +865,7 @@ class Connection:
         token = pkt[:-16][5 + 1 + len(self.scid) + 1 + len(retry_scid) :]
         # integrity check: AEAD over pseudo-packet (odcid prefixed)
         pseudo = bytes([len(self.dcid)]) + self.dcid + pkt[:-16]
-        want = A.AesGcm(_RETRY_KEY).encrypt(_RETRY_NONCE, b"", pseudo)
+        want = _retry_aead().encrypt(_RETRY_NONCE, b"", pseudo)
         if not _hmac.compare_digest(want[-16:], tag):
             return
         self.token = token
@@ -1048,15 +1065,29 @@ class QuicServer:
         identity_secret: bytes,
         max_conns: int = MAX_CONNS,
         retry: bool = False,
+        admission=None,
     ):
         """retry=True: stateless Retry with address-validating tokens —
         no connection state (TLS engine, certs) is allocated until the
-        client echoes a valid token (RFC 9000 section 8.1.2)."""
+        client echoes a valid token (RFC 9000 section 8.1.2).
+
+        admission: a waltz.admission.ConnAdmission policy consulted on
+        every connection-opening Initial (handshake-rate + global /
+        per-source caps).  The owner sets `now_tick` (tickcount domain)
+        before each datagram burst; refusals are tallied by reason in
+        `admit_drops` for the owning tile to meter — a refused datagram
+        never raises, and a rate-limited handshake draws a stateless
+        Retry so a legitimate client backs off and revalidates."""
         from firedancer_tpu.tango.lru import Lru
 
         self.identity_secret = identity_secret
         self.max_conns = max_conns
         self.retry = retry
+        self.admission = admission
+        #: owner-stamped tickcount for admission decisions + idle stamps
+        self.now_tick = 0
+        #: refusal tally by REASONS code, drained into tile metrics
+        self.admit_drops: dict[str, int] = {}
         self.token_secret = os.urandom(32)
         self.conns: dict[bytes, Connection] = {}  # by our scid
         self.by_addr: dict = {}
@@ -1070,11 +1101,45 @@ class QuicServer:
         #: migrations whose PATH_RESPONSE validated the new path
         self.paths_validated = 0
 
+    def _evict_at_cap(self) -> bool:
+        """Make room at the table cap: sweep closed conns, else evict
+        the least-recently-active conn, preferring one that never
+        finished its handshake (a handshake flood must not push out
+        established peers).  Returns True when a slot is free."""
+        for a, c in list(self.by_addr.items()):
+            if c.closed:
+                self._reap(a, c)
+        if len(self.conns) < self.max_conns:
+            return True
+        victim = None
+        for a in self.lru.iter_lru():
+            c = self.by_addr.get(a)
+            if c is not None and not c.established:
+                victim = a
+                break
+        victim = victim if victim is not None else self.lru.lru_key()
+        if victim is None:
+            return False
+        self._reap(victim, self.by_addr[victim])
+        return True
+
     def _reap(self, addr, conn) -> None:
         for cid in conn.scids:
             self.conns.pop(cid, None)
         self.by_addr.pop(addr, None)
         self.lru.remove(addr)
+        if self.admission is not None:
+            self.admission.conn_released(conn.scid)
+
+    def evict(self, addr) -> bool:
+        """Administrative eviction (idle-churn / slow-loris sweep from
+        the owning tile's housekeeping).  Returns True when a live
+        connection was reaped."""
+        conn = self.by_addr.get(addr)
+        if conn is None:
+            return False
+        self._reap(addr, conn)
+        return True
 
     @staticmethod
     def _addr_bytes(addr) -> bytes:
@@ -1115,7 +1180,7 @@ class QuicServer:
             + token
         )
         pseudo = bytes([len(odcid)]) + odcid + hdr
-        tag = A.AesGcm(_RETRY_KEY).encrypt(_RETRY_NONCE, b"", pseudo)[-16:]
+        tag = _retry_aead().encrypt(_RETRY_NONCE, b"", pseudo)[-16:]
         return hdr + tag
 
     def _check_token(self, token: bytes, addr) -> tuple[bytes, bytes] | None:
@@ -1175,6 +1240,7 @@ class QuicServer:
                     self.lru.remove(old)
                 self.by_addr[addr] = cand
                 cand._addr = addr
+                cand.last_rx_tick = self.now_tick
                 self.lru.acquire(addr)
                 self.migrations += 1
                 cand.send_path_challenge()
@@ -1200,52 +1266,102 @@ class QuicServer:
                 return None  # only an Initial may open a connection
             if 6 + data[5] + 1 > len(data):
                 return None  # malformed CID lengths
-            if len(self.conns) >= self.max_conns:
-                # sweep closed conns, then evict the least-recently-active
-                for a, c in list(self.by_addr.items()):
-                    if c.closed:
-                        self._reap(a, c)
-                if len(self.conns) >= self.max_conns:
-                    # evict the LRU conn, preferring one that never
-                    # finished its handshake (a handshake flood must not
-                    # push out established peers)
-                    victim = None
-                    for a in self.lru.iter_lru():
-                        c = self.by_addr.get(a)
-                        if c is not None and not c.established:
-                            victim = a
-                            break
-                    victim = victim if victim is not None else self.lru.lru_key()
-                    if victim is None:
-                        return None
-                    self._reap(victim, self.by_addr[victim])
-            dcil = data[5]
-            dcid = data[6 : 6 + dcil]
-            o = 6 + dcil
-            scil = data[o]
-            client_scid = data[o + 1 : o + 1 + scil]
-            o += 1 + scil
+            # cheap header parse (CIDs + token) shared by the admission
+            # gate and the retry path below
+            try:
+                dcil = data[5]
+                pre_dcid = data[6 : 6 + dcil]
+                po = 6 + dcil
+                pre_scid = data[po + 1 : po + 1 + data[po]]
+                po += 1 + data[po]
+                tok_len, to = vi_dec(data, po)
+                pre_token = data[to : to + tok_len]
+            except (IndexError, ValueError):
+                return None  # malformed Initial header: drop
+            # a token echoed from OUR Retry (MAC over addr + odcid +
+            # retry-scid, and the client must address us by the retry
+            # scid) proves address ownership: the Retry round-trip WAS
+            # this source's rate toll, so the echo bypasses the
+            # handshake bucket — the backoff signal guarantees a
+            # legitimate client progress under exactly the flood that
+            # empties the bucket, while a flood's forged tokens fail
+            # the MAC and stay rate-limited
+            tok_hit = (
+                self._check_token(pre_token, addr) if pre_token else None
+            )
+            token_valid = tok_hit is not None and tok_hit[1] == pre_dcid
+            if self.admission is not None:
+                # pre-allocation gate: handshake-rate + emergency-level
+                # refusal BEFORE any TLS/cert state exists.  A
+                # rate-limited source gets a stateless Retry — the RFC
+                # 9000 section 8 backoff signal — and revalidates by
+                # echoing the token (the bypass above)
+                reason = self.admission.admit_handshake(
+                    addr, self.now_tick, validated=token_valid
+                )
+                if reason is not None:
+                    self.admit_drops[reason] = (
+                        self.admit_drops.get(reason, 0) + 1
+                    )
+                    if reason == "drop_handshake_rate":
+                        self.stateless_out.append(
+                            (
+                                self._retry_packet(
+                                    pre_scid, pre_dcid, addr
+                                ),
+                                addr,
+                            )
+                        )
+                        self.admit_drops["retry_sent"] = (
+                            self.admit_drops.get("retry_sent", 0) + 1
+                        )
+                    return None
+            dcid, client_scid = pre_dcid, pre_scid
             validated = False
             odcid = dcid
             if self.retry:
-                try:
-                    tok_len, to = vi_dec(data, o)
-                    token = data[to : to + tok_len]
-                except (IndexError, ValueError):
-                    return None
-                if not token:
+                if not pre_token:
                     self.stateless_out.append(
                         (self._retry_packet(client_scid, dcid, addr), addr)
                     )
                     return None
-                hit = self._check_token(token, addr)
-                if hit is None:
+                if not token_valid:
                     return None  # forged/stale token: drop silently
-                odcid, retry_scid = hit
-                if retry_scid != dcid:
-                    return None  # client must address us by the retry cid
-                validated = True
-            scid = dcid if (self.retry and validated) else os.urandom(8)
+            if token_valid:
+                # either retry mode's mandatory round-trip, or the echo
+                # of a rate-limit Retry: the original DCID rides the
+                # token, and the path counts as validated (RFC 9000
+                # 8.1 — lifts the 3x anti-amplification budget)
+                odcid, validated = tok_hit[0], True
+            if self.admission is not None:
+                # cap gate at the exact allocation point (after token
+                # validation, so a Retry round-trip is never counted as
+                # a connection): global cap, then per-source-IP cap
+                reason = self.admission.admit_conn(addr, self.now_tick)
+                if reason == "drop_conn_cap" and self._evict_at_cap():
+                    # table-cap refusal is the one retryable reason:
+                    # evicting per the churn policy freed a registry
+                    # slot too (_reap -> conn_released), so re-gate
+                    reason = self.admission.admit_conn(
+                        addr, self.now_tick
+                    )
+                if reason is not None:
+                    self.admit_drops[reason] = (
+                        self.admit_drops.get(reason, 0) + 1
+                    )
+                    return None
+            if len(self.conns) >= self.max_conns:
+                # at-cap eviction runs only once every refusal gate has
+                # passed — an Initial that is about to be refused must
+                # never cost an existing peer its slot
+                if not self._evict_at_cap():
+                    return None
+                if len(self.conns) >= self.max_conns:
+                    return None
+            # a validated (token-echoing) client already addresses us
+            # by the Retry-chosen CID: keep it as our scid so its dcid
+            # stays stable across the handshake
+            scid = dcid if validated else os.urandom(8)
             tp = (
                 vi_enc(0x00) + vi_enc(len(odcid)) + odcid
                 + vi_enc(0x0F) + vi_enc(len(scid)) + scid
@@ -1261,7 +1377,10 @@ class QuicServer:
             conn.validated = conn.validated or validated
             self.conns[scid] = conn
             self.by_addr[addr] = conn
+            if self.admission is not None:
+                self.admission.conn_opened(scid, addr, self.now_tick)
         conn._addr = addr
+        conn.last_rx_tick = self.now_tick
         self.lru.acquire(addr)
         conn.on_datagram(data)
         if conn.path_response is not None:

@@ -259,10 +259,13 @@ class TlsServer(_Engine):
         )
         self._send(HANDSHAKE, _msg(CERTIFICATE, cert))
 
-        from firedancer_tpu.ops.ed25519 import golden
+        # hostpath.sign is bit-identical to golden (parity-tested) and
+        # ~50x faster — the per-handshake CertificateVerify must not
+        # cost a pure-python signature under a handshake storm
+        from firedancer_tpu.ops.ed25519 import hostpath
 
         to_sign = _CV_SERVER_CTX + hashlib.sha256(self.transcript).digest()
-        sig = golden.sign(self.identity_secret, to_sign)
+        sig = hostpath.sign(self.identity_secret, to_sign)
         cv = SIG_ED25519.to_bytes(2, "big") + _u16v(sig)
         self._send(HANDSHAKE, _msg(CERTIFICATE_VERIFY, cv))
 

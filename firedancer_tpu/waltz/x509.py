@@ -56,9 +56,22 @@ def _name(cn: str) -> bytes:
 _ALG_ED25519 = _tlv(_SEQ, _tlv(_OID, OID_ED25519))
 
 
+#: one cert per identity: a server presents the SAME certificate on
+#: every connection, so signing a fresh one per TlsServer (≈280 ms of
+#: pure-python ed25519) was a self-inflicted handshake-flood DoS — an
+#: attacker's bare Initial cost US a signature.  Keyed by (secret, cn).
+_CERT_CACHE: dict[tuple[bytes, str], bytes] = {}
+
+
 def generate(identity_secret: bytes, cn: str = "fdt") -> bytes:
-    """Self-signed Ed25519 certificate DER for the identity key."""
-    from firedancer_tpu.ops.ed25519 import golden
+    """Self-signed Ed25519 certificate DER for the identity key.
+    Cached per identity, and signed via the fast host path
+    (ops/ed25519/hostpath.py — bit-identical to golden by parity test,
+    ~50x faster), so connection setup never re-signs."""
+    cached = _CERT_CACHE.get((identity_secret, cn))
+    if cached is not None:
+        return cached
+    from firedancer_tpu.ops.ed25519 import hostpath as golden
 
     pub = golden.public_from_secret(identity_secret)
     validity = _tlv(_SEQ, _tlv(_UTCTIME, b"200101000000Z") * 2)
@@ -74,7 +87,9 @@ def generate(identity_secret: bytes, cn: str = "fdt") -> bytes:
         + spki,
     )
     sig = golden.sign(identity_secret, tbs)
-    return _tlv(_SEQ, tbs + _ALG_ED25519 + _tlv(_BITSTR, b"\0" + sig))
+    der = _tlv(_SEQ, tbs + _ALG_ED25519 + _tlv(_BITSTR, b"\0" + sig))
+    _CERT_CACHE[(identity_secret, cn)] = der
+    return der
 
 
 class _Reader:

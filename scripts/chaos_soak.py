@@ -2,8 +2,8 @@
 """Randomized supervision chaos soak.
 
 Generates a random fault schedule (kills, heartbeat-starving stalls,
-frag drops, payload corruption, credit squeezes, device-verify failures)
-from a seed, drives a synth -> verify -> dedup -> sink topology through
+frag drops, payload corruption, credit squeezes, device-verify failures,
+seeded duplicate-storm floods) from a seed, drives a synth -> verify -> dedup -> sink topology through
 it under the supervisor WITH the flight recorder attached, and checks
 the survival invariants:
 
@@ -67,10 +67,20 @@ RING_DEPTH = 256
 def _random_schedule(rng: np.random.Generator, n_txns: int, n_faults: int):
     faults = []
     kinds = ["kill", "stall", "drop", "corrupt", "backpressure",
-             "device_error"]
+             "device_error", "flood"]
     for _ in range(n_faults):
         kind = kinds[int(rng.integers(len(kinds)))]
-        if kind in ("kill", "stall"):
+        if kind == "flood":
+            # duplicate storm (ISSUE 13): the synth tile re-publishes a
+            # seeded burst of already-sent txns through the SAME
+            # injection path the adversary harness uses — dedup must
+            # hold the exactly-once invariant under it
+            faults.append(Fault(
+                "synth", "flood", on="tick",
+                at=int(rng.integers(10, 400)),
+                count=int(rng.integers(8, 48)),
+            ))
+        elif kind in ("kill", "stall"):
             tile = ["verify", "dedup"][int(rng.integers(2))]
             at = int(rng.integers(n_txns // 4, 3 * n_txns // 4))
             faults.append(Fault(
@@ -112,14 +122,14 @@ def run_soak(
     """One soak iteration.  Returns a report dict with ok=True/False.
 
     runtime="process" soaks the ISSUE 7 one-process-per-tile runtime:
-    the schedule is restricted to the supervision faults (kill / stall /
-    backpressure — SIGKILLed and heartbeat-starved CHILD PROCESSES),
-    because drop/corrupt/device_error invariants are accounted against
-    the injector's parent-side event log, which lives in each child
-    under process isolation.  Survival is checked against the sink's
-    shm sig log + shared-memory metrics instead of host-side tile
-    state, and the incident-bundle 1:1 checks stay thread-mode (the
-    recorder's canonical fired record is parent-side state)."""
+    the schedule is restricted to kill / stall / backpressure
+    (SIGKILLed and heartbeat-starved CHILD PROCESSES) plus injected
+    flood storms, because drop/corrupt loss invariants are accounted
+    against per-frag detail only each child sees.  Survival is checked
+    against the sink's shm sig log + shared-memory metrics instead of
+    host-side tile state; the incident-bundle 1:1 checks run under
+    BOTH runtimes (children's durable fired flags fold back into the
+    parent's canonical record — FaultInjector.fold_topology)."""
     process = runtime == "process"
     if seed is None:
         seed = int.from_bytes(os.urandom(4), "little")
@@ -130,9 +140,12 @@ def run_soak(
     rng = np.random.default_rng(seed)
     faults = _random_schedule(rng, n_txns, n_faults)
     if process:
+        # drop/corrupt need per-frag parent-side accounting (child-only
+        # detail); supervision faults and injected-traffic floods work
+        # identically in a child — the flags fold back (fold_topology)
         faults = [
             f for f in faults
-            if f.kind in ("kill", "stall", "backpressure")
+            if f.kind in ("kill", "stall", "backpressure", "flood")
         ]
     inj = FaultInjector(seed=seed, faults=faults)
 
@@ -214,6 +227,10 @@ def run_soak(
             n: d for n in topo.tiles
             if (d := sup.degraded(n)) is not None
         }
+        # process runtime: fold the children's durable fired flags into
+        # the parent record so counts and bundle classification read
+        # identically under both runtimes
+        inj.fold_topology(topo)
         injected = inj.dropped_frags() + inj.corrupted_frags()
         report.update(
             sent=n_txns,
@@ -232,14 +249,7 @@ def run_soak(
         by_class: dict[str, int] = {}
         for r in inc_rows:
             by_class[r["class"]] = by_class.get(r["class"], 0) + 1
-        if process:
-            # parent-side event log is empty under process isolation:
-            # count the SCHEDULE (every kill/stall's trigger index is
-            # inside the txn stream, so each must have fired)
-            n_kill = sum(1 for f in faults if f.kind == "kill")
-            n_stall = sum(1 for f in faults if f.kind == "stall")
-        else:
-            n_kill, n_stall = inj.count("kill"), inj.count("stall")
+        n_kill, n_stall = inj.count("kill"), inj.count("stall")
         report.update(
             incidents=[
                 {"class": r["class"], "tile": r["tile"]} for r in inc_rows
@@ -257,23 +267,24 @@ def run_soak(
             >= n_kill + n_stall,
             "nothing_degraded": not degraded,
         }
-        if not process:
-            # fdtflight: one correctly-classified bundle per scripted
-            # kill/stall, everything explained, zero when clean.  The
-            # classification keys off the injector's parent-side
-            # canonical fired record, which lives in the CHILDREN under
-            # process isolation — thread-mode checks only.
-            checks.update(
-                incident_kill_1to1=by_class.get("injected-kill", 0)
-                == n_kill,
-                incident_stall_1to1=by_class.get("injected-stall", 0)
-                == n_stall,
-                incidents_all_explained=all(
-                    r["explained"] for r in inc_rows
-                ),
-                incidents_zero_when_clean=bool(inj.events)
-                or not inc_rows,
-            )
+        # fdtflight: one correctly-classified bundle per scripted
+        # kill/stall, everything explained, zero when clean.  Holds
+        # under BOTH runtimes: the classification keys off the
+        # injector's canonical fired record, and under process
+        # isolation the children's durable fired flags fold back into
+        # the parent copy (FaultInjector.fold_topology) both at bundle
+        # freeze and before this accounting.
+        checks.update(
+            incident_kill_1to1=by_class.get("injected-kill", 0)
+            == n_kill,
+            incident_stall_1to1=by_class.get("injected-stall", 0)
+            == n_stall,
+            incidents_all_explained=all(
+                r["explained"] for r in inc_rows
+            ),
+            incidents_zero_when_clean=bool(inj.events)
+            or not inc_rows,
+        )
         report["checks"] = checks
         report["ok"] = all(checks.values())
         if verbose or not report["ok"]:

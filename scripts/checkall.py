@@ -1,12 +1,13 @@
 #!/usr/bin/env python3
 """checkall — the one-shot local gate: fdtlint + bounded fdtmc + a
-process-runtime smoke + the tier-1 pytest suite, aggregated into one
-exit code.
+process-runtime smoke + a seeded hostile-ingress smoke + the tier-1
+pytest suite, aggregated into one exit code.
 
 Usage:
-    scripts/checkall.py                 # all four stages
+    scripts/checkall.py                 # all five stages
     scripts/checkall.py --json          # machine-readable summary
-    scripts/checkall.py --skip mc       # skip stages (lint,mc,proc,pytest)
+    scripts/checkall.py --skip mc       # skip stages
+                                        # (lint,mc,proc,adversary,pytest)
     scripts/checkall.py --mc-budget 200 # bound the model checker
     scripts/checkall.py --pytest-timeout 1200
 
@@ -127,6 +128,49 @@ def _stage_proc(timeout_s: float) -> dict:
     return stage
 
 
+def _stage_adversary(timeout_s: float, seed: int) -> dict:
+    """Bounded hostile-ingress smoke (scripts/adversary.py, ISSUE 13):
+    a seeded ~10 s flood + churn + malformed + duplicate-storm mix
+    against a staked flow, asserting zero crashes, nonzero shed
+    counters, an exactly-closing drop ledger, staked exactly-once
+    delivery, the staked e2e SLO holding, and fdtincident
+    --assert-clean semantics (exactly the expected breach bundles,
+    each correctly classified) — the run_adversary `checks` dict IS
+    that assertion set, so rc=1 here means a named invariant broke
+    and the printed seed replays it."""
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rc, out = _run(
+        [
+            sys.executable, str(REPO / "scripts" / "adversary.py"),
+            "--seed", str(seed), "--staked", "48",
+            "--duration", "10", "--json",
+        ],
+        timeout_s, env=env,
+    )
+    stage: dict = {"rc": rc, "seed": seed,
+                   "seconds": round(time.perf_counter() - t0, 2)}
+    try:
+        doc = next(
+            json.loads(ln)
+            for ln in out.splitlines()
+            if ln.startswith("{") and ln.rstrip().endswith("}")
+        )
+        stage["ok"] = doc.get("ok")
+        stage["checks"] = doc.get("checks")
+        q = doc.get("quic", {})
+        stage["shed"] = {
+            k: q.get(k, 0)
+            for k in ("shed_unstaked", "shed_lowstake", "shed_backlog",
+                      "drop_handshake_rate", "adv_injected")
+        }
+        stage["incidents"] = doc.get("incidents")
+    except Exception:  # noqa: BLE001 — non-JSON tail ok on rc != 0
+        stage["tail"] = out[-2000:]
+    return stage
+
+
 def _stage_pytest(timeout_s: float, extra: list[str]) -> dict:
     t0 = time.perf_counter()
     env = dict(os.environ)
@@ -156,17 +200,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
-                    help="comma list of stages to skip: lint,mc,proc,pytest")
+                    help="comma list of stages to skip: "
+                         "lint,mc,proc,adversary,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
     ap.add_argument("--proc-timeout", type=float, default=600.0)
+    ap.add_argument("--adversary-timeout", type=float, default=300.0)
+    ap.add_argument("--adversary-seed", type=int, default=7,
+                    help="fixed seed for the hostile-ingress smoke "
+                         "(replayable; the stage prints it)")
     ap.add_argument("--pytest-timeout", type=float, default=1800.0)
     ap.add_argument("--pytest-args", default="",
                     help="extra args appended to the pytest command")
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
-    bad = skip - {"lint", "mc", "proc", "pytest"}
+    bad = skip - {"lint", "mc", "proc", "adversary", "pytest"}
     if bad:
         print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
         return 2
@@ -189,6 +238,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"checkall proc: rc={stages['proc']['rc']} "
                   f"({stages['proc'].get('landed', '?')} landed, "
                   f"{stages['proc']['seconds']}s)", flush=True)
+    if "adversary" not in skip:
+        stages["adversary"] = _stage_adversary(
+            args.adversary_timeout, args.adversary_seed
+        )
+        if not args.json:
+            print(f"checkall adversary: rc={stages['adversary']['rc']} "
+                  f"(seed={stages['adversary']['seed']}, "
+                  f"{stages['adversary']['seconds']}s)", flush=True)
     if "pytest" not in skip:
         stages["pytest"] = _stage_pytest(
             args.pytest_timeout, args.pytest_args.split()

@@ -21,7 +21,9 @@ Exit status follows the fdtlint convention: 0 clean, 1 findings,
     by a scripted kill is `injected-kill`, a heartbeat restart backed
     by a scripted stall is `injected-stall`, a quarantine backed by
     scripted device errors is `injected-device-error`, an SLO trigger
-    is `slo-breach:<name>`; anything else is `unexplained-*`.
+    is `slo-breach:<name>`, an ingress load-shed escalation backed by
+    scripted hostile traffic or a burning SLO is `load-shed:L<level>`;
+    anything else is `unexplained-*`.
     `--strict` exits 1 when any bundle is unexplained — the chaos
     suite's "every injected fault yields exactly one CORRECTLY
     classified bundle" gate.
@@ -118,6 +120,20 @@ def classify_bundle(bundle: dict) -> dict:
         cls = f"{kind}" if explained else f"unexplained-{kind}"
     elif kind == "slo":
         cls, explained = f"slo-breach:{detail.get('slo')}", True
+    elif kind == "shed":
+        # an ingress load-shed escalation is EXPECTED exactly when
+        # hostile traffic was scripted (flood/churn/backpressure in the
+        # fired record) or an SLO was burning budget (the engine's
+        # commanded level) — otherwise something unscripted is flooding
+        level = detail.get("level")
+        slo_burning = any(
+            s.get("breached") or s.get("burn_fast", 0) >= 1.0
+            for s in bundle.get("slo", {}).get("status", [])
+        )
+        if fired & {"flood", "conn_churn", "backpressure"} or slo_burning:
+            cls, explained = f"load-shed:L{level}", True
+        else:
+            cls = f"unexplained-shed:L{level}"
     elif kind in ("manual", "signal"):
         cls, explained = kind, True
     return {

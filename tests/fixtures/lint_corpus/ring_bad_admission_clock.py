@@ -1,0 +1,70 @@
+"""known-bad: an ingress admission-policy class reading the clock
+itself.  Admission/shed/rate decisions run INSIDE the wire-edge tile's
+on_frags/after_credit hot path — the policy must take `now` from the
+caller (tango.tempo.tickcount domain) so decisions stay replayable,
+deterministic under faultinj seeds, and off the loop's phase-sampling
+path.  Must trip hot-path-clock on every bare time.* read in ANY
+method of an Admission/Shedder/TokenBucket/StakeTable class; the
+caller-supplied-now control class and non-admission helper code must
+not.
+"""
+
+import time
+
+
+class LeakyTokenBucket:
+    """BAD: a rate limiter that reads wall/monotonic clocks itself."""
+
+    def __init__(self, rate: int, burst: int):
+        self.rate = rate
+        self.level = burst
+        self.last = 0.0
+
+    def take(self, n: int = 1) -> int:
+        # BAD: monotonic read inside the admission hot path
+        now = time.monotonic()
+        self.level = min(self.level + (now - self.last) * self.rate, 64)
+        self.last = now
+        got = min(n, int(self.level))
+        self.level -= got
+        return got
+
+
+class WallClockAdmission:
+    """BAD: handshake gate stamping births off time.time()."""
+
+    def __init__(self):
+        self.births = {}
+
+    def admit_handshake(self, addr):
+        # BAD: wall clock for an eviction deadline
+        self.births[addr] = time.time()
+        return None
+
+    def sweep(self, timeout_s: float):
+        # BAD: ns clock in the eviction sweep
+        cut = time.monotonic_ns() - int(timeout_s * 1e9)
+        return [a for a, b in self.births.items() if b < cut]
+
+
+class DisciplinedAdmission:
+    """control: caller-supplied tick-domain `now` must NOT trip."""
+
+    def __init__(self):
+        self.births = {}
+
+    def admit_handshake(self, addr, now: int):
+        self.births[addr] = now
+        return None
+
+    def sweep(self, now: int, timeout_ticks: int):
+        return [
+            a for a, b in self.births.items() if now - b >= timeout_ticks
+        ]
+
+
+def harness_wait(deadline_s: float) -> None:
+    """control: free function (not admission policy, not a tile hook) —
+    the rule must leave ordinary host-side code alone."""
+    while time.monotonic() < deadline_s:
+        time.sleep(0.01)

@@ -53,6 +53,7 @@ BAD_FIXTURES = {
     "ring_bad_device_dispatch.py": "device-dispatch",
     "ring_bad_stem_handler.py": "stem-native-handler",
     "ring_bad_hot_clock.py": "hot-path-clock",
+    "ring_bad_admission_clock.py": "hot-path-clock",
     "proc_bad_unsafe_tile.py": "proc-safe-tile",
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",
@@ -150,6 +151,18 @@ def test_hot_clock_fixture_controls_are_clean():
     hits = [f for f in rep.findings if f.rule == "hot-path-clock"]
     assert len(hits) == 4, hits  # the four BAD reads in ImpatientTile
     assert all(f.line < 32 for f in hits), hits  # controls stay clean
+
+
+def test_admission_clock_fixture_controls_are_clean():
+    """The ISSUE 13 coverage extension: the rule flags every bare
+    time.* read in admission-policy class methods (TokenBucket /
+    Admission tags) and NONE in the controls (caller-supplied `now`,
+    ordinary host-side functions)."""
+    rep = engine.run_paths([CORPUS / "ring_bad_admission_clock.py"])
+    hits = [f for f in rep.findings if f.rule == "hot-path-clock"]
+    assert len(hits) == 3, hits  # bucket.take + admit_handshake + sweep
+    assert all(f.line < 49 for f in hits), hits  # controls stay clean
+    assert all("admission-policy" in f.msg for f in hits), hits
 
 
 def test_proc_safe_fixture_controls_are_clean():

@@ -1,0 +1,800 @@
+"""Hostile-ingress hardening (ISSUE 13): admission control, stake-
+weighted QoS, SLO-driven load shedding, injected-attack faults, and the
+verify-layer poison-resistance the adversary harness leans on.
+
+Fast section: pure policy units (waltz/admission.py), the quic tile's
+gate/preemption/egress metering with a stub ctx, faultinj's injected
+kinds + the cross-process fired-flag fold, fdtincident shed
+classification, config plumbing, and the wire-edge pre-allocation gate.
+
+Slow section: a laced verify batch (non-canonical sigs + small-order
+pubkeys must die at verify without poisoning neighbors) and a bounded
+seeded adversary smoke — the same invariant set checkall's adversary
+stage runs at full scale.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco.faultinj import Fault, FaultInjector
+from firedancer_tpu.disco.metrics import Metrics
+from firedancer_tpu.disco.slo import SloConfig, SloEngine, SloStatus
+from firedancer_tpu.waltz import admission as ADM
+from firedancer_tpu.waltz.admission import (
+    TICKS_PER_S,
+    AdmissionConfig,
+    ConnAdmission,
+    LoadShedder,
+    StakeTable,
+    TokenBucket,
+)
+
+S = TICKS_PER_S
+
+
+# ---------------------------------------------------------------------------
+# token bucket (tick domain)
+
+
+def test_token_bucket_tick_domain():
+    b = TokenBucket(rate_per_s=10, burst=4)
+    # full burst up front, then empty
+    assert b.take(now=0, n=6) == 4
+    assert b.take(now=0, n=1) == 0
+    # refill is exact integer math: 10/s -> one token per S//10 ticks
+    assert b.take(now=S // 10, n=2) == 1
+    assert b.take(now=S // 10, n=1) == 0
+    # a long gap refills to the burst cap, never beyond
+    assert b.take(now=100 * S, n=100) == 4
+    # rate 0 disables (always admits)
+    assert TokenBucket(0, 1).take(now=0, n=999) == 999
+
+
+def test_token_bucket_never_reads_clock():
+    """The tick-domain contract the fdtlint hot-path-clock rule
+    polices: every admission-policy method takes `now` from the caller
+    (no time.* source inside waltz/admission.py at all)."""
+    import inspect
+
+    src = inspect.getsource(ADM)
+    assert "import time" not in src
+    assert "time.monotonic" not in src
+
+
+# ---------------------------------------------------------------------------
+# connection admission
+
+
+def _adm(**kw) -> ConnAdmission:
+    base = dict(
+        max_conns=4, max_conns_per_source=2,
+        handshake_rate=2, handshake_burst=2,
+        txn_rate=5, txn_burst=3,
+    )
+    base.update(kw)
+    return ConnAdmission(AdmissionConfig(**base))
+
+
+def test_conn_admission_caps_and_reasons():
+    a = _adm()
+    # handshake-rate bucket: burst of 2, then the rate reason
+    assert a.admit_handshake(("1.1.1.1", 1), now=0) is None
+    assert a.admit_handshake(("1.1.1.2", 1), now=0) is None
+    assert a.admit_handshake(("1.1.1.3", 1), now=0) == "drop_handshake_rate"
+    # per-source cap: one IP across ephemeral ports is ONE source
+    now = S  # refill
+    for i in range(2):
+        assert a.admit_conn(("9.9.9.9", 1000 + i), now) is None
+        a.conn_opened(bytes([i]), ("9.9.9.9", 1000 + i), now)
+    assert a.admit_conn(("9.9.9.9", 3000), now) == "drop_source_cap"
+    # global cap
+    for i in range(2):
+        a.conn_opened(bytes([16 + i]), (f"8.8.8.{i}", 1), now)
+    assert a.admit_conn(("7.7.7.7", 1), now) == "drop_conn_cap"
+    # releasing frees both the global slot and the source slot
+    a.conn_released(bytes([0]))
+    a.conn_released(bytes([16]))
+    assert a.admit_conn(("9.9.9.9", 3000), now) is None
+
+
+def test_conn_admission_emergency_level_refuses_unstaked():
+    stakes = StakeTable({b"1.2.3.4:5": 10_000})
+    a = ConnAdmission(AdmissionConfig(), stakes)
+    a.level = 3  # emergency staked-only (mirrored in by the tile)
+    assert a.admit_handshake(("6.6.6.6", 1), now=0) == "drop_emergency"
+    assert a.admit_handshake(("1.2.3.4", 5), now=0) is None
+
+
+def test_txn_rate_bucket_and_high_stake_exemption():
+    stakes = StakeTable({b"whale": 1_000_000}, low_stake=1000)
+    a = ConnAdmission(
+        AdmissionConfig(txn_rate=5, txn_burst=3), stakes
+    )
+    # unstaked flow: burst 3 then rate-limited
+    assert a.admit_txns(b"k1", b"nobody", now=0, n=5) == 3
+    assert a.admit_txns(b"k1", b"nobody", now=0, n=1) == 0
+    # high-stake identity is exempt — priority is the point
+    assert a.admit_txns(b"k2", b"whale", now=0, n=500) == 500
+
+
+def test_idle_and_slow_loris_sweep():
+    a = ConnAdmission(
+        AdmissionConfig(idle_timeout_s=1.0, handshake_timeout_s=0.5)
+    )
+    est = types.SimpleNamespace(
+        scid=b"A", established=True, last_rx_tick=1
+    )
+    loris = types.SimpleNamespace(
+        scid=b"B", established=False, last_rx_tick=0
+    )
+    server = types.SimpleNamespace(
+        by_addr={("1.1.1.1", 1): est, ("2.2.2.2", 2): loris}
+    )
+    a.conn_opened(b"A", ("1.1.1.1", 1), now=1)
+    a.conn_opened(b"B", ("2.2.2.2", 2), now=1)
+    # before any deadline: nothing
+    idle, hs = a.sweep(server, now=int(0.2 * S))
+    assert idle == [] and hs == []
+    # past the handshake deadline the un-established conn is a loris
+    # victim even though it stays "active"
+    loris.last_rx_tick = int(0.6 * S)
+    idle, hs = a.sweep(server, now=int(0.7 * S))
+    assert hs == [("2.2.2.2", 2)] and idle == []
+    # past idle_timeout the silent established conn is idle churn
+    idle, hs = a.sweep(server, now=int(1.5 * S))
+    assert ("1.1.1.1", 1) in idle
+
+
+# ---------------------------------------------------------------------------
+# load shedder
+
+
+def test_load_shedder_hysteresis_and_commanded_floor():
+    cfg = AdmissionConfig(
+        shed_hi=0.75, shed_lo=0.25, shed_cooldown_s=1.0, shed_dwell_s=0.1
+    )
+    sh = LoadShedder(cfg)
+    D = int(0.1 * S)
+    # escalation: one level per DWELL while hot (walks the ladder
+    # across dwells — a sub-dwell transient costs at most one level)
+    assert sh.update(0, 0.9) == 1
+    assert sh.update(1, 0.9) == 1  # same dwell: paced, no jump
+    assert sh.update(D, 0.9) == 2
+    assert sh.update(2 * D, 0.9) == 3
+    assert sh.update(3 * D, 0.9) == 3  # clamped at MAX_LEVEL
+    # mid-band occupancy holds the level (no flapping)
+    assert sh.update(3 * D, 0.5) == 3
+    # de-escalation needs calm SUSTAINED for the cooldown
+    assert sh.update(1 * S, 0.1) == 3
+    assert sh.update(int(1.5 * S), 0.1) == 3
+    assert sh.update(int(2.1 * S), 0.1) == 2
+    # the SLO engine's commanded level is a FLOOR: raises, never lowers
+    assert sh.update(int(2.2 * S), 0.1, commanded=3) == 3
+    lvl_before = sh.level
+    assert sh.update(int(2.3 * S), 0.1, commanded=0) == lvl_before
+    assert sh.transitions >= 5
+
+
+def test_shed_level_gates_by_class():
+    assert LoadShedder.admits(ADM.CLASS_UNSTAKED, 0)
+    assert not LoadShedder.admits(ADM.CLASS_UNSTAKED, 1)
+    assert LoadShedder.admits(ADM.CLASS_LOW, 1)
+    assert not LoadShedder.admits(ADM.CLASS_LOW, 2)
+    assert LoadShedder.admits(ADM.CLASS_HI, 3)
+
+
+# ---------------------------------------------------------------------------
+# stake table
+
+
+def test_stake_table_config_and_classes():
+    t = StakeTable.from_config(
+        {"0x0a0b": 500, "1.2.3.4:5": 70_000}, low_stake=1000
+    )
+    assert t.weight(b"\x0a\x0b") == 500
+    assert t.cls_of(b"\x0a\x0b") == ADM.CLASS_LOW
+    assert t.cls_of(b"1.2.3.4:5") == ADM.CLASS_HI
+    assert t.cls_of(b"unknown") == ADM.CLASS_UNSTAKED
+    assert t.cls_of(None) == ADM.CLASS_UNSTAKED
+
+
+def test_stake_table_synthetic_deterministic():
+    a = StakeTable.synthetic(12, seed=5)
+    b = StakeTable.synthetic(12, seed=5)
+    c = StakeTable.synthetic(12, seed=6)
+    assert a.stakes == b.stakes
+    assert a.stakes != c.stakes
+    assert all(w > 0 for w in a.stakes.values())
+
+
+# ---------------------------------------------------------------------------
+# SLO -> commanded shed level
+
+
+def _status(name, burn_fast=0.0, breached=False):
+    return SloStatus(
+        name=name, threshold=0.0, burn_fast=burn_fast, breached=breached
+    )
+
+
+def test_slo_recommended_shed_level():
+    eng = SloEngine(SloConfig(e2e_p99_us=60_000, burn_fast=8.0), {})
+    eng._last = [_status("e2e_p99_us")]
+    assert eng.recommended_shed_level() == 0
+    eng._last = [_status("e2e_p99_us", burn_fast=1.5)]
+    assert eng.recommended_shed_level() == 1
+    eng._last = [_status("e2e_p99_us", burn_fast=9.0)]
+    assert eng.recommended_shed_level() == 2
+    eng._last = [_status("e2e_p99_us", breached=True)]
+    assert eng.recommended_shed_level() == 3
+    # drop_rate_max AND landed_tps_min are EXCLUDED: shedding raises
+    # the drop rate and lowers landed throughput by design; feeding
+    # either back would latch the shedder at max forever (a benign
+    # traffic lull must never blackhole unstaked ingress)
+    eng._last = [_status("drop_rate_max", burn_fast=99.0, breached=True)]
+    assert eng.recommended_shed_level() == 0
+    eng._last = [_status("landed_tps_min", burn_fast=99.0, breached=True)]
+    assert eng.recommended_shed_level() == 0
+
+
+# ---------------------------------------------------------------------------
+# fdtincident: shed-bundle classification
+
+
+def _shed_bundle(level, fired=(), slo_status=()):
+    return {
+        "id": "t-0001-shed",
+        "trigger": {
+            "kind": "shed", "tile": "quic", "detail": {"level": level},
+        },
+        "faultinj": {"seed": 1, "fired": [list(e) for e in fired]},
+        "slo": {"status": [s.to_dict() for s in slo_status]},
+        "timeline": {},
+    }
+
+
+def test_fdtincident_classifies_shed_bundles():
+    from scripts.fdtincident import classify_bundle
+
+    # backed by a scripted flood: expected, correctly labeled
+    r = classify_bundle(
+        _shed_bundle(2, fired=[("quic", "flood", 100, (64, "garbage"))])
+    )
+    assert r["class"] == "load-shed:L2" and r["explained"]
+    # backed by a burning SLO (the engine's commanded floor)
+    r = classify_bundle(
+        _shed_bundle(1, slo_status=[_status("e2e_p99_us", burn_fast=2.0)])
+    )
+    assert r["class"] == "load-shed:L1" and r["explained"]
+    # nothing scripted, nothing burning: something unscripted is
+    # flooding — must demand investigation
+    r = classify_bundle(_shed_bundle(3))
+    assert r["class"] == "unexplained-shed:L3" and not r["explained"]
+
+
+# ---------------------------------------------------------------------------
+# faultinj: injected-traffic kinds
+
+
+def test_flood_fault_fires_once_and_is_canonical():
+    faults = [
+        Fault("quic", "flood", at=3, count=16, link="garbage"),
+        Fault("quic", "conn_churn", at=5, count=8),
+    ]
+    inj = FaultInjector(seed=9, faults=faults)
+    tf = inj.view("quic")
+    for _ in range(10):
+        tf.tick(None)
+    got = tf.take_injected()
+    assert [(k, c, p) for _, k, c, p in got] == [
+        ("flood", 16, "garbage"), ("conn_churn", 8, None),
+    ]
+    assert tf.take_injected() == []  # drained exactly once
+    for _ in range(10):
+        tf.tick(None)
+    assert tf.take_injected() == []  # fired flags are durable
+    # canonical record: same seed + schedule -> equal fired() lists
+    inj2 = FaultInjector(seed=9, faults=[
+        Fault("quic", "flood", at=3, count=16, link="garbage"),
+        Fault("quic", "conn_churn", at=5, count=8),
+    ])
+    tf2 = inj2.view("quic")
+    for _ in range(10):
+        tf2.tick(None)
+    assert inj.fired() == inj2.fired()
+    assert {e[1] for e in inj.fired()} == {"flood", "conn_churn"}
+
+
+def test_flood_fault_traced_in_timeline_codes():
+    from firedancer_tpu.disco.trace import FAULT_CODES, FAULT_NAMES
+
+    assert "flood" in FAULT_CODES and "conn_churn" in FAULT_CODES
+    assert FAULT_NAMES[FAULT_CODES["flood"]] == "flood"
+
+
+def test_fold_shm_fired_reconstructs_parent_record():
+    """Process-runtime bridge: a child's durable fired flags rebuild
+    the parent's canonical events for every tick-domain kind, so
+    bundles classify identically under both runtimes."""
+    sched = lambda: [  # noqa: E731 — same schedule on both sides
+        Fault("quic", "flood", at=2, count=12, link="dup"),
+        Fault("quic", "conn_churn", at=4, count=6),
+        Fault("quic", "backpressure", at=6, count=3),
+    ]
+    child = FaultInjector(seed=3, faults=sched())
+    tf = child.view("quic")
+    shm = np.zeros(64, np.uint8)
+    tf.bind_shm(shm)
+    for _ in range(8):
+        tf.tick(None)
+    tf.take_injected()
+    assert len(child.events) == 3
+
+    parent = FaultInjector(seed=3, faults=sched())
+    assert parent.fired() == []  # process isolation: no parent events
+    parent.fold_shm_fired("quic", shm)
+    assert parent.fired() == child.fired()
+    # idempotent: folding again does not duplicate
+    parent.fold_shm_fired("quic", shm)
+    assert parent.fired() == child.fired()
+
+
+# ---------------------------------------------------------------------------
+# quic tile: gate ledger, stake preemption, egress metering
+
+
+def _tile_ctx(tile):
+    mem = np.zeros(
+        Metrics.footprint(tile.schema.with_base()), dtype=np.uint8
+    )
+    return types.SimpleNamespace(
+        metrics=Metrics(mem, tile.schema.with_base())
+    )
+
+
+def _mk_tile(**adm_kw):
+    from firedancer_tpu.tiles.quic import QuicIngressTile
+
+    stakes = StakeTable(
+        {b"staker": 50_000, b"minnow": 10}, low_stake=1000
+    )
+    qt = QuicIngressTile(
+        b"\x07" * 32, via_net=True,
+        admission=AdmissionConfig(**adm_kw), stakes=stakes,
+    )
+    qt.on_boot(None)  # via_net: no sockets; wires admission/shedder
+    return qt
+
+
+def test_backlog_preemption_staked_evicts_unstaked():
+    qt = _mk_tile(backlog_cap=4)
+    ctx = _tile_ctx(qt)
+    for i in range(4):
+        assert qt._enqueue(ctx, b"u%d" % i, ADM.CLASS_UNSTAKED)
+    # at capacity: an arriving staked txn evicts the OLDEST unstaked
+    assert qt._enqueue(ctx, b"hi", ADM.CLASS_HI)
+    assert ctx.metrics.counter("shed_backlog") == 1
+    assert list(qt._backlogs[ADM.CLASS_HI]) == [b"hi"]
+    assert list(qt._backlogs[ADM.CLASS_UNSTAKED]) == [b"u1", b"u2", b"u3"]
+    # same-or-lower class incoming at capacity is the refused side
+    assert not qt._enqueue(ctx, b"u9", ADM.CLASS_UNSTAKED)
+    assert ctx.metrics.counter("shed_backlog") == 2
+
+
+def test_gate_ledger_closes_per_call():
+    qt = _mk_tile(txn_rate=5, txn_burst=2)
+    ctx = _tile_ctx(qt)
+    admitted = [[] for _ in range(3)]
+    # unstaked source under L1 shed: everything gate-shed
+    qt.shedder.level = 1
+    qt._gate_raws(ctx, [b"a", b"b"], b"nobody", b"k0", 0, admitted)
+    # staked source: rate-exempt? no — only CLASS_HI is exempt; this
+    # one IS high-stake so all admit
+    qt._gate_raws(ctx, [b"c"] * 3, b"staker", b"k1", 0, admitted)
+    # low-stake source at L1 passes the level gate but hits the rate
+    # bucket (burst 2)
+    qt._gate_raws(ctx, [b"d"] * 4, b"minnow", b"k2", 0, admitted)
+    m = ctx.metrics
+    offered = m.counter("gate_txns")
+    accounted = (
+        m.counter("admit_staked") + m.counter("admit_unstaked")
+        + m.counter("drop_txn_rate") + m.counter("shed_unstaked")
+        + m.counter("shed_lowstake")
+    )
+    assert offered == 9 and accounted == 9
+    assert m.counter("shed_unstaked") == 2
+    assert m.counter("drop_txn_rate") == 2
+    assert m.counter("admit_staked") == 5  # 3 whale + 2 minnow
+    assert len(admitted[ADM.CLASS_HI]) == 3
+    assert len(admitted[ADM.CLASS_LOW]) == 2
+
+
+def test_dup_wave_injects_exactly_scheduled_count():
+    """A dup wave replays exactly its scheduled count from the
+    recent-admit pool — it must not ALSO fall through to the malformed
+    branch and double-inject (canonical record would lie)."""
+    qt = _mk_tile()
+    ctx = _tile_ctx(qt)
+    qt._recent_raws.extend([b"r1", b"r2"])
+    h = np.arange(5, dtype=np.uint64)
+    qt._inject_txns(ctx, seed=7, fi=0, h=h, prof="dup", now=0)
+    assert ctx.metrics.counter("adv_injected") == 5
+    assert ctx.metrics.counter("gate_txns") == 5
+    # empty pool degrades to malformed spam, still exactly the count
+    qt2 = _mk_tile()
+    ctx2 = _tile_ctx(qt2)
+    qt2._inject_txns(ctx2, seed=7, fi=0, h=h, prof="dup", now=0)
+    assert ctx2.metrics.counter("adv_injected") == 5
+
+
+def test_tx_eagain_tail_is_metered():
+    """ISSUE 13 satellite: the egress burst tail dropped on EAGAIN was
+    a silent `break` — it must be a metered drop with a monitor NOTE."""
+    qt = _mk_tile()
+    qt.via_net = False  # exercise the native-send branch
+    ctx = _tile_ctx(qt)
+    qt._send_burst_native = lambda pkts: max(len(pkts) - 3, 0)  # EAGAIN
+    qt._tx(ctx, [(b"d%d" % i, ("127.0.0.1", 9)) for i in range(8)])
+    assert ctx.metrics.counter("tx_dgrams") == 5
+    assert ctx.metrics.counter("tx_eagain_drops") == 3
+
+    from firedancer_tpu.app.monitor import Monitor
+
+    snap = {
+        "quic": {
+            "signal": "RUN", "heartbeat": 1, "stale": False,
+            "counters": {
+                c: ctx.metrics.counter(c)
+                for c in qt.schema.with_base().counters
+            },
+        }
+    }
+    mon = object.__new__(Monitor)  # alarms() is pure over snap
+    notes = mon.alarms(snap)
+    assert any(
+        "tx_eagain" in n or "EAGAIN" in n for n in notes
+    ), notes
+
+
+def test_monitor_surfaces_shed_level_and_ingress_row():
+    from firedancer_tpu.app.monitor import Monitor
+
+    counters = {
+        "shed_level": 3, "shed_transitions": 4, "gate_txns": 100,
+        "admit_staked": 60, "admit_unstaked": 0, "shed_unstaked": 30,
+        "shed_lowstake": 5, "shed_backlog": 5, "drop_txn_rate": 0,
+        "drop_conn_cap": 1, "drop_source_cap": 0, "drop_emergency": 2,
+        "drop_handshake_rate": 7, "conns_evicted_idle": 1,
+        "conns_evicted_handshake": 2, "in_frags": 0, "out_frags": 60,
+    }
+    snap = {
+        "quic": {
+            "signal": "RUN", "heartbeat": 1, "stale": False,
+            "counters": counters,
+        }
+    }
+    mon = object.__new__(Monitor)
+    alarms = mon.alarms(snap)
+    # emergency staked-only is an ALARM, not a note
+    assert any(
+        a.startswith("ALARM") and "staked-only" in a for a in alarms
+    ), alarms
+    out = mon.render(None, snap, 1.0)
+    assert "ingress:" in out and "level=3" in out
+
+
+# ---------------------------------------------------------------------------
+# attack-path crypto cost (the quic-loop-under-flood fix)
+
+
+def test_ghash_fast_table_matches_bitserial_reference():
+    """The subset-xor GHASH table build (the 75 ms -> 1 ms AesGcm ctor
+    fix that un-wedged the quic loop under handshake flood) must be
+    bit-identical to the bit-serial GF(2^128) reference — checked
+    dependency-free (the cryptography-package cross-checks don't run
+    in every container)."""
+    from firedancer_tpu.ballet import aes as A
+
+    rng = np.random.default_rng(13)
+    for _ in range(2):
+        h = rng.integers(0, 256, 16, np.uint8).tobytes()
+        g = A.Ghash(h)
+        hi = int.from_bytes(h, "big")
+        for pos in (0, 5, 15):
+            for b in (0, 1, 2, 0x80, 0xA5, 0xFF):
+                assert g.table[pos][b] == A._gf128_mul(
+                    hi, b << (8 * (15 - pos))
+                )
+
+
+def test_aes_gcm_nist_vectors_dependency_free():
+    """NIST GCM test vectors (AES-128, 96-bit IV) pin the whole AEAD —
+    key schedule, CTR stream, GHASH, tag — with no external package."""
+    from firedancer_tpu.ballet import aes as A
+
+    # McGrew-Viega test case 1: empty pt, zero key/iv
+    g = A.AesGcm(bytes(16))
+    assert g.encrypt(bytes(12), b"", b"").hex() == (
+        "58e2fccefa7e3061367f1d57a4e7455a"
+    )
+    # test case 3: 4-block pt, no aad
+    key = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+    iv = bytes.fromhex("cafebabefacedbaddecaf888")
+    pt = bytes.fromhex(
+        "d9313225f88406e5a55909c5aff5269a"
+        "86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525"
+        "b16aedf5aa0de657ba637b391aafd255"
+    )
+    ct_tag = A.AesGcm(key).encrypt(iv, pt, b"")
+    assert ct_tag.hex() == (
+        "42831ec2217774244b7221b784d0d49c"
+        "e3aa212f2c02a4e035c17e2329aca12e"
+        "21d514b25466931c7d8f6a5aac84aa05"
+        "1ba30b396a0aac973d58e091473f5985"
+        "4d5c2af327cd64a62cf35abd2ba6fab4"
+    )
+    # decrypt round-trip + tag rejection
+    assert A.AesGcm(key).decrypt(iv, ct_tag, b"") == pt
+    bad = ct_tag[:-1] + bytes([ct_tag[-1] ^ 1])
+    assert A.AesGcm(key).decrypt(iv, bad, b"") is None
+
+
+def test_retry_aead_cached_and_round_trips():
+    """The Retry integrity AEAD (spec-constant key, RFC 9001 5.8) is
+    built ONCE (the 75 ms-per-Retry defense-cost bug), and a server-
+    minted Retry still authenticates at the client — while a tampered
+    tag is ignored (no token adopted, no CID switch)."""
+    from firedancer_tpu.waltz import quic as Q
+
+    assert Q._retry_aead() is Q._retry_aead()  # cached singleton
+    client = Q.QuicClient()
+    conn = client.conn
+    odcid = conn.dcid
+    srv = Q.QuicServer(b"\x07" * 32, retry=True)
+    retry = srv._retry_packet(conn.scid, odcid, ("127.0.0.1", 7))
+    scid_off = 5 + 1 + len(conn.scid) + 1
+    retry_scid = retry[scid_off : scid_off + 8]
+    # tampered tag first: must be ignored entirely
+    bad = retry[:-1] + bytes([retry[-1] ^ 1])
+    conn._on_retry(bad, retry_scid)
+    assert conn.token == b"" and conn.dcid == odcid
+    # genuine retry: token adopted, server-chosen CID adopted
+    conn._on_retry(retry, retry_scid)
+    assert conn.token != b"" and conn.dcid == retry_scid
+    # and the server accepts its own token back from the same addr
+    assert srv._check_token(conn.token, ("127.0.0.1", 7)) is not None
+    assert srv._check_token(conn.token, ("6.6.6.6", 7)) is None
+
+
+# ---------------------------------------------------------------------------
+# wire edge: pre-allocation admission in QuicServer
+
+
+def _initial_pkt(i: int) -> bytes:
+    from firedancer_tpu.waltz import quic as Q
+
+    return (
+        bytes([0xC0]) + (1).to_bytes(4, "big")
+        + bytes([8]) + int(i).to_bytes(8, "little")
+        + bytes([8]) + bytes(8)
+        + b"\x00" + Q.vi_enc(40) + bytes(40)
+    )
+
+
+def test_quic_server_admission_gates_before_allocation():
+    from firedancer_tpu.waltz import quic as Q
+
+    adm = ConnAdmission(
+        AdmissionConfig(handshake_rate=1, handshake_burst=2)
+    )
+    srv = Q.QuicServer(b"\x07" * 32, admission=adm)
+    srv.now_tick = 0
+    for i in range(8):
+        srv.on_datagram(_initial_pkt(i), (f"127.0.5.{i}", 4000))
+    # burst of 2 admitted (and allocated); the rest refused pre-alloc
+    # with a stateless Retry as the backoff signal
+    assert len(srv.conns) >= 2
+    assert srv.admit_drops["drop_handshake_rate"] == 6
+    assert srv.admit_drops["retry_sent"] == 6
+    retries = [d for d, _ in srv.stateless_out if (d[0] & 0xF0) == 0xF0]
+    assert len(retries) == 6
+    # malformed garbage never raises and never allocates
+    before = len(srv.conns)
+    srv.on_datagram(b"\x40" + bytes(60), ("127.0.6.1", 1))
+    srv.on_datagram(b"\xc0\xff", ("127.0.6.2", 1))
+    assert len(srv.conns) == before
+
+
+def test_quic_server_handshake_flood_cannot_evict_established():
+    """At the connection cap, the LRU eviction prefers a victim that
+    never completed its handshake — a flood must not push out peers."""
+    from firedancer_tpu.waltz import quic as Q
+
+    srv = Q.QuicServer(b"\x07" * 32, max_conns=4)
+    for i in range(4):
+        srv.on_datagram(_initial_pkt(i), (f"127.0.7.{i}", 4000))
+    assert len(srv.by_addr) == 4
+    # mark one victim-candidate established (oldest in LRU order)
+    est_addr = ("127.0.7.0", 4000)
+    srv.by_addr[est_addr].established = True
+    srv.on_datagram(_initial_pkt(99), ("127.0.8.1", 4000))
+    assert est_addr in srv.by_addr  # survived; a zombie was evicted
+
+
+def test_refused_initial_never_evicts_established():
+    """An Initial that will be REFUSED (per-source cap) must not cost
+    an existing peer its slot: the at-cap eviction runs only after
+    every admission gate has passed."""
+    from firedancer_tpu.waltz import quic as Q
+
+    adm = ConnAdmission(AdmissionConfig(max_conns_per_source=1))
+    srv = Q.QuicServer(b"\x07" * 32, max_conns=3, admission=adm)
+    for i in range(3):
+        srv.on_datagram(_initial_pkt(i), (f"127.0.9.{i}", 4000))
+    assert len(srv.by_addr) == 3
+    for a in list(srv.by_addr):
+        srv.by_addr[a].established = True
+    # 127.0.9.0 already holds its 1 allowed conn: its new Initial (new
+    # port, same source IP) is refused at the source cap — and the full
+    # table of established peers must be untouched
+    before = set(srv.by_addr)
+    srv.on_datagram(_initial_pkt(77), ("127.0.9.0", 5000))
+    assert srv.admit_drops.get("drop_source_cap", 0) >= 1
+    assert set(srv.by_addr) == before
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+
+
+def test_config_parses_admission_and_stakes():
+    from firedancer_tpu.app import config as C
+
+    cfg = C.parse(
+        """
+[tiles.quic]
+max_conns = 128
+handshake_rate = 50
+txn_rate = 200
+backlog_cap = 512
+low_stake = 777
+
+[stakes]
+"0x0a0b" = 500
+"1.2.3.4:5" = 70000
+"""
+    )
+    assert cfg.quic_admission is not None
+    assert cfg.quic_admission.max_conns == 128
+    assert cfg.quic_admission.handshake_rate == 50
+    assert cfg.quic_admission.backlog_cap == 512
+    t = StakeTable.from_config(
+        cfg.stakes, low_stake=cfg.quic_admission.low_stake
+    )
+    assert t.weight(b"\x0a\x0b") == 500
+    assert t.cls_of(b"\x0a\x0b") == ADM.CLASS_LOW  # < 777
+    assert t.cls_of(b"1.2.3.4:5") == ADM.CLASS_HI
+    # no admission keys -> None (permissive pre-hardening behavior)
+    assert C.parse("[tiles.quic]\nquic_port = 1\n").quic_admission is None
+
+
+def test_admission_config_roundtrip():
+    a = AdmissionConfig(max_conns=7, txn_rate=9, shed_hi=0.5)
+    b = AdmissionConfig.from_dict(a.to_dict())
+    assert a == b
+    # unknown keys are ignored (forward-compatible config docs)
+    c = AdmissionConfig.from_dict({"max_conns": 3, "not_a_knob": 1})
+    assert c.max_conns == 3
+
+
+# ---------------------------------------------------------------------------
+# slow: verify-layer poison resistance + the adversary smoke
+
+pytestmark_slow = pytest.mark.slow
+
+
+@pytest.mark.slow
+def test_malformed_and_smallorder_batch_does_not_poison_neighbors():
+    """A batch laced with non-canonical sigs and small-order pubkeys is
+    rejected AT VERIFY while every honest neighbor in the same batch
+    still lands, and the rejects are metered (verify_fail_txns)."""
+    import time
+
+    from firedancer_tpu.ballet import txn as T
+    from firedancer_tpu.disco import Topology
+    from firedancer_tpu.ops.ed25519 import golden, hostpath
+    from firedancer_tpu.tiles import wire
+    from firedancer_tpu.tiles.dedup import DedupTile
+    from firedancer_tpu.tiles.sink import SinkTile
+    from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool
+    from firedancer_tpu.tiles.verify import VerifyTile
+
+    n_good = 12
+    rows, szs, good = make_txn_pool(n_good, seed=77)
+    assert good.all()
+
+    # poison txns: STRUCTURALLY valid (they parse, they reach the
+    # sig-verify lanes) but cryptographically rotten
+    def lace(payload: bytes) -> None:
+        nonlocal rows, szs
+        desc = T.parse(payload)
+        assert desc is not None, "poison txns must parse"
+        full = wire.append_trailer(payload, desc)
+        row = np.zeros((1, wire.LINK_MTU), np.uint8)
+        row[0, : len(full)] = np.frombuffer(full, np.uint8)
+        rows = np.vstack([rows, row])
+        szs = np.append(szs, np.uint16(len(full)))
+
+    base = bytes(rows[0, : szs[0] - wire.TRAILER_SZ])
+
+    # 1) non-canonical s: a copy of an honest txn with s >= L
+    L = (1 << 252) + 27742317777372353535851937790883648493
+    bad_s = bytearray(base)
+    bad_s[1 + 32 : 1 + 64] = (L + 5).to_bytes(32, "little")
+    lace(bytes(bad_s))
+
+    # 2) small-order A: payer pubkey is a blocklisted small-order point
+    small = golden.small_order_blocklist()[0]
+    sk = b"\x11" * 32
+    body = T.build(
+        [bytes(64)], [small, b"\x22" * 32, b"\x33" * 32],
+        b"\x44" * 32, [(2, [0, 1], b"\x00" * 8)],
+        readonly_unsigned_cnt=1,
+    )
+    desc = T.parse(body)
+    sig = hostpath.sign(sk, desc.message(body))  # sig by SOME key
+    lace(body[:1] + sig + body[1 + 64 :])
+
+    # 3) small-order R: honest txn, R replaced by the identity point
+    bad_r = bytearray(base)
+    bad_r[1 : 1 + 32] = golden.small_order_blocklist()[0]
+    lace(bytes(bad_r))
+
+    n_total = len(szs)
+    synth = SynthTile(rows, szs, total=n_total)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off",
+        device_fn=hostpath.verify_batch_digest_host, async_depth=2,
+    )
+    topo = Topology()
+    topo.link("synth_verify", depth=64, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=64, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=64, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
+    topo.tile(DedupTile(depth=1 << 10), ins=[("verify_dedup", True)],
+              outs=["dedup_sink"])
+    sink = SinkTile(record=True)
+    topo.tile(sink, ins=[("dedup_sink", True)])
+    topo.build()
+    topo.start(batch_max=64)
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if topo.metrics("sink").counter("sunk_frags") >= n_good:
+                break
+            time.sleep(0.02)
+        topo.halt()
+        mv = topo.metrics("verify")
+        assert mv.counter("verify_fail_txns") == 3  # all poison metered
+        tags = set(sink.all_sigs().tolist())
+        want = set(synth.tags[:n_good].tolist())
+        assert tags == want  # every honest neighbor landed, no poison
+    finally:
+        topo.close()
+
+
+@pytest.mark.slow
+def test_adversary_smoke_thread_runtime():
+    """Bounded seeded adversarial run — the full invariant set
+    (zero crashes, exactly-once staked delivery, exact drop ledger,
+    escalation incidents classified, staked SLO holds)."""
+    from scripts.adversary import run_adversary
+
+    rep = run_adversary(seed=7, staked=32, duration_s=8.0)
+    assert rep["ok"], json.dumps(rep.get("checks"), indent=1)
