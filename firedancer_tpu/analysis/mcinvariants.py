@@ -63,6 +63,13 @@ RULES = {
     "mc-livelock": (
         "the execution exceeded its step budget without terminating"
     ),
+    "mc-shard-handover": (
+        "a seq-sharded frag was lost or double-processed across an "
+        "elastic membership flip (disco/elastic.py): the producer "
+        "assigned post-flip frags with a stale shard-map view, or two "
+        "members resolved the same seq to themselves — the burst-"
+        "boundary epoch re-read / flip-journal discipline failed"
+    ),
 }
 
 

@@ -530,6 +530,159 @@ def _build_restart_producer(env: Env, mutation: str | None):
     env.spawn("sup", supervisor)
 
 
+def _build_elastic_handover(env: Env, mutation: str | None, *, seq0: int = 0):
+    """Elastic shard handover (disco/elastic.py): a producer assigns
+    each frag to one of two member rings from the shared shard map;
+    the controller retires member 1 mid-stream (mask flip -> producer
+    ack -> member caught-up -> reap), and traffic continues after the
+    reap.  Honest discipline: the producer re-reads the epoch/mask at
+    EVERY burst boundary, so post-flip frags all land on the surviving
+    member.  The `elastic-stale-epoch` mutant acknowledges the flip
+    (so the controller proceeds to reap) but keeps assigning per its
+    FIRST mask read — post-flip frags land in the reaped member's ring
+    and are lost on every schedule (mc-shard-handover)."""
+    depth, cr_max = 4, 2
+    n, n_pre = 6, 4  # frags total; the last n-n_pre flow AFTER the reap
+    w = R.Workspace(64 << 10)
+    mcs = [
+        R.MCache.create(w, f"mc{m}", depth=depth, seq0=seq0)
+        for m in range(2)
+    ]
+    fss = [
+        R.FSeq.create(w, f"fs{m}", seq0=seq0) for m in range(2)
+    ]
+    # the modeled shard map: epoch + active-member tuple + producer ack
+    # (scratch state — the model checks the PROTOCOL, not the region
+    # layout; reads are scheduling-transparent like every scratch hint)
+    env.scratch["smap"] = {"epoch": 1, "mask": (0, 1)}
+    env.scratch["ack"] = 1
+    processed: dict[int, list[int]] = {0: [], 1: []}
+    env.scratch["recv_el0"] = processed[0]
+    env.scratch["recv_el1"] = processed[1]
+
+    def producer():
+        seqs = [seq0, seq0]
+        smap = env.scratch["smap"]  # controller mutates IN PLACE
+        stale = dict(smap) if mutation == "elastic-stale-epoch" else None
+
+        def ack():
+            # burst boundary: acknowledge the observed flip (the
+            # mutant acks TOO — holding a stale mask while telling
+            # the controller the handover is safe is the fault)
+            if env.scratch["ack"] < smap["epoch"]:
+                env.scratch["ack"] = smap["epoch"]
+                return True
+            return False
+
+        for k in range(n):
+            if k >= n_pre:
+                # traffic continuing after the controller reaped the
+                # retiring member; parked-at-idle is still a sequence
+                # of burst boundaries, so flips are acked from here too
+                while not env.scratch.get("resumed"):
+                    ack()
+                    env.wait_for(
+                        lambda: env.scratch.get("resumed")
+                        or env.scratch["ack"] < smap["epoch"]
+                    )
+            ack()
+            view = stale if stale is not None else dict(smap)
+            mem = view["mask"][k % len(view["mask"])]
+            mc, fs = mcs[mem], fss[mem]
+            while True:
+                cr = R.cr_avail(seqs[mem], fs.query(), cr_max)
+                if cr > 0:
+                    break
+                env.wait_for(
+                    lambda m=mem: R.cr_avail(
+                        seqs[m], env.raw_fseq(fss[m]), cr_max
+                    ) > 0,
+                    watch_objs=[fss[mem]],
+                )
+            mc.publish(seq=seqs[mem], sig=1000 + k)
+            seqs[mem] = U64(seqs[mem] + 1)
+        env.scratch["prod_done"] = True
+
+    def consumer(mem: int):
+        def run():
+            seq = seq0
+            recv = processed[mem]
+            while True:
+                frags, seq, ovr = mcs[mem].drain(seq, 2)
+                if ovr:
+                    env.violation(
+                        "mc-reliable-overrun",
+                        f"member {mem} overrun on a reliable link",
+                    )
+                for f in frags:
+                    recv.append(int(f["sig"]) - 1000)
+                fss[mem].update(seq)
+                if env.scratch.get("prod_done") and seq_diff(
+                    seq, env.raw_seq_prod(mcs[mem])
+                ) >= 0:
+                    return
+                if not len(frags):
+                    env.wait_for(
+                        lambda: env.scratch.get("prod_done")
+                        or seq_diff(seq, env.raw_seq_prod(mcs[mem])) < 0,
+                        watch_objs=[mcs[mem]],
+                    )
+
+        return run
+
+    c1 = env.spawn("member1", consumer(1))
+
+    def controller():
+        # flip once some traffic flowed under the old map
+        env.wait_for(
+            lambda: (
+                seq_diff(env.raw_seq_prod(mcs[0]), seq0)
+                + seq_diff(env.raw_seq_prod(mcs[1]), seq0)
+            ) >= 2,
+            watch_objs=mcs,
+        )
+        smap = env.scratch["smap"]
+        smap["mask"] = (0,)  # mask first, then the epoch bump
+        smap["epoch"] = 2
+        # drain protocol: producer acked + retiring member caught up
+        env.wait_for(lambda: env.scratch["ack"] >= 2)
+        env.wait_for(
+            lambda: seq_diff(
+                env.raw_fseq(fss[1]), env.raw_seq_prod(mcs[1])
+            ) >= 0,
+            watch_objs=[fss[1], mcs[1]],
+        )
+        env.crash_point(focus=fss[1])
+        env.kill(c1)  # reap
+        env.scratch["resumed"] = True
+
+    def end_check(_sched):
+        got = sorted(processed[0] + processed[1])
+        if len(set(got)) != len(got):
+            raise McViolation(
+                "mc-shard-handover",
+                f"frag(s) double-processed across the flip: {got}",
+            )
+        missing = sorted(set(range(n)) - set(got))
+        if missing:
+            raise McViolation(
+                "mc-shard-handover",
+                f"frag(s) {missing} lost across the membership flip "
+                f"(assigned to the reaped member by a stale shard-map "
+                f"view)",
+            )
+
+    env.sched.monitors += [
+        FseqMonotonic(),
+        CreditBound(env.hook.label_of(mcs[0]), [fss[0]], cr_max),
+        CreditBound(env.hook.label_of(mcs[1]), [fss[1]], cr_max),
+        EndCheck(end_check),
+    ]
+    env.spawn("prod", producer)
+    env.spawn("member0", consumer(0))
+    env.spawn("ctl", controller)
+
+
 # a seq0 two frags shy of the wrap: every scenario's arithmetic crosses
 # 2^64 mid-run
 _WRAP_SEQ0 = U64((1 << 64) - 2)
@@ -557,6 +710,8 @@ SCENARIOS: dict[str, Scenario] = {
                  tier1_schedules=300, max_steps=2000),
         Scenario("restart_producer", _build_restart_producer,
                  tier1_schedules=300, max_steps=2000),
+        Scenario("elastic_handover", _build_elastic_handover,
+                 tier1_schedules=200, max_steps=2500),
         Scenario("wrap_1p1c",
                  lambda env, m: _build_1p1c(env, m, seq0=_WRAP_SEQ0),
                  tier1_schedules=250),

@@ -105,6 +105,16 @@ MUTATIONS = frozenset(
         # producer_rejoin returns seq_query blindly (pre-PR-3), re-publishing
         # a line a crashed publish had already made live (scenario-level)
         "rejoin-blind-producer",
+        # an elastic shard producer (disco/elastic.py) holds a STALE
+        # shard-map epoch: it acknowledges the membership flip (so the
+        # controller proceeds to drain + reap the retiring member) but
+        # keeps assigning frags per its FIRST mask read instead of
+        # re-reading at every burst boundary — post-flip frags land in
+        # the reaped member's ring and are lost (scenario-level).  The
+        # shipped discipline re-reads the epoch word at the top of
+        # every burst (Python loop per iteration; fdt_stem.c
+        # C_EPOCH_PTR/C_EPOCH_SEEN hands the burst back unconsumed).
+        "elastic-stale-epoch",
     }
 )
 

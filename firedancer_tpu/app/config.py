@@ -58,10 +58,24 @@ Config shape (all keys optional; defaults below):
     [slo]                            # asserted SLOs (disco/slo.py)
     e2e_p99_us = 50000               # omit a key = not asserted
     verify_hop_p99_us = 20000
+    queue_wait_p99_us = 10000        # capacity signal (elastic scale-out)
     landed_tps_min = 5000
     drop_rate_max = 0.001
     fast_window_s = 5.0
     slow_window_s = 60.0
+    [elastic]                        # elastic topology (disco/elastic.py)
+    dwell_s = 2.0                    # min seconds between reconfig ops
+    [elastic.verify]                 # per shard kind
+    min_shards = 1                   # scale-in floor
+    max_shards = 4                   # PROVISIONED members (ring layout
+                                     # is built for max; [tiles.verify]
+                                     # count is the boot-active count)
+    scale_out_burn = 1.0             # queue-wait/e2e fast-burn trigger
+    scale_in_idle_tps = 1.0          # per-shard idle floor
+    idle_for_s = 3.0
+    [elastic.bank]
+    min_shards = 1
+    max_shards = 4
 """
 
 from __future__ import annotations
@@ -132,7 +146,21 @@ class Config:
     rpc_port: int = 0
     #: asserted SLOs from the `[slo]` section; None = none asserted
     slo: SloConfig | None = None
+    #: elastic-topology policy from the `[elastic]` section
+    #: (disco/elastic.py ElasticConfig); None = static topology.  When
+    #: a kind's max_shards exceeds the boot count, the builders
+    #: PROVISION the extra members (rings + tiles, inactive) so the
+    #: controller can scale at runtime without touching ring layout.
+    elastic: object | None = None
     raw: dict = field(default_factory=dict)
+
+    def provisioned(self, kind: str, boot_count: int) -> int:
+        """Members to provision for a shard kind: max(config max_shards,
+        boot count) — ring layout is sized for the scale ceiling."""
+        if self.elastic is None:
+            return boot_count
+        kc = self.elastic.kinds.get(kind)
+        return boot_count if kc is None else max(kc.max_shards, boot_count)
 
 
 def parse(text: str) -> Config:
@@ -184,8 +212,35 @@ def parse(text: str) -> Config:
         metrics_port=t.get("metric", {}).get("port", 0),
         rpc_port=t.get("rpc", {}).get("port", 0),
         slo=SloConfig.from_dict(doc["slo"]) if "slo" in doc else None,
+        elastic=(
+            _parse_elastic(doc["elastic"]) if "elastic" in doc else None
+        ),
         raw=doc,
     )
+
+
+def _parse_elastic(doc: dict):
+    from firedancer_tpu.disco.elastic import ElasticConfig
+
+    return ElasticConfig.from_dict(doc)
+
+
+def _verify_device_split(cfg: Config, n: int, n_prov: int) -> list[list[int]]:
+    """Device partition for n boot-ACTIVE verify replicas out of n_prov
+    provisioned members: the active ones keep the full disjoint split
+    (provisioning spares must not dilute boot-time accelerator
+    capacity), while inactive spares get the whole ordinal list —
+    shared/contended only if and when a scale-out activates them (the
+    documented fewer-devices-than-replicas semantics of
+    device_assignments; per-shard-count REBALANCING is the ROADMAP
+    leftover)."""
+    from firedancer_tpu.disco.topo import device_assignments
+
+    devs = device_assignments(cfg.verify_devices, n)
+    if n_prov > n:
+        spare = device_assignments(cfg.verify_devices, 1)[0]
+        devs = devs + [list(spare) for _ in range(n_prov - n)]
+    return devs
 
 
 def _quic_policy(cfg: Config):
@@ -219,13 +274,30 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     from firedancer_tpu.tiles.store import StoreTile
     from firedancer_tpu.ballet import shred as SH
 
-    from firedancer_tpu.disco.topo import device_assignments
-
     mb_mtu = 65_535
     depth = cfg.link_depth
     n = cfg.verify_count
     n_banks = cfg.bank_count
-    verify_devs = device_assignments(cfg.verify_devices, n)
+    # elastic provisioning: ring layout is built for the scale CEILING;
+    # members past the boot count start inactive (fseqs parked) and are
+    # activated at runtime by add_shard / the ElasticController
+    n_prov = cfg.provisioned("verify", n)
+    nb_prov = cfg.provisioned("bank", n_banks)
+    # a kind is elastic only when ITS section is configured AND more
+    # than one member exists — an [elastic] section without
+    # [elastic.verify] must not silently strip the static seq filter
+    # (every replica would verify the full stream)
+    verify_elastic = (
+        cfg.elastic is not None
+        and "verify" in cfg.elastic.kinds
+        and n_prov > 1
+    )
+    bank_elastic = (
+        cfg.elastic is not None
+        and "bank" in cfg.elastic.kinds
+        and nb_prov > 1
+    )
+    verify_devs = _verify_device_split(cfg, n, n_prov)
     topo = Topology(name=cfg.name, runtime=cfg.runtime, stem=cfg.stem)
     # asserted SLOs ride the topology: build() allocates the shared slo
     # gauge region and the manifest carries the config to attached
@@ -245,13 +317,15 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
     topo.tile(net, ins=[("quic_net", True)], outs=["net_quic"])
     topo.tile(qt, ins=[("net_quic", True)], outs=["quic_verify", "quic_net"])
-    for i in range(n):
+    for i in range(n_prov):
         topo.link(f"verify{i}_dedup", depth=depth, mtu=wire.LINK_MTU)
         topo.tile(
             VerifyTile(
                 msg_width=cfg.verify_msg_width,
                 max_lanes=cfg.verify_max_lanes,
-                shard=(i, n) if n > 1 else None,
+                # elastic groups shard via the runtime map; static
+                # topologies keep the boot-frozen seq filter
+                shard=((i, n) if n > 1 and not verify_elastic else None),
                 # one compiled shape: every sub-batch pads to max_lanes,
                 # so the boot-time warm covers steady state AND trickle
                 # (bucket shapes would each pay a multi-minute cold
@@ -267,19 +341,19 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     topo.link("dedup_pack", depth=depth, mtu=wire.LINK_MTU)
     topo.tile(
         DedupTile(depth=cfg.dedup_depth),
-        ins=[(f"verify{i}_dedup", True) for i in range(n)],
+        ins=[(f"verify{i}_dedup", True) for i in range(n_prov)],
         outs=["dedup_pack"],
     )
     # bank-facing ring depths must cover the pipelining depth (inflight
     # microblocks per bank) with headroom for completion batching
     bank_ring = 1 << max(64, 4 * cfg.pack_mb_inflight).bit_length()
-    for i in range(n_banks):
+    for i in range(nb_prov):
         topo.link(f"pack_bank{i}", depth=bank_ring, mtu=mb_mtu)
         topo.link(f"bank{i}_pack", depth=bank_ring)
         topo.link(f"bank{i}_poh", depth=bank_ring, mtu=mb_mtu)
     topo.tile(
         PackTile(
-            n_banks,
+            nb_prov,
             use_device_select=cfg.pack_device_select,
             depth=cfg.pack_depth,
             mb_inflight=cfg.pack_mb_inflight,
@@ -288,10 +362,10 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
             slot_ns=cfg.pack_slot_ns,
         ),
         ins=[("dedup_pack", True)]
-        + [(f"bank{i}_pack", True) for i in range(n_banks)],
-        outs=[f"pack_bank{i}" for i in range(n_banks)],
+        + [(f"bank{i}_pack", True) for i in range(nb_prov)],
+        outs=[f"pack_bank{i}" for i in range(nb_prov)],
     )
-    for i in range(n_banks):
+    for i in range(nb_prov):
         topo.tile(
             BankTile(
                 i, funk=funk, native=cfg.bank_native,
@@ -303,9 +377,21 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     topo.link("poh_shred", depth=4096, mtu=ENTRY_SZ)
     topo.tile(
         PohTile(ticks_per_slot=cfg.ticks_per_slot),
-        ins=[(f"bank{i}_poh", True) for i in range(n_banks)],
+        ins=[(f"bank{i}_poh", True) for i in range(nb_prov)],
         outs=["poh_shred"],
     )
+    if verify_elastic:
+        topo.declare_shards(
+            "verify", [f"verify{i}" for i in range(n_prov)],
+            producer="quic", producer_link="quic_verify", active=n,
+        )
+    if bank_elastic:
+        topo.declare_shards(
+            "bank", [f"bank{i}" for i in range(nb_prov)],
+            producer="pack",
+            member_links=[f"pack_bank{i}" for i in range(nb_prov)],
+            active=n_banks,
+        )
     topo.link("shred_store", depth=4096, mtu=SH.MAX_SZ)
     topo.link("shred_sign", depth=256, mtu=32)
     topo.link("sign_shred", depth=256, mtu=64)
@@ -328,7 +414,7 @@ def build_validator_topology(cfg: Config, identity_secret: bytes,
     rpc = RpcTile(
         txn_count=lambda: sum(
             topo.metrics(f"bank{i}").counter("executed_txns")
-            for i in range(n_banks)
+            for i in range(nb_prov)
         ),
         slot=lambda: topo.metrics("poh").counter("slots"),
         funk=funk,
@@ -346,8 +432,6 @@ def build_ingress_topology(
 ) -> tuple[Topology, QuicIngressTile]:
     """The production ingress shape: quic -> N seq-sharded verify ->
     dedup -> sink (reference connection map, config.c:681-712)."""
-    from firedancer_tpu.disco.topo import device_assignments
-
     topo = Topology(name=cfg.name, runtime=cfg.runtime, stem=cfg.stem)
     topo.slo = cfg.slo
     adm, stakes = _quic_policy(cfg)
@@ -362,13 +446,22 @@ def build_ingress_topology(
     topo.link("quic_verify", depth=depth, mtu=wire.LINK_MTU)
     topo.tile(qt, outs=["quic_verify"])
     n = cfg.verify_count
-    verify_devs = device_assignments(cfg.verify_devices, n)
-    for i in range(n):
+    n_prov = cfg.provisioned("verify", n)
+    # same rule as the validator builder: elastic only when the verify
+    # kind is actually configured — otherwise the static seq filter
+    # must survive an unrelated [elastic] section
+    verify_elastic = (
+        cfg.elastic is not None
+        and "verify" in cfg.elastic.kinds
+        and n_prov > 1
+    )
+    verify_devs = _verify_device_split(cfg, n, n_prov)
+    for i in range(n_prov):
         topo.link(f"verify{i}_dedup", depth=depth, mtu=wire.LINK_MTU)
         vt = VerifyTile(
             msg_width=cfg.verify_msg_width,
             max_lanes=cfg.verify_max_lanes,
-            shard=(i, n) if n > 1 else None,
+            shard=((i, n) if n > 1 and not verify_elastic else None),
             devices=verify_devs[i],
             stall_patience_s=cfg.verify_stall_patience_s,
             name=f"verify{i}",
@@ -380,8 +473,13 @@ def build_ingress_topology(
     dedup = DedupTile(depth=cfg.dedup_depth)
     topo.tile(
         dedup,
-        ins=[(f"verify{i}_dedup", True) for i in range(n)],
+        ins=[(f"verify{i}_dedup", True) for i in range(n_prov)],
         outs=["dedup_sink"],
     )
     topo.tile(SinkTile(), ins=[("dedup_sink", True)])
+    if verify_elastic:
+        topo.declare_shards(
+            "verify", [f"verify{i}" for i in range(n_prov)],
+            producer="quic", producer_link="quic_verify", active=n,
+        )
     return topo, qt

@@ -79,6 +79,21 @@ class Monitor:
                 self.profiles[name] = Metrics(
                     self.wksp.view(alloc), PROFILE_SCHEMA
                 )
+        # elastic topology (disco/elastic.py): the shared gauge region
+        # + the manifest's kind table — live shard counts, epochs and
+        # reconfig history render as `elastic:` rows
+        self.elastic: Metrics | None = None
+        self.elastic_kinds: dict = {}
+        el = extra.get("elastic")
+        if el is not None:
+            self.elastic_kinds = el.get("kinds", {})
+            # the gauge schema rides the manifest (layout-authoritative
+            # like the tile schemas) — never re-derived here, so kind
+            # ordering can't drift between writer and reader
+            self.elastic = Metrics(
+                self.wksp.view(el["metrics"]),
+                MetricsSchema(counters=tuple(el.get("counters", ()))),
+            )
         # asserted SLOs: the monitor runs its OWN burn-rate engine over
         # its snapshots (same objectives + same shared hists as the
         # in-process flight recorder), so `alarms` carries SLO rows
@@ -143,6 +158,13 @@ class Monitor:
                 "produced": prod_seq,
                 "consumers": seqs,
             }
+        # elastic gauge region (disco/elastic.py): per-kind shard
+        # count / epoch / drain state + reconfig history
+        if self.elastic is not None:
+            out["_elastic"] = {
+                c: self.elastic.counter(c)
+                for c in self.elastic.schema.counters
+            }
         # profiler summaries ride the snapshot (disco/profile.py)
         if self.profiles:
             from firedancer_tpu.disco.profile import profile_row
@@ -161,7 +183,7 @@ class Monitor:
         state (circuit breaker open / restart churn), as alarm lines."""
         out = []
         for name, row in snap.items():
-            if name == "_links":
+            if name.startswith("_"):
                 continue
             c = row.get("counters", {})
             if c.get("degraded"):
@@ -225,7 +247,7 @@ class Monitor:
             f"{'in_frags':>12} {'out_frags':>12} {'bp%':>6}"
         ]
         for name, row in cur.items():
-            if name == "_links":
+            if name.startswith("_"):
                 continue
             c = row["counters"]
             if prev is not None and name in prev:
@@ -348,6 +370,34 @@ class Monitor:
                     lines.append(
                         f"{'':>10} link {lname} -> {tile}: lag {s['lag']:,}"
                     )
+        # elastic topology rows (disco/elastic.py): per-kind live shard
+        # count, shard-map epoch, drain-in-progress, last reconfig op
+        el = cur.get("_elastic")
+        if el:
+            from firedancer_tpu.disco.elastic import OP_CODES
+
+            for kind in sorted(self.elastic_kinds):
+                drain = el.get(f"{kind}_drain_pending", 0)
+                lines.append(
+                    f"{'':>10} elastic {kind}: shards="
+                    f"{el.get(f'{kind}_shards', 0)} epoch="
+                    f"{el.get(f'{kind}_epoch', 0)}"
+                    + (f" DRAINING={drain}" if drain else "")
+                )
+            code = el.get("last_op_code", 0)
+            if code:
+                names = {v: k for k, v in OP_CODES.items()}
+                import time as _t
+
+                age_s = max(
+                    _t.monotonic_ns() // 1000 - el.get("last_op_ts_us", 0),
+                    0,
+                ) / 1e6
+                lines.append(
+                    f"{'':>10} elastic last op: "
+                    f"{names.get(code, code)} ({age_s:,.1f}s ago, "
+                    f"{el.get('reconfigs', 0)} total)"
+                )
         lines.extend(self.alarms(cur))
         return "\n".join(lines)
 
@@ -372,10 +422,19 @@ class Monitor:
         refresh; consumers diff two documents)."""
         snap = self.snapshot()
         doc = {
-            "tiles": {k: v for k, v in snap.items() if k != "_links"},
+            "tiles": {
+                k: v
+                for k, v in snap.items()
+                if k not in ("_links", "_elastic")
+            },
             "links": snap.get("_links", {}),
             "alarms": self.alarms(snap),
         }
+        if "_elastic" in snap:
+            doc["elastic"] = {
+                "gauges": snap["_elastic"],
+                "kinds": self.elastic_kinds,
+            }
         if self.slo is not None:
             doc["slo"] = self.slo.to_dict()
         return doc

@@ -9,6 +9,12 @@ work over device-sized batches and the per-frag work happens in native
 code or on the TPU, never in the Python loop body.
 """
 
+from .elastic import (  # noqa: F401
+    ElasticConfig,
+    ElasticController,
+    ElasticKindConfig,
+    ShardMap,
+)
 from .faultinj import Fault, FaultInjector  # noqa: F401
 from .flight import FlightConfig, FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401

@@ -1,0 +1,766 @@
+"""fdt_elastic: SLO-driven runtime scaling and live topology
+reconfiguration with zero-loss shard handover.
+
+The reference validator's topology — like every build here before this
+module — is fixed at boot.  ROADMAP item 4 names the frontier past the
+paper: millions of users means diurnal and adversarial load swings, and
+a static 17-tile shape is wrong at both ends.  This module composes the
+machinery previous PRs built (process runtime + boot-manifest rejoin,
+crash-restart with zero-loss ring rejoin, the shared bank table, burn-
+rate SLOs) into an elasticity subsystem with three parts:
+
+  * THE SHARD MAP — a versioned shared-memory region holding, per shard
+    KIND (seq-sharded verify replicas, pack-assigned bank shards), an
+    epoch word and a per-member active mask.  Ring layout never changes
+    at runtime: the topology provisions `max_shards` members (links,
+    mcaches, fseqs, metrics) at build and membership changes by
+    flipping mask bits under a bumped epoch.  Producers and members
+    re-read the map ONLY at burst boundaries — the Python run loop
+    checks the epoch word each iteration before draining, and the
+    native stem carries the same word in its config block
+    (fdt_stem.c C_EPOCH_PTR/C_EPOCH_SEEN) and hands the burst back to
+    Python unconsumed when it moved.  The `elastic-stale-epoch` fdtmc
+    corpus mutant pins exactly the bug this discipline prevents: a
+    producer trusting a pre-flip map for post-flip frags.
+
+  * HANDOVER PROTOCOL — seq-sharded links (quic_verify) need every seq
+    owned by exactly ONE member across a flip, even though members
+    observe the flip at different times.  The link's single PRODUCER
+    resolves the race: on observing a new epoch at a burst boundary it
+    appends a FLIP ENTRY (start_seq = its next publish seq, the new
+    mask) to a small journal in the shard-map region, then publishes.
+    Because the journal store is sequenced before the mcache publish
+    (and consumers read frags through the line-seq acquire), any
+    consumer that can see a frag with seq >= start_seq can also see
+    the entry that governs it — assignment is a pure function of
+    (seq, journal), never of when a consumer happened to re-read.
+    Bank shards need no journal: assignment is explicit (pack chooses
+    the out ring), so the mask just gates the scheduler.
+
+  * DRAIN / RETIRE — retirement is drain -> handover -> reap: the
+    retiring member stops being assigned new seqs at the flip, drains
+    its in-flight window (verify lands its device pool + reorder
+    buffer; banks flush their funk commit), then publishes a DRAINED
+    marker (the epoch it drained at) in the shard-map region (mirrored
+    into its pstat words by the parent), and only then is reaped.  A
+    SIGKILL mid-drain is recovered by the retire loop itself: the dead
+    member is respawned (ring rejoin + replay, the PR 1/7 machinery)
+    until the drain completes — the same zero-loss/zero-dup bar as
+    crash chaos, asserted by tests/test_elastic.py.
+
+Inactive members' reliable fseqs are PARKED in the far seq future
+(producer head + 2^62): fdt_fctl_cr_avail treats a consumer ahead of
+the producer as fresh credit, so a provisioned-but-idle member (or a
+reaped corpse) never backpressures the producer, and activation lands
+it at the live head via the ordinary consumer_rejoin path.
+
+`ElasticController` runs in the parent next to the supervisor: it
+consumes the SLO burn-rate engine (scale-out on queue-wait / e2e p99
+burn, scale-in on sustained idle), paces operations with dwell
+hysteresis like the ingress LoadShedder, brackets every operation as a
+COMMANDED op with the supervisor (so deliberate drains never count
+toward the circuit breaker and classify as `reconfig:<op>` incident
+bundles), exposes rolling restart / config reload as first-class
+operations, and feeds admission-cap autosizing (the quic tile scales
+its ConnAdmission caps with the live verify shard count).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from firedancer_tpu.tango import rings as R
+
+# ---------------------------------------------------------------------------
+# shard-map region layout (u64 words)
+#
+# One region per topology ("shared_shardmap"), allocated by the topology
+# at build time so process-runtime children join it by name.  Writers
+# are disjoint by word (the single-writer-per-word discipline every
+# other shm control plane here follows):
+#   * the CONTROLLER (parent) owns epoch / n_members / active mask;
+#   * the kind's PRODUCER tile owns producer_ack / jlen / journal;
+#   * member i owns ack[i] and drained[i].
+
+SHARDMAP_MAGIC = 0x46445445_4C415331  # "FDTELAS1"
+MAX_KINDS = 4
+MAX_MEMBERS = 16
+JOURNAL_ENTRIES = 8
+
+_H_MAGIC, _H_NKINDS = 0, 1
+_KIND0 = 8
+_KIND_WORDS = 64
+_K_EPOCH, _K_NMEMB, _K_MASK, _K_PACK, _K_JLEN = 0, 1, 2, 3, 4
+_K_DRAINED0 = 8   # 16 words: drained epoch per member (0 = never)
+_K_ACK0 = 24      # 16 words: last epoch member i observed
+_K_J0 = 40        # 8 entries x (start_seq, mask, index tag) = 24 words
+_J_ENT_WORDS = 3
+
+SHARDMAP_FOOTPRINT = 8 * (_KIND0 + MAX_KINDS * _KIND_WORDS)
+
+#: inactive/reaped members' fseqs are parked this far AHEAD of the
+#: producer: cr_avail treats a consumer ahead as fresh credit, and
+#: consumer_rejoin's wrap-safe min lands activation at the live head
+PARK_OFFSET = 1 << 62
+
+#: bank_ready_at parking value for deactivated banks (pack's scheduler
+#: — both loops — skips a bank whose ready_at is in the far future);
+#: threshold distinguishes parking from ordinary cadence gating
+BANK_PARKED_AT = 1 << 62
+BANK_PARKED_THRESH = 1 << 61
+
+
+def _popcount(mask: int) -> int:
+    return bin(mask & ((1 << MAX_MEMBERS) - 1)).count("1")
+
+
+def active_members(mask: int) -> list[int]:
+    """Sorted member indices of an active mask — the seq-shard
+    assignment order (seq s belongs to members[s % len(members)])."""
+    return [i for i in range(MAX_MEMBERS) if mask & (1 << i)]
+
+
+class ShardMap:
+    """View of the shared shard-map region (owner or joiner)."""
+
+    def __init__(self, mem_u8: np.ndarray, join: bool = True):
+        self.words = mem_u8[: (len(mem_u8) // 8) * 8].view(np.uint64)
+        if not join and int(self.words[_H_MAGIC]) != SHARDMAP_MAGIC:
+            self.words[_H_NKINDS] = 0
+            # magic last: a joiner that sees it sees a full header
+            self.words[_H_MAGIC] = np.uint64(SHARDMAP_MAGIC)
+
+    def _k(self, slot: int) -> int:
+        assert 0 <= slot < MAX_KINDS
+        return _KIND0 + slot * _KIND_WORDS
+
+    # -- controller-owned words -------------------------------------------
+
+    def init_kind(self, slot: int, n_members: int, mask: int) -> None:
+        k = self._k(slot)
+        w = self.words
+        w[k + _K_NMEMB] = n_members
+        w[k + _K_MASK] = mask
+        w[k + _K_EPOCH] = 1
+        # journal entry 0 covers the whole seq space from boot
+        w[k + _K_J0] = 0
+        w[k + _K_J0 + 1] = mask
+        w[k + _K_J0 + 2] = 0  # index tag
+        w[k + _K_JLEN] = 1
+        self.words[_H_NKINDS] = max(int(self.words[_H_NKINDS]), slot + 1)
+
+    def flip(self, slot: int, mask: int) -> int:
+        """Set the active mask and bump the epoch (mask store first, so
+        an epoch observer always reads the new mask).  Returns the new
+        epoch."""
+        k = self._k(slot)
+        self.words[k + _K_MASK] = mask
+        ep = int(self.words[k + _K_EPOCH]) + 1
+        self.words[k + _K_EPOCH] = np.uint64(ep)
+        return ep
+
+    # -- reads -------------------------------------------------------------
+
+    def epoch_word(self, slot: int) -> np.ndarray:
+        k = self._k(slot)
+        return self.words[k + _K_EPOCH : k + _K_EPOCH + 1]
+
+    def epoch(self, slot: int) -> int:
+        return int(self.words[self._k(slot) + _K_EPOCH])
+
+    def n_members(self, slot: int) -> int:
+        return int(self.words[self._k(slot) + _K_NMEMB])
+
+    def mask(self, slot: int) -> int:
+        return int(self.words[self._k(slot) + _K_MASK])
+
+    def n_active(self, slot: int) -> int:
+        return _popcount(self.mask(slot))
+
+    def producer_ack(self, slot: int) -> int:
+        return int(self.words[self._k(slot) + _K_PACK])
+
+    def member_ack(self, slot: int, i: int) -> int:
+        return int(self.words[self._k(slot) + _K_ACK0 + i])
+
+    def drained(self, slot: int, i: int) -> int:
+        return int(self.words[self._k(slot) + _K_DRAINED0 + i])
+
+    # -- producer-owned words ---------------------------------------------
+
+    def append_flip(self, slot: int, start_seq: int, mask: int) -> None:
+        """Producer-side: record that frags from start_seq onward are
+        assigned per `mask`.  Entry body (start, mask, then its INDEX
+        TAG) first, length last — and the caller publishes frags only
+        AFTER this returns, so a consumer that can see a governed frag
+        can see its entry.  The journal is a ring of JOURNAL_ENTRIES;
+        once it wraps, a reader racing the overwrite of its oldest slot
+        detects the mismatch via the tag and retries (journal()).  The
+        controller's dwell pacing keeps live frags governed by retained
+        entries (every entry older than one ring depth of the newest is
+        dead by the reliable-consumer bound); the drain gate in
+        ElasticBinding.tick is conservative when an excluding entry may
+        have been evicted."""
+        k = self._k(slot)
+        w = self.words
+        n = int(w[k + _K_JLEN])
+        e = k + _K_J0 + _J_ENT_WORDS * (n % JOURNAL_ENTRIES)
+        w[e] = np.uint64(R.seq_u64(start_seq))
+        w[e + 1] = np.uint64(mask)
+        w[e + 2] = np.uint64(n)
+        w[k + _K_JLEN] = np.uint64(n + 1)
+
+    def set_producer_ack(self, slot: int, epoch: int) -> None:
+        self.words[self._k(slot) + _K_PACK] = np.uint64(epoch)
+
+    # -- member-owned words -----------------------------------------------
+
+    def set_member_ack(self, slot: int, i: int, epoch: int) -> None:
+        self.words[self._k(slot) + _K_ACK0 + i] = np.uint64(epoch)
+
+    def set_drained(self, slot: int, i: int, epoch: int) -> None:
+        self.words[self._k(slot) + _K_DRAINED0 + i] = np.uint64(epoch)
+
+    # -- journal reads -----------------------------------------------------
+
+    def journal(self, slot: int) -> tuple[np.ndarray, np.ndarray]:
+        """(start_seqs, masks) of the live journal entries, oldest
+        first.  Reader-safe across the ring wrap: each entry carries
+        its journal INDEX tag, so a reader racing the producer's
+        overwrite of its oldest slot sees a tag from the future,
+        re-reads jlen and retries — the producer is the single writer,
+        so the retry converges immediately."""
+        k = self._k(slot)
+        w = self.words
+        while True:
+            n = int(w[k + _K_JLEN])
+            take = min(n, JOURNAL_ENTRIES)
+            lo = n - take
+            idx = [(lo + j) % JOURNAL_ENTRIES for j in range(take)]
+            starts = np.empty(take, np.uint64)
+            masks = np.empty(take, np.uint64)
+            ok = True
+            for j, sl in enumerate(idx):
+                e = k + _K_J0 + _J_ENT_WORDS * sl
+                starts[j] = w[e]
+                masks[j] = w[e + 1]
+                if int(w[e + 2]) != lo + j:
+                    ok = False
+                    break
+            if ok and int(w[k + _K_JLEN]) == n:
+                return starts, masks
+
+    def assign_mask(
+        self, slot: int, seqs: np.ndarray, member: int
+    ) -> np.ndarray:
+        """Bool mask over a frag-seq batch: which seqs belong to
+        `member` under the journal's epoch-resolved assignment.  Wrap-
+        safe: per-entry comparisons go through signed mod-2^64
+        distances, never raw u64 order."""
+        starts, masks = self.journal(slot)
+        seqs = np.asarray(seqs, np.uint64)
+        out = np.zeros(len(seqs), bool)
+        if not len(starts):
+            return out
+        # entry index per seq = (# entries with start <= seq) - 1;
+        # entries are append-ordered so later entries shadow earlier
+        with np.errstate(over="ignore"):
+            ge = np.stack(
+                [
+                    (seqs - np.uint64(s)).astype(np.int64) >= 0
+                    for s in starts
+                ]
+            )
+        eidx = np.maximum(ge.sum(axis=0) - 1, 0)
+        for j in range(len(starts)):
+            mem = active_members(int(masks[j]))
+            if not mem:
+                continue
+            sel = eidx == j
+            if not sel.any():
+                continue
+            # fully vectorized ownership test: one modulo + one gather
+            # per entry (a batch spans 1-2 entries in practice) — no
+            # per-frag Python, the elastic analog of the static
+            # filter's single `seq % cnt == idx`
+            mem_arr = np.asarray(mem, np.int64)
+            pos = (seqs[sel] % np.uint64(len(mem))).astype(np.int64)
+            out[sel] = mem_arr[pos] == member
+        return out
+
+    def jlen(self, slot: int) -> int:
+        return int(self.words[self._k(slot) + _K_JLEN])
+
+    def member_past_flip(self, slot: int, member: int, seq: int) -> bool:
+        """Has `seq` passed the newest flip entry that EXCLUDES member?
+        (the retiring member's drain boundary; True when no such entry
+        exists — nothing to drain past)."""
+        starts, masks = self.journal(slot)
+        bound = None
+        for j in range(len(starts)):
+            if not (int(masks[j]) >> member) & 1:
+                bound = int(starts[j])
+        if bound is None:
+            return True
+        return R.seq_diff(R.seq_u64(seq), bound) >= 0
+
+
+# ---------------------------------------------------------------------------
+# per-tile binding
+
+
+@dataclass
+class ElasticBinding:
+    """Injected onto member/producer tiles by Topology.declare_shards;
+    rides the spawn pickle so process children reconstruct it.  The
+    generic role behavior (flip-journal appends, acks, drain markers)
+    lives here; tiles override Tile.on_epoch / Tile.elastic_drained to
+    add their own reconfiguration on top."""
+
+    kind: str
+    slot: int
+    role: str  # "member" | "producer"
+    index: int | None = None  # member index (members only)
+    link: str | None = None   # producer: sharded out link (None = bank
+    #                           style); member: its sharded in link
+    #: initial active count — the autosizing base (quic admission caps
+    #: scale by n_active / base_active)
+    base_active: int = 1
+
+    def __post_init__(self):
+        self._smv: ShardMap | None = None
+
+    # dataclass + pickle: drop the cached view (child re-binds)
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        st["_smv"] = None
+        return st
+
+    def bind(self, ctx) -> ShardMap:
+        if self._smv is None:
+            self._smv = ShardMap(
+                ctx.shared("shardmap", SHARDMAP_FOOTPRINT)
+            )
+        return self._smv
+
+    def epoch_word(self, ctx) -> np.ndarray:
+        return self.bind(ctx).epoch_word(self.slot)
+
+    def is_active(self, ctx) -> bool:
+        assert self.index is not None
+        return bool((self.bind(ctx).mask(self.slot) >> self.index) & 1)
+
+    def _member_link(self, ctx):
+        if self.link is None:
+            return None
+        for il in ctx.ins:
+            if il.name == self.link:
+                return il
+        return None
+
+    def on_epoch(self, tile, ctx) -> None:
+        """Burst-boundary epoch observation (generic role half)."""
+        smv = self.bind(ctx)
+        ep = smv.epoch(self.slot)
+        if self.role == "producer":
+            # the shm ACK word is the append guard: run_loop calls
+            # on_epoch at EVERY (re)boot, and a producer that re-
+            # appended per incarnation would churn the 8-entry journal
+            # ring past live flip entries under crash-restart storms —
+            # an already-acked epoch appends nothing.  Append-then-ack
+            # order bounds the failure the other way: a crash between
+            # the two re-appends ONE duplicate entry (same mask, later
+            # start) on the next boot, which assignment resolves
+            # identically.
+            if smv.producer_ack(self.slot) < ep:
+                if self.link is not None:
+                    # flip entry BEFORE any frag it governs publishes:
+                    # the next publish seq is the entry's start
+                    try:
+                        out = ctx.out(self.link)
+                    except KeyError:
+                        out = None
+                    if out is not None:
+                        smv.append_flip(
+                            self.slot, out.seq, smv.mask(self.slot)
+                        )
+                smv.set_producer_ack(self.slot, ep)
+        else:
+            smv.set_member_ack(self.slot, self.index, ep)
+
+    def assign(self, ctx, seqs: np.ndarray) -> np.ndarray:
+        """Member-side frag filter for a drained batch."""
+        return self.bind(ctx).assign_mask(self.slot, seqs, self.index)
+
+    def tick(self, tile, ctx) -> None:
+        """Housekeeping-cadence member bookkeeping: refresh the ack and
+        evaluate the drain contract when retired.  Drained requires,
+        in order: (1) this member observed the retiring epoch, (2) the
+        producer acked it (no more frags will be assigned here), (3)
+        the in cursor passed the flip boundary (journal kinds) or
+        caught the quiet ring head (bank kinds), (4) the tile's own
+        in-flight window is empty (tile.elastic_drained)."""
+        if self.role != "member":
+            return
+        smv = self.bind(ctx)
+        ep = smv.epoch(self.slot)
+        smv.set_member_ack(self.slot, self.index, ep)
+        if self.is_active(ctx):
+            return
+        if smv.drained(self.slot, self.index) >= ep:
+            return
+        if smv.producer_ack(self.slot) < ep:
+            return
+        il = self._member_link(ctx)
+        if il is not None:
+            starts, masks = smv.journal(self.slot)
+            bound = None
+            for j in range(len(starts)):
+                if not (int(masks[j]) >> self.index) & 1:
+                    bound = int(starts[j])
+            caught_up = R.seq_diff(
+                R.seq_u64(il.seq), il.mcache.seq_query()
+            ) >= 0
+            if bound is not None:
+                # journal kind: drain past the excluding flip boundary
+                if R.seq_diff(R.seq_u64(il.seq), bound) < 0:
+                    return
+            elif len(starts) > 1 and (
+                smv.jlen(self.slot) <= JOURNAL_ENTRIES
+            ):
+                # journal kind, but no entry excludes us and nothing
+                # was evicted: the producer has not yet appended the
+                # retiring flip — too early to judge
+                return
+            elif not caught_up:
+                # bank-style kind (no flips recorded) or the excluding
+                # entry may have been EVICTED by ring wrap: be
+                # conservative and require the quiet ring head
+                return
+        if not tile.elastic_drained(ctx):
+            return
+        smv.set_drained(self.slot, self.index, ep)
+
+
+# ---------------------------------------------------------------------------
+# config + controller
+
+
+@dataclass(frozen=True)
+class ElasticKindConfig:
+    """Per-kind controller policy (the `[elastic.<kind>]` config)."""
+
+    min_shards: int = 1
+    max_shards: int = 1
+    #: scale OUT when the watched SLOs' fast burn reaches this and holds
+    #: for a dwell (1.0 = budget-exhausting rate)
+    scale_out_burn: float = 1.0
+    #: scale IN when the per-active-shard landed rate stays under this
+    #: for idle_for_s
+    scale_in_idle_tps: float = 1.0
+    idle_for_s: float = 3.0
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """The `[elastic]` config section (app/config.py)."""
+
+    kinds: dict = field(default_factory=dict)  # kind -> ElasticKindConfig
+    #: minimum time between reconfig operations (dwell pacing, the
+    #: LoadShedder discipline: a transient burst costs one op, and the
+    #: flip-journal ring can never outrun live frags)
+    dwell_s: float = 2.0
+    poll_s: float = 0.05
+    #: SLO names whose fast burn drives scale-out
+    watch_slos: tuple = ("queue_wait_p99_us", "e2e_p99_us")
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ElasticConfig":
+        kinds = {}
+        top = {
+            k: v
+            for k, v in doc.items()
+            if k in ("dwell_s", "poll_s")
+        }
+        if "watch_slos" in doc:
+            top["watch_slos"] = tuple(doc["watch_slos"])
+        for k, v in doc.items():
+            if isinstance(v, dict):
+                import dataclasses as _dc
+
+                known = {f.name for f in _dc.fields(ElasticKindConfig)}
+                kinds[k] = ElasticKindConfig(
+                    **{kk: vv for kk, vv in v.items() if kk in known}
+                )
+        return cls(kinds=kinds, **top)
+
+
+#: elastic gauge-region op codes (last_op_code gauge)
+OP_CODES = {
+    "scale-out": 1,
+    "scale-in": 2,
+    "rolling-restart": 3,
+    "config-reload": 4,
+}
+
+
+def elastic_metrics_schema(kinds: list[str]):
+    """Schema for the shared `elastic` gauge region (fdt_elastic_* via
+    the metric tile): per-kind shard count / epoch / drain-pending,
+    plus the op history gauges the monitor renders."""
+    from .metrics import MetricsSchema
+
+    counters: list[str] = []
+    for kind in kinds:
+        counters += [
+            f"{kind}_shards",
+            f"{kind}_epoch",
+            f"{kind}_drain_pending",
+        ]
+    counters += ["reconfigs", "last_op_code", "last_op_ts_us"]
+    return MetricsSchema(counters=tuple(counters))
+
+
+class ElasticController:
+    """SLO-driven scaling policy over a Topology's shard groups.
+
+    Deliberate-operation plumbing: every op runs inside the
+    supervisor's COMMANDED bracket (the watchdog stands back; a crash
+    mid-op is the op's to repair) and emits a `reconfig` event through
+    the supervisor listeners (so the flight recorder freezes a bundle
+    fdtincident classifies as `reconfig:<op>`), or directly through an
+    attached FlightRecorder when unsupervised.
+
+    Policy: scale-out fires when any watched SLO's fast burn holds at
+    or above scale_out_burn; scale-in fires when the per-active-shard
+    landed rate stays under scale_in_idle_tps for idle_for_s.  Both are
+    dwell-paced (one op per dwell_s) with the same hysteresis shape as
+    the ingress LoadShedder.
+    """
+
+    def __init__(
+        self,
+        topo,
+        cfg: ElasticConfig,
+        sup=None,
+        slo=None,
+        flight=None,
+        clock=time.monotonic,
+    ):
+        self.topo = topo
+        self.cfg = cfg
+        self.sup = sup
+        self.slo = slo
+        self.flight = flight
+        self.clock = clock
+        self.ops: list[dict] = []  # history, newest last
+        self._last_op_t = 0.0
+        self._idle_since: dict[str, float] = {}
+        self._rate_base: dict[str, tuple[float, int]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="elastic", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — scaling must not kill the host
+                from firedancer_tpu.utils import log
+                import traceback
+
+                log.err(
+                    "elastic controller error:\n%s", traceback.format_exc()
+                )
+
+    # -- policy -----------------------------------------------------------
+
+    def _burn(self) -> float:
+        """Max fast burn across the watched SLOs' last evaluation."""
+        if self.slo is None:
+            return 0.0
+        return max(
+            (
+                s.burn_fast
+                for s in self.slo._last
+                if s.name in self.cfg.watch_slos
+            ),
+            default=0.0,
+        )
+
+    def _member_rate(self, kind: str, now: float) -> float | None:
+        """Per-active-shard landed (in_frags) rate since the last tick."""
+        grp = self.topo._shard_groups.get(kind)
+        if grp is None:
+            return None
+        total = 0
+        n_act = 0
+        smv = self.topo.shardmap()
+        mask = smv.mask(grp["slot"])
+        for i, name in enumerate(grp["members"]):
+            if not (mask >> i) & 1:
+                continue
+            n_act += 1
+            total += self.topo.metrics(name).counter("in_frags")
+        base = self._rate_base.get(kind)
+        self._rate_base[kind] = (now, total)
+        if base is None or now <= base[0]:
+            return None
+        return (total - base[1]) / (now - base[0]) / max(n_act, 1)
+
+    def tick(self) -> None:
+        """One controller pass (exposed for deterministic tests)."""
+        now = self.clock()
+        if self.slo is not None:
+            from .flight import snapshot_topology
+
+            self.slo.observe(snapshot_topology(self.topo), now=now)
+            self.slo.evaluate(now=now)
+        burn = self._burn()
+        smv = self.topo.shardmap()
+        for kind, kcfg in self.cfg.kinds.items():
+            grp = self.topo._shard_groups.get(kind)
+            if grp is None:
+                continue
+            n_act = smv.n_active(grp["slot"])
+            rate = self._member_rate(kind, now)
+            if burn >= kcfg.scale_out_burn and n_act < kcfg.max_shards:
+                self._idle_since.pop(kind, None)
+                if now - self._last_op_t >= self.cfg.dwell_s:
+                    self.scale_out(kind)
+                continue
+            if rate is not None and rate < kcfg.scale_in_idle_tps:
+                t0 = self._idle_since.setdefault(kind, now)
+                if (
+                    n_act > kcfg.min_shards
+                    and now - t0 >= kcfg.idle_for_s
+                    and now - self._last_op_t >= self.cfg.dwell_s
+                ):
+                    # retire the highest active member (LIFO, so the
+                    # boot members are the stable core)
+                    mask = smv.mask(grp["slot"])
+                    i = max(active_members(mask))
+                    self.scale_in(kind, i)
+            else:
+                self._idle_since.pop(kind, None)
+        self.export_gauges()
+
+    # -- deliberate operations --------------------------------------------
+
+    def _commanded(self, name: str, op: str):
+        if self.sup is not None:
+            return self.sup.command(name, op)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _note(self, op: str, tile: str | None, detail: dict) -> None:
+        rec = {"op": op, "tile": tile, "t": self.clock(), **detail}
+        self.ops.append(rec)
+        self._last_op_t = self.clock()
+        m = self.topo._metrics.get("elastic")
+        if m is not None:
+            m.inc("reconfigs")
+            m.set("last_op_code", OP_CODES.get(op.split(":")[0], 0))
+            m.set("last_op_ts_us", time.monotonic_ns() // 1000)
+        if self.sup is not None:
+            self.sup.note_commanded(tile, op, detail)
+        elif self.flight is not None:
+            self.flight.trigger(
+                "reconfig", tile, {"op": op, **detail}
+            )
+
+    def scale_out(self, kind: str) -> int:
+        grp = self.topo._shard_groups[kind]
+        smv = self.topo.shardmap()
+        mask = smv.mask(grp["slot"])
+        # same dual check as add_shard's own selection (mask bit clear
+        # AND tile inactive), so a half-retired member is never picked
+        # and an at-capacity kind raises descriptively, not IndexError
+        free = [
+            i
+            for i in range(len(grp["members"]))
+            if not (mask >> i) & 1
+            and not self.topo.tiles[grp["members"][i]].active
+        ]
+        if not free:
+            raise RuntimeError(
+                f"shard kind {kind!r}: no free member to scale out"
+            )
+        i = free[0]
+        name = grp["members"][i]
+        with self._commanded(name, f"scale-out:{kind}"):
+            if self.sup is not None:
+                self.sup.note_spawn(name)
+            self.topo.add_shard(kind, i)
+        self._note(
+            f"scale-out:{kind}", name,
+            {"member": i, "shards": smv.n_active(grp["slot"])},
+        )
+        return i
+
+    def scale_in(self, kind: str, i: int | None = None) -> int:
+        grp = self.topo._shard_groups[kind]
+        smv = self.topo.shardmap()
+        if i is None:
+            i = max(active_members(smv.mask(grp["slot"])))
+        name = grp["members"][i]
+        with self._commanded(name, f"scale-in:{kind}"):
+            self.topo.retire_shard(kind, i)
+        self._note(
+            f"scale-in:{kind}", name,
+            {"member": i, "shards": smv.n_active(grp["slot"])},
+        )
+        return i
+
+    def rolling_restart(self, name: str, mutate=None, replay: int = 0) -> None:
+        """Restart one tile under traffic (drain -> respawn -> rejoin);
+        `mutate(tile)` applies a config change to the respawned
+        incarnation (config reload / code hot-swap both ride it)."""
+        op = "config-reload" if mutate is not None else "rolling-restart"
+        with self._commanded(name, op):
+            if self.sup is not None:
+                self.sup.note_spawn(name)
+            self.topo.rolling_restart(name, mutate=mutate, replay=replay)
+        self._note(op, name, {})
+
+    # -- gauges -----------------------------------------------------------
+
+    def export_gauges(self) -> None:
+        m = self.topo._metrics.get("elastic")
+        if m is None:
+            return
+        smv = self.topo.shardmap()
+        known = set(m.schema.counters)
+        for kind, grp in self.topo._shard_groups.items():
+            slot = grp["slot"]
+            vals = {
+                f"{kind}_shards": smv.n_active(slot),
+                f"{kind}_epoch": smv.epoch(slot),
+                f"{kind}_drain_pending": sum(
+                    1
+                    for i in range(len(grp["members"]))
+                    if not (smv.mask(slot) >> i) & 1
+                    and self.topo.tiles[grp["members"][i]].active
+                ),
+            }
+            for k, v in vals.items():
+                if k in known:
+                    m.set(k, v)

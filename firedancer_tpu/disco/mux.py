@@ -364,6 +364,46 @@ class Tile:
     #: internal queues via in_budget.
     manual_credits = False
 
+    #: elastic topology (disco/elastic.py): an ElasticBinding injected
+    #: by Topology.declare_shards onto shard members and producers (it
+    #: rides the spawn pickle).  None = not elastic; every hook below
+    #: stays a single attribute check.
+    elastic = None
+
+    def epoch_word(self, ctx: MuxCtx):
+        """The shard-map epoch word this tile watches (u64[1] shm view)
+        or None.  The run loop re-reads it at every burst boundary and
+        calls on_epoch when it moved — the ONLY sanctioned point for a
+        tile to act on a membership flip (the burst-boundary re-read
+        discipline the elastic-stale-epoch fdtmc mutant pins)."""
+        eb = self.elastic
+        return None if eb is None else eb.epoch_word(ctx)
+
+    def on_epoch(self, ctx: MuxCtx) -> None:
+        """A shard-map epoch flip was observed at a burst boundary.
+        The base behavior is the binding's role half (producers append
+        the flip-journal entry + ack; members ack); tiles override AND
+        call super() to layer their own reconfiguration (pack parks
+        retired banks' cadence words, quic autosizes admission caps)."""
+        eb = self.elastic
+        if eb is not None:
+            eb.on_epoch(self, ctx)
+
+    def shard_tick(self, ctx: MuxCtx) -> None:
+        """Housekeeping-cadence elastic bookkeeping (ack refresh + the
+        retirement drain contract — see ElasticBinding.tick)."""
+        eb = self.elastic
+        if eb is not None:
+            eb.tick(self, ctx)
+
+    def elastic_drained(self, ctx: MuxCtx) -> bool:
+        """Member-side drain predicate: True when this tile holds no
+        in-flight work beyond its ring cursors (those are checked by
+        the binding).  Tiles with internal pipelines override: verify
+        waits for its device pool + reorder buffer to land, banks flush
+        their funk commit first."""
+        return True
+
     def after_credit(self, ctx: MuxCtx) -> None:
         """Called every iteration after frag processing while credits
         remain — where producer tiles generate work (reference:
@@ -527,6 +567,14 @@ def run_loop(
         cnc.signal(R.CNC_FAIL)
         raise
     ctx.booted = True
+    # elastic shard map (disco/elastic.py): bind the watched epoch word
+    # and apply the CURRENT membership before any frag flows — the loop
+    # re-reads the word at every burst boundary below
+    ep_word = tile.epoch_word(ctx)
+    ep_seen = -1
+    if ep_word is not None:
+        ep_seen = int(ep_word[0])
+        tile.on_epoch(ctx)
     # native stem (ISSUE 10): the tile may register a native frag
     # handler; the loop then drains/handles/publishes whole bursts in
     # one GIL-released call, falling back to the Python path per
@@ -557,6 +605,12 @@ def run_loop(
                 stem_obj = None
                 stem_spec = None
     ctx.stem = stem_obj
+    if stem_obj is not None and ep_word is not None:
+        # the stem carries the same epoch word in its config block and
+        # hands a burst back UNCONSUMED when it moved, so the native
+        # loop keeps the burst-boundary re-read discipline even though
+        # Python only regains control between bursts
+        stem_obj.watch_epoch(ep_word, ep_seen)
     cnc.signal(R.CNC_RUN)
     if lazy_ns is None:
         depths = [il.mcache.depth for il in ctx.ins] + [
@@ -576,6 +630,16 @@ def run_loop(
                 faults.tick(ctx)
             if ctx.interrupt.is_set():
                 raise TileInterrupted(f"{ctx.name}: abandoned by supervisor")
+            # burst-boundary shard-map re-read: one shm load per
+            # iteration; a moved epoch reconfigures the tile BEFORE any
+            # frag of the new membership window is drained
+            if ep_word is not None:
+                _e = int(ep_word[0])
+                if _e != ep_seen:
+                    ep_seen = _e
+                    tile.on_epoch(ctx)
+                    if stem_obj is not None:
+                        stem_obj.set_epoch_seen(_e)
             now = time.monotonic_ns()
             # phase durations are histogram-sampled every 16th iteration
             # (the reference histograms every phase, fd_mux.c:435-444; a
@@ -601,6 +665,8 @@ def run_loop(
                 m.inc("housekeep_iters")
                 if cnc.signal_query() == R.CNC_HALT:
                     break
+                if ep_word is not None:
+                    tile.shard_tick(ctx)
                 tile.during_housekeeping(ctx)
                 if prof is not None:
                     if hk_lag_ns:
@@ -681,7 +747,7 @@ def run_loop(
                 # fseq/credit updates all native; Python resumes here
                 # at the burst boundary with the accumulated deltas
                 ts_b0 = now_ts()
-                s_got, s_stat, _s_in = stem_obj.run(cr, ts_b0)
+                s_got, s_stat, s_in = stem_obj.run(cr, ts_b0)
                 got += _stem_apply(
                     ctx, m, stem_obj, stem_spec, tracer, faults,
                     out_seq0, ts_b0,
@@ -692,9 +758,15 @@ def run_loop(
                 # python-only in-link has traffic) — fall through to the
                 # Python drain with the remaining credit budget.  Any
                 # other status (IDLE/BUDGET/BP) already consumed
-                # everything this iteration may.
-                run_py = s_stat == R.STEM_PYTHON
-                if stem_spec.ac_handler:
+                # everything this iteration may.  An EPOCH handback
+                # (the shard map moved under the stem) skips the Python
+                # drain outright: the next iteration's top-of-loop
+                # check reconfigures the tile FIRST, so no frag is ever
+                # handled under a stale membership view.
+                run_py = (
+                    s_stat == R.STEM_PYTHON and s_in != R.STEM_IN_EPOCH
+                )
+                if stem_spec.ac_handler or s_in == R.STEM_IN_EPOCH:
                     run_ac = run_py
             # rotate the drain order so a saturated in-link cannot starve
             # the others of the shared credit budget (e.g. pack's txn

@@ -85,6 +85,12 @@ class SloConfig:
 
     e2e_p99_us: float | None = None
     verify_hop_p99_us: float | None = None
+    #: queue-wait tail ceiling across every hop (qwait_us_* hists,
+    #: merged over all tiles): time frags sit in rings behind a busy
+    #: consumer — the CAPACITY signal, and what the elastic controller
+    #: (disco/elastic.py) watches for scale-out (a saturated shard
+    #: shows up as queue-wait long before e2e breaches)
+    queue_wait_p99_us: float | None = None
     landed_tps_min: float | None = None
     drop_rate_max: float | None = None
     #: error budget for the latency SLOs: tolerated fraction of samples
@@ -103,7 +109,9 @@ class SloConfig:
         bound comes from the hist width the objective is evaluated
         over: the per-link latency hists are 16-bucket, so their
         ceilings must sit under hist_domain_end_us()."""
-        for name in ("e2e_p99_us", "verify_hop_p99_us"):
+        for name in (
+            "e2e_p99_us", "verify_hop_p99_us", "queue_wait_p99_us"
+        ):
             v = getattr(self, name)
             if v is not None and v >= hist_domain_end_us():
                 raise ValueError(
@@ -120,6 +128,7 @@ class SloConfig:
             for k in (
                 "e2e_p99_us",
                 "verify_hop_p99_us",
+                "queue_wait_p99_us",
                 "landed_tps_min",
                 "drop_rate_max",
             )
@@ -167,6 +176,7 @@ class _Digest:
     ts: float
     e2e: dict = field(default_factory=dict)
     verify_hop: dict = field(default_factory=dict)
+    qwait: dict = field(default_factory=dict)
     landed_frags: int = 0
     dropped_frags: int = 0
 
@@ -216,7 +226,7 @@ class SloEngine:
         now = self.clock() if now is None else now
         d = _Digest(ts=now)
         exits = set(self._exit_tiles(snap))
-        e2e, vhop = [], []
+        e2e, vhop, qwait = [], [], []
         for name, row in snap.items():
             if name == "_links":
                 continue
@@ -231,11 +241,17 @@ class SloEngine:
                 vhop.extend(
                     h for k, h in hists.items() if k.startswith("svc_us_")
                 )
+            # queue-wait merges EVERY hop: the signal is "frags waiting
+            # behind a busy consumer", wherever the bottleneck sits
+            qwait.extend(
+                h for k, h in hists.items() if k.startswith("qwait_us_")
+            )
             d.dropped_frags += sum(
                 c.get(k, 0) for k in DEFAULT_DROP_COUNTERS
             )
         d.e2e = merge_hists(e2e)
         d.verify_hop = merge_hists(vhop)
+        d.qwait = merge_hists(qwait)
         self._digests.append(d)
         horizon = now - 2.0 * self.cfg.slow_window_s - 1.0
         while len(self._digests) > 2 and self._digests[1].ts <= horizon:
@@ -296,6 +312,7 @@ class SloEngine:
         for name, which in (
             ("e2e_p99_us", "e2e"),
             ("verify_hop_p99_us", "verify_hop"),
+            ("queue_wait_p99_us", "qwait"),
         ):
             ceiling = getattr(cfg, name)
             if ceiling is None:
@@ -387,7 +404,12 @@ class SloEngine:
         burns the throughput floor, commands a shed, which lowers
         landed TPS further and latches the shedder at max forever.
         Shedding is judged right only if it protects the latency tail,
-        so only the latency tail may command it."""
+        so only the latency tail may command it.  queue_wait_p99_us is
+        also excluded: a burning queue-wait means the topology is
+        UNDERSIZED, and the right actuator is the elastic controller
+        (scale-out, disco/elastic.py) — shedding paying traffic to
+        mask a capacity shortfall would hide exactly the signal
+        scaling needs."""
         lvl = 0
         for s in self._last:
             if s.name not in ("e2e_p99_us", "verify_hop_p99_us"):

@@ -136,6 +136,15 @@ class Supervisor:
         #: works on a wedged child, unlike a wedged thread) and ring
         #: rejoin happens in the respawned child at boot
         self._process = False
+        #: deliberate reconfiguration in progress (disco/elastic.py):
+        #: tile name -> operation label.  While a tile is COMMANDED the
+        #: watchdog stands back entirely — the operation owns its
+        #: lifecycle (including crash-mid-drain recovery), so a
+        #: deliberate drain/halt/respawn never counts toward the
+        #: circuit breaker, never escalates backoff, and never races a
+        #: watchdog respawn.  Events emitted for commanded work carry
+        #: kind "reconfig" (flight bundles classify as reconfig:<op>).
+        self._commanded: dict[str, str] = {}
         self._halting = False
         self._watchdog: threading.Thread | None = None
         self._stop = threading.Event()
@@ -143,6 +152,42 @@ class Supervisor:
     def add_listener(self, cb) -> None:
         """Register a failure observer: cb(tile, kind, detail)."""
         self._listeners.append(cb)
+
+    # ---- commanded reconfiguration (disco/elastic.py) -------------------
+
+    def command(self, name: str, op: str):
+        """Context manager bracketing a DELIBERATE operation on `name`
+        (elastic scale-out/in, rolling restart, config reload): the
+        watchdog ignores the tile for the duration, so the halt/reap/
+        respawn sequence the operation performs is never misread as a
+        crash — no breaker count, no backoff escalation, no racing
+        respawn.  The operation reports itself via note_commanded."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _bracket():
+            self._commanded[name] = op
+            try:
+                yield
+            finally:
+                self._commanded.pop(name, None)
+
+        return _bracket()
+
+    def note_spawn(self, name: str) -> None:
+        """A commanded operation is about to (re)spawn `name`: refresh
+        the watchdog's boot clock so the tile is not instantly declared
+        boot-timed-out when the command bracket releases."""
+        st = self._state.get(name)
+        if st is not None:
+            st.boot_mono_ns = time.monotonic_ns()
+            st.respawn_at = 0.0
+
+    def note_commanded(self, name: str | None, op: str, detail: dict) -> None:
+        """Emit a deliberate-reconfiguration event to the listeners
+        (the flight recorder freezes a bundle fdtincident classifies
+        as `reconfig:<op>` — distinct from crash incidents)."""
+        self._emit(name or "", "reconfig", {"op": op, **detail})
 
     def _emit(self, tile: str, kind: str, detail: dict) -> None:
         for cb in self._listeners:
@@ -183,12 +228,15 @@ class Supervisor:
             # via the directory, and re-publishing per spawn would
             # truncate-rewrite the file under a concurrent attach
             topo.export_manifest()
-        for name in topo.tiles:
-            self._spawn(name)
+        for name, ts in topo.tiles.items():
+            if ts.active:
+                self._spawn(name)
         # boot-wait: every tile leaves BOOT (RUN, or FAIL -> the watchdog
         # will treat the boot crash like any other failure)
         deadline = time.monotonic() + boot_timeout_s
         for name, ts in topo.tiles.items():
+            if not ts.active:
+                continue
             while topo._cncs[name].signal_query() == R.CNC_BOOT:
                 p = ts.proc
                 if p is not None and not p.is_alive():
@@ -240,6 +288,11 @@ class Supervisor:
             for name, ts in self.topo.tiles.items():
                 st = self._state[name]
                 if st.degraded is not None or self._halting:
+                    continue
+                # elastic: inactive (provisioned/retired) members are
+                # not running by design; commanded tiles are mid-
+                # deliberate-op and the operation owns their lifecycle
+                if not ts.active or name in self._commanded:
                     continue
                 if st.respawn_at:  # waiting out the backoff
                     if now >= st.respawn_at:

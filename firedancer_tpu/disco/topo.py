@@ -56,6 +56,12 @@ from .trace import SpanRing, TraceConfig, Tracer
 #: construction error vs a post-RUN crash without any Python-object
 #: channel).
 PSTAT_INCARNATION, PSTAT_PID, PSTAT_BOOTED = 0, 1, 2
+#: elastic retirement (disco/elastic.py): the epoch a retired member
+#: completed its drain at, mirrored here by the PARENT after it
+#: observes the member's canonical drained marker in the shard-map
+#: region (the region is the cross-runtime home; pstat exists only
+#: under the process runtime)
+PSTAT_DRAINED = 3
 _PSTAT_BYTES = 64
 #: per-tile faultinj cumulative-trigger state (TileFaults.bind_shm):
 #: 2 counter words + up to 62 per-fault fired flags
@@ -128,6 +134,12 @@ class TileSpec:
     #: handle).  None for thread tiles and proc_safe=False observers.
     proc: object | None = None
     error: BaseException | None = None
+    #: elastic topology (disco/elastic.py): False = a PROVISIONED but
+    #: inactive shard member — its rings/metrics/cnc exist (layout is
+    #: fixed at build), but it is not spawned, supervised, or halted
+    #: until Topology.add_shard activates it.  Its reliable in-fseqs
+    #: are parked in the far seq future so producers never gate on it.
+    active: bool = True
 
 
 class Topology:
@@ -188,6 +200,11 @@ class Topology:
         #: allocated (metrics_registry()["slo"]) and the config rides
         #: the manifest so attached monitors evaluate the same SLOs.
         self.slo = None
+        #: elastic shard groups (disco/elastic.py): kind -> {"slot",
+        #: "members" (tile names, member-index order), "producer",
+        #: "base_active"}.  Declared via declare_shards() before build().
+        self._shard_groups: dict[str, dict] = {}
+        self._shardmap = None  # elastic.ShardMap, bound at build
         self._mcaches: dict[str, R.MCache] = {}
         self._dcaches: dict[str, R.DCache] = {}
         self._fseqs: dict[tuple[str, str], R.FSeq] = {}
@@ -249,6 +266,75 @@ class Topology:
             assert spec.producer is None, f"link {ln!r} has two producers"
             spec.producer = name
         self.tiles[name] = TileSpec(tile, ins, outs)
+
+    def declare_shards(
+        self,
+        kind: str,
+        members: list[str],
+        *,
+        producer: str | None = None,
+        producer_link: str | None = None,
+        member_links: list[str] | None = None,
+        active: int | None = None,
+    ) -> None:
+        """Declare an elastic shard group (disco/elastic.py): `members`
+        are already-declared tiles, in member-index order; the first
+        `active` (default: all) start live, the rest are PROVISIONED
+        (rings and metrics built, fseqs parked) but not spawned until
+        add_shard().
+
+        producer/producer_link: the seq-sharded link's single producer
+        tile and the link it writes — it appends flip-journal entries
+        at every epoch it observes, which is what makes assignment a
+        pure function of (seq, journal) across a membership flip.
+        Omit both for producer-ASSIGNED kinds (bank shards: pack picks
+        the out ring, so the mask alone gates the scheduler — pass
+        `producer` without a link so it still acks epochs).
+
+        member_links: each member's sharded in-link (default: the
+        producer_link for every member — the quic_verify shape)."""
+        from .elastic import MAX_KINDS, MAX_MEMBERS, ElasticBinding
+
+        assert self.wksp is None, "declare_shards before build()"
+        assert kind not in self._shard_groups, f"duplicate kind {kind!r}"
+        assert len(members) <= MAX_MEMBERS
+        slot = len(self._shard_groups)
+        assert slot < MAX_KINDS
+        n_active = len(members) if active is None else int(active)
+        assert 1 <= n_active <= len(members)
+        if member_links is None:
+            member_links = [producer_link] * len(members)
+        for i, name in enumerate(members):
+            ts = self.tiles[name]
+            assert getattr(ts.tile, "elastic", None) is None, (
+                f"tile {name!r} already bound to a shard kind"
+            )
+            ts.tile.elastic = ElasticBinding(
+                kind, slot, "member", index=i, link=member_links[i],
+                base_active=n_active,
+            )
+            if i >= n_active:
+                ts.active = False
+        if producer is not None:
+            pt = self.tiles[producer].tile
+            assert getattr(pt, "elastic", None) is None, (
+                f"tile {producer!r} already bound to a shard kind"
+            )
+            pt.elastic = ElasticBinding(
+                kind, slot, "producer", link=producer_link,
+                base_active=n_active,
+            )
+        self._shard_groups[kind] = {
+            "slot": slot,
+            "members": list(members),
+            "producer": producer,
+            "base_active": n_active,
+        }
+
+    def shardmap(self):
+        """The built topology's elastic.ShardMap view (parent side)."""
+        assert self._shardmap is not None, "no shard groups declared"
+        return self._shardmap
 
     # ---- build ----------------------------------------------------------
 
@@ -347,6 +433,13 @@ class Topology:
             from .slo import slo_metrics_schema
 
             total += Metrics.footprint(slo_metrics_schema(self.slo)) + 256
+        if self._shard_groups:
+            from .elastic import SHARDMAP_FOOTPRINT, elastic_metrics_schema
+
+            total += SHARDMAP_FOOTPRINT + 256
+            total += Metrics.footprint(
+                elastic_metrics_schema(list(self._shard_groups))
+            ) + 256
         if self._runtime == "process":
             # process-runtime control plane + child-side allocation
             # arenas (ctx.alloc cannot bump an attached workspace).
@@ -400,6 +493,41 @@ class Topology:
         # workspace resolves, never allocates)
         for nm, fp in sorted(self._shared_regions().items()):
             self.wksp.alloc(f"shared_{nm}", fp)
+        if self._shard_groups:
+            # elastic shard map + gauge region: allocated before any
+            # tile boots (children join both by name), initialized
+            # before the first spawn so every epoch observer sees a
+            # complete header
+            from .elastic import (
+                SHARDMAP_FOOTPRINT, ShardMap, elastic_metrics_schema,
+            )
+
+            self._shardmap = ShardMap(
+                self.wksp.alloc("shared_shardmap", SHARDMAP_FOOTPRINT),
+                join=False,
+            )
+            for kind, grp in self._shard_groups.items():
+                mask = (1 << grp["base_active"]) - 1
+                self._shardmap.init_kind(
+                    grp["slot"], len(grp["members"]), mask
+                )
+            eschema = elastic_metrics_schema(list(self._shard_groups))
+            emem = self.wksp.alloc(
+                "metrics_elastic", Metrics.footprint(eschema)
+            )
+            # a pseudo-tile region like "slo": the metric tile renders
+            # it as fdt_elastic_* gauges; parent-side reconfig code
+            # (topology ops + ElasticController) is the single writer
+            self._metrics["elastic"] = Metrics(emem, eschema)
+            # park every inactive member's reliable in-fseqs in the far
+            # seq future: cr_avail reads a consumer AHEAD of the
+            # producer as fresh credit, so a provisioned-but-idle
+            # member never backpressures the link, and activation lands
+            # at the live head via consumer_rejoin's wrap-safe min
+            for grp in self._shard_groups.values():
+                for i, name in enumerate(grp["members"]):
+                    if not self.tiles[name].active:
+                        self._park_member_fseqs(name)
         # link ids: declaration-order small ints, shared with the span
         # events (u8 field) and the manifest's id -> name table
         link_ids = {ln: i for i, ln in enumerate(self.links)}
@@ -562,6 +690,37 @@ class Topology:
                 "config": self.slo.to_dict(),
                 "metrics": "metrics_slo",
             }
+        if self._shard_groups and self._shardmap is not None:
+            # elastic attach surface: kinds, live membership, and the
+            # gauge-region schema — REWRITTEN (atomic rename, see
+            # publish_directory) on every add/retire so a child booting
+            # mid-reconfig or an attached monitor never reads a torn
+            # or stale membership table
+            m = self._metrics.get("elastic")
+            extra["elastic"] = {
+                "metrics": "metrics_elastic",
+                "counters": (
+                    list(m.schema.counters) if m is not None else []
+                ),
+                "kinds": {
+                    kind: {
+                        "slot": grp["slot"],
+                        "members": grp["members"],
+                        "producer": grp["producer"],
+                        "base_active": grp["base_active"],
+                        "epoch": self._shardmap.epoch(grp["slot"]),
+                        "active_mask": self._shardmap.mask(grp["slot"]),
+                        "active": [
+                            n
+                            for j, n in enumerate(grp["members"])
+                            if self.tiles[n].active
+                            and (self._shardmap.mask(grp["slot"]) >> j)
+                            & 1
+                        ],
+                    }
+                    for kind, grp in self._shard_groups.items()
+                },
+            }
         if self._runtime == "process":
             extra["boot"] = self._boot_manifest()
         self.wksp.publish_directory(extra)
@@ -666,11 +825,14 @@ class Topology:
         if runtime == "process":
             self._start_process(boot_timeout_s)
             return
-        for name in self.tiles:
-            self._spawn_tile(name)
+        for name, ts in self.tiles.items():
+            if ts.active:
+                self._spawn_tile(name)
         # wait for every tile to reach RUN (or fail during boot)
         deadline = time.monotonic() + boot_timeout_s
         for name, ts in self.tiles.items():
+            if not ts.active:
+                continue
             while self._cncs[name].signal_query() == R.CNC_BOOT:
                 if ts.error is not None:
                     self.halt()
@@ -708,10 +870,13 @@ class Topology:
         # land in per-tile shm arenas, so no re-publish is needed for
         # monitors — the arena name tables live in shared memory)
         self.export_manifest()
-        for name in self.tiles:
-            self._spawn_tile(name)
+        for name, ts in self.tiles.items():
+            if ts.active:
+                self._spawn_tile(name)
         deadline = time.monotonic() + boot_timeout_s
         for name, ts in self.tiles.items():
+            if not ts.active:
+                continue
             cnc = self._cncs[name]
             while cnc.signal_query() == R.CNC_BOOT:
                 if ts.error is not None:  # proc_safe=False thread tile
@@ -773,12 +938,17 @@ class Topology:
         pid = int(self._pstat(name)[PSTAT_PID])
         return pid or ts.proc.pid
 
-    def _spawn_tile(self, name: str, replay: int = 0) -> None:
+    def _spawn_tile(
+        self, name: str, replay: int = 0, rejoin: bool | None = None
+    ) -> None:
         """Spawn one tile in the resolved runtime (process children, or
         threads for proc_safe=False observers).  Shared by start() and
         the supervisor's restart path; `replay` is the reliable-link
         rejoin rewind the CHILD applies (tango.rings.consumer_rejoin)
-        when its incarnation > 0."""
+        when its incarnation > 0.  `rejoin=True` forces the child-side
+        ring rejoin even on a first incarnation — the elastic add_shard
+        path, where a provisioned member's parked fseqs must resolve to
+        the live producer head."""
         ts = self.tiles[name]
         ts.error = None
         if self._runtime != "process" or not ts.tile.proc_safe:
@@ -815,6 +985,8 @@ class Topology:
                 ts.ctx.incarnation,
                 replay,
                 self.faults_spec,
+                bool(rejoin) if rejoin is not None
+                else ts.ctx.incarnation > 0,
             ),
             name=f"tile:{name}",
             daemon=True,
@@ -847,6 +1019,8 @@ class Topology:
     def poll_failure(self) -> None:
         """Fail-stop check: if any tile died, halt everything and re-raise."""
         for name, ts in self.tiles.items():
+            if not ts.active:
+                continue
             if ts.error is not None:
                 self.halt()
                 raise RuntimeError(f"tile {name!r} failed") from ts.error
@@ -862,6 +1036,268 @@ class Topology:
                     + (f":\n{err}" if err else "")
                 )
 
+    # ---- elastic reconfiguration (disco/elastic.py) ----------------------
+
+    def _park_member_fseqs(self, name: str) -> None:
+        """Park an (inactive/reaped) member's reliable in-fseqs ahead of
+        each producer so the link never gates on it; see build()."""
+        from .elastic import PARK_OFFSET
+
+        for ln, rel in self.tiles[name].ins:
+            if not rel:
+                continue
+            fs = self._fseqs[(ln, name)]
+            head = self._mcaches[ln].seq_query()
+            fs.update(R.seq_u64(head + PARK_OFFSET))
+
+    def _elastic_gauge(self, kind: str) -> None:
+        m = self._metrics.get("elastic")
+        if m is None or self._shardmap is None:
+            return
+        grp = self._shard_groups[kind]
+        known = set(m.schema.counters)
+        for key, v in (
+            (f"{kind}_shards", self._shardmap.n_active(grp["slot"])),
+            (f"{kind}_epoch", self._shardmap.epoch(grp["slot"])),
+        ):
+            if key in known:
+                m.set(key, v)
+
+    def _wait_run(self, name: str, timeout_s: float) -> None:
+        """Wait for one (re)spawned tile to reach RUN; raise on a boot
+        crash or timeout (the tile's error/err-sidecar attached).
+
+        Deliberately NOT shared with start()'s boot-waits: those are
+        fail-stop (any boot failure halts the WHOLE topology and
+        classifies construction errors via pstat), while an elastic op
+        failing to boot one member must leave the rest of the topology
+        running and surface only its own error."""
+        ts = self.tiles[name]
+        cnc = self._cncs[name]
+        deadline = time.monotonic() + timeout_s
+        while cnc.signal_query() in (R.CNC_BOOT,):
+            p = ts.proc
+            if ts.error is not None:
+                raise ts.error
+            if p is not None and not p.is_alive():
+                err = _read_err(self.name, name)
+                raise RuntimeError(
+                    f"tile {name!r} died during elastic boot"
+                    + (f":\n{err}" if err else "")
+                )
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"tile {name!r} stuck in BOOT")
+            time.sleep(1e-3)
+        if cnc.signal_query() == R.CNC_FAIL:
+            err = _read_err(self.name, name)
+            if ts.error is not None:
+                raise ts.error
+            raise RuntimeError(
+                f"tile {name!r} failed during elastic boot"
+                + (f":\n{err}" if err else "")
+            )
+
+    def add_shard(
+        self, kind: str, i: int | None = None, *, timeout_s: float = 300.0
+    ) -> int:
+        """Activate one provisioned member of an elastic shard group at
+        RUNTIME: spawn its tile (thread or process), land its consumer
+        cursors at the live producer head (consumer_rejoin unparks the
+        far-future fseq), extend the boot manifest (atomic rename), and
+        flip the shard-map epoch only AFTER the new member has rejoined
+        its rings and reached RUN — so the first frag assigned to it
+        finds it consuming.  Returns the member index."""
+        grp = self._shard_groups[kind]
+        smv = self.shardmap()
+        mask = smv.mask(grp["slot"])
+        if i is None:
+            free = [
+                j
+                for j in range(len(grp["members"]))
+                if not (mask >> j) & 1 and not self.tiles[
+                    grp["members"][j]
+                ].active
+            ]
+            if not free:
+                raise RuntimeError(f"shard kind {kind!r}: no free member")
+            i = free[0]
+        name = grp["members"][i]
+        ts = self.tiles[name]
+        assert not ts.active and not (mask >> i) & 1, (
+            f"member {name!r} already active"
+        )
+        ts.active = True
+        is_proc = self._runtime == "process" and ts.tile.proc_safe
+        try:
+            if is_proc:
+                # the CHILD rejoins at boot (rejoin=True even on the
+                # first incarnation): consumer_rejoin reads the parked
+                # fseq and lands at the producer head
+                self._spawn_tile(name, rejoin=True)
+            else:
+                from .supervisor import rejoin_links
+
+                rejoin_links(ts.ctx.ins, ts.ctx.outs, replay=0)
+                self._spawn_tile(name)
+            self._wait_run(name, timeout_s)
+        except BaseException:
+            ts.active = False
+            self._park_member_fseqs(name)
+            raise
+        # flip AFTER the member is live: the producer's next burst
+        # boundary appends the flip entry, and every seq it governs
+        # lands on a consuming member
+        smv.flip(grp["slot"], mask | (1 << i))
+        self._elastic_gauge(kind)
+        self.export_manifest()
+        return i
+
+    def retire_shard(
+        self,
+        kind: str,
+        i: int,
+        *,
+        timeout_s: float = 300.0,
+        replay: int = 0,
+    ) -> None:
+        """Retire one active member: drain -> handover -> reap.  The
+        epoch flips first (no new seqs are assigned past the flip
+        entry); the member then drains its in-flight window and
+        publishes a DRAINED marker (the epoch) in the shard map; only
+        then is it halted and reaped, its fseqs parked so the producer
+        never gates on the corpse.  A member that dies mid-drain (chaos
+        SIGKILL) is respawned — ring rejoin + `replay`, the crash-
+        restart machinery — until the drain completes: the same
+        zero-loss/zero-dup bar as crashes."""
+        grp = self._shard_groups[kind]
+        smv = self.shardmap()
+        name = grp["members"][i]
+        ts = self.tiles[name]
+        assert ts.active and (smv.mask(grp["slot"]) >> i) & 1, (
+            f"member {name!r} not active"
+        )
+        ep = smv.flip(grp["slot"], smv.mask(grp["slot"]) & ~(1 << i))
+        self._elastic_gauge(kind)
+        self.export_manifest()
+        deadline = time.monotonic() + timeout_s
+        while smv.drained(grp["slot"], i) < ep:
+            if time.monotonic() > deadline:
+                # ROLL BACK: the member is still running and was never
+                # reaped — re-admit it under a fresh epoch so the mask
+                # and ts.active stay consistent (a half-retired member
+                # would otherwise wedge every future scale-out of this
+                # kind) and surface the failure to the caller
+                smv.flip(grp["slot"], smv.mask(grp["slot"]) | (1 << i))
+                self._elastic_gauge(kind)
+                self.export_manifest()
+                raise TimeoutError(
+                    f"member {name!r} failed to drain for epoch {ep}; "
+                    f"membership rolled back"
+                )
+            self._revive_if_dead(name, replay)
+            time.sleep(2e-3)
+        # drained: deliberate halt (on_halt runs; halt-ack -> BOOT)
+        self._cncs[name].signal(R.CNC_HALT)
+        if ts.proc is not None:
+            self._reap(ts, timeout_s=30.0)
+        elif ts.thread is not None:
+            ts.thread.join(timeout=30.0)
+        ts.active = False
+        if self._runtime == "process" and ts.tile.proc_safe:
+            # observability mirror: the drained epoch into the pstat
+            # words (the parent owns this word; the member's canonical
+            # marker lives in the shard-map region, which works in both
+            # runtimes)
+            pstat = self._pstat(name)
+            pstat[PSTAT_DRAINED] = np.uint64(ep)
+        self._park_member_fseqs(name)
+        self._elastic_gauge(kind)
+        self.export_manifest()
+
+    def _respawn_incarnation(
+        self, name: str, replay: int, *, crashed: bool
+    ) -> None:
+        """The one reincarnation recipe shared by the elastic paths
+        (mid-drain crash revival, rolling restart): thread-runtime ring
+        rejoin with the standard skip accounting (process children
+        rejoin themselves at boot), incarnation bump, BOOT signal,
+        respawn.  `crashed` adds the crash-only steps (on_crash
+        cleanup, the restarts counter) that a clean halt skips."""
+        ts = self.tiles[name]
+        ctx = ts.ctx
+        is_proc = self._runtime == "process" and ts.tile.proc_safe
+        if not is_proc:
+            from .supervisor import rejoin_links
+
+            metrics = self._metrics[name]
+
+            def _account_skip(il, skipped):
+                metrics.inc("overrun_frags", skipped)
+                il.fseq.diag_add(0, skipped)
+
+            rejoin_links(
+                ctx.ins, ctx.outs, replay=replay, on_skip=_account_skip
+            )
+            if crashed:
+                ts.tile.on_crash(ctx)
+        ctx.interrupt.clear()
+        ctx.booted = False
+        ctx.incarnation += 1
+        if crashed:
+            self._metrics[name].inc("restarts")
+        self._cncs[name].signal(R.CNC_BOOT)
+        self._spawn_tile(name, replay=replay)
+
+    def _revive_if_dead(self, name: str, replay: int) -> None:
+        """Mid-drain crash recovery for a deliberately-retiring member
+        (the supervisor stands back during commanded ops): respawn the
+        dead incarnation through the ordinary rejoin path so the drain
+        completes exactly-once."""
+        ts = self.tiles[name]
+        sig = self._cncs[name].signal_query()
+        died = (
+            not ts.proc.is_alive()
+            if ts.proc is not None
+            else ts.thread is not None and not ts.thread.is_alive()
+        )
+        if not died and sig != R.CNC_FAIL:
+            return
+        if ts.proc is not None:
+            self._reap(ts, timeout_s=10.0)
+        elif ts.thread is not None:
+            ts.thread.join(timeout=10.0)
+        self._respawn_incarnation(name, replay, crashed=True)
+
+    def rolling_restart(
+        self,
+        name: str,
+        *,
+        mutate=None,
+        replay: int = 0,
+        timeout_s: float = 300.0,
+    ) -> None:
+        """Deliberately restart one tile under traffic: halt (on_halt
+        drains), reap, optionally apply a config mutation to the tile
+        object (`mutate(tile)` — the respawn pickles the mutated tile
+        into the new child, which is what makes config reload and code
+        hot-swap first-class), rejoin the rings, respawn, wait for RUN.
+        Exactly-once across the restart rides the same replay +
+        surviving-dedup discipline as crash restarts."""
+        ts = self.tiles[name]
+        assert ts.active, f"tile {name!r} is not active"
+        cnc = self._cncs[name]
+        cnc.signal(R.CNC_HALT)
+        if ts.proc is not None:
+            self._reap(ts, timeout_s=30.0)
+        elif ts.thread is not None:
+            ts.thread.join(timeout=30.0)
+            ts.thread = None
+        if mutate is not None:
+            mutate(ts.tile)
+        self._respawn_incarnation(name, replay, crashed=False)
+        self._wait_run(name, timeout_s)
+        self.export_manifest()
+
     def halt(self, timeout_s: float = 30.0) -> None:
         """Halt upstream-first so in-flight frags drain before consumers
         stop.  Process children are reaped with bounded SIGTERM→SIGKILL
@@ -870,7 +1306,7 @@ class Topology:
         order = self._topo_order()
         for name in order:
             cnc = self._cncs.get(name)
-            if cnc is None:
+            if cnc is None or not self.tiles[name].active:
                 continue
             cnc.signal(R.CNC_HALT)
             ts = self.tiles[name]
@@ -958,6 +1394,7 @@ def _tile_process_main(
     incarnation: int,
     replay: int,
     faults_spec: tuple | None,
+    rejoin: bool | None = None,
 ) -> None:
     import sys
     import traceback
@@ -1071,11 +1508,13 @@ def _tile_process_main(
             # incarnation does not re-fire already-fired faults
             tf.bind_shm(ws.view(t["fstat"]))
             ctx.faults = tf
-        if incarnation > 0:
+        if rejoin if rejoin is not None else incarnation > 0:
             # ring rejoin runs IN the child (the dead incarnation's seqs
             # live in the shm fseqs/mcaches, so the repair is derivable
             # here) — same helper, and the same loss accounting, as the
-            # thread runtime's supervisor-side rejoin
+            # thread runtime's supervisor-side rejoin.  An elastic
+            # add_shard spawn forces rejoin on a FIRST incarnation: the
+            # member's parked fseq resolves to the live producer head.
             from .supervisor import rejoin_links
 
             def _account_skip(il, skipped):

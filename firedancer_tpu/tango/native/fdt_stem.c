@@ -45,6 +45,9 @@
 #define C_AC_ARGS 12
 /* stem flags (fdt_stem.h word 13): FDT_STEM_F_* */
 #define C_FLAGS 13
+/* elastic shard-map epoch watch (fdt_stem.h words 14/15) */
+#define C_EPOCH_PTR 14
+#define C_EPOCH_SEEN 15
 
 #define IN0 16
 #define IN_STRIDE 12
@@ -766,6 +769,21 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
   int64_t total = 0;
   uint64_t status = FDT_STEM_IDLE;
   uint64_t status_in = 0;
+
+  /* elastic burst-boundary epoch re-read (fdt_stem.h words 14/15):
+     a moved shard map means the handler state (pack's bank gating,
+     a member's assignment view) may be stale — hand the whole burst
+     back UNCONSUMED so Python re-reads the map first.  Checked after
+     the scratch zeroing above so _stem_apply reads clean deltas. */
+  if( cfg[ C_EPOCH_PTR ] ) {
+    uint64_t e = __atomic_load_n( (uint64_t const *)cfg[ C_EPOCH_PTR ],
+                                  __ATOMIC_ACQUIRE );
+    if( e != cfg[ C_EPOCH_SEEN ] ) {
+      cfg[ C_STATUS ] = FDT_STEM_PYTHON;
+      cfg[ C_STATUS_IN ] = FDT_STEM_IN_EPOCH;
+      return 0;
+    }
+  }
 
   for( ;; ) {
     int progressed = 0;

@@ -105,6 +105,15 @@
    hook (block-boundary end_block), not from a pending frag */
 #define FDT_STEM_IN_AC 0xFFFFFFFFUL
 
+/* status_in sentinel: the shard-map EPOCH word (cfg word 14/15, the
+   elastic-topology membership version — disco/elastic.py) moved since
+   the host configured this stem.  The burst consumed NOTHING: Python
+   must re-read the map (tile.on_epoch), reconfigure the handler state,
+   and update cfg word 15 before the next burst.  This is the native
+   half of the burst-boundary re-read discipline the
+   `elastic-stale-epoch` fdtmc corpus mutant pins. */
+#define FDT_STEM_IN_EPOCH 0xFFFFFFFEUL
+
 /* ---- out-block word layout (shared with fdt_pack_sched) ----------------
  *
  * The after-credit hook lives in fdt_pack.c but publishes through the
@@ -154,7 +163,14 @@
  * word 12 after-credit args block ptr (layout per hook; the pack hook
  *         is fdt_pack.h's FDT_PACK_SS_* block)
  * word 13 stem flags (FDT_STEM_F_*: bit0 = manual-credit tile)
- * words 14..15 reserved
+ * word 14 elastic epoch ptr (0 = no shard map): the shm shard-map
+ *         epoch word for this tile's kind (disco/elastic.py).  Read
+ *         with acquire at the TOP of every call; if it differs from
+ *         word 15 the call returns immediately (status PYTHON,
+ *         status_in FDT_STEM_IN_EPOCH, zero consumed) so the tile can
+ *         never handle a frag under a stale membership view.
+ * word 15 elastic epoch seen: the epoch the host last configured the
+ *         handler state against (updated by Python after on_epoch)
  *
  * per-in block i at word 16 + 12*i:
  *   +0 mcache ptr          +1 dcache base ptr (0 = none)

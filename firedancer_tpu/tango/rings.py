@@ -1122,6 +1122,13 @@ STEM_IDLE, STEM_BUDGET, STEM_PYTHON, STEM_BP = 0, 1, 2, 3
 #: hook (block-boundary end_block), not a pending frag
 STEM_IN_AC = 0xFFFFFFFF
 
+#: status_in sentinel: the shard-map EPOCH word moved since the last
+#: burst (elastic topology, disco/elastic.py) — the stem consumed
+#: NOTHING and Python must re-read the map (tile.on_epoch) before the
+#: next burst.  The burst-boundary re-read discipline this enforces is
+#: pinned by the `elastic-stale-epoch` fdtmc corpus mutant.
+STEM_IN_EPOCH = 0xFFFFFFFE
+
 #: fdt_pack_sched args-block word count (fdt_pack.h FDT_PACK_SS_*)
 PACK_SCHED_WORDS = 50
 
@@ -1132,6 +1139,9 @@ _STEM_MAX_INS, _STEM_MAX_OUTS, _STEM_N_CTRS = 8, 8, 16
 _SC_MAGIC, _SC_HANDLER, _SC_NINS, _SC_NOUTS, _SC_CAP = 0, 1, 2, 3, 4
 _SC_STATUS, _SC_STATUS_IN, _SC_ARGS, _SC_CTRS, _SC_TSPUB = 5, 6, 7, 8, 9
 _SC_AC, _SC_AC_ARGS, _SC_FLAGS = 11, 12, 13
+#: elastic epoch watch (words 14/15): pointer to the shm shard-map
+#: epoch word + the epoch the host configured this stem against
+_SC_EPOCH_PTR, _SC_EPOCH_SEEN = 14, 15
 _SI0, _SI_STRIDE = 16, 12
 # in-block word 5 is reserved (handlers address payloads by chunk)
 (_SI_MCACHE, _SI_DCACHE, _SI_FSEQ, _SI_SEQ, _SI_FLAGS, _SI_RSVD,
@@ -1275,6 +1285,21 @@ class Stem:
                 w[b + _SO_FSEQ0 + j] = _ptr(fs.mem)
             w[b + _SO_SIGS] = self._out_sigs[o].ctypes.data
             w[b + _SO_TSORIGS] = self._out_tsorigs[o].ctypes.data
+
+    def watch_epoch(self, word: np.ndarray, seen: int) -> None:
+        """Arm the elastic epoch watch: `word` is the shard-map epoch
+        word (u64[1] shm view, kept alive here), `seen` the epoch the
+        host just configured the tile against.  fdt_stem_run compares
+        the live word against SEEN at the top of every burst and hands
+        back (STEM_PYTHON / STEM_IN_EPOCH, nothing consumed) when it
+        moved — the run loop then re-reads the map via tile.on_epoch
+        and updates SEEN via set_epoch_seen."""
+        self._epoch_word = word  # keepalive
+        self._w[_SC_EPOCH_PTR] = _ptr(word)
+        self._w[_SC_EPOCH_SEEN] = np.uint64(seen)
+
+    def set_epoch_seen(self, epoch: int) -> None:
+        self._w[_SC_EPOCH_SEEN] = np.uint64(epoch)
 
     def run(self, budget: int, tspub: int) -> tuple[int, int, int]:
         """One GIL-released burst: up to `budget` frags drained,

@@ -340,6 +340,20 @@ class BankTile(Tile):
         if ncom:
             ctx.metrics.inc("committed_accounts", ncom)
 
+    def elastic_drained(self, ctx: MuxCtx) -> bool:
+        """Retirement drain contract (disco/elastic.py): the binding
+        has already established that pack acked the retiring epoch (no
+        new microblocks will be scheduled here) and that the in ring is
+        consumed to its head; what remains is THIS shard's deferred
+        state — flush the funk commit so every balance the shard
+        dirtied is durable and its shared-table slots are released
+        (clean, committed slots are claimable by the surviving
+        shards).  Execution itself is synchronous per frag, so a
+        caught-up ring implies no half-applied microblock."""
+        if self._table is not None and self._mb_uncommitted:
+            self._commit(ctx)
+        return True
+
     def during_housekeeping(self, ctx: MuxCtx) -> None:
         # bound funk staleness for observers (RPC txn counts read
         # metrics, but balances read funk): a clean table makes this a

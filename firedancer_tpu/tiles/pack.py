@@ -324,6 +324,33 @@ class PackTile(Tile):
             ac_args=sa,
         )
 
+    def on_epoch(self, ctx: MuxCtx) -> None:
+        """Elastic bank membership (disco/elastic.py): pack is the
+        bank kind's PRODUCER — assignment is explicit (it picks the out
+        ring), so the mask gates the scheduler rather than a seq
+        journal.  A deactivated bank's cadence word is parked in the
+        far future: BOTH loops (the Python after_credit and the native
+        fdt_pack_sched hook) already skip a bank whose bank_ready_at
+        is beyond `now`, so one shared-word store retires the bank from
+        scheduling without touching the native ABI.  The stem's epoch
+        watch guarantees this runs at a burst boundary before any
+        post-flip scheduling round."""
+        super().on_epoch(ctx)
+        eb = self.elastic
+        if eb is None:
+            return
+        from firedancer_tpu.disco.elastic import (
+            BANK_PARKED_AT, BANK_PARKED_THRESH,
+        )
+
+        mask = eb.bind(ctx).mask(eb.slot)
+        for i in range(self.n_banks):
+            if (mask >> i) & 1:
+                if self._bank_ready_at[i] >= BANK_PARKED_THRESH:
+                    self._bank_ready_at[i] = 0  # re-activated: ready now
+            else:
+                self._bank_ready_at[i] = BANK_PARKED_AT
+
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         if in_idx == 0:
             il = ctx.ins[0]

@@ -927,7 +927,16 @@ class VerifyTile(Tile):
 
     def on_frags(self, ctx: MuxCtx, in_idx: int, frags: np.ndarray) -> None:
         il = ctx.ins[in_idx]
-        if self.shard is not None:
+        if self.elastic is not None:
+            # elastic seq sharding (disco/elastic.py): assignment is a
+            # pure function of (seq, flip journal) — the producer's
+            # flip entries are sequenced before the frags they govern,
+            # so every member resolves the same owner for every seq
+            # regardless of when it observed the epoch flip
+            frags = frags[self.elastic.assign(ctx, frags["seq"])]
+            if not len(frags):
+                return
+        elif self.shard is not None:
             idx, cnt = self.shard
             frags = frags[frags["seq"] % cnt == idx]
             if not len(frags):
@@ -958,6 +967,22 @@ class VerifyTile(Tile):
             and self._pool.can_accept()
         ):
             self._submit_front(self.max_lanes)
+
+    def elastic_drained(self, ctx: MuxCtx) -> bool:
+        """Retirement drain contract (disco/elastic.py): beyond the
+        ring-cursor checks the binding performs, this replica holds
+        in-flight work in its staging deque, its device pool (dispatch
+        pipelines + the in-order reorder buffer), and its credit-gated
+        publish queue — ALL must land and publish before the drained
+        marker may be written (zero-loss handover)."""
+        p = self._pool
+        return (
+            self._staged_lanes == 0
+            and not self._staged
+            and self._outq_txns == 0
+            and not self._outq
+            and (p is None or p.idle())
+        )
 
     def in_budget(self, ctx: MuxCtx) -> int | None:
         # stop draining the ring when the device pool is full or results

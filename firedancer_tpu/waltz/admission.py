@@ -171,6 +171,27 @@ class AdmissionConfig:
 
         return asdict(self)
 
+    def autosized(self, n_active: int, base_active: int) -> "AdmissionConfig":
+        """Scale the CAPACITY-shaped knobs with the live verify shard
+        count (elastic topology, disco/elastic.py): the configured
+        values are calibrated for `base_active` shards, so with
+        n_active live shards the connection cap and txn backlog scale
+        linearly — admission tracks what the pipeline can actually
+        absorb.  RATE knobs (handshake/txn buckets) and the shed/
+        eviction policy are per-source defenses, not capacity, and stay
+        fixed."""
+        import dataclasses
+
+        n = max(int(n_active), 1)
+        b = max(int(base_active), 1)
+        if n == b:
+            return self
+        return dataclasses.replace(
+            self,
+            max_conns=max(self.max_conns * n // b, 1),
+            backlog_cap=max(self.backlog_cap * n // b, 1),
+        )
+
     @classmethod
     def from_dict(cls, doc: dict) -> "AdmissionConfig":
         import dataclasses

@@ -118,6 +118,7 @@ def run_soak(
     deadline_s: float = 180.0,
     verbose: bool = False,
     runtime: str = "thread",
+    elastic: bool = False,
 ) -> dict:
     """One soak iteration.  Returns a report dict with ok=True/False.
 
@@ -135,10 +136,27 @@ def run_soak(
         seed = int.from_bytes(os.urandom(4), "little")
     print(
         f"chaos_soak: seed={seed} txns={n_txns} faults={n_faults} "
-        f"runtime={runtime}"
+        f"runtime={runtime} elastic={elastic}"
     )
     rng = np.random.default_rng(seed)
     faults = _random_schedule(rng, n_txns, n_faults)
+    if elastic:
+        # elastic mode interleaves DELIBERATE reconfiguration (scale-
+        # out/in of a provisioned verify member, rolling restart of
+        # dedup) with the scripted faults.  Faults stay on verify
+        # (member 0, never commanded): a scripted kill landing inside a
+        # commanded window would be repaired by the operation itself,
+        # which is correct but breaks the 1:1 bundle accounting this
+        # soak asserts — the SIGKILL-mid-drain interaction is pinned
+        # deterministically by tests/test_elastic.py instead.
+        faults = [
+            Fault(
+                "verify" if f.tile == "dedup" else f.tile, f.kind,
+                at=f.at, on=f.on, count=f.count, frac=f.frac,
+                link=f.link, duration_s=f.duration_s,
+            )
+            for f in faults
+        ]
     if process:
         # drop/corrupt need per-frag parent-side accounting (child-only
         # detail); supervision faults and injected-traffic floods work
@@ -172,15 +190,36 @@ def run_soak(
     topo.link("dedup_sink", depth=RING_DEPTH, mtu=wire.LINK_MTU)
     topo.tile(synth, outs=["synth_verify"])
     topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
-    topo.tile(dedup, ins=[("verify_dedup", True)], outs=["dedup_sink"])
+    dedup_ins = [("verify_dedup", True)]
+    if elastic:
+        # one PROVISIONED spare verify member: scale-out/in events
+        # activate and retire it while the fault schedule runs
+        topo.link("verify1_dedup", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+        topo.tile(
+            VerifyTile(
+                msg_width=256, max_lanes=32, pre_dedup=False,
+                device="off",
+                device_fn=hostpath.verify_batch_digest_host,
+                async_depth=2, name="verify1",
+            ),
+            ins=[("synth_verify", True)], outs=["verify1_dedup"],
+        )
+        dedup_ins.append(("verify1_dedup", True))
+    topo.tile(dedup, ins=dedup_ins, outs=["dedup_sink"])
     topo.tile(sink, ins=[("dedup_sink", True)])
+    if elastic:
+        topo.declare_shards(
+            "verify", ["verify", "verify1"], producer="synth",
+            producer_link="synth_verify", active=1,
+        )
     sup = Supervisor(
         topo,
         RestartPolicy(
             hb_timeout_s=0.5,
             backoff_base_s=0.05,
             breaker_n=2 * n_faults + 4,
-            replay={"verify": RING_DEPTH, "dedup": RING_DEPTH},
+            replay={"verify": RING_DEPTH, "verify1": RING_DEPTH,
+                    "dedup": RING_DEPTH},
         ),
         faults=inj,
     )
@@ -206,13 +245,64 @@ def run_soak(
             ).tolist()
         return sink.all_sigs().tolist()
 
+    # elastic mode: a seeded, deterministic-SEQUENCE schedule of
+    # deliberate reconfig events interleaved with the scripted faults
+    # (scale-out -> rolling-restart -> scale-in -> ... while traffic
+    # and SIGKILLs flow); every op runs under the supervisor's
+    # commanded bracket via the controller's operation plumbing
+    elastic_ops: list[str] = []
+    ctl = None
+    if elastic:
+        from firedancer_tpu.disco import ElasticConfig, ElasticController
+
+        ctl = ElasticController(
+            topo, ElasticConfig(kinds={}), sup=sup, flight=None
+        )
+        op_kinds = ["scale-out", "rolling-restart", "scale-in"]
+        n_ops = 3 + int(rng.integers(0, 3))
+        op_plan = [op_kinds[i % len(op_kinds)] for i in range(n_ops)]
+        op_gap_s = [float(rng.uniform(0.05, 0.4)) for _ in op_plan]
     try:
         end = time.monotonic() + deadline_s
+        next_op = time.monotonic() + (op_gap_s[0] if elastic else 1e9)
         while time.monotonic() < end:
             injected = inj.dropped_frags() + inj.corrupted_frags()
-            if len(set(_sunk_sigs())) >= n_txns - injected:
+            if len(set(_sunk_sigs())) >= n_txns - injected and not (
+                ctl is not None and op_plan
+            ):
                 break
+            if ctl is not None and op_plan and time.monotonic() >= next_op:
+                op = op_plan.pop(0)
+                try:
+                    if op == "scale-out" and topo.shardmap().n_active(
+                        0
+                    ) < 2:
+                        ctl.scale_out("verify")
+                    elif op == "scale-in" and topo.shardmap().n_active(
+                        0
+                    ) > 1:
+                        ctl.scale_in("verify", 1)
+                    elif op == "rolling-restart":
+                        ctl.rolling_restart(
+                            "dedup", replay=RING_DEPTH
+                        )
+                    else:
+                        op = f"skipped-{op}"
+                    elastic_ops.append(op)
+                except Exception as e:  # noqa: BLE001 — report, keep soaking
+                    elastic_ops.append(f"FAILED-{op}: {e!r}")
+                next_op = time.monotonic() + (
+                    op_gap_s[len(elastic_ops) % len(op_gap_s)]
+                )
             time.sleep(0.1)
+        # settle: a member still retiring at traffic-end must finish
+        # its drain before the halt tears the topology down
+        if ctl is not None and topo.shardmap().n_active(0) > 1:
+            try:
+                ctl.scale_in("verify", 1)
+                elastic_ops.append("final-scale-in")
+            except Exception as e:  # noqa: BLE001
+                elastic_ops.append(f"FAILED-final-scale-in: {e!r}")
     finally:
         flight.stop()
         sup.halt()
@@ -255,6 +345,7 @@ def run_soak(
                 {"class": r["class"], "tile": r["tile"]} for r in inc_rows
             ],
             incident_dir=inc_dir,
+            elastic_ops=elastic_ops,
         )
         checks = {
             "no_duplicates": len(uniq) == len(sunk),
@@ -282,9 +373,19 @@ def run_soak(
             incidents_all_explained=all(
                 r["explained"] for r in inc_rows
             ),
+            # a fault-free soak yields zero CRASH bundles; deliberate
+            # reconfig bundles are the elastic schedule's own record
             incidents_zero_when_clean=bool(inj.events)
-            or not inc_rows,
+            or all(r["kind"] == "reconfig" for r in inc_rows),
         )
+        if elastic:
+            checks.update(
+                elastic_ops_ran=bool(elastic_ops),
+                elastic_ops_clean=not any(
+                    op.startswith("FAILED") for op in elastic_ops
+                ),
+                elastic_settled=topo.shardmap().n_active(0) == 1,
+            )
         report["checks"] = checks
         report["ok"] = all(checks.values())
         if verbose or not report["ok"]:
@@ -312,12 +413,17 @@ def main() -> int:
                     default="thread",
                     help="tile runtime under chaos (process = ISSUE 7 "
                          "one-process-per-tile; supervision faults only)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="interleave seeded scale-out/scale-in/rolling-"
+                         "restart reconfig events (disco/elastic.py) "
+                         "with the fault schedule")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     for i in range(args.repeat):
         report = run_soak(
             seed=args.seed, n_txns=args.txns, n_faults=args.faults,
             verbose=args.verbose, runtime=args.runtime,
+            elastic=args.elastic,
         )
         if not report["ok"]:
             return 1

@@ -171,6 +171,34 @@ def _stage_adversary(timeout_s: float, seed: int) -> dict:
     return stage
 
 
+def _stage_elastic(timeout_s: float, seed: int) -> dict:
+    """Elastic-topology smoke (disco/elastic.py): a seeded chaos soak
+    with scale-out / rolling-restart / scale-in reconfig events
+    interleaved into the fault schedule (scripts/chaos_soak.py
+    --elastic) — exactly-once delivery across deliberate membership
+    flips AND scripted kills, every bundle classified (reconfig ops as
+    reconfig:<op>, never as crashes)."""
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rc, out = _run(
+        [
+            sys.executable, str(REPO / "scripts" / "chaos_soak.py"),
+            "--elastic", "--seed", str(seed),
+            "--txns", "192", "--faults", "4",
+        ],
+        timeout_s, env=env,
+    )
+    stage: dict = {"rc": rc, "seed": seed,
+                   "seconds": round(time.perf_counter() - t0, 2)}
+    for line in out.splitlines():
+        if line.startswith("iteration") or "elastic_ops" in line:
+            stage.setdefault("detail", []).append(line.strip())
+    if rc != 0:
+        stage["tail"] = out[-2000:]
+    return stage
+
+
 def _stage_pytest(timeout_s: float, extra: list[str]) -> dict:
     t0 = time.perf_counter()
     env = dict(os.environ)
@@ -201,7 +229,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
                     help="comma list of stages to skip: "
-                         "lint,mc,proc,adversary,pytest")
+                         "lint,mc,proc,adversary,elastic,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
@@ -210,12 +238,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--adversary-seed", type=int, default=7,
                     help="fixed seed for the hostile-ingress smoke "
                          "(replayable; the stage prints it)")
+    ap.add_argument("--elastic-timeout", type=float, default=300.0)
+    ap.add_argument("--elastic-seed", type=int, default=11,
+                    help="fixed seed for the elastic reconfig smoke")
     ap.add_argument("--pytest-timeout", type=float, default=1800.0)
     ap.add_argument("--pytest-args", default="",
                     help="extra args appended to the pytest command")
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
-    bad = skip - {"lint", "mc", "proc", "adversary", "pytest"}
+    bad = skip - {"lint", "mc", "proc", "adversary", "elastic", "pytest"}
     if bad:
         print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
         return 2
@@ -246,6 +277,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"checkall adversary: rc={stages['adversary']['rc']} "
                   f"(seed={stages['adversary']['seed']}, "
                   f"{stages['adversary']['seconds']}s)", flush=True)
+    if "elastic" not in skip:
+        stages["elastic"] = _stage_elastic(
+            args.elastic_timeout, args.elastic_seed
+        )
+        if not args.json:
+            print(f"checkall elastic: rc={stages['elastic']['rc']} "
+                  f"(seed={stages['elastic']['seed']}, "
+                  f"{stages['elastic']['seconds']}s)", flush=True)
     if "pytest" not in skip:
         stages["pytest"] = _stage_pytest(
             args.pytest_timeout, args.pytest_args.split()

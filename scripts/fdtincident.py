@@ -134,6 +134,13 @@ def classify_bundle(bundle: dict) -> dict:
             cls, explained = f"load-shed:L{level}", True
         else:
             cls = f"unexplained-shed:L{level}"
+    elif kind == "reconfig":
+        # a DELIBERATE topology reconfiguration (elastic scale-out/in,
+        # rolling restart, config reload — disco/elastic.py): emitted
+        # through the supervisor's commanded-operation path, so it is
+        # self-explaining by construction — the point of the commanded
+        # bracket is that planned surgery never classifies as a crash
+        cls, explained = f"reconfig:{detail.get('op')}", True
     elif kind in ("manual", "signal"):
         cls, explained = kind, True
     return {

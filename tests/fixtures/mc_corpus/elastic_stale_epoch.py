@@ -1,0 +1,17 @@
+"""An elastic shard producer (disco/elastic.py) holds a STALE shard-map
+epoch across a membership flip: it acknowledges the flip (so the
+controller proceeds to drain and reap the retiring member) but keeps
+assigning frags per its FIRST mask read instead of re-reading at every
+burst boundary — post-flip frags are published into the reaped member's
+ring and lost.  The shipped discipline re-reads the epoch word at the
+top of every burst: the Python run loop checks it per iteration before
+draining (disco/mux.py), and the native stem carries the same word in
+its config block (fdt_stem.c C_EPOCH_PTR/C_EPOCH_SEEN) and hands the
+burst back to Python UNCONSUMED when it moved, so no frag is ever
+assigned — or handled — under a stale membership view."""
+
+MUTATION = "elastic-stale-epoch"
+SCENARIO = "elastic_handover"
+MODE = "dpor"
+BUDGET = 80
+EXPECT_RULES = {"mc-shard-handover"}
