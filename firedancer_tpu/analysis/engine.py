@@ -104,6 +104,13 @@ def run_repo(root: Path | str | None = None) -> Report:
     rep.findings.extend(abi_findings)
     rep.coverage["abi"] = abi_cov
 
+    # -- native C publish discipline (stem-emit-only, ISSUE 15) ----------
+    native_c_files: list[str] = []
+    for p in sorted(native.glob("*.c")):
+        native_c_files.append(p.relative_to(root).as_posix())
+        rep.findings.extend(ringlint.check_native_c_file(p, rel=root))
+    rep.coverage["native_c_files"] = native_c_files
+
     # -- ring discipline + spawn safety: tiles/ + disco/ -----------------
     proc_safe_files = 0
     for d in RING_DIRS:
@@ -163,7 +170,17 @@ def run_paths(paths: list[Path | str]) -> Report:
                 f, cov = abi.check(c_paths, py_paths, rel=p)
                 rep.findings.extend(f)
                 rep.coverage.setdefault("abi", cov)
+                for cp in c_paths:
+                    if cp.suffix == ".c":
+                        rep.findings.extend(
+                            ringlint.check_native_c_file(cp, rel=p)
+                        )
             targets = py_paths
+        elif p.suffix == ".c":
+            # C fixture / targeted native-source run: the publish
+            # discipline (stem-emit-only) is the only C-side rule
+            rep.findings.extend(ringlint.check_native_c_file(p))
+            targets = []
         elif p.suffix == ".py":
             targets = [p]
         else:

@@ -65,6 +65,15 @@ over the tile layer (tiles/, disco/):
                        replay after a crash.  Everything the handler
                        works on must live in the args block's
                        shared/native memory.
+  stem-emit-only       tango/native C sources: every handler/hook
+                       publish routes through the stem's shared emit
+                       bodies (fdt_stem_out_emit / fdt_stem_out_emit_at)
+                       — a raw fdt_mcache_publish in a handler bypasses
+                       per-frag tspub stamping and native span emission
+                       (fdt_trace.c, ISSUE 15), producing frags the
+                       latency attribution never sees.  fdt_tango.c/h
+                       (the primitive layer) are exempt; fdt_stem.c's
+                       one emit body carries the allow pragma.
   hot-path-clock       tile hook bodies (on_frags/after_credit) must not
                        read the clock through bare time.* calls
                        (time.monotonic_ns / time.time / ...) — clock
@@ -580,6 +589,7 @@ BASE_SCHEMA_COUNTERS = (
     "housekeep_iters",
     "loop_iters",
     "stem_frags",
+    "stem_engaged",
     "py_frags",
     "py_credit",
     "restarts",
@@ -772,3 +782,74 @@ def check_file(
     findings.extend(_check_metrics_schema(disp, tree))
 
     return apply_pragmas(sorted(set(findings)), text.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# stem-emit-only: C-source discipline for the native data-plane sources
+#
+# Every native handler/hook publish must route through the stem's shared
+# emit bodies (fdt_stem_out_emit / fdt_stem_out_emit_at, fdt_stem.c):
+# those are where per-frag publish timestamps are stamped and PUBLISH
+# span events emitted (tango/native/fdt_trace.c, ISSUE 15).  A raw
+# fdt_mcache_publish call in a handler source compiles and runs — and
+# silently publishes frags whose tspub is burst-quantized and whose
+# spans never appear, i.e. frags invisible to the latency attribution
+# the SLO engine and the elastic controller act on.  The tango
+# primitive layer (fdt_tango.c/h) defines the op and is exempt;
+# fdt_stem.c's one sanctioned call site carries an allow pragma.
+
+#: C sources exempt from stem-emit-only: the primitive layer that
+#: DEFINES the publish op (and its header)
+NATIVE_EMIT_EXEMPT_FILES = ("fdt_tango.c", "fdt_tango.h")
+
+_C_FN_DEF_RE = re.compile(
+    r"^(?:static\s+)?(?:inline\s+)?[A-Za-z_][A-Za-z0-9_]*"
+    r"(?:\s+\*?|\s*\*\s*)([a-z_][a-z0-9_]*)\s*\("
+)
+_C_PUBLISH_RE = re.compile(r"\bfdt_mcache_publish(?:_batch)?\s*\(")
+
+
+def check_native_c_file(path: Path, rel: Path | None = None) -> list[Finding]:
+    """stem-emit-only over one tango/native C source (see the module
+    rule table).  Line-regex based: function definitions in this
+    codebase start at column 0, so the enclosing function of every
+    publish call is derivable without a C parser."""
+    disp = path.as_posix()
+    if rel is not None:
+        try:
+            disp = path.relative_to(rel).as_posix()
+        except ValueError:
+            pass
+    if path.name in NATIVE_EMIT_EXEMPT_FILES:
+        return []
+    from .cparse import strip_comments
+
+    text = path.read_text()
+    raw_lines = text.splitlines()
+    # the ABI checker's line-preserving stripper — one comment lexer
+    # for the whole analysis package
+    stripped = strip_comments(text).splitlines()
+    findings: list[Finding] = []
+    current_fn = "<file scope>"
+    for lineno, line in enumerate(stripped, start=1):
+        if line and not line[0].isspace():
+            m = _C_FN_DEF_RE.match(line)
+            if m:
+                current_fn = m.group(1)
+                if current_fn.startswith("fdt_mcache_publish"):
+                    # a declaration/definition of the primitive itself
+                    # (a fixture's local prototype), not a call site
+                    continue
+        if _C_PUBLISH_RE.search(line):
+            findings.append(
+                Finding(
+                    disp, lineno, "stem-emit-only",
+                    f"raw fdt_mcache_publish in {current_fn}() — native "
+                    "handlers/hooks publish ONLY through "
+                    "fdt_stem_out_emit/fdt_stem_out_emit_at (fdt_stem.c), "
+                    "where per-frag tspub stamping and span emission "
+                    "live; a raw publish produces frags the latency "
+                    "attribution and trace assembly never see",
+                )
+            )
+    return apply_pragmas(findings, raw_lines)

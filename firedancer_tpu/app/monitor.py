@@ -40,13 +40,30 @@ class TileView:
 class Monitor:
     """Attach-and-read view of a named topology workspace."""
 
-    #: class-level defaults keep alarms()/render() pure over a snapshot
-    #: dict even on a Monitor built without __init__ (tests construct
-    #: bare instances via object.__new__ to drive them offline).  None,
-    #: not {}: a shared class-level dict would leak profiler regions
-    #: between bare instances.
+    #: class-level defaults keep alarms()/render() working over a bare
+    #: snapshot dict even on a Monitor built without __init__ (tests
+    #: construct bare instances via object.__new__ to drive them
+    #: offline).  None, not {}: a shared class-level dict would leak
+    #: state between bare instances.  NOTE: alarms() is no longer pure
+    #: — the stem-pin detector (ISSUE 15) keeps per-instance streak
+    #: state across calls, so feed it a live snapshot SEQUENCE, not
+    #: replayed history.
     slo: SloEngine | None = None
     profiles: dict[str, Metrics] | None = None
+    #: resolved stem mode from the manifest (python|native|None) — keys
+    #: the stem-coverage rows; None on bare offline instances
+    stem_mode: str | None = None
+    #: stem-pin persistence state (ISSUE 15): last (stem_frags,
+    #: py_frags) per tile and the consecutive-snapshot streak of
+    #: "py_frags advanced while stem_frags sat flat".  Class-level None
+    #: (lazily replaced per instance) for the same bare-instance reason
+    #: as above.
+    _stem_last: dict | None = None
+    _stem_pin: dict | None = None
+    #: consecutive pinned snapshots before the alarm fires — one
+    #: handback window (dedup amnesty draining) is normal; persistent
+    #: pinning is silent native-coverage loss
+    STEM_PIN_STREAK = 3
 
     def __init__(self, wksp_name: str):
         self.wksp, extra = R.Workspace.attach(wksp_name)
@@ -68,6 +85,7 @@ class Monitor:
                 "ins": t.get("ins", []), "outs": t.get("outs", [])
             }
         self.links = extra.get("links", {})
+        self.stem_mode = extra.get("stem")
         # per-tile run-loop profiler regions (disco/profile.py), when
         # the topology was built with enable_profile()
         self.profiles: dict[str, Metrics] = {}
@@ -178,6 +196,31 @@ class Monitor:
             self.slo.observe(out)
         return out
 
+    @staticmethod
+    def stem_row(counters: dict) -> dict | None:
+        """The per-tile stem-coverage row (ISSUE 15): the native-vs-
+        Python frag split of a stem-ENGAGED tile, None otherwise.
+        `coverage` is cumulative stem_frags / (stem_frags + py_frags);
+        `pinned` flags a tile whose stem NEVER consumed a frag while
+        the Python loop handled a meaningful number — full native-
+        coverage loss visible even from one snapshot (--once)."""
+        if not counters.get("stem_engaged"):
+            return None
+        sf = int(counters.get("stem_frags", 0))
+        pf = int(counters.get("py_frags", 0))
+        tot = sf + pf
+        return {
+            "engaged": True,
+            "stem_frags": sf,
+            "py_frags": pf,
+            "coverage": round(sf / tot, 4) if tot else None,
+            "pinned": sf == 0 and pf >= Monitor.STEM_PIN_MIN_FRAGS,
+        }
+
+    #: cumulative py_frags below this never count as a full pin — a
+    #: couple of boot-window handbacks are normal stem behavior
+    STEM_PIN_MIN_FRAGS = 64
+
     def alarms(self, snap: dict) -> list[str]:
         """Stale heartbeats, failed tiles, and supervisor degradation
         state (circuit breaker open / restart churn), as alarm lines."""
@@ -186,6 +229,38 @@ class Monitor:
             if name.startswith("_"):
                 continue
             c = row.get("counters", {})
+            # stem-coverage pin detection (ISSUE 15): a stem-configured
+            # tile persistently handling frags on the Python loop has
+            # silently lost native coverage (dedup amnesty wedged, a
+            # frag-fault pin, a handler that keeps bailing) — that loss
+            # was previously invisible from outside the process
+            srow = self.stem_row(c)
+            if srow is not None:
+                if self._stem_pin is None:
+                    self._stem_pin = {}
+                    self._stem_last = {}
+                sf, pf = srow["stem_frags"], srow["py_frags"]
+                p_sf, p_pf = self._stem_last.get(name, (sf, pf))
+                self._stem_last[name] = (sf, pf)
+                if sf < p_sf or pf < p_pf:
+                    # counters rewound (workspace rebuilt / replayed
+                    # snapshots): two unrelated pin episodes must not
+                    # combine into one alarm-triggering streak
+                    self._stem_pin[name] = 0
+                elif pf > p_pf and sf == p_sf:
+                    self._stem_pin[name] = self._stem_pin.get(name, 0) + 1
+                elif sf > p_sf:
+                    self._stem_pin[name] = 0
+                if (
+                    srow["pinned"]
+                    or self._stem_pin.get(name, 0) >= self.STEM_PIN_STREAK
+                ):
+                    out.append(
+                        f"ALARM {name}: stem-configured tile pinned to "
+                        f"the Python loop (stem_frags={sf:,} flat, "
+                        f"py_frags={pf:,}) — native coverage lost "
+                        f"(amnesty or fault pin?)"
+                    )
             if c.get("degraded"):
                 out.append(
                     f"ALARM {name}: degraded (supervisor circuit breaker "
@@ -313,6 +388,31 @@ class Monitor:
                     f"e2e p50={hist_percentile(he, 50):,.0f}us "
                     f"p99={hist_percentile(he, 99):,.0f}us"
                 )
+            # stem-coverage sub-row (ISSUE 15): the native-vs-Python
+            # frag split for stem-engaged tiles, windowed vs the
+            # previous snapshot so live coverage loss moves the row
+            srow = self.stem_row(c)
+            if srow is not None:
+                if prev is not None and name in prev:
+                    p = prev[name]["counters"]
+                    d_sf = srow["stem_frags"] - p.get("stem_frags", 0)
+                    d_pf = srow["py_frags"] - p.get("py_frags", 0)
+                else:
+                    d_sf, d_pf = srow["stem_frags"], srow["py_frags"]
+                d_tot = d_sf + d_pf
+                cov = srow["coverage"]
+                lines.append(
+                    f"{'':>10}   stem: cov="
+                    + ("-" if cov is None else f"{cov * 100:.1f}%")
+                    + (
+                        ""
+                        if not d_tot
+                        else f" (win {100.0 * d_sf / d_tot:.1f}%)"
+                    )
+                    + f" stem_frags={srow['stem_frags']:,}"
+                    f" py_frags={srow['py_frags']:,}"
+                    + (" PINNED" if srow["pinned"] else "")
+                )
             # run-loop profile sub-row (enable_profile topologies):
             # GIL-wait share, phase split, scheduler-lag p99
             prof = row.get("profile")
@@ -430,6 +530,14 @@ class Monitor:
             "links": snap.get("_links", {}),
             "alarms": self.alarms(snap),
         }
+        # per-tile stem-coverage doc (ISSUE 15): the native/Python frag
+        # split machine-readable, so CI can assert coverage floors
+        if self.stem_mode is not None:
+            doc["stem_mode"] = self.stem_mode
+        for k, v in doc["tiles"].items():
+            srow = self.stem_row(v.get("counters", {}))
+            if srow is not None:
+                v["stem"] = srow
         if "_elastic" in snap:
             doc["elastic"] = {
                 "gauges": snap["_elastic"],

@@ -543,6 +543,66 @@ class FlightRecorder:
             }
         if self.slo is not None:
             bundle["slo"] = self.slo.to_dict()
+        # live reconfig/shed context (ISSUE 15): the elastic shard-map
+        # epochs and the commanded shed level at freeze time, snapshot
+        # into the bundle AND into per-tile state below — a postmortem
+        # of a frag-loss or latency incident must answer "was a
+        # membership flip or a shed escalation in flight?" without
+        # correlating external logs
+        shardmap = getattr(topo, "_shardmap", None)
+        shard_groups = getattr(topo, "_shard_groups", {}) or {}
+        elastic_kinds: dict = {}
+        tile_elastic: dict[str, dict] = {}
+        if shardmap is not None:
+            for kind, grp in shard_groups.items():
+                epoch = shardmap.epoch(grp["slot"])
+                mask = shardmap.mask(grp["slot"])
+                elastic_kinds[kind] = {
+                    "epoch": epoch,
+                    "active_mask": mask,
+                    "producer": grp["producer"],
+                }
+                for j, member in enumerate(grp["members"]):
+                    tile_elastic[member] = {
+                        "kind": kind,
+                        "epoch": epoch,
+                        "active": bool((mask >> j) & 1),
+                        "member_idx": j,
+                    }
+                if grp["producer"]:
+                    tile_elastic.setdefault(
+                        grp["producer"],
+                        {"kind": kind, "epoch": epoch, "role": "producer"},
+                    )
+        if elastic_kinds:
+            bundle["elastic"] = elastic_kinds
+        shed_commanded = None
+        if self._shed_words is None and topo.wksp is not None:
+            # resolve the shared region READ-ONLY (it may exist even if
+            # this recorder never commanded a shed — the quic tile
+            # allocates it via ctx.shared): view(), never alloc() —
+            # alloc is create-or-attach and would fabricate a zeroed
+            # shed block in every bundle of a topology that has no shed
+            # subsystem at all.  Leave None on a missing region so
+            # _command_shed's False latch semantics stay its own.
+            try:
+                mem = topo.wksp.view("shared_shed")
+                self._shed_words = (
+                    mem[: (len(mem) // 8) * 8].view(np.uint64)
+                )
+            except KeyError:
+                pass
+        if self._shed_words is not None and self._shed_words is not False:
+            from firedancer_tpu.waltz.admission import (
+                SHED_W_COMMANDED, SHED_W_LEVEL, SHED_W_TRANSITIONS,
+            )
+
+            shed_commanded = int(self._shed_words[SHED_W_COMMANDED])
+            bundle["shed"] = {
+                "commanded": shed_commanded,
+                "live_level": int(self._shed_words[SHED_W_LEVEL]),
+                "transitions": int(self._shed_words[SHED_W_TRANSITIONS]),
+            }
         tiles: dict = {}
         boxes = getattr(topo, "_flightboxes", {})
         for name, row in snap.items():
@@ -552,6 +612,17 @@ class FlightRecorder:
                 "signal": row["signal"],
                 "counters": row["counters"],
             }
+            el = tile_elastic.get(name)
+            if el is not None:
+                entry["elastic"] = el
+            # per-tile shed state: the tile's LOCAL level (its counters)
+            # alongside the SLO engine's commanded floor — divergence
+            # (local > commanded) means local backpressure escalated
+            if "shed_level" in row["counters"] or shed_commanded:
+                entry["shed"] = {
+                    "level": row["counters"].get("shed_level", 0),
+                    "commanded": shed_commanded or 0,
+                }
             box = boxes.get(name)
             if box is not None:
                 ins = tlinks[name]["ins"]

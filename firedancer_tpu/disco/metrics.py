@@ -10,6 +10,13 @@ IS the config language), but the storage contract is the same: a flat u64
 array in a workspace, single-writer, torn-read-tolerant, readable by any
 process mapping the workspace.  Histograms use the reference's shape: 16
 power-of-two buckets (src/util/hist/fd_histf.h) plus sum and count words.
+
+NATIVE MIRROR (ISSUE 15): tango/native/fdt_trace.c's
+fdt_trace_hist_sample re-states hist_sample's exact bucketing (bucket
+floor(log2(max(v,1))) clamped to nb-1; sum += max(v,0); count += 1) so
+the in-burst stem writes qwait/svc/e2e samples into the SAME hist words
+this module lays out (see hist_ref) — shared format, pinned
+word-identical by tests/test_fdttrace_native.py.
 """
 
 from __future__ import annotations
@@ -110,6 +117,14 @@ class MetricsSchema:
         # so stem_frags/in_frags is the native-coverage ratio a monitor
         # or bench can read straight off the tile
         "stem_frags",
+        # 1 when this incarnation's run loop engaged a native stem (the
+        # tile registered a handler under stem="native"), written at
+        # boot by the tile itself.  Monitors key stem-coverage rows and
+        # the pinned-to-Python alarm off it: a stem-CONFIGURED tile
+        # whose py_frags advance while stem_frags sit flat has silently
+        # lost native coverage (amnesty/fault pins), which was
+        # previously invisible from outside.
+        "stem_engaged",
         # the Python-side complements (ISSUE 11 zero-Python steady-state
         # contract): frags the Python on_frags callback handled, and
         # Python after_credit invocations.  A fully native data-plane
@@ -201,6 +216,14 @@ class Metrics:
         w[h.base : h.base + h.nb] += counts
         w[h.base + h.nb] += np.uint64(int(np.maximum(raw, 0).sum()))
         w[h.base + h.nb + 1] += np.uint64(len(raw))
+
+    def hist_ref(self, name: str) -> tuple[int, int]:
+        """(address of the hist's first bucket word, bucket count) — the
+        native in-burst trace emitter (tango/native/fdt_trace.c) updates
+        the hist in place with hist_sample's exact bucketing, so native
+        and Python samples land in ONE storage with one estimator."""
+        h = self._hist[name]
+        return int(self.words.ctypes.data) + h.base * 8, h.nb
 
     # -- reader side (any process) ---------------------------------------
 

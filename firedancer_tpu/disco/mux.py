@@ -218,6 +218,13 @@ class MuxCtx:
         #: loop is driving this tile's registered native handler; None
         #: on the Python loop (tests/monitors read it, never write)
         self.stem = None
+        #: deterministic-clock injection for the trace parity harness
+        #: (tests only): a u64[2] (value, step) array the native
+        #: in-burst trace reads instead of CLOCK_MONOTONIC.  Harnesses
+        #: monkeypatch disco.mux.now_ts to read the SAME array so the
+        #: Python loop and the native stem stamp identical timestamps
+        #: on identical frag streams.  None in production.
+        self.trace_clock = None
         self.incarnation = 0
         #: True once the current incarnation's on_boot completed — lets
         #: the topology distinguish "died during boot" (raise at start)
@@ -468,13 +475,73 @@ def drain_straggler_ins(
             return got
 
 
-def _stem_apply(ctx, m, stem, spec, tracer, faults, out_seq0, tspub) -> int:
+def _arm_stem_trace(stem, ctx, m, tracer) -> bool:
+    """Arm the native in-burst trace (tango/native/fdt_trace.c) on a
+    freshly built stem: wire the tile's per-in-link latency hists, its
+    span ring and the (test-harness) injected clock into the stem's
+    trace block so per-frag drain/publish timestamps, qwait/svc/e2e
+    hist updates and span emission all happen INSIDE the GIL-released
+    burst — the measurement substrate living with the data plane
+    instead of being applied at the burst boundary with one post-burst
+    clock read (the PROFILE.md round-11d skew).  Returns False when the
+    ctx has neither link hists nor a tracer; the stem then runs
+    untraced (zero overhead) and _stem_apply keeps the legacy
+    burst-boundary bookkeeping for whatever hists exist."""
+    in_rows = []
+    any_h = False
+    for il in ctx.ins:
+        if il.h_qwait is not None:
+            any_h = True
+            in_rows.append(
+                (
+                    il.link_id,
+                    m.hist_ref(il.h_qwait),
+                    m.hist_ref(il.h_e2e),
+                    m.hist_ref(il.h_svc),
+                )
+            )
+        else:
+            in_rows.append((il.link_id, None, None, None))
+    ring_addr = 0
+    sample = 1
+    if tracer is not None:
+        ring_addr = tracer.ring.words.ctypes.data
+        sample = tracer.sample
+    if not any_h and not ring_addr:
+        return False
+    batch = (
+        m.hist_ref("batch_sz") if "batch_sz" in m.schema.hists else None
+    )
+    stem.arm_trace(
+        ring_addr=ring_addr,
+        sample=sample,
+        in_rows=in_rows,
+        out_links=[ol.link_id for ol in ctx.outs],
+        batch_hist=batch,
+        clock=ctx.trace_clock,
+        keepalive=(
+            m.words,
+            None if tracer is None else tracer.ring.words,
+        ),
+    )
+    return True
+
+
+def _stem_apply(
+    ctx, m, stem, spec, tracer, faults, out_seq0, tspub,
+    trace_native=False,
+) -> int:
     """Burst-boundary bookkeeping for one native stem call: the stem
     accumulated counter deltas, drained-frag metas and published-sig
-    scratch in native memory; apply them to metrics/trace/faultinj ONCE
-    per burst (the batched per-frag-update contract).  Latency hists use
-    the post-burst clock, so qwait/e2e carry up to one burst of skew —
-    the same order of skew the Python loop's per-batch sampling has.
+    scratch in native memory; apply them to metrics/faultinj ONCE per
+    burst (the batched per-frag-update contract).
+
+    With the in-burst trace armed (trace_native, ISSUE 15) this slims
+    to COUNTERS + FAULTINJ: hists and span events were already written
+    per frag inside the burst by fdt_trace with per-frag clock reads.
+    Unarmed (no link hists, no tracer — or a pre-trace harness), the
+    legacy path applies latency hists with the post-burst clock, where
+    qwait/e2e carry up to one burst of skew.
     Returns total frags consumed by the burst."""
     total = 0
     for i, il in enumerate(ctx.ins):
@@ -488,9 +555,11 @@ def _stem_apply(ctx, m, stem, spec, tracer, faults, out_seq0, tspub) -> int:
         total += n
         m.inc("in_frags", n)
         m.inc("in_bytes", stem.in_bytes(i))
-        m.hist_sample("batch_sz", n)
         if faults is not None:
             faults.note_frags(il, n)
+        if trace_native:
+            continue
+        m.hist_sample("batch_sz", n)
         frags = stem.frags(i)
         t_cons = 0
         if il.h_qwait is not None:
@@ -512,7 +581,7 @@ def _stem_apply(ctx, m, stem, spec, tracer, faults, out_seq0, tspub) -> int:
             continue
         m.inc("out_frags", p)
         m.inc("out_bytes", stem.out_bytes(o))
-        if ol.tracer is not None:
+        if ol.tracer is not None and not trace_native:
             ol.tracer.publish(
                 ol.link_id, out_seq0[o], stem.out_sigs(o), tspub,
                 stem.out_tsorigs(o),
@@ -605,6 +674,15 @@ def run_loop(
                 stem_obj = None
                 stem_spec = None
     ctx.stem = stem_obj
+    # in-burst tracing (ISSUE 15): move the measurement substrate into
+    # the burst — per-frag drain/publish timestamps, native hist
+    # updates and native span emission.  stem_engaged is the monitor's
+    # stem-coverage anchor (set every boot so a restarted incarnation
+    # under a different stem mode reports truthfully).
+    stem_trace = False
+    if stem_obj is not None:
+        stem_trace = _arm_stem_trace(stem_obj, ctx, m, tracer)
+    m.set("stem_engaged", 1 if stem_obj is not None else 0)
     if stem_obj is not None and ep_word is not None:
         # the stem carries the same epoch word in its config block and
         # hands a burst back UNCONSUMED when it moved, so the native
@@ -750,7 +828,7 @@ def run_loop(
                 s_got, s_stat, s_in = stem_obj.run(cr, ts_b0)
                 got += _stem_apply(
                     ctx, m, stem_obj, stem_spec, tracer, faults,
-                    out_seq0, ts_b0,
+                    out_seq0, ts_b0, stem_trace,
                 )
                 if s_got:
                     m.inc("stem_frags", s_got)

@@ -23,12 +23,13 @@ SLO semantics (all optional; None = not asserted):
                     NOTE: a latency ceiling must sit inside its hist's
                     log2 domain or a violation can never be observed —
                     the bound is derived from the storage format
-                    (hist_domain_end_us), NOT hardcoded: link latency
-                    hists are 16-bucket (domain ends at 2^16 µs), wide
-                    hists like sched_lag_us run to 2^WIDE_HIST_BUCKETS
-                    µs with an explicit overflow bucket.  SloConfig
-                    validation rejects unobservable ceilings loudly
-                    instead of asserting an SLO that can never fire.
+                    (hist_domain_end_us), NOT hardcoded: the per-link
+                    latency hists are WIDE (2^WIDE_HIST_BUCKETS µs
+                    domain with an explicit overflow bucket — ISSUE 15
+                    widened them from 16-bucket, retiring the old
+                    2^16 µs SLO ceiling bound).  SloConfig validation
+                    rejects unobservable ceilings loudly instead of
+                    asserting an SLO that can never fire.
   verify_hop_p99_us verify service-time p99 ceiling (svc_us_* hists of
                     verify* tiles), same budget semantics.
   landed_tps_min    throughput floor: windowed in_frags rate at the
@@ -107,19 +108,22 @@ class SloConfig:
         """Reject latency ceilings the storage format can never observe
         as violated (they would assert an SLO that cannot fire).  The
         bound comes from the hist width the objective is evaluated
-        over: the per-link latency hists are 16-bucket, so their
-        ceilings must sit under hist_domain_end_us()."""
+        over: the per-link qwait/svc/e2e hists are WIDE
+        (WIDE_HIST_BUCKETS with an explicit overflow bucket, ISSUE 15 —
+        previously 16-bucket, which capped every latency SLO at
+        2^16 µs), so ceilings must sit under the wide domain end
+        (2^WIDE_HIST_BUCKETS µs ~ 16.8 s)."""
         for name in (
             "e2e_p99_us", "verify_hop_p99_us", "queue_wait_p99_us"
         ):
             v = getattr(self, name)
-            if v is not None and v >= hist_domain_end_us():
+            if v is not None and v >= hist_domain_end_us(wide=True):
                 raise ValueError(
                     f"slo {name}={v:,.0f}us is unobservable: the "
-                    f"{HIST_BUCKETS}-bucket latency hist domain ends at "
-                    f"{hist_domain_end_us():,.0f}us — a violation could "
-                    f"never be recorded (lower the ceiling, or widen "
-                    f"the hist like sched_lag_us)"
+                    f"{WIDE_HIST_BUCKETS}-bucket latency hist domain "
+                    f"ends at {hist_domain_end_us(wide=True):,.0f}us — "
+                    f"a violation could never be recorded (lower the "
+                    f"ceiling)"
                 )
 
     def asserted(self) -> list[str]:

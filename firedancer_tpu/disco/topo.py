@@ -372,14 +372,22 @@ class Topology:
         attribution hists (qwait/svc/e2e per consumed link) the run
         loop records.  Everything that reads a tile's metrics region —
         build, manifest export, monitor, metric tile — must agree on
-        this one layout."""
+        this one layout.
+
+        The link hists are WIDE (WIDE_HIST_BUCKETS + explicit overflow
+        bucket, ISSUE 15): the 16-bucket domain capped every latency
+        SLO at 2^16 µs (the documented fdtflight observability bound) —
+        an e2e or queue-wait ceiling above 65.5 ms was rejected as
+        unobservable.  slo.py derives its ceiling bound from the
+        storage, so widening here lifts the bound to 2^24 µs (~16.8 s)
+        with the overflow bucket catching the rest."""
         base = ts.tile.schema.with_base()
         link_hists = tuple(
             h for ln, _rel in ts.ins for h in link_hist_names(ln)
         )
         return MetricsSchema(
             base.counters, base.hists + link_hists,
-            wide_hists=base.wide_hists,
+            wide_hists=base.wide_hists + link_hists,
         )
 
     def _shared_regions(self) -> dict[str, int]:
@@ -664,6 +672,9 @@ class Topology:
             for ls in self.links.values()
         }
         extra = {"tiles": tiles, "links": links}
+        # resolved stem mode (python|native): monitors key their
+        # stem-coverage rows and the pinned-to-Python alarm off it
+        extra["stem"] = self._loop_kw.get("stem") or self._resolve_stem()
         if self.trace is not None:
             # fdttrace attach surface: per-tile span ring alloc names +
             # the link id -> name table the u8 link field indexes

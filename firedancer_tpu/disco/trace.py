@@ -30,6 +30,16 @@ Event layout (4 u64 words, little-endian):
 ts is the same compressed µs-mod-2^32 domain as the frag meta's
 tsorig/tspub (disco.mux.now_ts) — all arithmetic on it must go through
 the wrap-safe ts_diff helpers in disco/mux.py.
+
+NATIVE MIRROR (ISSUE 15): tango/native/fdt_trace.c re-states this
+module's storage format in C — the event word packing, the ring
+header's reserve-before-store / commit-after-store cursor discipline,
+and the 1-in-N sig sampling — so the native stem emits span records a
+Python reader drains indistinguishably from Tracer's.  The layout
+constants below (_HDR_WORDS, EVENT_WORDS, header word meanings, INGEST/
+PUBLISH kinds) are therefore SHARED FORMAT: changing any of them means
+changing fdt_trace.c in the same commit, and the differential tests in
+tests/test_fdttrace_native.py pin the two byte-identical.
 """
 
 from __future__ import annotations

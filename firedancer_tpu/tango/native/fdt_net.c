@@ -186,23 +186,14 @@ int64_t fdt_net_rx( uint64_t * args, uint64_t * outs, int64_t n_outs,
                   dc + ( c + i * stride_chunks ) *
                            (int64_t)FDT_CHUNK_SZ,
                   (uint64_t)szs[ i ] );
-        fdt_mcache_publish(
-            (void *)ob[ FDT_STEM_O_MCACHE ], ob[ FDT_STEM_O_SEQ ],
-            sig, (uint32_t)( c + w_idx * stride_chunks ),
-            (uint16_t)szs[ i ],
+        /* the shared emit body (ring-publish order + sig scratch +
+           in-burst trace): the payload is already in place, so the
+           chunk-addressed variant publishes without a copy */
+        fdt_stem_out_emit_at(
+            ob, sig, (uint32_t)( c + w_idx * stride_chunks ),
+            (uint64_t)szs[ i ],
             (uint16_t)( ctls[ s ] | FDT_CTL_SOM | FDT_CTL_EOM ),
-            (uint32_t)tspub, (uint32_t)tspub );
-        uint64_t p = ob[ FDT_STEM_O_PUBLISHED ];
-        if( (int64_t)p < sig_cap ) {
-          if( ob[ FDT_STEM_O_SIGS ] )
-            ( (uint64_t *)ob[ FDT_STEM_O_SIGS ] )[ p ] = sig;
-          if( ob[ FDT_STEM_O_TSORIGS ] )
-            ( (uint32_t *)ob[ FDT_STEM_O_TSORIGS ] )[ p ] =
-                (uint32_t)tspub;
-        }
-        ob[ FDT_STEM_O_SEQ ] = ob[ FDT_STEM_O_SEQ ] + 1UL;
-        ob[ FDT_STEM_O_PUBLISHED ] = p + 1UL;
-        ob[ FDT_STEM_O_BYTES ] += (uint64_t)szs[ i ];
+            (uint32_t)tspub, (uint32_t)tspub, sig_cap );
         sig++;
         published++;
         w_idx++;

@@ -964,21 +964,12 @@ int64_t fdt_pack_sched( uint64_t * a, uint64_t * outs, int64_t n_outs,
                                     o[ FDT_STEM_O_MTU ],
                                     o[ FDT_STEM_O_WMARK ] );
     uint64_t sig = ( (uint64_t)bank << 32 ) | ( handle & 0xFFFFFFFFUL );
-    fdt_mcache_publish( (void *)o[ FDT_STEM_O_MCACHE ],
-                        o[ FDT_STEM_O_SEQ ], sig, (uint32_t)c,
-                        (uint16_t)sz,
-                        (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
-                        (uint32_t)tspub, (uint32_t)tspub );
-    uint64_t p = o[ FDT_STEM_O_PUBLISHED ];
-    if( (int64_t)p < sig_cap ) {
-      if( o[ FDT_STEM_O_SIGS ] )
-        ( (uint64_t *)o[ FDT_STEM_O_SIGS ] )[ p ] = sig;
-      if( o[ FDT_STEM_O_TSORIGS ] )
-        ( (uint32_t *)o[ FDT_STEM_O_TSORIGS ] )[ p ] = (uint32_t)tspub;
-    }
-    o[ FDT_STEM_O_SEQ ] = o[ FDT_STEM_O_SEQ ] + 1UL;
-    o[ FDT_STEM_O_PUBLISHED ] = p + 1UL;
-    o[ FDT_STEM_O_BYTES ] += (uint64_t)sz;
+    /* the shared emit body (ring-publish order + sig scratch +
+       in-burst trace): encode wrote the payload in place above, so
+       the chunk-addressed variant publishes without a copy */
+    fdt_stem_out_emit_at( o, sig, (uint32_t)c, (uint64_t)sz,
+                          (uint16_t)( FDT_CTL_SOM | FDT_CTL_EOM ),
+                          (uint32_t)tspub, (uint32_t)tspub, sig_cap );
 
     bank_busy[ bank ]++;
     bank_ready[ bank ] = now_ns + mb_ns;

@@ -18,6 +18,7 @@
 #include "fdt_poh.h"
 #include "fdt_shred.h"
 #include "fdt_tango.h"
+#include "fdt_trace.h"
 
 #include <stdatomic.h>
 #include <string.h>
@@ -48,6 +49,8 @@
 /* elastic shard-map epoch watch (fdt_stem.h words 14/15) */
 #define C_EPOCH_PTR 14
 #define C_EPOCH_SEEN 15
+/* in-burst trace block ptr (fdt_stem.h word 240; fdt_trace.h layout) */
+#define C_TRACE FDT_STEM_C_TRACE
 
 #define IN0 16
 #define IN_STRIDE 12
@@ -112,6 +115,103 @@ static inline uint64_t * out_blk( stem_t * st, int64_t o ) {
   return st->w + OUT0 + o * OUT_STRIDE;
 }
 
+/* ---- in-burst tracing (ISSUE 15) ---------------------------------------
+ *
+ * The trace block (fdt_trace.h) rides cfg word C_TRACE and is consulted
+ * from the one publish body below via thread-local state armed for the
+ * duration of fdt_stem_run — so every handler and after-credit hook
+ * that publishes through fdt_stem_out_emit(_at) gets per-frag publish
+ * timestamps and PUBLISH span emission with NO signature change, and a
+ * direct (non-stem) emit call traces nothing.  One stem runs per tile
+ * thread, so thread-local is exactly per-tile. */
+
+/* initial-exec TLS: see fdt_trace.c's tcal note — the default model in
+   a dlopen'd .so pays a __tls_get_addr call per access on the per-frag
+   publish path */
+static _Thread_local __attribute__(( tls_model( "initial-exec" ) ))
+uint64_t * tls_trace = 0;
+static _Thread_local __attribute__(( tls_model( "initial-exec" ) ))
+uint64_t * tls_cfg = 0;
+
+static inline uint64_t trace_w0( uint64_t kind, uint64_t link,
+                                 uint32_t ts ) {
+  return ( ( kind & 0xFFUL ) << 56 ) | ( ( link & 0xFFUL ) << 48 ) |
+         (uint64_t)ts;
+}
+
+/* flush the buffered PUBLISH span rows to the ring (ordering contract:
+   the caller writes the batch's INGEST block first) */
+static void trace_flush_pub( uint64_t * tr ) {
+  uint64_t cnt = tr[ FDT_TRACE_W_PUBCNT ];
+  if( !cnt ) return;
+  uint64_t * ring = (uint64_t *)tr[ FDT_TRACE_W_RING ];
+  if( ring )
+    fdt_trace_span_block( ring, (uint64_t *)tr[ FDT_TRACE_W_PUBROWS ],
+                          (int64_t)cnt );
+  tr[ FDT_TRACE_W_PUBCNT ] = 0;
+}
+
+/* 1-in-N sig sampling: N is a power of two in practice (the default
+   TraceConfig sample is 64), where a mask beats the hardware div on
+   the per-publish path; arbitrary N falls back to the modulo */
+static inline int trace_sampled( uint64_t sig, uint64_t sample ) {
+  if( sample <= 1UL ) return 1;
+  if( ( sample & ( sample - 1UL ) ) == 0UL )
+    return ( sig & ( sample - 1UL ) ) == 0UL;
+  return sig % sample == 0UL;
+}
+
+static void trace_pub_span( uint64_t * tr, uint64_t link, uint64_t seq,
+                            uint64_t sig, uint32_t tsorig,
+                            uint32_t tspub ) {
+  uint64_t * rows = (uint64_t *)tr[ FDT_TRACE_W_PUBROWS ];
+  if( !rows ) return;
+  uint64_t cnt = tr[ FDT_TRACE_W_PUBCNT ];
+  if( cnt >= tr[ FDT_TRACE_W_PUBCAP ] ) {
+    trace_flush_pub( tr ); /* overflow: flush early, order best-effort */
+    cnt = 0;
+  }
+  uint64_t * r = rows + cnt * 4;
+  r[ 0 ] = trace_w0( FDT_TRACE_K_PUBLISH, link, tspub );
+  r[ 1 ] = seq;
+  r[ 2 ] = sig;
+  r[ 3 ] = (uint64_t)tsorig; /* Tracer.publish w3 with tsorigs given */
+  tr[ FDT_TRACE_W_PUBCNT ] = cnt + 1;
+}
+
+/* The one publish body every native path shares: release-ordered mcache
+   publish + sig/tsorig scratch + out-block bookkeeping, with the trace
+   hook applied when a stem armed it — a fresh per-frag compressed
+   publish timestamp (the burst-quantization fix: downstream qwait no
+   longer sees every frag of a burst stamped alike) and a buffered
+   PUBLISH span for sampled sigs. */
+static void stem_emit_common( uint64_t * o, uint64_t sig, uint32_t chunk,
+                              uint64_t sz, uint16_t ctl, uint32_t tsorig,
+                              uint32_t tspub, int64_t sig_cap ) {
+  uint64_t * tr = tls_trace;
+  if( tr ) tspub = fdt_trace_read_clock( tr );
+  /* fdtlint: allow[stem-emit-only] THE sanctioned publish body */
+  fdt_mcache_publish( (void *)o[ O_MCACHE ], o[ O_SEQ ], sig, chunk,
+                      (uint16_t)sz, ctl, tsorig, tspub );
+  uint64_t p = o[ O_PUBLISHED ];
+  if( (int64_t)p < sig_cap ) {
+    if( o[ O_SIGS ] ) ( (uint64_t *)o[ O_SIGS ] )[ p ] = sig;
+    if( o[ O_TSORIGS ] ) ( (uint32_t *)o[ O_TSORIGS ] )[ p ] = tsorig;
+  }
+  if( tr && tr[ FDT_TRACE_W_RING ] &&
+      trace_sampled( sig, tr[ FDT_TRACE_W_SAMPLE ] ) ) {
+    int64_t oi =
+        ( o - ( tls_cfg + FDT_STEM_OUT0 ) ) / FDT_STEM_OUT_STRIDE;
+    uint64_t link = ( oi >= 0 && oi < FDT_STEM_MAX_OUTS )
+                        ? tr[ FDT_TRACE_OUT0 + oi ]
+                        : 0UL;
+    trace_pub_span( tr, link, o[ O_SEQ ], sig, tsorig, tspub );
+  }
+  o[ O_SEQ ] = o[ O_SEQ ] + 1UL;
+  o[ O_PUBLISHED ] = p + 1UL;
+  o[ O_BYTES ] += sz;
+}
+
 /* Publish one frag on an out block: payload (if any) goes into the out
    dcache at the shared chunk cursor first (the ring-publish-order rule:
    bytes before metadata), then the release-ordered mcache publish — the
@@ -130,16 +230,19 @@ void fdt_stem_out_emit( uint64_t * o, uint64_t sig,
     chunk = (uint32_t)c;
     *cur = fdt_dcache_compact_next( c, sz, o[ O_MTU ], o[ O_WMARK ] );
   }
-  fdt_mcache_publish( (void *)o[ O_MCACHE ], o[ O_SEQ ], sig, chunk,
-                      (uint16_t)sz, ctl, tsorig, tspub );
-  uint64_t p = o[ O_PUBLISHED ];
-  if( (int64_t)p < sig_cap ) {
-    if( o[ O_SIGS ] ) ( (uint64_t *)o[ O_SIGS ] )[ p ] = sig;
-    if( o[ O_TSORIGS ] ) ( (uint32_t *)o[ O_TSORIGS ] )[ p ] = tsorig;
-  }
-  o[ O_SEQ ] = o[ O_SEQ ] + 1UL;
-  o[ O_PUBLISHED ] = p + 1UL;
-  o[ O_BYTES ] += sz;
+  stem_emit_common( o, sig, chunk, sz, ctl, tsorig, tspub, sig_cap );
+}
+
+/* Publish a frag whose payload the caller ALREADY placed in the out
+   dcache (fdt_net_rx's recvmmsg-into-dcache rows, fdt_pack_sched's
+   encode-in-place) — same metadata/trace body, no copy.  Every native
+   publish routes through one of these two entry points (the fdtlint
+   `stem-emit-only` rule), so per-frag tspub stamping and span
+   propagation cannot be bypassed. */
+void fdt_stem_out_emit_at( uint64_t * o, uint64_t sig, uint32_t chunk,
+                           uint64_t sz, uint16_t ctl, uint32_t tsorig,
+                           uint32_t tspub, int64_t sig_cap ) {
+  stem_emit_common( o, sig, chunk, sz, ctl, tsorig, tspub, sig_cap );
 }
 
 /* cr_avail for one out block against its slowest reliable consumer —
@@ -723,6 +826,65 @@ static int64_t h_net( stem_t * st, int64_t ii, fdt_frag_t const * f,
 
 /* ==== the burst loop ==================================================== */
 
+/* Apply the in-burst trace for one handled run: per-frag qwait/e2e
+   hist samples against the DRAIN-TIME stamps (captured before the
+   handler ran — the per-frag clock reads that remove the burst
+   quantization), the batch's INGEST span block, then the publish spans
+   the handler buffered (the Python loop's ring order: ingest before
+   that batch's publishes), one batch svc sample and the batch_sz
+   sample — everything the Python loop records per drained batch,
+   recorded here per handled run with identical bucketing. */
+static void stem_trace_apply( uint64_t * tr, int64_t ii,
+                              fdt_frag_t const * f,
+                              uint32_t const * tsbuf, int64_t handled ) {
+  uint64_t const * ib = tr + FDT_TRACE_IN0 + ii * FDT_TRACE_IN_STRIDE;
+  uint64_t * hq = (uint64_t *)ib[ FDT_TRACE_I_QWAIT ];
+  uint64_t * he = (uint64_t *)ib[ FDT_TRACE_I_E2E ];
+  int64_t qnb = (int64_t)ib[ FDT_TRACE_I_QWAIT_NB ];
+  int64_t enb = (int64_t)ib[ FDT_TRACE_I_E2E_NB ];
+  for( int64_t j = 0; j < handled; j++ ) {
+    if( hq ) {
+      int64_t d = fdt_trace_ts_diff( tsbuf[ j ], f[ j ].tspub );
+      fdt_trace_hist_sample( hq, qnb, d > 0 ? d : 0 );
+    }
+    if( he ) {
+      int64_t d = fdt_trace_ts_diff( tsbuf[ j ], f[ j ].tsorig );
+      fdt_trace_hist_sample( he, enb, d > 0 ? d : 0 );
+    }
+  }
+  uint64_t * ring = (uint64_t *)tr[ FDT_TRACE_W_RING ];
+  if( ring ) {
+    uint64_t sample = tr[ FDT_TRACE_W_SAMPLE ];
+    uint64_t link = ib[ FDT_TRACE_I_LINK ];
+    uint64_t * rows = (uint64_t *)tr[ FDT_TRACE_W_INROWS ];
+    int64_t m = 0;
+    for( int64_t j = 0; j < handled; j++ ) {
+      uint64_t sig = f[ j ].sig;
+      if( !trace_sampled( sig, sample ) ) continue;
+      uint64_t * r = rows + m * 4;
+      r[ 0 ] = trace_w0( FDT_TRACE_K_INGEST, link, tsbuf[ j ] );
+      r[ 1 ] = f[ j ].seq;
+      r[ 2 ] = sig;
+      r[ 3 ] = ( (uint64_t)f[ j ].tsorig << 32 ) |
+               (uint64_t)f[ j ].tspub;
+      m++;
+    }
+    if( m ) fdt_trace_span_block( ring, rows, m );
+  }
+  trace_flush_pub( tr );
+  uint64_t * hs = (uint64_t *)ib[ FDT_TRACE_I_SVC ];
+  if( hs && handled > 0 ) {
+    int64_t d =
+        fdt_trace_ts_diff( fdt_trace_read_clock( tr ), tsbuf[ 0 ] );
+    fdt_trace_hist_sample( hs, (int64_t)ib[ FDT_TRACE_I_SVC_NB ],
+                           d > 0 ? d : 0 );
+  }
+  uint64_t * hb = (uint64_t *)tr[ FDT_TRACE_W_BATCH ];
+  if( hb )
+    fdt_trace_hist_sample( hb, (int64_t)tr[ FDT_TRACE_W_BATCH_NB ],
+                           handled );
+}
+
 /* min over outs of cr_avail against the slowest reliable consumer —
    re-read from the live fseqs at every call site (per sweep AND before
    the after-credit hook), never carried across a boundary */
@@ -785,6 +947,15 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
     }
   }
 
+  /* arm the in-burst trace (fdt_trace.h) for this call: every publish
+     through stem_emit_common and every handled run below records its
+     own per-frag timestamps while the burst runs */
+  uint64_t * tr = (uint64_t *)cfg[ C_TRACE ];
+  if( tr && tr[ FDT_TRACE_W_MAGIC ] != FDT_TRACE_MAGIC ) tr = 0;
+  tls_cfg = cfg;
+  tls_trace = tr;
+  if( tr ) tr[ FDT_TRACE_W_PUBCNT ] = 0;
+
   for( ;; ) {
     int progressed = 0;
     int pending_blocked = 0;
@@ -834,6 +1005,24 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
         in[ I_SEQ ] = seq; /* overrun resync may have advanced it */
         continue;
       }
+      /* drain-time consume stamps: captured BEFORE the handler runs
+         (queue-wait ends when the frag is picked up, not when the
+         burst returns to Python) — applied below only for the handled
+         prefix, so a handed-back frag is stamped by whichever loop
+         actually consumes it.  One clock read per drained RUN: the
+         batched fdt_mcache_drain picks the whole run up at one
+         instant, so its frags genuinely share a pickup time (the
+         Python loop's per-batch t_cons, bit-for-bit) — the burst-
+         quantization this removes is the POST-handler application
+         across many runs, not intra-run spread.  Publish stamps
+         (stem_emit_common) stay truly per frag: emissions spread
+         across the handler's work. */
+      uint32_t * tsbuf = 0;
+      if( tr ) {
+        tsbuf = (uint32_t *)tr[ FDT_TRACE_W_TS ];
+        uint32_t t_run = fdt_trace_read_clock( tr );
+        for( int64_t j = 0; j < n; j++ ) tsbuf[ j ] = t_run;
+      }
       int64_t handled;
       switch( st.handler ) {
       case FDT_STEM_H_DEDUP:
@@ -855,8 +1044,12 @@ int64_t fdt_stem_run( uint64_t * cfg, int64_t max_frags ) {
         handled = h_net( &st, i, buf, n );
         break;
       default:
+        tls_trace = 0;
+        tls_cfg = 0;
         return -1;
       }
+      if( tr && handled > 0 )
+        stem_trace_apply( tr, i, buf, tsbuf, handled );
       uint64_t bytes = 0;
       for( int64_t j = 0; j < handled; j++ ) bytes += buf[ j ].sz;
       in[ I_BYTES ] += bytes;
@@ -945,6 +1138,11 @@ done:
       break;
     }
   }
+  /* the after-credit hook's publish spans were buffered — flush them
+     before control returns to Python (the hook is the batch here) */
+  if( tr ) trace_flush_pub( tr );
+  tls_trace = 0;
+  tls_cfg = 0;
   cfg[ C_STATUS ] = status;
   cfg[ C_STATUS_IN ] = status_in;
   return total;

@@ -171,6 +171,11 @@
  *         never handle a frag under a stale membership view.
  * word 15 elastic epoch seen: the epoch the host last configured the
  *         handler state against (updated by Python after on_epoch)
+ * word 240 in-burst trace block ptr (0 = untraced; fdt_trace.h layout,
+ *         armed by tango/rings.py Stem.arm_trace): per-frag drain/
+ *         publish timestamps, native qwait/svc/e2e hist updates, and
+ *         native span emission for the duration of each fdt_stem_run
+ *         call (ISSUE 15)
  *
  * per-in block i at word 16 + 12*i:
  *   +0 mcache ptr          +1 dcache base ptr (0 = none)
@@ -199,6 +204,9 @@
 
 #define FDT_STEM_CFG_WORDS 256
 
+/* cfg word 240: the in-burst trace block pointer (fdt_trace.h) */
+#define FDT_STEM_C_TRACE 240
+
 /* Layout self-description so the Python side can assert against drift. */
 uint64_t fdt_stem_cfg_words( void );
 
@@ -222,6 +230,16 @@ void fdt_stem_out_emit( uint64_t * ob, uint64_t sig,
                         uint8_t const * payload, uint64_t sz,
                         uint16_t ctl, uint32_t tsorig, uint32_t tspub,
                         int64_t sig_cap );
+
+/* Publish a frag whose payload the caller already placed in the out
+   dcache at `chunk` (recvmmsg-into-dcache rows, encode-in-place) —
+   the same metadata/trace body as fdt_stem_out_emit without the copy.
+   These two are the ONLY sanctioned native publish entry points (the
+   fdtlint `stem-emit-only` rule): publishing around them would bypass
+   per-frag tspub stamping and span propagation (ISSUE 15). */
+void fdt_stem_out_emit_at( uint64_t * ob, uint64_t sig, uint32_t chunk,
+                           uint64_t sz, uint16_t ctl, uint32_t tsorig,
+                           uint32_t tspub, int64_t sig_cap );
 
 /* Run the stem until a burst boundary: consume up to max_frags frags
    across the native-handled in-links, dispatching each drained run to

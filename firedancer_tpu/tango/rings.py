@@ -64,6 +64,7 @@ def _load() -> ct.CDLL:
             _HERE / "native" / "fdt_poh.c",
             _HERE / "native" / "fdt_shred.c",
             _HERE / "native" / "fdt_net.c",
+            _HERE / "native" / "fdt_trace.c",
         ],
     )
     lib = ct.CDLL(str(so))
@@ -226,6 +227,22 @@ def _load() -> ct.CDLL:
         "fdt_stem_out_cr": (ct.c_int64, [vp]),
         "fdt_stem_out_emit": (
             None, [vp, u64, vp, u64, u16, u32, u32, ct.c_int64],
+        ),
+        "fdt_stem_out_emit_at": (
+            None, [vp, u64, u32, u64, u16, u32, u32, ct.c_int64],
+        ),
+        # in-burst tracing (ISSUE 15): per-frag compressed timestamps,
+        # native log2-hist updates, and native span emission — the
+        # trace block rides stem cfg word 240 (fdt_trace.h); the direct
+        # bindings exist for differential tests and ABI coverage
+        "fdt_trace_words": (u64, []),
+        "fdt_trace_now": (u32, []),
+        "fdt_trace_read_clock": (u32, [vp]),
+        "fdt_trace_ts_diff": (ct.c_int64, [u32, u32]),
+        "fdt_trace_hist_sample": (None, [vp, ct.c_int64, ct.c_int64]),
+        "fdt_trace_span_block": (None, [vp, vp, ct.c_int64]),
+        "fdt_trace_span": (
+            None, [vp, u64, u64, u64, u64, u64, u64, u64],
         ),
     }
     _bind(lib, sigs)
@@ -1150,6 +1167,67 @@ _SO0, _SO_STRIDE = 112, 16
 (_SO_MCACHE, _SO_DCACHE, _SO_CHUNKP, _SO_MTU, _SO_WMARK, _SO_DEPTH,
  _SO_NFSEQ, _SO_FSEQ0) = range(8)
 _SO_SEQ, _SO_PUBLISHED, _SO_BYTES, _SO_SIGS, _SO_TSORIGS = 11, 12, 13, 14, 15
+#: in-burst trace block pointer (fdt_stem.h FDT_STEM_C_TRACE)
+_SC_TRACE = 240
+
+# ---------------------------------------------------------------------------
+# in-burst trace block (fdt_trace.h) — word indices mirrored from C
+
+_TR_MAGIC = 0xF17EDA2CE57E0002
+_TR_WORDS = 128
+(_TR_W_MAGIC, _TR_W_RING, _TR_W_SAMPLE, _TR_W_CLOCK, _TR_W_PUBROWS,
+ _TR_W_PUBCAP, _TR_W_PUBCNT, _TR_W_TS, _TR_W_BATCH, _TR_W_BATCH_NB,
+ _TR_W_INROWS) = range(11)
+_TR_IN0, _TR_IN_STRIDE = 16, 8
+(_TR_I_LINK, _TR_I_QWAIT, _TR_I_QWAIT_NB, _TR_I_E2E, _TR_I_E2E_NB,
+ _TR_I_SVC, _TR_I_SVC_NB) = range(7)
+_TR_OUT0 = 80
+
+
+def trace_now() -> int:
+    """One compressed µs timestamp from the NATIVE clock
+    (fdt_trace.c fdt_trace_now) — the same CLOCK_MONOTONIC µs-mod-2^32
+    domain as disco.mux.now_ts, so native and Python stamps interleave
+    on one clock."""
+    return int(_lib.fdt_trace_now())
+
+
+def trace_ts_diff(a: int, b: int) -> int:
+    """The C restatement of disco.mux.ts_diff (wrap-safe signed µs
+    distance on the u32 ring) — exported for the differential
+    wrap-boundary test."""
+    return int(_lib.fdt_trace_ts_diff(a & 0xFFFFFFFF, b & 0xFFFFFFFF))
+
+
+def trace_hist_sample(hist_addr: int, nb: int, value: int) -> None:
+    """One native log2-hist sample with Metrics.hist_sample's exact
+    bucketing, written at `hist_addr` (a hist's first bucket word, e.g.
+    disco.metrics.Metrics.hist_ref)."""
+    _lib.fdt_trace_hist_sample(hist_addr, nb, int(value))
+
+
+def trace_span(ring_words: np.ndarray, kind: int, link: int = 0,
+               aux16: int = 0, ts: int = 0, seq: int = 0, sig: int = 0,
+               aux64: int = 0) -> None:
+    """One native span event into a SpanRing's u64 words —
+    byte-compatible with disco.trace.Tracer.point."""
+    _lib.fdt_trace_span(
+        _ptr(ring_words), kind, link, aux16, ts & 0xFFFFFFFF,
+        seq & (2**64 - 1), sig & (2**64 - 1), aux64 & (2**64 - 1),
+    )
+
+
+def trace_span_block(ring_words: np.ndarray, rows: np.ndarray) -> None:
+    """Append a (k, 4) u64 event block natively — SpanRing.write_block's
+    reserve→store→commit discipline from C."""
+    rows = np.ascontiguousarray(rows, np.uint64)
+    _lib.fdt_trace_span_block(_ptr(ring_words), rows.ctypes.data, len(rows))
+
+
+def trace_read_clock(block: np.ndarray) -> int:
+    """Read an armed trace block's clock (injected (value, step) pair
+    when configured, the native monotonic clock otherwise)."""
+    return int(_lib.fdt_trace_read_clock(_ptr(block)))
 
 
 class StemSpec:
@@ -1300,6 +1378,76 @@ class Stem:
 
     def set_epoch_seen(self, epoch: int) -> None:
         self._w[_SC_EPOCH_SEEN] = np.uint64(epoch)
+
+    #: True once arm_trace wired the in-burst trace block — the run
+    #: loop then skips its burst-boundary hist/span application
+    #: (_stem_apply slims to counters + faultinj)
+    trace_armed = False
+
+    def arm_trace(
+        self,
+        *,
+        ring_addr: int = 0,
+        sample: int = 1,
+        in_rows=(),
+        out_links=(),
+        batch_hist: tuple[int, int] | None = None,
+        clock: np.ndarray | None = None,
+        keepalive: tuple = (),
+    ) -> None:
+        """Arm the in-burst trace block (tango/native/fdt_trace.h) on
+        this stem: per-frag compressed timestamps at drain and publish
+        time, native qwait/svc/e2e (+batch_sz) hist updates straight
+        into the tile's shared metrics words, and native span emission
+        byte-compatible with disco/trace.py's SpanRing.
+
+        ring_addr: the SpanRing's u64 words base address (0 = span
+        emission off); sample: the tracer's 1-in-N sig sampling.
+        in_rows: per in-link (link_id, qwait, e2e, svc) where each hist
+        is (first-bucket-word address, bucket count) or None (hand-built
+        ctxs without link hists record nothing for that link).
+        batch_hist: the tile's batch_sz hist ref.  clock: a u64[2]
+        (value, step) injected-clock array for the deterministic parity
+        harness — None reads CLOCK_MONOTONIC.  Everything addressed
+        must stay alive; pass owners via keepalive."""
+        assert int(_lib.fdt_trace_words()) == _TR_WORDS
+        t = self._trace_block = np.zeros(_TR_WORDS, np.uint64)
+        # publish spans can exceed one row per consumed frag (bank
+        # publishes completion + poh per microblock), so size the
+        # buffer at 2x cap; overflow flushes early rather than drops
+        self._trace_pub = np.zeros((2 * self.cap + 64, 4), np.uint64)
+        self._trace_in_rows = np.zeros((self.cap, 4), np.uint64)
+        self._trace_ts = np.zeros(self.cap, np.uint32)
+        self._trace_keep = tuple(keepalive)
+        t[_TR_W_MAGIC] = _TR_MAGIC
+        t[_TR_W_RING] = ring_addr
+        t[_TR_W_SAMPLE] = max(int(sample), 1)
+        if clock is not None:
+            clock = np.ascontiguousarray(clock, np.uint64)
+            assert len(clock) >= 2, "injected clock is (value, step)"
+            self._trace_clock = clock
+            t[_TR_W_CLOCK] = clock.ctypes.data
+        t[_TR_W_PUBROWS] = self._trace_pub.ctypes.data
+        t[_TR_W_PUBCAP] = len(self._trace_pub)
+        t[_TR_W_TS] = self._trace_ts.ctypes.data
+        t[_TR_W_INROWS] = self._trace_in_rows.ctypes.data
+        if batch_hist is not None:
+            t[_TR_W_BATCH] = batch_hist[0]
+            t[_TR_W_BATCH_NB] = batch_hist[1]
+        for i, row in enumerate(in_rows[: len(self.ins)]):
+            b = _TR_IN0 + i * _TR_IN_STRIDE
+            link_id, hq, he, hs = row
+            t[b + _TR_I_LINK] = link_id
+            if hq is not None:
+                t[b + _TR_I_QWAIT], t[b + _TR_I_QWAIT_NB] = hq
+            if he is not None:
+                t[b + _TR_I_E2E], t[b + _TR_I_E2E_NB] = he
+            if hs is not None:
+                t[b + _TR_I_SVC], t[b + _TR_I_SVC_NB] = hs
+        for o, lid in enumerate(list(out_links)[: len(self.outs)]):
+            t[_TR_OUT0 + o] = lid
+        self._w[_SC_TRACE] = t.ctypes.data
+        self.trace_armed = True
 
     def run(self, budget: int, tspub: int) -> tuple[int, int, int]:
         """One GIL-released burst: up to `budget` frags drained,

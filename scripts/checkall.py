@@ -1,13 +1,15 @@
 #!/usr/bin/env python3
 """checkall — the one-shot local gate: fdtlint + bounded fdtmc + a
-process-runtime smoke + a seeded hostile-ingress smoke + the tier-1
-pytest suite, aggregated into one exit code.
+process-runtime smoke + the native-trace parity gate + a seeded
+hostile-ingress smoke + an elastic reconfig smoke + the tier-1 pytest
+suite, aggregated into one exit code.
 
 Usage:
-    scripts/checkall.py                 # all five stages
+    scripts/checkall.py                 # all stages
     scripts/checkall.py --json          # machine-readable summary
     scripts/checkall.py --skip mc       # skip stages
-                                        # (lint,mc,proc,adversary,pytest)
+                                        # (lint,mc,proc,trace,
+                                        #  adversary,elastic,pytest)
     scripts/checkall.py --mc-budget 200 # bound the model checker
     scripts/checkall.py --pytest-timeout 1200
 
@@ -199,6 +201,35 @@ def _stage_elastic(timeout_s: float, seed: int) -> dict:
     return stage
 
 
+def _stage_trace(timeout_s: float) -> dict:
+    """Native-trace parity gate (ISSUE 15): the differential tests in
+    tests/test_fdttrace_native.py assert the native in-burst emitter's
+    qwait/svc/e2e hist contents and drained span streams are
+    BIT-IDENTICAL to the Python loop's on the same frag stream (both
+    stem modes run inside the test: the Python reference drives one
+    side, the armed stem the other), plus the C-side u32 wrap math and
+    concurrent native-writer/Python-reader ring drains."""
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rc, out = _run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_fdttrace_native.py", "-q", "-m", "not slow",
+            "-p", "no:cacheprovider",
+        ],
+        timeout_s, env=env,
+    )
+    stage = {"rc": rc, "seconds": round(time.perf_counter() - t0, 2)}
+    for line in reversed(out.splitlines()):
+        if "passed" in line or "failed" in line or "error" in line:
+            stage["summary"] = line.strip().strip("= ")
+            break
+    if rc != 0:
+        stage["tail"] = out[-2000:]
+    return stage
+
+
 def _stage_pytest(timeout_s: float, extra: list[str]) -> dict:
     t0 = time.perf_counter()
     env = dict(os.environ)
@@ -229,11 +260,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
                     help="comma list of stages to skip: "
-                         "lint,mc,proc,adversary,elastic,pytest")
+                         "lint,mc,proc,trace,adversary,elastic,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
     ap.add_argument("--proc-timeout", type=float, default=600.0)
+    ap.add_argument("--trace-timeout", type=float, default=300.0)
     ap.add_argument("--adversary-timeout", type=float, default=300.0)
     ap.add_argument("--adversary-seed", type=int, default=7,
                     help="fixed seed for the hostile-ingress smoke "
@@ -246,7 +278,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="extra args appended to the pytest command")
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
-    bad = skip - {"lint", "mc", "proc", "adversary", "elastic", "pytest"}
+    bad = skip - {
+        "lint", "mc", "proc", "trace", "adversary", "elastic", "pytest"
+    }
     if bad:
         print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
         return 2
@@ -269,6 +303,12 @@ def main(argv: list[str] | None = None) -> int:
             print(f"checkall proc: rc={stages['proc']['rc']} "
                   f"({stages['proc'].get('landed', '?')} landed, "
                   f"{stages['proc']['seconds']}s)", flush=True)
+    if "trace" not in skip:
+        stages["trace"] = _stage_trace(args.trace_timeout)
+        if not args.json:
+            print(f"checkall trace: rc={stages['trace']['rc']} "
+                  f"({stages['trace'].get('summary', '')}, "
+                  f"{stages['trace']['seconds']}s)", flush=True)
     if "adversary" not in skip:
         stages["adversary"] = _stage_adversary(
             args.adversary_timeout, args.adversary_seed

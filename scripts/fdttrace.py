@@ -81,7 +81,11 @@ class TraceSession:
         tile_links = {}
         for name, t in tiles.items():
             schema = MetricsSchema(
-                counters=tuple(t["counters"]), hists=tuple(t["hists"])
+                counters=tuple(t["counters"]), hists=tuple(t["hists"]),
+                # layout-affecting: the per-link latency hists are wide
+                # (ISSUE 15) — dropping this field misreads every hist
+                # after the first wide one
+                wide_hists=tuple(t.get("wide_hists", ())),
             )
             metrics[name] = Metrics(wksp.view(t["metrics"]), schema)
             tile_links[name] = {

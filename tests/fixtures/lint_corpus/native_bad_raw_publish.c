@@ -1,0 +1,28 @@
+/* known-bad fixture for the stem-emit-only rule (ISSUE 15): a native
+ * frag handler publishing with a raw fdt_mcache_publish instead of the
+ * stem's shared emit bodies — the published frags carry the
+ * burst-boundary tspub and emit no PUBLISH span, so the latency
+ * attribution and trace assembly never see them.  The second site shows
+ * the batch variant is caught too; the third shows the pragma escape. */
+
+#include <stdint.h>
+
+void fdt_mcache_publish( void * mc, uint64_t seq, uint64_t sig,
+                         uint32_t chunk, uint16_t sz, uint16_t ctl,
+                         uint32_t tsorig, uint32_t tspub );
+void fdt_mcache_publish_batch( void * mc, uint64_t seq );
+
+/* a handler that bypasses the emit body: BAD (two findings) */
+int64_t h_bad_handler( uint64_t * o, uint64_t sig ) {
+  /* comments mentioning fdt_mcache_publish( are not call sites */
+  fdt_mcache_publish( (void *)o[ 0 ], o[ 11 ], sig, 0, 0, 3, 7, 7 );
+  fdt_mcache_publish_batch( (void *)o[ 0 ], o[ 11 ] );
+  return 0;
+}
+
+/* a deliberate exemption must carry the pragma: CLEAN */
+int64_t h_pragma_ok( uint64_t * o, uint64_t sig ) {
+  /* fdtlint: allow[stem-emit-only] fixture-sanctioned call site */
+  fdt_mcache_publish( (void *)o[ 0 ], o[ 11 ], sig, 0, 0, 3, 7, 7 );
+  return 0;
+}

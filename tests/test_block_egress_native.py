@@ -629,11 +629,15 @@ def test_egress_zero_python_steady_state():
     bank→poh→shred chain, a steady window advances stem_frags/entries
     with ZERO Python per frag and per after-credit on poh AND shred
     (run_loop skips tile.after_credit when the hook scheduled
-    natively)."""
+    natively).  Tracing is ON (ISSUE 15): the in-burst native emitter
+    records per-frag hists and spans WITHOUT re-introducing any
+    per-frag Python — py_frags must stay zero with the full
+    observability substrate live."""
     from firedancer_tpu.tiles.bank import BankTile
 
     payloads, funk = _transfer_mbs(96)
     topo = Topology()
+    topo.enable_trace(sample=4)
     topo.link("fb", depth=256, mtu=65_535)
     topo.link("bp", depth=256)
     topo.link("bpoh", depth=256, mtu=65_535)
@@ -691,6 +695,12 @@ def test_egress_zero_python_steady_state():
         # full coverage: every frag poh and shred consumed rode the stem
         assert after_p["py_frags"] == 0
         assert after_s["py_frags"] == 0
+        # ...while the native emitter measured every one of them: the
+        # qwait samples can only have come from the in-burst C path
+        hq = mpoh.hist("qwait_us_bpoh")
+        assert hq["count"] == after_p["in_frags"], hq
+        evs, _, _ = topo._tracers["poh"].ring.read(0)
+        assert len(evs) > 0, "native span emission produced nothing"
     finally:
         topo.halt()
         topo.close()

@@ -174,9 +174,11 @@ def test_slo_queue_wait_objective():
     sts = {s.name: s for s in eng.evaluate(now=1.0)}
     st = sts["queue_wait_p99_us"]
     assert st.burn_fast >= 1.0 and st.breached
-    # an unobservable ceiling is rejected loudly
+    # an unobservable ceiling is rejected loudly (the bound moved to
+    # the wide-hist domain end with ISSUE 15's link-hist widening)
+    SloConfig(queue_wait_p99_us=float(1 << 20)).validate()  # now fine
     with pytest.raises(ValueError, match="unobservable"):
-        SloConfig(queue_wait_p99_us=float(1 << 20)).validate()
+        SloConfig(queue_wait_p99_us=float(1 << 25)).validate()
 
 
 def test_stem_epoch_handback_unit():

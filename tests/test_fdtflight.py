@@ -17,6 +17,7 @@ Everything runs on the strict host verify path (device="off"), JAX-free.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -563,4 +564,78 @@ def test_monitor_once_json_and_slo_rows(capsys):
         assert M.main(["no_such_wksp_x", "--once", "--json"]) == 2
     finally:
         topo.halt()
+        topo.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellite: every bundle snapshots the live elastic epoch and
+# shed level in per-tile state — reconfig/shed context in every
+# postmortem without correlating external logs
+
+
+def test_bundle_carries_elastic_epoch_and_shed_context(tmp_path):
+    from firedancer_tpu.tiles.verify import VerifyTile
+    from firedancer_tpu.waltz.admission import (
+        SHED_FOOTPRINT,
+        SHED_W_COMMANDED,
+        SHED_W_LEVEL,
+        SHED_W_TRANSITIONS,
+    )
+
+    rows, szs, _ = make_txn_pool(8, seed=3)
+    topo = Topology(name=f"flt_el_{os.getpid()}")
+    topo.enable_flight(depth=8)
+    topo.link("synth_verify", depth=64, mtu=wire.LINK_MTU)
+    for i in range(2):
+        topo.link(f"verify{i}_sink", depth=64, mtu=wire.LINK_MTU)
+    topo.tile(SynthTile(rows, szs, total=8), outs=["synth_verify"])
+    for i in range(2):
+        topo.tile(
+            VerifyTile(
+                msg_width=256, max_lanes=32, pre_dedup=False,
+                device="off", name=f"verify{i}",
+            ),
+            ins=[("synth_verify", True)], outs=[f"verify{i}_sink"],
+        )
+    topo.tile(
+        SinkTile(shm_log=64),
+        ins=[(f"verify{i}_sink", True) for i in range(2)],
+    )
+    topo.declare_shards(
+        "verify", ["verify0", "verify1"], producer="synth",
+        producer_link="synth_verify", active=1,
+    )
+    topo.build()
+    try:
+        # a live shed region with a commanded floor + tile-side level
+        shed = topo.wksp.alloc("shared_shed", SHED_FOOTPRINT)
+        w = shed[: (len(shed) // 8) * 8].view(np.uint64)
+        w[SHED_W_COMMANDED] = 2
+        w[SHED_W_LEVEL] = 1
+        w[SHED_W_TRANSITIONS] = 3
+        rec = FlightRecorder(topo, str(tmp_path))
+        bundle = rec._build_bundle("manual", None, {}, 0)
+        # topology-level context
+        assert bundle["elastic"]["verify"]["epoch"] == 1
+        assert bundle["elastic"]["verify"]["active_mask"] == 1
+        assert bundle["shed"] == {
+            "commanded": 2, "live_level": 1, "transitions": 3,
+        }
+        # per-tile state: members carry their kind/epoch/active view,
+        # the producer its role, and the shed floor rides every tile
+        # that has shed state
+        v0 = bundle["tiles"]["verify0"]["elastic"]
+        v1 = bundle["tiles"]["verify1"]["elastic"]
+        assert v0 == {"kind": "verify", "epoch": 1, "active": True,
+                      "member_idx": 0}
+        assert v1["active"] is False and v1["member_idx"] == 1
+        assert bundle["tiles"]["synth"]["elastic"]["role"] == "producer"
+        for t in bundle["tiles"].values():
+            assert t["shed"]["commanded"] == 2
+        # a membership flip is visible in the NEXT bundle
+        topo._shardmap.flip(topo._shard_groups["verify"]["slot"], 0b11)
+        bundle2 = rec._build_bundle("manual", None, {}, 1)
+        assert bundle2["elastic"]["verify"]["epoch"] == 2
+        assert bundle2["tiles"]["verify1"]["elastic"]["active"] is True
+    finally:
         topo.close()

@@ -104,9 +104,11 @@ def test_abi_coverage_is_substantive(repo_report):
     # fdt_stem exports (cfg_words / run / bank_pipeline, ISSUE 10) + the
     # fdt_pack_sched after-credit scheduler (ISSUE 11) + the 14
     # block-egress exports (4 fdt_sha256_*, 2 fdt_poh_*, 3
-    # fdt_shred_*, 3 fdt_net_*, 2 fdt_stem_out_* — ISSUE 12)
-    assert len(cov["table_symbols"]) >= 78, cov["table_symbols"]
-    assert cov["call_sites"] >= 50  # rings.py methods + the direct binders
+    # fdt_shred_*, 3 fdt_net_*, 2 fdt_stem_out_* — ISSUE 12) + the 8
+    # in-burst trace exports (7 fdt_trace_* + fdt_stem_out_emit_at —
+    # ISSUE 15)
+    assert len(cov["table_symbols"]) >= 86, cov["table_symbols"]
+    assert cov["call_sites"] >= 58  # rings.py methods + the direct binders
     # the native exported surface and the ctypes tables are in bijection:
     # no unbound exports, no phantom bindings
     assert set(cov["c_symbols"]) == set(cov["table_symbols"])
@@ -253,6 +255,37 @@ def test_stem_handler_fixture_controls_are_clean():
         i for i, ln in enumerate(src, 1) if "DescriptorOnly" in ln
     )
     assert all(ln < eager_end for ln in bad_lines), sorted(bad_lines)
+
+
+def test_stem_emit_only_fixture_flags_raw_publishes():
+    """ISSUE 15 satellite: the C-side stem-emit-only rule flags raw
+    fdt_mcache_publish(_batch) calls in native handler sources — those
+    bypass per-frag tspub stamping and native span emission — naming
+    the enclosing function; pragma'd sites and comment mentions are
+    clean."""
+    rep = engine.run_paths([CORPUS / "native_bad_raw_publish.c"])
+    hits = [f for f in rep.findings if f.rule == "stem-emit-only"]
+    assert len(hits) == 2, [str(f) for f in rep.findings]
+    assert all("h_bad_handler" in f.msg for f in hits)
+    assert not any("h_pragma_ok" in f.msg for f in hits)
+
+
+def test_stem_emit_only_repo_surface_is_covered(repo_report):
+    """Every tango/native .c joins the scan (fdt_tango.c is listed but
+    exempt inside the checker), and the live sources are clean — every
+    native publish routes through the stem emit bodies."""
+    cov = repo_report.coverage
+    native = set(cov.get("native_c_files", ()))
+    for must in (
+        "firedancer_tpu/tango/native/fdt_stem.c",
+        "firedancer_tpu/tango/native/fdt_net.c",
+        "firedancer_tpu/tango/native/fdt_pack.c",
+        "firedancer_tpu/tango/native/fdt_trace.c",
+    ):
+        assert must in native, native
+    assert not [
+        f for f in repo_report.findings if f.rule == "stem-emit-only"
+    ]
 
 
 def test_good_fixtures_scan_clean():

@@ -41,6 +41,10 @@ NATIVE_SURFACE = [
     # the fdt_stem burst loop + fused bank pipeline (ISSUE 10): the
     # parity/fault/backpressure tests drive every stem code path
     "tests/test_fdt_stem.py",
+    # the in-burst trace emitter (ISSUE 15): fdt_trace clock/hist/span
+    # writers + the traced stem emit path, incl. the concurrent
+    # native-writer ring drain
+    "tests/test_fdttrace_native.py",
     # the block-egress natives (ISSUE 12): fdt_sha256 / fdt_poh /
     # fdt_shred / fdt_net handlers + hooks, incl. the SIGKILL harness
     "tests/test_block_egress_native.py",
