@@ -91,6 +91,17 @@ over the tile layer (tiles/, disco/):
                        INSIDE the wire-edge hooks, so they take `now`
                        from the caller's tickcount domain rather than
                        reading any clock themselves (ISSUE 13).
+  ring-handshake-rebind a REBIND path — a function that attaches a
+                       workspace (Workspace.attach) and then constructs
+                       ring endpoints (InLink/OutLink) or repairs them
+                       (rejoin_links) — must run the version handshake
+                       (disco/handshake.py check_join) in between: a
+                       joining incarnation that binds rings before
+                       proving its ring-ABI digest against the
+                       workspace word can corrupt every ring it touches
+                       under a hot code upgrade (ISSUE 16).  Pure
+                       observers (attach without endpoint construction:
+                       the monitor, fdttrace) are out of scope.
 
 Heuristics are receiver-name based (`*.mcache.drain`, `*.dcache.write*`,
 `*.consumer_fseqs[..]`), matching this codebase's idiom: InLink/OutLink
@@ -781,7 +792,62 @@ def check_file(
     # -- metrics-schema ----------------------------------------------------
     findings.extend(_check_metrics_schema(disp, tree))
 
+    # -- ring-handshake-rebind ---------------------------------------------
+    findings.extend(_check_rebind_handshake(disp, tree))
+
     return apply_pragmas(sorted(set(findings)), text.splitlines())
+
+
+def _check_rebind_handshake(path: str, tree: ast.AST) -> list[Finding]:
+    """ring-handshake-rebind (see the module rule table): a function
+    that both attaches a workspace AND constructs/repairs ring
+    endpoints must call the version handshake (check_join / a
+    handshake-named helper) — the gate that keeps a stale or
+    ABI-skewed incarnation from binding rings it cannot speak."""
+    findings: list[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        attach = None
+        binds = False
+        checks = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                _is_attr_call(node, {"attach"})
+                and "Workspace" in _receiver(node)
+            ):
+                attach = node
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                "InLink", "OutLink",
+            ):
+                binds = True
+            name = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else ""
+            )
+            if name == "rejoin_links":
+                binds = True
+            if name == "check_join" or "handshake" in name.lower():
+                checks = True
+        if attach is not None and binds and not checks:
+            findings.append(
+                Finding(
+                    path, attach.lineno, "ring-handshake-rebind",
+                    f"{fn.name} attaches a workspace and binds ring "
+                    "endpoints without running the version handshake "
+                    "(disco.handshake.check_join) — a stale or "
+                    "ABI-skewed incarnation would touch rings it cannot "
+                    "speak; check the shared_handshake word between "
+                    "Workspace.attach and the first InLink/OutLink/"
+                    "rejoin_links",
+                )
+            )
+    return findings
 
 
 # ---------------------------------------------------------------------------

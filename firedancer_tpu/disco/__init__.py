@@ -17,6 +17,11 @@ from .elastic import (  # noqa: F401
 )
 from .faultinj import Fault, FaultInjector  # noqa: F401
 from .flight import FlightConfig, FlightRecorder  # noqa: F401
+from .handshake import (  # noqa: F401
+    Handshake,
+    HandshakeRefused,
+    probe_digest,
+)
 from .metrics import (  # noqa: F401
     Metrics,
     MetricsSchema,
@@ -34,5 +39,9 @@ from .mux import (  # noqa: F401
     ts_diff_arr,
 )
 from .supervisor import RestartPolicy, Supervisor  # noqa: F401
-from .topo import Topology  # noqa: F401
+from .topo import (  # noqa: F401
+    Topology,
+    UpgradeRefused,
+    UpgradeRolledBack,
+)
 from .trace import SpanRing, TraceConfig, Tracer  # noqa: F401

@@ -124,6 +124,23 @@ def active_members(mask: int) -> list[int]:
     return [i for i in range(MAX_MEMBERS) if mask & (1 << i)]
 
 
+def device_partition(universe: list[int], mask: int, index: int) -> list[int]:
+    """Member `index`'s device-ordinal slice of `universe` under the
+    LIVE active mask — the runtime restatement of topo.py's boot-time
+    device_assignments (same strided partition, same round-robin
+    sharing when devices are scarcer than members), keyed by rank among
+    the CURRENTLY active members.  Scale-out recruits the spare
+    ordinals the smaller active set left unused; scale-in returns them
+    to the survivors.  Empty for an inactive member."""
+    act = active_members(mask)
+    if index not in act:
+        return []
+    rank, n = act.index(index), len(act)
+    if len(universe) < n:
+        return [universe[rank % len(universe)]]
+    return list(universe[rank::n])
+
+
 class ShardMap:
     """View of the shared shard-map region (owner or joiner)."""
 
@@ -505,6 +522,11 @@ OP_CODES = {
     "scale-in": 2,
     "rolling-restart": 3,
     "config-reload": 4,
+    # hot code upgrade lifecycle (fdt_upgrade): commanded, refused at
+    # the version handshake, or rolled back to the old recipe
+    "hot-upgrade": 5,
+    "refused": 6,
+    "rollback": 7,
 }
 
 
@@ -671,7 +693,10 @@ class ElasticController:
 
         return contextlib.nullcontext()
 
-    def _note(self, op: str, tile: str | None, detail: dict) -> None:
+    def _note(
+        self, op: str, tile: str | None, detail: dict,
+        kind: str = "reconfig",
+    ) -> None:
         rec = {"op": op, "tile": tile, "t": self.clock(), **detail}
         self.ops.append(rec)
         self._last_op_t = self.clock()
@@ -681,11 +706,12 @@ class ElasticController:
             m.set("last_op_code", OP_CODES.get(op.split(":")[0], 0))
             m.set("last_op_ts_us", time.monotonic_ns() // 1000)
         if self.sup is not None:
-            self.sup.note_commanded(tile, op, detail)
+            if kind == "upgrade":
+                self.sup.note_upgrade(tile, op, detail)
+            else:
+                self.sup.note_commanded(tile, op, detail)
         elif self.flight is not None:
-            self.flight.trigger(
-                "reconfig", tile, {"op": op, **detail}
-            )
+            self.flight.trigger(kind, tile, {"op": op, **detail})
 
     def scale_out(self, kind: str) -> int:
         grp = self.topo._shard_groups[kind]
@@ -740,6 +766,43 @@ class ElasticController:
                 self.sup.note_spawn(name)
             self.topo.rolling_restart(name, mutate=mutate, replay=replay)
         self._note(op, name, {})
+
+    def hot_upgrade(self, name: str, **kw) -> None:
+        """Commanded hot code upgrade of one tile (topo.hot_upgrade
+        kwargs pass through: version_root/so_path/digest/mutate/replay/
+        timeout_s).  Every outcome is an `upgrade`-kind event the
+        flight recorder bundles and fdtincident classifies as
+        `upgrade:<op>`: success (`hot-upgrade`), handshake refusal
+        (`refused`, carrying BOTH version digests — the running tile
+        was never touched), or boot-failure rollback (`rollback` — the
+        old recipe is back at RUN before this re-raises).  The whole
+        sequence runs under the supervisor's command bracket, so a
+        refused/failed new-version spawn never burns the circuit
+        breaker."""
+        from .topo import UpgradeRefused, UpgradeRolledBack
+
+        with self._commanded(name, "hot-upgrade"):
+            if self.sup is not None:
+                self.sup.note_spawn(name)
+            try:
+                self.topo.hot_upgrade(name, **kw)
+            except UpgradeRefused as e:
+                self._note(
+                    "refused", name,
+                    {
+                        "shm_digest": f"{e.shm_digest:#018x}",
+                        "new_digest": f"{e.new_digest:#018x}",
+                    },
+                    kind="upgrade",
+                )
+                raise
+            except UpgradeRolledBack as e:
+                self._note(
+                    "rollback", name, {"cause": repr(e.cause)},
+                    kind="upgrade",
+                )
+                raise
+        self._note("hot-upgrade", name, {}, kind="upgrade")
 
     # -- gauges -----------------------------------------------------------
 

@@ -336,6 +336,21 @@ class Tile:
         the rings instead of an unbounded host buffer."""
         return None
 
+    def ack_floor(self, ctx: MuxCtx, in_idx: int) -> int | None:
+        """Oldest ins[in_idx] frag seq this tile might still need, or
+        None when everything consumed is flushed.  The loop publishes
+        min(cursor, floor) as the fseq — so a tile holding consumed
+        frags in an internal pipeline (async device dispatch) keeps the
+        producer's credit gate protecting them in the ring until their
+        results are published downstream.  Without the holdback, a
+        crash between consume and publish can lose frags PERMANENTLY:
+        the advanced fseq lets the producer overwrite them, putting
+        them beyond any rejoin replay window (consumer_rejoin clamps to
+        the oldest frag the ring still holds).  The floor must be
+        monotone between calls (it only advances as the pipeline
+        flushes in frag order)."""
+        return None
+
     #: False = this tile stays a THREAD in the parent even under the
     #: process runtime (Topology.start(mode="process")).  Observer
     #: tiles that close over parent-side state (the metric tile's
@@ -738,8 +753,12 @@ def run_loop(
                 hk_lag_ns = now - next_hk if next_hk else 0
                 next_hk = now + tempo.async_reload(lazy_ns)
                 cnc.heartbeat(now)
-                for il in ctx.ins:
-                    il.fseq.update(il.seq)
+                for i_hk, il in enumerate(ctx.ins):
+                    floor = tile.ack_floor(ctx, i_hk)
+                    il.fseq.update(
+                        il.seq if floor is None
+                        else R.seq_min(floor, il.seq)
+                    )
                 m.inc("housekeep_iters")
                 if cnc.signal_query() == R.CNC_HALT:
                     break
@@ -969,8 +988,23 @@ def run_loop(
         cnc.signal(R.CNC_FAIL)
         raise
     finally:
-        for il in ctx.ins:
-            il.fseq.update(il.seq)
+        # crash finalize honors the ack floor: frags still in the
+        # tile's internal pipeline stay producer-protected in the ring,
+        # so the next incarnation's rejoin replay recovers them
+        for i_f, il in enumerate(ctx.ins):
+            floor = tile.ack_floor(ctx, i_f)
+            il.fseq.update(
+                il.seq if floor is None else R.seq_min(floor, il.seq)
+            )
         if cnc.signal_query() != R.CNC_FAIL:
             tile.on_halt(ctx)
+            # on_halt flushed the pipeline (or timed out with a
+            # residue): republish so a completed drain finalizes at the
+            # consumed cursor — commanded-halt boundaries compare this
+            # fseq against the producer cursor
+            for i_f, il in enumerate(ctx.ins):
+                floor = tile.ack_floor(ctx, i_f)
+                il.fseq.update(
+                    il.seq if floor is None else R.seq_min(floor, il.seq)
+                )
             cnc.signal(R.CNC_BOOT)  # halt acknowledged (reference protocol)

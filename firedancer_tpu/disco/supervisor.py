@@ -189,6 +189,15 @@ class Supervisor:
         as `reconfig:<op>` — distinct from crash incidents)."""
         self._emit(name or "", "reconfig", {"op": op, **detail})
 
+    def note_upgrade(self, name: str | None, op: str, detail: dict) -> None:
+        """Emit a hot-upgrade lifecycle event (commanded, refused, or
+        rolled back — disco/topo.py hot_upgrade).  Flight bundles
+        classify as `upgrade:<op>`; refusal/rollback details carry both
+        version digests so the incident names the ABI drift.  Like
+        note_commanded, never a crash: a failed upgrade rolls back the
+        old recipe under the command bracket and burns no breaker."""
+        self._emit(name or "", "upgrade", {"op": op, **detail})
+
     def _emit(self, tile: str, kind: str, detail: dict) -> None:
         for cb in self._listeners:
             try:

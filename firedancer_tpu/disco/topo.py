@@ -67,6 +67,11 @@ _PSTAT_BYTES = 64
 #: 2 counter words + up to 62 per-fault fired flags
 _FSTAT_BYTES = 512
 
+#: hot upgrade: serializes the scoped sys.path/FDT_SO_PATH mutation a
+#: version-carrying spawn performs around Process.start (the spawn
+#: method snapshots both into the child)
+_SPAWN_ENV_LOCK = threading.Lock()
+
 
 def _err_path(wksp_name: str, tile: str) -> str:
     """Child-crash report sidecar: the process analog of TileSpec.error
@@ -140,6 +145,46 @@ class TileSpec:
     #: until Topology.add_shard activates it.  Its reliable in-fseqs
     #: are parked in the far seq future so producers never gate on it.
     active: bool = True
+    #: hot code upgrade (fdt_upgrade): when set, this tile's NEXT
+    #: process-runtime incarnation imports firedancer_tpu from this
+    #: module tree (prepended to the child's sys.path at spawn) and/or
+    #: loads this prebuilt native artifact (FDT_SO_PATH) instead of
+    #: rebuilding.  None = the parent's own tree/.so.  Thread tiles
+    #: cannot swap module trees (one interpreter); their hot upgrade is
+    #: the mutate-based tile-object swap.
+    version_root: str | None = None
+    so_path: str | None = None
+
+
+class UpgradeRefused(RuntimeError):
+    """hot_upgrade pre-flight: the candidate version's ABI digest is
+    neither the workspace word nor compat-approved — the running tile
+    was NOT touched (zero downtime on refusal)."""
+
+    def __init__(self, shm_digest: int, new_digest: int, tile: str):
+        self.shm_digest = shm_digest
+        self.new_digest = new_digest
+        self.tile = tile
+        super().__init__(
+            f"hot upgrade of {tile!r} refused: candidate ABI digest "
+            f"{new_digest:#018x} vs workspace {shm_digest:#018x} — "
+            f"approve_version() it after an out-of-band compatibility "
+            f"proof, or rebuild from a ring-compatible tree"
+        )
+
+
+class UpgradeRolledBack(RuntimeError):
+    """hot_upgrade: the new-version incarnation failed to reach RUN;
+    the tile was respawned on its OLD recipe (which reached RUN before
+    this raised).  `cause` is the new version's boot failure."""
+
+    def __init__(self, tile: str, cause: BaseException):
+        self.tile = tile
+        self.cause = cause
+        super().__init__(
+            f"hot upgrade of {tile!r} rolled back to the old "
+            f"incarnation recipe: new version failed to boot ({cause!r})"
+        )
 
 
 class Topology:
@@ -205,6 +250,7 @@ class Topology:
         #: "base_active"}.  Declared via declare_shards() before build().
         self._shard_groups: dict[str, dict] = {}
         self._shardmap = None  # elastic.ShardMap, bound at build
+        self._handshake = None  # handshake.Handshake, bound at build
         self._mcaches: dict[str, R.MCache] = {}
         self._dcaches: dict[str, R.DCache] = {}
         self._fseqs: dict[tuple[str, str], R.FSeq] = {}
@@ -408,7 +454,10 @@ class Topology:
         return shared
 
     def _footprint(self) -> int:
-        total = 4096
+        from .handshake import HANDSHAKE_FOOTPRINT
+
+        # version-handshake word region (every topology has one)
+        total = 4096 + HANDSHAKE_FOOTPRINT + 256
         for fp in self._shared_regions().values():
             total += fp + 256
         for ls in self.links.values():
@@ -501,6 +550,17 @@ class Topology:
         # workspace resolves, never allocates)
         for nm, fp in sorted(self._shared_regions().items()):
             self.wksp.alloc(f"shared_{nm}", fp)
+        # version-handshake word (disco/handshake.py): written ONCE by
+        # the building tree with its own ring-ABI digest, read by every
+        # joining incarnation before it binds a ring.  Allocated before
+        # any tile boots so process children can join it by name.
+        from .handshake import HANDSHAKE_FOOTPRINT, Handshake
+
+        self._handshake = Handshake(
+            self.wksp.alloc("shared_handshake", HANDSHAKE_FOOTPRINT),
+            join=False,
+        )
+        self._handshake.init(R.abi_digest())
         if self._shard_groups:
             # elastic shard map + gauge region: allocated before any
             # tile boots (children join both by name), initialized
@@ -782,10 +842,15 @@ class Topology:
                 "profile": (
                     f"profile_{name}" if self.profile is not None else None
                 ),
+                # hot upgrade: the module tree / native artifact the
+                # NEXT incarnation of this tile runs (None = parent's)
+                "version_root": ts.version_root,
+                "so_path": ts.so_path,
             }
         return {
             "runtime": "process",
             "spawn": self._spawn_method(),
+            "handshake": "shared_handshake",
             "links": links,
             "tiles": tiles,
             "trace": (
@@ -1003,7 +1068,32 @@ class Topology:
             daemon=True,
         )
         ts.proc = p
-        p.start()
+        if ts.version_root is None and ts.so_path is None:
+            p.start()
+            return
+        # hot upgrade: the spawn method captures the parent's sys.path
+        # in its preparation data and the environment at exec, so a
+        # scoped mutation around start() is exactly "this child imports
+        # firedancer_tpu from the new tree / loads the prebuilt .so".
+        # Serialized: concurrent spawns must not see each other's tree.
+        with _SPAWN_ENV_LOCK:
+            import sys
+
+            saved_env = os.environ.get("FDT_SO_PATH")
+            if ts.version_root is not None:
+                sys.path.insert(0, ts.version_root)
+            if ts.so_path is not None:
+                os.environ["FDT_SO_PATH"] = ts.so_path
+            try:
+                p.start()
+            finally:
+                if ts.version_root is not None:
+                    sys.path.remove(ts.version_root)
+                if ts.so_path is not None:
+                    if saved_env is None:
+                        os.environ.pop("FDT_SO_PATH", None)
+                    else:
+                        os.environ["FDT_SO_PATH"] = saved_env
 
     def _reap(self, ts: TileSpec, timeout_s: float) -> None:
         """Join a child with bounded escalation: HALT should have ended
@@ -1309,6 +1399,107 @@ class Topology:
         self._wait_run(name, timeout_s)
         self.export_manifest()
 
+    # ---- hot code upgrade (fdt_upgrade) ---------------------------------
+
+    def handshake(self):
+        """The workspace's version-handshake view (disco/handshake.py),
+        bound at build()."""
+        assert self._handshake is not None, "build() first"
+        return self._handshake
+
+    def approve_version(self, digest: int) -> None:
+        """Admit a foreign ABI digest into the workspace compat table —
+        the operator's out-of-band ring-compatibility proof.  Joining
+        incarnations carrying it pass the handshake thereafter."""
+        self.handshake().approve(digest)
+
+    def hot_upgrade(
+        self,
+        name: str,
+        *,
+        version_root: str | None = None,
+        so_path: str | None = None,
+        digest: int | None = None,
+        mutate=None,
+        replay: int = 0,
+        timeout_s: float = 300.0,
+    ) -> None:
+        """Rolling restart into NEW CODE behind the same rings.
+
+        Pre-flight: the candidate version's ring-ABI digest (`digest`
+        if given, else probed via handshake.probe_digest — identity
+        versions answer in-process) must be proven compatible with the
+        workspace handshake word BEFORE the running tile is touched; a
+        mismatch raises UpgradeRefused with both digests and zero
+        downtime.  Accepted: halt → reap → stamp the version onto the
+        tile spec (the next incarnation imports firedancer_tpu from
+        `version_root` and loads `so_path`, see _spawn_tile) → mutate →
+        respawn → wait RUN.  A new-version boot failure rolls back to
+        the OLD recipe (old version fields, pre-mutate tile snapshot
+        where picklable), respawns it, and raises UpgradeRolledBack —
+        commanded-then-rollback, not a crash streak (the supervisor's
+        breaker never sees it when bracketed via
+        ElasticController.hot_upgrade).
+
+        `version_root`/`so_path` are process-runtime contracts (one
+        interpreter cannot swap module trees): thread tiles hot-upgrade
+        via `mutate` swapping the tile object, still digest-gated.
+        """
+        ts = self.tiles[name]
+        assert ts.active, f"tile {name!r} is not active"
+        is_proc = self._runtime == "process" and ts.tile.proc_safe
+        if (version_root is not None or so_path is not None) and not is_proc:
+            raise ValueError(
+                f"tile {name!r} runs in-process: version_root/so_path "
+                f"need a process-runtime child (use mutate for a "
+                f"thread-tile code swap)"
+            )
+        if digest is None:
+            from .handshake import probe_digest
+
+            digest = probe_digest(version_root, so_path)
+        hs = self.handshake()
+        if not hs.compatible(digest):
+            raise UpgradeRefused(hs.digest(), digest, name)
+        # snapshot the old recipe for rollback (tile snapshot is
+        # best-effort: an unpicklable tile rolls back version fields
+        # only, keeping the mutated object)
+        import pickle
+
+        old_version = (ts.version_root, ts.so_path)
+        try:
+            old_tile = pickle.dumps(ts.tile)
+        except Exception:  # noqa: BLE001 — thread tiles may hold locks
+            old_tile = None
+        cnc = self._cncs[name]
+        cnc.signal(R.CNC_HALT)
+        if ts.proc is not None:
+            self._reap(ts, timeout_s=30.0)
+        elif ts.thread is not None:
+            ts.thread.join(timeout=30.0)
+            ts.thread = None
+        if version_root is not None or so_path is not None:
+            ts.version_root, ts.so_path = version_root, so_path
+        try:
+            if mutate is not None:
+                mutate(ts.tile)
+            self._respawn_incarnation(name, replay, crashed=False)
+            self._wait_run(name, timeout_s)
+        except BaseException as cause:  # noqa: BLE001 — rollback then raise
+            if ts.proc is not None:
+                self._reap(ts, timeout_s=10.0)
+            elif ts.thread is not None:
+                ts.thread.join(timeout=10.0)
+                ts.thread = None
+            ts.version_root, ts.so_path = old_version
+            if old_tile is not None:
+                ts.tile = pickle.loads(old_tile)
+            self._respawn_incarnation(name, replay, crashed=False)
+            self._wait_run(name, timeout_s)
+            self.export_manifest()
+            raise UpgradeRolledBack(name, cause) from cause
+        self.export_manifest()
+
     def halt(self, timeout_s: float = 30.0) -> None:
         """Halt upstream-first so in-flight frags drain before consumers
         stop.  Process children are reaped with bounded SIGTERM→SIGKILL
@@ -1424,6 +1615,19 @@ def _tile_process_main(
         t = boot["tiles"][tile_name]
         pstat = ws.view(t["pstat"])[: 4 * 8].view(np.uint64)
         pstat[PSTAT_PID] = os.getpid()
+        # version handshake (disco/handshake.py): prove THIS
+        # incarnation's ring-ABI digest against the workspace word
+        # BEFORE binding a single ring — a mixed-version join is either
+        # digest/compat-proven or refused right here (HandshakeRefused
+        # lands in the err sidecar with both digests; exit code 2, a
+        # construction failure).  The ring-handshake-rebind lint rule
+        # pins that this check precedes the link construction below.
+        if boot.get("handshake") is not None:
+            from .handshake import check_join
+
+            check_join(
+                ws.view(boot["handshake"]), R.abi_digest(), tile=tile_name
+            )
         mcaches: dict[str, R.MCache] = {}
         dcaches: dict[str, R.DCache] = {}
 

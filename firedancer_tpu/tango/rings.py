@@ -51,22 +51,39 @@ def _bind(lib, sigs: dict, origin: str = "fdt_tango") -> None:
         fn.argtypes = args
 
 
+#: sources of the fdt_tango library, in link order (also the parse set
+#: for the ABI handshake sidecar — utils/cbuild.py abi_symbols)
+_NATIVE_SOURCES = [
+    _HERE / "native" / "fdt_tango.c",
+    _HERE / "native" / "fdt_sha512.c",
+    _HERE / "native" / "fdt_sha256.c",
+    _HERE / "native" / "fdt_pack.c",
+    _HERE / "native" / "fdt_bank.c",
+    _HERE / "native" / "fdt_stem.c",
+    _HERE / "native" / "fdt_poh.c",
+    _HERE / "native" / "fdt_shred.c",
+    _HERE / "native" / "fdt_net.c",
+    _HERE / "native" / "fdt_trace.c",
+]
+
+#: set by _load(): path of the loaded .so and the ctypes sigs table —
+#: the Python-side inputs to the version-handshake digest (abi_digest)
+_SO_PATH: str | None = None
+_SIGS: dict | None = None
+
+
 def _load() -> ct.CDLL:
-    so = cbuild.build(
-        "fdt_tango",
-        [
-            _HERE / "native" / "fdt_tango.c",
-            _HERE / "native" / "fdt_sha512.c",
-            _HERE / "native" / "fdt_sha256.c",
-            _HERE / "native" / "fdt_pack.c",
-            _HERE / "native" / "fdt_bank.c",
-            _HERE / "native" / "fdt_stem.c",
-            _HERE / "native" / "fdt_poh.c",
-            _HERE / "native" / "fdt_shred.c",
-            _HERE / "native" / "fdt_net.c",
-            _HERE / "native" / "fdt_trace.c",
-        ],
-    )
+    global _SO_PATH, _SIGS
+    # fdt_upgrade: an incarnation respawned into a new version tree may
+    # carry a prebuilt artifact — load it directly instead of rebuilding
+    # from this tree's sources, so the .so under test is exactly the one
+    # whose ABI sidecar the handshake digested
+    so_env = os.environ.get("FDT_SO_PATH", "")
+    if so_env:
+        so = Path(so_env)
+    else:
+        so = cbuild.build("fdt_tango", _NATIVE_SOURCES)
+    _SO_PATH = str(so)
     lib = ct.CDLL(str(so))
     u64, u32, u16, i32, vp = (
         ct.c_uint64,
@@ -245,6 +262,7 @@ def _load() -> ct.CDLL:
             None, [vp, u64, u64, u64, u64, u64, u64, u64],
         ),
     }
+    _SIGS = sigs
     _bind(lib, sigs)
     # inject the derived SHA-512/SHA-256 constant tables (no constant
     # blocks in C)
@@ -1514,3 +1532,84 @@ class Stem:
     @property
     def counters(self) -> np.ndarray:
         return self._ctrs
+
+# ---------------------------------------------------------------------------
+# version-handshake digest (fdt_upgrade)
+#
+# A mixed-version topology is ring-safe iff both incarnations agree on
+# every contract the /dev/shm rings encode: the native symbol set (the
+# .so's ABI sidecar), the ctypes sigs table, the ring/stem layout
+# constants, the stem cfg-word map, and the emit-body signatures.
+# abi_digest() folds all of that into one u64 (never 0 — 0 is the
+# uninitialized-word sentinel); disco/handshake.py stores it in a
+# per-workspace shm word at build() and every joining incarnation
+# compares before binding a single ring.  Lazy + cached: the cfg-word
+# constants below are module-level and must exist before collection.
+
+_ABI_CACHE: dict | None = None
+
+#: module-global int constants folded into the digest's layout/cfg-word
+#: components — any rename, renumber, add, or remove changes the digest
+_ABI_CONST_PREFIXES = (
+    "CHUNK_SZ", "CTL_", "STEM_", "PACK_SCHED_WORDS",
+    "_STEM_", "_SC_", "_SI", "_SO", "_TR_",
+)
+
+#: the emit-body surface: the native calls a handler body may make
+#: mid-burst (fdt_stem.h) — split out of "sigs" so the component diff
+#: in a refusal incident names the half that moved
+_ABI_EMIT_SYMBOLS = ("fdt_stem_out_emit", "fdt_stem_out_emit_at",
+                     "fdt_stem_out_cr")
+
+
+def _ct_name(t) -> str:
+    return "None" if t is None else getattr(t, "__name__", str(t))
+
+
+def abi_components() -> dict:
+    """The handshake digest's input document, canonical and
+    JSON-stable.  Split by component so tests (and refused-join
+    incident detail) can name WHICH contract moved."""
+    global _ABI_CACHE
+    if _ABI_CACHE is not None:
+        return _ABI_CACHE
+    side = cbuild.read_sidecar(Path(_SO_PATH)) if _SO_PATH else None
+    c_syms = (side or {}).get("symbols")
+    if c_syms is None:
+        # foreign .so without a sidecar: fall back to parsing this
+        # tree's sources (best effort; a sidecar-less artifact from a
+        # DIFFERENT tree digests as this tree and must instead be
+        # approved via the compat table)
+        c_syms = cbuild.abi_symbols(_NATIVE_SOURCES)
+    sigs = {
+        name: [_ct_name(res), [_ct_name(a) for a in args]]
+        for name, (res, args) in (_SIGS or {}).items()
+    }
+    consts = {
+        k: v
+        for k, v in sorted(globals().items())
+        if isinstance(v, int) and k.startswith(_ABI_CONST_PREFIXES)
+    }
+    _ABI_CACHE = {
+        "c": list(c_syms),
+        "sigs": sigs,
+        "cfg_words": consts,
+        "emit": {k: sigs[k] for k in _ABI_EMIT_SYMBOLS if k in sigs},
+    }
+    return _ABI_CACHE
+
+
+def digest_of(components: dict) -> int:
+    """Fold an abi_components()-shaped document into the nonzero u64
+    handshake word value (exposed separately so tests can digest
+    mutated documents)."""
+    import hashlib
+    import json as _json
+
+    blob = _json.dumps(components, sort_keys=True).encode()
+    return int.from_bytes(hashlib.sha256(blob).digest()[:8], "big") | 1
+
+
+def abi_digest() -> int:
+    """This incarnation's version-handshake word (see abi_components)."""
+    return digest_of(abi_components())

@@ -711,6 +711,7 @@ class VerifyTile(Tile):
         device: str = "auto",
         device_fn=None,
         devices: int | str | list | None = 1,
+        device_universe: list | None = None,
         fallback_trip: int = 3,
         fallback_reprobe: int = 64,
         dev_backoff_base_s: float = 0.5,
@@ -743,7 +744,18 @@ class VerifyTile(Tile):
         device; resolves to 1 off-device).  With N > 1 each domain is
         its own fault domain: dev_backoff_base_s/dev_backoff_max_s cap
         the quarantine backoff and stall_patience_s is the per-device
-        stall patience (round 5's global 120 s, now per device)."""
+        stall patience (round 5's global 120 s, now per device).
+
+        device_universe: elastic shard members only — the kind-wide
+        device-ordinal list shared by EVERY member.  Instead of keeping
+        a boot-time partition forever, the member recomputes its slice
+        from the LIVE active mask at every epoch flip
+        (elastic.device_partition): scale-out recruits the ordinals the
+        smaller active set left spare, scale-in returns them to the
+        survivors.  The pool is rebuilt only at a quiet boundary (no
+        in-flight device batches), so repartition never strands work.
+        Metrics rows are sized for the full universe (the region is
+        fixed at build).  Overrides `devices` when set."""
         assert max_lanes & (max_lanes - 1) == 0, (
             "max_lanes must be a power of two (pad buckets + warm compiles "
             "assume it)"
@@ -757,8 +769,18 @@ class VerifyTile(Tile):
         self.async_depth = max(async_depth, 1)
         self.device = device
         self._device_fn_override = device_fn
-        self.device_indices = _resolve_devices(devices, device, device_fn)
+        self.device_universe = (
+            [int(d) for d in device_universe] if device_universe else None
+        )
+        if self.device_universe is not None:
+            # boot with the full universe (metrics rows size to it);
+            # on_boot / the first epoch flip narrows to the live slice
+            self.device_indices = list(self.device_universe)
+        else:
+            self.device_indices = _resolve_devices(devices, device, device_fn)
         self.n_devices = len(self.device_indices)
+        self._pending_devices: list[int] | None = None
+        self._fault_hook = None
         self.fallback_trip = fallback_trip
         self.fallback_reprobe = fallback_reprobe
         self.dev_backoff_base_s = dev_backoff_base_s
@@ -890,35 +912,108 @@ class VerifyTile(Tile):
             # must NOT be swallowed by a stale pre-dedup entry — the
             # real dedup tile downstream keeps the durable history
             self._tc = R.TCache(ctx.alloc("tcache", fp), depth, map_cnt)
-        fns = self._make_device_fns()
+        self._fault_hook = (
+            ctx.faults.device_error if ctx.faults is not None else None
+        )
+        eb = self.elastic
+        if (
+            self.device_universe is not None
+            and eb is not None
+            and eb.role == "member"
+        ):
+            # shard-count-aware partition: this member's slice of the
+            # kind's device universe under the LIVE mask, not the
+            # boot-time ordinal list (repartition drops the cached
+            # fns/policies; an elastic member's degradation counters
+            # reset with its device set, deliberately)
+            from firedancer_tpu.disco.elastic import device_partition
+
+            part = device_partition(
+                self.device_universe, eb.bind(ctx).mask(eb.slot), eb.index
+            )
+            if part and part != self.device_indices:
+                self.device_indices = part
+                self.n_devices = len(part)
+                self._fns = None
+                self._policies = None
         if self._policies is None:
             # policies (and their degradation counters) persist across
             # supervisor restarts; only the worker threads are per-life
-            hook = ctx.faults.device_error if ctx.faults is not None else None
-            if self.n_devices == 1:
-                self._policies = [
-                    FallbackPolicy(
-                        fns[0],
-                        hostpath.verify_batch_digest_host,
-                        trip_after=self.fallback_trip,
-                        reprobe_every=self.fallback_reprobe,
-                        fault_hook=hook,
-                    )
-                ]
-            else:
-                self._policies = [
-                    DevicePolicy(
-                        fns[i],
-                        hostpath.verify_batch_digest_host,
-                        index=i,
-                        trip_after=self.fallback_trip,
-                        backoff_base_s=self.dev_backoff_base_s,
-                        backoff_max_s=self.dev_backoff_max_s,
-                        stall_patience_s=self.stall_patience_s,
-                        fault_hook=hook,
-                    )
-                    for i in range(self.n_devices)
-                ]
+            self._policies = self._build_policies()
+        self._pool = _DevicePool(
+            self._policies, self.async_depth, name=self.name
+        )
+
+    def _build_policies(self) -> list:
+        from firedancer_tpu.ops.ed25519 import hostpath
+
+        fns = self._make_device_fns()
+        hook = self._fault_hook
+        if self.n_devices == 1:
+            return [
+                FallbackPolicy(
+                    fns[0],
+                    hostpath.verify_batch_digest_host,
+                    trip_after=self.fallback_trip,
+                    reprobe_every=self.fallback_reprobe,
+                    fault_hook=hook,
+                )
+            ]
+        return [
+            DevicePolicy(
+                fns[i],
+                hostpath.verify_batch_digest_host,
+                index=i,
+                trip_after=self.fallback_trip,
+                backoff_base_s=self.dev_backoff_base_s,
+                backoff_max_s=self.dev_backoff_max_s,
+                stall_patience_s=self.stall_patience_s,
+                fault_hook=hook,
+            )
+            for i in range(self.n_devices)
+        ]
+
+    # ---- elastic device repartition (fdt_upgrade satellite) -------------
+
+    def on_epoch(self, ctx: MuxCtx) -> None:
+        super().on_epoch(ctx)
+        eb = self.elastic
+        if (
+            self.device_universe is None
+            or eb is None
+            or eb.role != "member"
+        ):
+            return
+        from firedancer_tpu.disco.elastic import device_partition
+
+        part = device_partition(
+            self.device_universe, eb.bind(ctx).mask(eb.slot), eb.index
+        )
+        if part and part != self.device_indices:
+            self._pending_devices = part
+            self._maybe_repartition()
+
+    def _maybe_repartition(self) -> None:
+        """Apply a pending device repartition at a QUIET boundary: the
+        pool must be idle (submitted work lands on the devices it was
+        scheduled to — a mid-flight swap would strand results), so a
+        busy pool retries from after_credit until its pipelines drain."""
+        part = self._pending_devices
+        if part is None:
+            return
+        if part == self.device_indices:
+            self._pending_devices = None
+            return
+        pool = self._pool
+        if pool is not None:
+            if not pool.idle():
+                return
+            pool.stop(timeout_s=30.0)
+        self.device_indices = list(part)
+        self.n_devices = len(part)
+        self._pending_devices = None
+        self._fns = None
+        self._policies = self._build_policies()
         self._pool = _DevicePool(
             self._policies, self.async_depth, name=self.name
         )
@@ -956,6 +1051,9 @@ class VerifyTile(Tile):
         lanes = len(b["sigs"])
         b.pop("txn_idx")
         b["tsorigs"] = frags["tsorig"].copy()
+        # ring seq per txn, carried through staging -> device -> publish
+        # so ack_floor can hold the fseq at the oldest unflushed frag
+        b["seqs"] = frags["seq"].copy()
         self._staged.append(b)
         self._staged_lanes += lanes
         # submit only while the pool has room: a full pool means every
@@ -983,6 +1081,27 @@ class VerifyTile(Tile):
             and not self._outq
             and (p is None or p.idle())
         )
+
+    def ack_floor(self, ctx: MuxCtx, in_idx: int) -> int | None:
+        """Oldest in-ring frag seq still riding the async pipeline
+        (staged -> device pool -> credit-gated publish queue).  The mux
+        holds the fseq here so the producer cannot overwrite a consumed
+        -but-unpublished frag — a crash anywhere in the pipeline is
+        then recoverable by rejoin replay (the drop/landing of a txn
+        releases its seq, so the floor only ever advances)."""
+        floor = None
+        batches = [b["seqs"] for q in (self._outq, self._staged) for b in q]
+        pool = self._pool
+        if pool is not None:
+            batches += [ent[0]["seqs"] for ent in pool.outstanding.values()]
+            batches += [meta["seqs"] for meta, _ok in pool.reorder.values()]
+            batches += [meta["seqs"] for meta, _ok in pool.ready]
+        for seqs in batches:
+            s = int(seqs[0])
+            # wrap-safe min (fdtmc finding, PR 3: plain-int min picks
+            # the wrapped-to-tiny seq across a 2^64 crossing)
+            floor = s if floor is None else R.seq_min(floor, s)
+        return floor
 
     def in_budget(self, ctx: MuxCtx) -> int | None:
         # stop draining the ring when the device pool is full or results
@@ -1046,7 +1165,8 @@ class VerifyTile(Tile):
         )
         meta = dict(
             rows=b["rows"], szs=b["szs"], tsorigs=b["tsorigs"],
-            sig_cnt=b["sig_cnt"], tags=b["tags"], lanes=lanes,
+            sig_cnt=b["sig_cnt"], tags=b["tags"], seqs=b["seqs"],
+            lanes=lanes,
         )
         self._submit(
             meta,
@@ -1133,6 +1253,7 @@ class VerifyTile(Tile):
                     rows=meta["rows"][txn_ok],
                     szs=meta["szs"][txn_ok].astype(np.uint16),
                     tsorigs=meta["tsorigs"][txn_ok],
+                    seqs=meta["seqs"][txn_ok],
                 )
             )
             self._outq_txns += int(txn_ok.sum())
@@ -1152,7 +1273,7 @@ class VerifyTile(Tile):
                     b["tags"][:m], b["rows"][:m], b["szs"][:m],
                     tsorigs=b["tsorigs"][:m],
                 )
-                for k in ("tags", "rows", "szs", "tsorigs"):
+                for k in ("tags", "rows", "szs", "tsorigs", "seqs"):
                     b[k] = b[k][m:]
                 ctx.credits = 0
                 self._outq_txns -= m
@@ -1160,6 +1281,8 @@ class VerifyTile(Tile):
     def after_credit(self, ctx: MuxCtx) -> None:
         self._land_results(ctx)
         self._publish_ready(ctx)
+        if self._pending_devices is not None:
+            self._maybe_repartition()
         # keep the devices fed: push a partial batch when the pool has
         # room and nothing fuller is coming (trickle traffic)
         if self._staged_lanes and self._pool.can_accept():
@@ -1325,7 +1448,7 @@ def _clone_policy(
 def _split_chunk(chunk: dict, k_txns: int, k_lanes: int) -> tuple[dict, dict]:
     """Split a staged chunk after k_txns txns / k_lanes lanes."""
     head, tail = {}, {}
-    for key in ("rows", "szs", "tsorigs", "sig_cnt", "tags"):
+    for key in ("rows", "szs", "tsorigs", "sig_cnt", "tags", "seqs"):
         head[key], tail[key] = chunk[key][:k_txns], chunk[key][k_txns:]
     for key in ("digests", "sigs", "pubs"):
         head[key], tail[key] = chunk[key][:k_lanes], chunk[key][k_lanes:]

@@ -17,7 +17,9 @@ the tango/pack native test surface re-run under it.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
+import re
 import subprocess
 import tempfile
 from pathlib import Path
@@ -69,6 +71,70 @@ def sanitizer_preload() -> str | None:
     return ":".join(libs) if len(libs) == 2 else None
 
 
+# ---------------------------------------------------------------------------
+# ABI sidecar (fdt_upgrade, ISSUE 16): every built .so gets a `<so>.hsk`
+# JSON next to it holding the EXPORTED fdt_* prototype set parsed from
+# the sources.  This is the C half of the runtime version-handshake
+# digest (disco/handshake.py): a joining incarnation loading a custom
+# FDT_SO_PATH reads the sidecar instead of re-parsing sources it may
+# not ship with.  The set deliberately covers the ABI surface only
+# (names + normalized prototypes) so a rebuilt-from-identical-source
+# .so — or a body-only patch — digests identically, while a symbol
+# add/remove or a prototype change does not.
+
+#: one exported (non-static) C function definition opening at line
+#: start: return type words/pointers, an fdt_* name, the parameter
+#: list, then `{` on the same or a following line (handled by the
+#: multiline collapse in abi_symbols)
+_C_EXPORT_RE = re.compile(
+    r"^(?!static\b)(?P<ret>[A-Za-z_][A-Za-z0-9_ ]*[A-Za-z0-9_*]"
+    r"[\s*]+)(?P<name>fdt_[a-z0-9_]+)\s*\((?P<args>[^;{)]*)\)\s*\{",
+    re.MULTILINE,
+)
+
+
+def abi_symbols(sources: list[Path]) -> list[str]:
+    """Sorted normalized `ret name(args)` prototypes for every exported
+    fdt_* function defined in `sources` (.c only; headers declare, the
+    definition is the export)."""
+    out: set[str] = set()
+    for src in sources:
+        if src.suffix != ".c":
+            continue
+        # collapse each definition's header onto one line so the regex
+        # sees multi-line parameter lists
+        text = re.sub(r"\(\s*\n\s*", "(", src.read_text())
+        text = re.sub(r",\s*\n\s*", ", ", text)
+        for m in _C_EXPORT_RE.finditer(text):
+            ret = " ".join(m.group("ret").replace("*", " * ").split())
+            args = " ".join(m.group("args").replace("*", " * ").split())
+            out.add(f"{ret} {m.group('name')}({args})")
+    return sorted(out)
+
+
+def _write_sidecar(out: Path, sources: list[Path]) -> None:
+    doc = {"symbols": abi_symbols(sources)}
+    fd, tmp = tempfile.mkstemp(dir=out.parent, suffix=".hsk")
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+    os.replace(tmp, sidecar_path(out))
+
+
+def sidecar_path(so: Path) -> Path:
+    return so.with_suffix(so.suffix + ".hsk")
+
+
+def read_sidecar(so: Path) -> dict | None:
+    """The .hsk ABI sidecar written next to `so` at build, or None when
+    the .so arrived without one (foreign artifact — the handshake
+    digest then treats its C component as unknown)."""
+    try:
+        with open(sidecar_path(so)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) -> Path:
     """Compile `sources` into a shared library, returning its path."""
     flags = list(_BASE_FLAGS)
@@ -85,6 +151,9 @@ def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) 
             h.update(hdr.read_bytes())
     out = _cache_dir() / f"{name}-{h.hexdigest()[:16]}.so"
     if out.exists():
+        # backfill the ABI sidecar for artifacts cached before it existed
+        if not sidecar_path(out).exists():
+            _write_sidecar(out, sources)
         return out
     # build into a temp file then atomically rename, so concurrent importers
     # (e.g. pytest-xdist workers) never load a half-written .so
@@ -97,4 +166,5 @@ def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) 
         os.unlink(tmp)
         raise RuntimeError(f"native build failed:\n{' '.join(cmd)}\n{e.stderr}") from e
     os.replace(tmp, out)
+    _write_sidecar(out, sources)
     return out

@@ -64,6 +64,13 @@ BLOOM_FP_BUDGET = 2
 RING_DEPTH = 256
 
 
+def _mark_upgraded(tile) -> None:
+    """Hot-upgrade mutate stub: the 'new code' is the old code plus a
+    generation stamp (module-level so the mutated tile still rides the
+    process runtime's spawn pickle)."""
+    tile._upgrade_gen = getattr(tile, "_upgrade_gen", 0) + 1
+
+
 def _random_schedule(rng: np.random.Generator, n_txns: int, n_faults: int):
     faults = []
     kinds = ["kill", "stall", "drop", "corrupt", "backpressure",
@@ -119,6 +126,7 @@ def run_soak(
     verbose: bool = False,
     runtime: str = "thread",
     elastic: bool = False,
+    upgrade: bool = False,
 ) -> dict:
     """One soak iteration.  Returns a report dict with ok=True/False.
 
@@ -130,13 +138,20 @@ def run_soak(
     against the sink's shm sig log + shared-memory metrics instead of
     host-side tile state; the incident-bundle 1:1 checks run under
     BOTH runtimes (children's durable fired flags fold back into the
-    parent's canonical record — FaultInjector.fold_topology)."""
+    parent's canonical record — FaultInjector.fold_topology).
+
+    upgrade=True (implies elastic) interleaves commanded HOT UPGRADES
+    of dedup (identity-digest, handshake-gated, replay-protected) into
+    the op schedule — reconfig + chaos + live code swap concurrently,
+    with the upgrade bundles held to the same 1:1 accounting."""
     process = runtime == "process"
+    if upgrade:
+        elastic = True  # hot upgrades ride the elastic op plumbing
     if seed is None:
         seed = int.from_bytes(os.urandom(4), "little")
     print(
         f"chaos_soak: seed={seed} txns={n_txns} faults={n_faults} "
-        f"runtime={runtime} elastic={elastic}"
+        f"runtime={runtime} elastic={elastic} upgrade={upgrade}"
     )
     rng = np.random.default_rng(seed)
     faults = _random_schedule(rng, n_txns, n_faults)
@@ -259,7 +274,9 @@ def run_soak(
             topo, ElasticConfig(kinds={}), sup=sup, flight=None
         )
         op_kinds = ["scale-out", "rolling-restart", "scale-in"]
-        n_ops = 3 + int(rng.integers(0, 3))
+        if upgrade:
+            op_kinds.append("hot-upgrade")
+        n_ops = len(op_kinds) + int(rng.integers(0, 3))
         op_plan = [op_kinds[i % len(op_kinds)] for i in range(n_ops)]
         op_gap_s = [float(rng.uniform(0.05, 0.4)) for _ in op_plan]
     try:
@@ -285,6 +302,16 @@ def run_soak(
                     elif op == "rolling-restart":
                         ctl.rolling_restart(
                             "dedup", replay=RING_DEPTH
+                        )
+                    elif op == "hot-upgrade":
+                        # identity-digest hot code swap of the mid-
+                        # pipeline tile, handshake-gated like a real
+                        # new-version rollout (exercises halt → digest
+                        # check → mutate → respawn → rejoin under the
+                        # live fault schedule)
+                        ctl.hot_upgrade(
+                            "dedup", mutate=_mark_upgraded,
+                            replay=RING_DEPTH,
                         )
                     else:
                         op = f"skipped-{op}"
@@ -374,9 +401,11 @@ def run_soak(
                 r["explained"] for r in inc_rows
             ),
             # a fault-free soak yields zero CRASH bundles; deliberate
-            # reconfig bundles are the elastic schedule's own record
+            # reconfig/upgrade bundles are the op schedule's own record
             incidents_zero_when_clean=bool(inj.events)
-            or all(r["kind"] == "reconfig" for r in inc_rows),
+            or all(
+                r["kind"] in ("reconfig", "upgrade") for r in inc_rows
+            ),
         )
         if elastic:
             checks.update(
@@ -385,6 +414,20 @@ def run_soak(
                     op.startswith("FAILED") for op in elastic_ops
                 ),
                 elastic_settled=topo.shardmap().n_active(0) == 1,
+            )
+        if upgrade:
+            # every commanded hot upgrade froze exactly one explained
+            # upgrade:hot-upgrade bundle and left a generation stamp
+            checks.update(
+                upgrade_ops_ran=elastic_ops.count("hot-upgrade") >= 1,
+                upgrade_incidents_1to1=by_class.get(
+                    "upgrade:hot-upgrade", 0
+                )
+                == elastic_ops.count("hot-upgrade"),
+                upgrade_applied=getattr(
+                    topo.tiles["dedup"].tile, "_upgrade_gen", 0
+                )
+                == elastic_ops.count("hot-upgrade"),
             )
         report["checks"] = checks
         report["ok"] = all(checks.values())
@@ -417,13 +460,17 @@ def main() -> int:
                     help="interleave seeded scale-out/scale-in/rolling-"
                          "restart reconfig events (disco/elastic.py) "
                          "with the fault schedule")
+    ap.add_argument("--upgrade", action="store_true",
+                    help="also interleave commanded HOT UPGRADES of "
+                         "dedup (handshake-gated identity-digest code "
+                         "swap, disco/handshake.py); implies --elastic")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     for i in range(args.repeat):
         report = run_soak(
             seed=args.seed, n_txns=args.txns, n_faults=args.faults,
             verbose=args.verbose, runtime=args.runtime,
-            elastic=args.elastic,
+            elastic=args.elastic, upgrade=args.upgrade,
         )
         if not report["ok"]:
             return 1

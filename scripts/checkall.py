@@ -1,15 +1,16 @@
 #!/usr/bin/env python3
 """checkall — the one-shot local gate: fdtlint + bounded fdtmc + a
 process-runtime smoke + the native-trace parity gate + a seeded
-hostile-ingress smoke + an elastic reconfig smoke + the tier-1 pytest
-suite, aggregated into one exit code.
+hostile-ingress smoke + an elastic reconfig smoke + a bounded
+combined-stressor endurance gauntlet (both runtimes) + the tier-1
+pytest suite, aggregated into one exit code.
 
 Usage:
     scripts/checkall.py                 # all stages
     scripts/checkall.py --json          # machine-readable summary
     scripts/checkall.py --skip mc       # skip stages
-                                        # (lint,mc,proc,trace,
-                                        #  adversary,elastic,pytest)
+                                        # (lint,mc,proc,trace,adversary,
+                                        #  elastic,endurance,pytest)
     scripts/checkall.py --mc-budget 200 # bound the model checker
     scripts/checkall.py --pytest-timeout 1200
 
@@ -201,6 +202,35 @@ def _stage_elastic(timeout_s: float, seed: int) -> dict:
     return stage
 
 
+def _stage_endurance(timeout_s: float, seed: int) -> dict:
+    """Combined-stressor endurance gauntlet (scripts/endurance.py),
+    bounded for CI: elastic reconfigs + adversary floods + SIGKILL
+    chaos + rolling HOT UPGRADES (handshake-gated, incl. one refused
+    ABI-skewed candidate per cycle) run CONCURRENTLY on BOTH runtimes,
+    asserting exactly-once delivery, a closing drop ledger, 1:1
+    incident classification, SLO burn within budget, and a zero-growth
+    /proc + /dev/shm leak audit."""
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    stage: dict = {"rc": 0, "seed": seed}
+    for runtime in ("thread", "process"):
+        rc, out = _run(
+            [
+                sys.executable, str(REPO / "scripts" / "endurance.py"),
+                "--seed", str(seed), "--runtime", runtime,
+                "--duration", "10", "--txns", "384", "--faults", "4",
+            ],
+            timeout_s, env=env,
+        )
+        stage[runtime] = rc
+        if rc != 0:
+            stage["rc"] = rc
+            stage[f"{runtime}_tail"] = out[-2000:]
+    stage["seconds"] = round(time.perf_counter() - t0, 2)
+    return stage
+
+
 def _stage_trace(timeout_s: float) -> dict:
     """Native-trace parity gate (ISSUE 15): the differential tests in
     tests/test_fdttrace_native.py assert the native in-burst emitter's
@@ -259,8 +289,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
-                    help="comma list of stages to skip: "
-                         "lint,mc,proc,trace,adversary,elastic,pytest")
+                    help="comma list of stages to skip: lint,mc,proc,"
+                         "trace,adversary,elastic,endurance,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
@@ -273,13 +303,19 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--elastic-timeout", type=float, default=300.0)
     ap.add_argument("--elastic-seed", type=int, default=11,
                     help="fixed seed for the elastic reconfig smoke")
+    ap.add_argument("--endurance-timeout", type=float, default=300.0,
+                    help="per-runtime wall budget for the endurance "
+                         "gauntlet stage")
+    ap.add_argument("--endurance-seed", type=int, default=13,
+                    help="fixed seed for the endurance gauntlet")
     ap.add_argument("--pytest-timeout", type=float, default=1800.0)
     ap.add_argument("--pytest-args", default="",
                     help="extra args appended to the pytest command")
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
     bad = skip - {
-        "lint", "mc", "proc", "trace", "adversary", "elastic", "pytest"
+        "lint", "mc", "proc", "trace", "adversary", "elastic",
+        "endurance", "pytest",
     }
     if bad:
         print(f"checkall: unknown stage(s) {sorted(bad)}", file=sys.stderr)
@@ -325,6 +361,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"checkall elastic: rc={stages['elastic']['rc']} "
                   f"(seed={stages['elastic']['seed']}, "
                   f"{stages['elastic']['seconds']}s)", flush=True)
+    if "endurance" not in skip:
+        stages["endurance"] = _stage_endurance(
+            args.endurance_timeout, args.endurance_seed
+        )
+        if not args.json:
+            print(f"checkall endurance: rc={stages['endurance']['rc']} "
+                  f"(seed={stages['endurance']['seed']}, "
+                  f"thread={stages['endurance'].get('thread')} "
+                  f"process={stages['endurance'].get('process')}, "
+                  f"{stages['endurance']['seconds']}s)", flush=True)
     if "pytest" not in skip:
         stages["pytest"] = _stage_pytest(
             args.pytest_timeout, args.pytest_args.split()

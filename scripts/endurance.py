@@ -1,0 +1,441 @@
+#!/usr/bin/env python
+"""Combined-stressor endurance gauntlet (fdt_upgrade, ISSUE 16).
+
+Runs the repo's stressors CONCURRENTLY against one topology for a
+wall-clock budget, on a chosen runtime x stem mode:
+
+  * elastic reconfiguration — seeded scale-out / rolling-restart /
+    scale-in of a provisioned verify member (disco/elastic.py);
+  * adversary mix — seeded duplicate-storm floods through the synth
+    injection path, plus drop/corrupt loss faults on the thread
+    runtime (disco/faultinj.py);
+  * SIGKILL / heartbeat-stall chaos on the live verify member,
+    repaired by the supervisor watchdog under the normal breaker;
+  * rolling HOT UPGRADES — commanded identity-digest code swaps of the
+    mid-pipeline dedup behind the runtime version handshake
+    (disco/handshake.py), plus one deliberately ABI-SKEWED candidate
+    per cycle that must be REFUSED with zero downtime.
+
+At the end the gauntlet asserts the full ledger:
+
+  * exactly-once delivery — every surviving txn landed once, no dups;
+  * the drop ledger CLOSES — sent - landed <= injected loss + declared
+    overruns + the documented tag-collision budget;
+  * incident classification is 1:1 — one explained bundle per scripted
+    kill/stall, one upgrade:<op> bundle per commanded upgrade outcome
+    (hot-upgrade AND refused), one reconfig:<op> per reconfiguration,
+    nothing unexplained;
+  * the queue-wait SLO burn stays within budget — the live burn-rate
+    engine (disco/slo.py) rides the flight recorder and no
+    slo-breach:* bundle may fire;
+  * leak audit via /proc and /dev/shm — zero growth in shm regions,
+    open fds, and live child processes between the post-boot baseline
+    and the pre-halt sample.
+
+The seed is printed up front and again on failure; --seed replays the
+identical fault schedule and op cadence.
+
+Usage:
+    python scripts/endurance.py [--seed N] [--duration S]
+        [--runtime thread|process] [--stem python|native]
+        [--txns N] [--faults N] [--json] [--verbose]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import multiprocessing as mp
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+from firedancer_tpu.disco import (  # noqa: E402
+    ElasticConfig,
+    ElasticController,
+    FaultInjector,
+    FlightRecorder,
+    RestartPolicy,
+    Supervisor,
+    Topology,
+    UpgradeRefused,
+)
+from firedancer_tpu.disco.flight import tile_links  # noqa: E402
+from firedancer_tpu.disco.slo import SloConfig, SloEngine  # noqa: E402
+from firedancer_tpu.ops.ed25519 import hostpath  # noqa: E402
+from firedancer_tpu.tango import rings as R  # noqa: E402
+from firedancer_tpu.tiles import wire  # noqa: E402
+from firedancer_tpu.tiles.dedup import DedupTile  # noqa: E402
+from firedancer_tpu.tiles.sink import SinkTile, read_siglog  # noqa: E402
+from firedancer_tpu.tiles.synth import SynthTile, make_txn_pool  # noqa: E402
+from firedancer_tpu.tiles.verify import VerifyTile  # noqa: E402
+from scripts.chaos_soak import (  # noqa: E402
+    BLOOM_FP_BUDGET,
+    RING_DEPTH,
+    _mark_upgraded,
+    _random_schedule,
+)
+
+#: one gauntlet cycle: reconfig + live upgrade + refused upgrade, all
+#: interleaved with the running fault schedule
+OP_CYCLE = (
+    "scale-out", "hot-upgrade", "rolling-restart", "refused-upgrade",
+    "scale-in",
+)
+
+
+def _fd_count() -> int:
+    # min over a few samples: a bundle/manifest write caught mid-flight
+    # holds a transient fd that is not a leak
+    n = min(
+        len(os.listdir("/proc/self/fd"))
+        for _ in range(3)
+        if time.sleep(0.05) is None
+    )
+    return n
+
+
+def _shm_count(wksp: str) -> int:
+    return len(glob.glob(f"/dev/shm/fdt_wksp_{wksp}*"))
+
+
+def _leak_sample(wksp: str) -> dict:
+    return {
+        "fds": _fd_count(),
+        "shm": _shm_count(wksp),
+        "children": len(mp.active_children()),
+        "fd_targets": sorted(
+            os.readlink(f"/proc/self/fd/{f}")
+            for f in os.listdir("/proc/self/fd")
+            if os.path.islink(f"/proc/self/fd/{f}")
+        ),
+    }
+
+
+def run_endurance(
+    seed: int | None = None,
+    duration_s: float = 20.0,
+    runtime: str = "thread",
+    stem: str = "python",
+    n_txns: int = 1024,
+    n_faults: int = 6,
+    verbose: bool = False,
+) -> dict:
+    """One gauntlet run.  Returns a report dict with ok=True/False."""
+    process = runtime == "process"
+    if seed is None:
+        seed = int.from_bytes(os.urandom(4), "little")
+    print(
+        f"endurance: seed={seed} duration={duration_s}s txns={n_txns} "
+        f"faults={n_faults} runtime={runtime} stem={stem}"
+    )
+    rng = np.random.default_rng(seed)
+    faults = _random_schedule(rng, n_txns, n_faults)
+    # chaos stays on verify member 0 (never commanded): a scripted kill
+    # inside a commanded window would be repaired by the op itself and
+    # break the 1:1 bundle accounting this gauntlet asserts
+    faults = [
+        type(f)(
+            "verify" if f.tile == "dedup" else f.tile, f.kind,
+            at=f.at, on=f.on, count=f.count, frac=f.frac,
+            link=f.link, duration_s=f.duration_s,
+        )
+        for f in faults
+    ]
+    if process:
+        faults = [
+            f for f in faults
+            if f.kind in ("kill", "stall", "backpressure", "flood")
+        ]
+    inj = FaultInjector(seed=seed, faults=faults)
+
+    rows, szs, _ = make_txn_pool(n_txns, seed=seed)
+    synth = SynthTile(rows, szs, total=n_txns)
+    mk_verify = lambda name: VerifyTile(  # noqa: E731
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off",
+        device_fn=hostpath.verify_batch_digest_host, async_depth=2,
+        name=name,
+    )
+    topo = Topology(
+        name=f"end{os.getpid()}", runtime=runtime, stem=stem
+    )
+    # the gauntlet must BURN WITHIN BUDGET under its own chaos — a
+    # breach bundle is a failure, not noise.  The ceiling is a WEDGE
+    # detector: far above any scripted stall (5s) + heartbeat timeout +
+    # restart replay, far below frags sitting in a ring forever
+    slo_cfg = SloConfig(
+        queue_wait_p99_us=15_000_000, budget=0.05,
+        fast_window_s=1.0, slow_window_s=4.0,
+        burn_fast=8.0, burn_slow=2.0,
+    )
+    topo.slo = slo_cfg
+    topo.enable_flight(depth=32)
+    topo.link("synth_verify", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.link("verify1_dedup", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=RING_DEPTH, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(
+        mk_verify("verify"), ins=[("synth_verify", True)],
+        outs=["verify_dedup"],
+    )
+    topo.tile(
+        mk_verify("verify1"), ins=[("synth_verify", True)],
+        outs=["verify1_dedup"],
+    )
+    topo.tile(
+        DedupTile(depth=1 << 12),
+        ins=[("verify_dedup", True), ("verify1_dedup", True)],
+        outs=["dedup_sink"],
+    )
+    topo.tile(
+        SinkTile(record=False, shm_log=8 * n_txns),
+        ins=[("dedup_sink", True)],
+    )
+    topo.declare_shards(
+        "verify", ["verify", "verify1"], producer="synth",
+        producer_link="synth_verify", active=1,
+    )
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=0.5 if process else 2.0,
+            backoff_base_s=0.05,
+            breaker_n=2 * n_faults + 4,
+            replay={"verify": RING_DEPTH, "verify1": RING_DEPTH,
+                    "dedup": RING_DEPTH},
+        ),
+        faults=inj,
+    )
+    inc_dir = tempfile.mkdtemp(prefix="fdt_endurance_")
+    topo.build()
+    flight = FlightRecorder(
+        topo, inc_dir, slo=SloEngine(slo_cfg, tile_links(topo)),
+        faults=inj, poll_s=0.05,
+    )
+    flight.attach_supervisor(sup)
+    ctl = ElasticController(topo, ElasticConfig(kinds={}), sup=sup)
+    flight.start()
+    sup.start(batch_max=32)
+
+    def _sunk() -> list[int]:
+        return read_siglog(topo.tile_alloc_view("sink", "siglog")).tolist()
+
+    # a candidate digest no tree computes: every refused-upgrade op
+    # must bounce off the handshake with zero downtime
+    skewed = (R.abi_digest() ^ 0x5CE57ED000000000) | 1
+    ops_done: list[str] = []
+    report: dict = {"ok": False, "seed": seed}
+    baseline: dict | None = None
+    final: dict | None = None
+    try:
+        end = time.monotonic() + duration_s
+        hard = end + float(os.environ.get("FDT_ENDURANCE_SETTLE", "240"))
+        next_op = time.monotonic() + float(rng.uniform(0.1, 0.5))
+        op_i = 0
+        while True:
+            now = time.monotonic()
+            injected = inj.dropped_frags() + inj.corrupted_frags()
+            drained = len(set(_sunk())) >= n_txns - injected
+            if baseline is None and len(_sunk()) > 0:
+                # post-boot steady state: every leak the run creates
+                # after this point must be returned by the end
+                baseline = _leak_sample(topo.name)
+            # at least ONE full op cycle always runs (a slow box must
+            # not dodge the refused-upgrade probe), bounded by `hard`
+            cycle_done = op_i >= len(OP_CYCLE)
+            if (now >= end and drained and cycle_done) or now >= hard:
+                break
+            if (
+                now >= next_op
+                and (now < end or not cycle_done)
+                and baseline is not None
+            ):
+                _cycle = [
+                    o for o in OP_CYCLE
+                    if o in os.environ.get(
+                        "FDT_ENDURANCE_OPS", ",".join(OP_CYCLE)
+                    ).split(",")
+                ] or list(OP_CYCLE)
+                op = _cycle[op_i % len(_cycle)]
+                op_i += 1
+                try:
+                    if op == "scale-out":
+                        if topo.shardmap().n_active(0) < 2:
+                            ctl.scale_out("verify")
+                        else:
+                            op = f"skipped-{op}"
+                    elif op == "scale-in":
+                        if topo.shardmap().n_active(0) > 1:
+                            ctl.scale_in("verify", 1)
+                        else:
+                            op = f"skipped-{op}"
+                    elif op == "hot-upgrade":
+                        ctl.hot_upgrade(
+                            "dedup", mutate=_mark_upgraded,
+                            replay=RING_DEPTH,
+                        )
+                    elif op == "rolling-restart":
+                        ctl.rolling_restart("dedup", replay=RING_DEPTH)
+                    elif op == "refused-upgrade":
+                        try:
+                            ctl.hot_upgrade("dedup", digest=skewed)
+                            op = "FAILED-refused-upgrade: not refused"
+                        except UpgradeRefused:
+                            pass
+                    ops_done.append(op)
+                except Exception as e:  # noqa: BLE001 — report, keep running
+                    ops_done.append(f"FAILED-{op}: {e!r}")
+                next_op = time.monotonic() + float(rng.uniform(0.1, 0.5))
+            time.sleep(0.05)
+        # settle: back to one member, drains complete, then the leak
+        # sample — the run must have RETURNED everything it borrowed
+        if topo.shardmap().n_active(0) > 1:
+            try:
+                ctl.scale_in("verify", 1)
+                ops_done.append("final-scale-in")
+            except Exception as e:  # noqa: BLE001
+                ops_done.append(f"FAILED-final-scale-in: {e!r}")
+        final = _leak_sample(topo.name)
+    finally:
+        flight.stop()
+        sup.halt()
+    try:
+        sunk = _sunk()
+        uniq = set(sunk)
+        inj.fold_topology(topo)
+        injected = inj.dropped_frags() + inj.corrupted_frags()
+        overruns = sum(
+            topo.metrics(n).counter("overrun_frags") for n in topo.tiles
+        )
+        restarts = {n: sup.restarts(n) for n in topo.tiles}
+        degraded = {
+            n: d for n in topo.tiles
+            if (d := sup.degraded(n)) is not None
+        }
+        from scripts.fdtincident import classify_dir
+
+        inc_rows = classify_dir(inc_dir)
+        by_class: dict[str, int] = {}
+        for r in inc_rows:
+            by_class[r["class"]] = by_class.get(r["class"], 0) + 1
+        n_kill, n_stall = inj.count("kill"), inj.count("stall")
+        n_up = ops_done.count("hot-upgrade")
+        n_ref = ops_done.count("refused-upgrade")
+        slo_rows = (
+            flight.slo.to_dict().get("status", []) if flight.slo else []
+        )
+        flow = {
+            n: {
+                "in": topo.metrics(n).counter("in_frags"),
+                "out": topo.metrics(n).counter("out_frags"),
+            }
+            for n in topo.tiles
+        }
+        report.update(
+            sent=n_txns, sunk=len(sunk), unique=len(uniq), flow=flow,
+            injected_loss=injected, overruns=overruns,
+            restarts=restarts, degraded=degraded, fired=inj.fired(),
+            ops=ops_done, incidents=sorted(by_class.items()),
+            incident_dir=inc_dir, slo=slo_rows,
+            leak_baseline=baseline, leak_final=final,
+        )
+        checks = {
+            # exactly-once delivery
+            "no_duplicates": len(uniq) == len(sunk),
+            "only_known_tags": uniq <= set(synth.tags.tolist()),
+            # the drop ledger closes exactly
+            "ledger_closes": (
+                n_txns - len(uniq) <= injected + overruns + BLOOM_FP_BUDGET
+            ),
+            # chaos repaired, nothing degraded
+            "faults_repaired": sum(restarts.values()) >= n_kill + n_stall,
+            "nothing_degraded": not degraded,
+            # 1:1 incident classification across EVERY stressor
+            "incident_kill_1to1": by_class.get("injected-kill", 0) == n_kill,
+            "incident_stall_1to1": (
+                by_class.get("injected-stall", 0) == n_stall
+            ),
+            "upgrade_1to1": by_class.get("upgrade:hot-upgrade", 0) == n_up,
+            "refused_1to1": by_class.get("upgrade:refused", 0) == n_ref,
+            "incidents_all_explained": all(
+                r["explained"] for r in inc_rows
+            ),
+            # the gauntlet actually ganged the stressors
+            "ops_ran": n_up >= 1 and n_ref >= 1
+            and any(o.startswith("scale") for o in ops_done),
+            "ops_clean": not any(o.startswith("FAILED") for o in ops_done),
+            "upgrade_applied": getattr(
+                topo.tiles["dedup"].tile, "_upgrade_gen", 0
+            )
+            == n_up,
+            # SLO burn within budget: the live engine never breached
+            "slo_within_budget": not any(
+                r["class"].startswith("slo-breach") for r in inc_rows
+            )
+            and not any(s["breached"] for s in slo_rows),
+            "settled": topo.shardmap().n_active(0) == 1,
+        }
+        # leak audit: zero growth post-boot -> pre-halt
+        if baseline is not None and final is not None:
+            checks.update(
+                no_shm_growth=final["shm"] <= baseline["shm"],
+                no_fd_growth=final["fds"] <= baseline["fds"],
+                no_child_growth=final["children"] <= baseline["children"],
+            )
+        else:  # pragma: no cover — sink never progressed
+            checks["leak_audit_sampled"] = False
+        report["checks"] = checks
+        report["ok"] = all(checks.values())
+        if verbose or not report["ok"]:
+            print(f"endurance report (seed={seed}):")
+            for k, v in report.items():
+                print(f"  {k}: {v}")
+        if not report["ok"]:
+            print(f"endurance FAILED — replay with --seed {seed}")
+            print(f"  incident bundles kept at {inc_dir}")
+        else:
+            shutil.rmtree(inc_dir, ignore_errors=True)
+        return report
+    finally:
+        topo.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="wall-clock stressor budget in seconds")
+    ap.add_argument("--runtime", choices=["thread", "process"],
+                    default="thread")
+    ap.add_argument("--stem", choices=["python", "native"],
+                    default="python")
+    ap.add_argument("--txns", type=int, default=1024)
+    ap.add_argument("--faults", type=int, default=6)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    report = run_endurance(
+        seed=args.seed, duration_s=args.duration, runtime=args.runtime,
+        stem=args.stem, n_txns=args.txns, n_faults=args.faults,
+        verbose=args.verbose,
+    )
+    if args.as_json:
+        print(json.dumps(report, default=str, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

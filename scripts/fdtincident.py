@@ -22,7 +22,11 @@ Exit status follows the fdtlint convention: 0 clean, 1 findings,
     by a scripted stall is `injected-stall`, a quarantine backed by
     scripted device errors is `injected-device-error`, an SLO trigger
     is `slo-breach:<name>`, an ingress load-shed escalation backed by
-    scripted hostile traffic or a burning SLO is `load-shed:L<level>`;
+    scripted hostile traffic or a burning SLO is `load-shed:L<level>`,
+    a commanded reconfiguration is `reconfig:<op>`, and a hot-upgrade
+    lifecycle event is `upgrade:<op>` (`hot-upgrade` completed,
+    `refused` — the version handshake rejected an ABI-skewed
+    candidate, detail carries both digests — or `rollback`);
     anything else is `unexplained-*`.
     `--strict` exits 1 when any bundle is unexplained — the chaos
     suite's "every injected fault yields exactly one CORRECTLY
@@ -141,6 +145,17 @@ def classify_bundle(bundle: dict) -> dict:
         # self-explaining by construction — the point of the commanded
         # bracket is that planned surgery never classifies as a crash
         cls, explained = f"reconfig:{detail.get('op')}", True
+    elif kind == "upgrade":
+        # hot code upgrade lifecycle (disco/topo.py hot_upgrade via
+        # ElasticController.hot_upgrade): commanded like reconfig, so
+        # self-explaining by construction.  `upgrade:hot-upgrade` is a
+        # completed upgrade; `upgrade:refused` is the version handshake
+        # rejecting an ABI-skewed candidate (detail carries BOTH
+        # digests — shm_digest vs new_digest — naming the drift);
+        # `upgrade:rollback` is a new-version boot failure rolled back
+        # to the old recipe.  None of them is a crash: the command
+        # bracket keeps the breaker out of all three.
+        cls, explained = f"upgrade:{detail.get('op')}", True
     elif kind in ("manual", "signal"):
         cls, explained = kind, True
     return {

@@ -1,0 +1,21 @@
+"""known-bad: a stale incarnation's rebind path — Workspace.attach
+followed straight by InLink/OutLink construction with NO version
+handshake (disco.handshake.check_join).  Under a hot code upgrade
+(ISSUE 16) this child would bind rings whose ABI contract it cannot
+prove it speaks: a skewed cfg-word map or symbol set corrupts every
+ring it touches.  (rule: ring-handshake-rebind)"""
+
+
+def _tile_process_main(wksp_name, tile_name, t, links):
+    from firedancer_tpu.disco.mux import InLink, OutLink
+    from firedancer_tpu.tango import rings as R
+
+    ws, extra = R.Workspace.attach(wksp_name)
+    # straight to endpoint construction — the shared_handshake word is
+    # never consulted
+    ins = [
+        InLink(ln, ws.view(links[ln]["mcache"]), None, None, rel)
+        for ln, rel in t["ins"]
+    ]
+    outs = [OutLink(ln, ws.view(links[ln]["mcache"]), None, []) for ln in t["outs"]]
+    return ins, outs

@@ -937,3 +937,116 @@ def test_manifest_atomic_under_reconfig():
         topo.close()
     assert not errors, f"torn manifest read: {errors[:3]}"
     assert reads[0] > 0
+
+
+# ---------------------------------------------------------------------------
+# shard-count-aware device rebalancing (fdt_upgrade satellite)
+
+
+def test_device_partition_unit():
+    """device_partition is the runtime restatement of the boot-time
+    assignment: rank-strided over the LIVE active set, disjoint cover,
+    modulo sharing when devices are scarce, empty for inactive."""
+    from firedancer_tpu.disco.elastic import device_partition
+
+    universe = [0, 1, 2, 3]
+    # sole member owns the whole universe; inactive members own nothing
+    assert device_partition(universe, 0b001, 0) == [0, 1, 2, 3]
+    assert device_partition(universe, 0b001, 1) == []
+    # scale-out to two: the spare RECRUITS the ordinals the incumbent
+    # releases (strided, so each member keeps a spread of devices)
+    assert device_partition(universe, 0b011, 0) == [0, 2]
+    assert device_partition(universe, 0b011, 1) == [1, 3]
+    # holes in the mask: ranks follow the sorted active list
+    assert device_partition(universe, 0b101, 2) == [1, 3]
+    # any mask covers the universe disjointly
+    parts = [device_partition(universe, 0b111, i) for i in range(3)]
+    flat = sorted(x for p in parts for x in p)
+    assert flat == universe
+    # scarcer devices than members: round-robin sharing, never empty
+    # for an active member
+    assert device_partition([7], 0b011, 0) == [7]
+    assert device_partition([7], 0b011, 1) == [7]
+    assert device_partition([5, 9], 0b111, 2) == [5]
+
+
+def _dev_stub(digests, sigs, pubs):
+    """Module-level device stub (picklable): host verify, any index."""
+    return hostpath.verify_batch_digest_host(digests, sigs, pubs)
+
+
+def test_device_universe_scale_recruits_and_returns_ordinals():
+    """Live rebalance: scale-out hands the activated spare its strided
+    slice of the kind-wide device universe AT BOOT and the incumbent
+    releases it at the next quiet pool boundary; scale-in returns the
+    retiree's ordinals to the survivor — with the stream exactly-once
+    across both repartitions."""
+    pool_n, repeat = 128, 2
+    rows, szs, _ = make_txn_pool(pool_n, seed=17)
+    total = pool_n * repeat
+    topo = Topology(name=f"tdu{os.getpid()}", runtime="thread")
+    topo.link("synth_verify", depth=256, mtu=wire.LINK_MTU)
+    for i in range(2):
+        topo.link(f"verify{i}_dedup", depth=256, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=256, mtu=wire.LINK_MTU)
+    synth = SynthTile(rows, szs, total=total, repeat=repeat)
+    topo.tile(synth, outs=["synth_verify"])
+    for i in range(2):
+        topo.tile(
+            VerifyTile(
+                msg_width=256, max_lanes=32, pre_dedup=False,
+                device="off", device_fn=_dev_stub, async_depth=2,
+                device_universe=[0, 1, 2, 3], name=f"verify{i}",
+            ),
+            ins=[("synth_verify", True)], outs=[f"verify{i}_dedup"],
+        )
+    topo.tile(
+        DedupTile(depth=1 << 12),
+        ins=[(f"verify{i}_dedup", True) for i in range(2)],
+        outs=["dedup_sink"],
+    )
+    topo.tile(SinkTile(shm_log=4 * total), ins=[("dedup_sink", True)])
+    topo.declare_shards(
+        "verify", ["verify0", "verify1"], producer="synth",
+        producer_link="synth_verify", active=1,
+    )
+    topo.build()
+    topo.start(batch_max=32)
+    try:
+        v0 = topo.tiles["verify0"].tile
+        assert v0.device_indices == [0, 1, 2, 3]
+        assert topo.add_shard("verify") == 1
+        v1 = topo.tiles["verify1"].tile
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if v0.device_indices == [0, 2] and v1.device_indices == [1, 3]:
+                break
+            time.sleep(0.02)
+        assert v1.device_indices == [1, 3], "spare never recruited"
+        assert v0.device_indices == [0, 2], "incumbent never released"
+        assert v0.n_devices == 2 and len(v0._policies) == 2
+        topo.retire_shard("verify", 1, timeout_s=120.0, replay=256)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            topo.poll_failure()
+            if v0.device_indices == [0, 1, 2, 3]:
+                break
+            time.sleep(0.02)
+        assert v0.device_indices == [0, 1, 2, 3], (
+            "scale-in must return the retiree's ordinals"
+        )
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            sigs = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+            if len(set(sigs.tolist())) >= pool_n:
+                break
+            topo.poll_failure()
+            time.sleep(0.05)
+        sigs = read_siglog(topo.tile_alloc_view("sink", "siglog"))
+        uniq = set(sigs.tolist())
+        assert len(uniq) == pool_n, f"lost {pool_n - len(uniq)} frags"
+        assert len(sigs) == len(uniq), "duplicated frags past dedup"
+        topo.halt()
+    finally:
+        topo.close()

@@ -54,6 +54,7 @@ BAD_FIXTURES = {
     "ring_bad_stem_handler.py": "stem-native-handler",
     "ring_bad_hot_clock.py": "hot-path-clock",
     "ring_bad_admission_clock.py": "hot-path-clock",
+    "ring_bad_skip_handshake.py": "ring-handshake-rebind",
     "proc_bad_unsafe_tile.py": "proc-safe-tile",
     "purity_bad_host_sync.py": "purity-host-sync",
     "purity_bad_float.py": "purity-float",

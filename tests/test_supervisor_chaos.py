@@ -582,3 +582,85 @@ def test_chaos_soak_smoke():
 
     report = run_soak(seed=7, n_txns=96, n_faults=4)
     assert report["ok"], report
+
+
+# ---------------------------------------------------------------------------
+# ack-floor fseq holdback (fdt_upgrade endurance-gauntlet finding): a
+# tile with an async internal pipeline must not let the producer
+# overwrite consumed-but-unpublished frags
+
+
+def test_ack_floor_tracks_pipeline_stages():
+    """Unit: the floor is the oldest frag seq across every pipeline
+    stage (publish queue < device pool < staging, FIFO), None when
+    everything consumed has been flushed."""
+    v = VerifyTile(
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off"
+    )
+    assert v.ack_floor(None, 0) is None
+    v._staged.append({"seqs": np.array([7, 8], np.uint64)})
+    assert v.ack_floor(None, 0) == 7
+    v._outq.append({"seqs": np.array([3], np.uint64)})
+    assert v.ack_floor(None, 0) == 3  # publish queue is oldest
+    v._outq.clear()
+    assert v.ack_floor(None, 0) == 7
+    v._staged.clear()
+    assert v.ack_floor(None, 0) is None
+
+
+def test_kill_beyond_ring_depth_loses_nothing():
+    """Regression (found by scripts/endurance.py, fixed via
+    Tile.ack_floor): with a stream LONGER than the ring, a SIGKILL of
+    the async verify tile used to lose the frags its device pipeline
+    held — the advanced fseq let the producer overwrite them beyond
+    the rejoin replay window.  The fseq holdback keeps them producer-
+    protected, so recovery is exact at any stream length."""
+    n = 384  # > ring depth: the whole stream can NOT sit in the ring
+    depth = 256
+    inj = FaultInjector(seed=1, faults=[
+        Fault("verify", "kill", at=240, on="frag"),
+    ])
+    rows, szs, _ = make_txn_pool(n, seed=42)
+    synth = SynthTile(rows, szs, total=n)
+    verify = VerifyTile(
+        msg_width=256, max_lanes=32, pre_dedup=False, device="off",
+        device_fn=hostpath.verify_batch_digest_host, async_depth=2,
+    )
+    dedup = DedupTile(depth=1 << 12)
+    sink = SinkTile(record=True, shm_log=8 * n)
+    topo = Topology()
+    topo.link("synth_verify", depth=depth, mtu=wire.LINK_MTU)
+    topo.link("verify_dedup", depth=depth, mtu=wire.LINK_MTU)
+    topo.link("dedup_sink", depth=depth, mtu=wire.LINK_MTU)
+    topo.tile(synth, outs=["synth_verify"])
+    topo.tile(verify, ins=[("synth_verify", True)], outs=["verify_dedup"])
+    topo.tile(dedup, ins=[("verify_dedup", True)], outs=["dedup_sink"])
+    topo.tile(sink, ins=[("dedup_sink", True)])
+    sup = Supervisor(
+        topo,
+        RestartPolicy(
+            hb_timeout_s=0.5, backoff_base_s=0.05, breaker_n=8,
+            replay={"verify": depth, "dedup": depth},
+        ),
+        faults=inj,
+    )
+    sup.start(batch_max=32)
+    try:
+        def fail_fast():
+            bad = {
+                t: d for t in topo.tiles
+                if (d := sup.degraded(t)) is not None
+            }
+            assert not bad, f"tiles degraded: {bad}"
+
+        _wait(
+            lambda: len(set(sink.all_sigs().tolist())) >= n,
+            120.0, fail_fast,
+        )
+    finally:
+        sup.halt()
+    sigs = sink.all_sigs().tolist()
+    assert len(set(sigs)) == n, f"lost {n - len(set(sigs))} txns"
+    assert len(sigs) == len(set(sigs)), "duplicate admitted past dedup"
+    assert sup.restarts("verify") == 1
+    topo.close()
