@@ -17,7 +17,8 @@ Types are normalized to ABI-relevant triples (kind, width, signed):
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
+from bisect import bisect_left
+from dataclasses import dataclass, field
 from pathlib import Path
 
 # ABI triple: (kind, width-bytes, signed).  Pointers are all equivalent at
@@ -181,3 +182,365 @@ def fmt_ctype(t: CType) -> str:
     if kind in ("void", "ptr"):
         return kind
     return f"{'i' if signed else 'u'}{width * 8}"
+
+
+# ---------------------------------------------------------------------------
+# Statement-level parser (fdtshm).
+#
+# The prototype parser above answers "what is exported"; the shared-memory
+# effects analyzer (shmlint.py) needs "what does each statement DO".  This
+# is still not a C frontend: it is a delimiter-exact recursive splitter
+# tuned to the native layer's plain C11 — paren/brace/bracket matching is
+# real (string- and char-literal aware), preprocessor lines and comments
+# are skipped, and control flow (if/else, for/while/do, switch, blocks,
+# labels) is recovered structurally so the analyzer knows which loop(s)
+# enclose every access.  Expressions inside a statement stay as text; the
+# effects extractor pattern-matches them.
+
+#: control / declaration words that can never be a function or call name
+_C_KEYWORDS = {
+    "if", "else", "for", "while", "do", "switch", "case", "default",
+    "return", "goto", "break", "continue", "sizeof", "typedef",
+    "struct", "union", "enum",
+}
+
+
+@dataclass
+class CStmt:
+    """One parsed statement.
+
+    kind      "expr" | "if" | "loop" | "switch" | "block"
+    line      1-based source line of the statement start
+    text      expression text for "expr"; condition/header text for
+              "if"/"loop"/"switch"; "" for "block"
+    loop_kind "for" | "while" | "do" for kind=="loop"
+    body      nested statements (then-branch for "if")
+    orelse    else-branch statements for "if"
+    """
+
+    kind: str
+    line: int
+    text: str
+    loop_kind: str = ""
+    body: list["CStmt"] = field(default_factory=list)
+    orelse: list["CStmt"] = field(default_factory=list)
+
+
+@dataclass
+class CFunc:
+    """One parsed function definition (static or exported)."""
+
+    name: str
+    line: int
+    static: bool
+    params: str
+    body: list[CStmt]
+
+
+def _skip_literal(text: str, i: int) -> int:
+    """Index just past the string/char literal starting at text[i]."""
+    q = text[i]
+    i += 1
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == q:
+            return i + 1
+        i += 1
+    return n
+
+
+def match_group(text: str, i: int) -> int:
+    """Index just past the delimiter matching text[i] ('(' / '{' / '[')."""
+    openc = text[i]
+    closec = {"(": ")", "{": "}", "[": "]"}[openc]
+    depth = 0
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if c in "\"'":
+            i = _skip_literal(text, i)
+            continue
+        if c == openc:
+            depth += 1
+        elif c == closec:
+            depth -= 1
+            if not depth:
+                return i + 1
+        i += 1
+    return n
+
+
+def _skip_preproc(text: str, i: int, hi: int) -> int:
+    """Index past a preprocessor line at text[i], honoring backslash
+    continuations."""
+    while i < hi:
+        j = text.find("\n", i, hi)
+        if j < 0:
+            return hi
+        if j > i and text[j - 1] == "\\":
+            i = j + 1
+            continue
+        return j + 1
+    return hi
+
+
+def find_calls(text: str) -> list[tuple[str, str, int]]:
+    """All `name( args )` call sites in an expression text, in source
+    order: (name, args_text, offset_of_name).  Includes nested calls;
+    excludes control keywords and casts (where ')' precedes '(')."""
+    out: list[tuple[str, str, int]] = []
+    for m in re.finditer(r"\b([A-Za-z_][A-Za-z0-9_]*)\s*\(", text):
+        name = m.group(1)
+        if name in _C_KEYWORDS:
+            continue
+        op = m.end() - 1
+        end = match_group(text, op)
+        out.append((name, text[op + 1 : end - 1], m.start(1)))
+    return out
+
+
+def split_args(args_text: str) -> list[str]:
+    """Split a call's argument text at top-level commas."""
+    out: list[str] = []
+    depth = 0
+    start = 0
+    i = 0
+    n = len(args_text)
+    while i < n:
+        c = args_text[i]
+        if c in "\"'":
+            i = _skip_literal(args_text, i)
+            continue
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        elif c == "," and depth == 0:
+            out.append(args_text[start:i].strip())
+            start = i + 1
+        i += 1
+    tail = args_text[start:].strip()
+    if tail or out:
+        out.append(tail)
+    return out
+
+
+class _Lines:
+    """Offset -> 1-based line number, via bisect over newline positions."""
+
+    def __init__(self, text: str):
+        self._nl = [m.start() for m in re.finditer("\n", text)]
+
+    def at(self, i: int) -> int:
+        return bisect_left(self._nl, i) + 1
+
+
+_LABEL_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)\s*:(?!:)")
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _parse_stmt(
+    text: str, i: int, hi: int, lines: _Lines
+) -> tuple[CStmt | None, int]:
+    """Parse one statement starting at/after offset i.  Returns
+    (stmt_or_None, next_offset); None means the region was consumed
+    without producing a node (labels, stray ';', preprocessor lines)."""
+    while i < hi and text[i].isspace():
+        i += 1
+    if i >= hi:
+        return None, hi
+    c = text[i]
+    if c == "#":
+        return None, _skip_preproc(text, i, hi)
+    if c == ";":
+        return None, i + 1
+    if c == "{":
+        end = match_group(text, i)
+        body = _parse_stmts(text, i + 1, end - 1, lines)
+        return CStmt("block", lines.at(i), "", body=body), end
+
+    m = _WORD_RE.match(text, i, hi)
+    word = m.group(0) if m else ""
+
+    if word in ("case", "default"):
+        # `case EXPR :` — the expr is a constant with no top-level ':'
+        j = i
+        while j < hi:
+            ch = text[j]
+            if ch in "\"'":
+                j = _skip_literal(text, j)
+                continue
+            if ch in "([{":
+                j = match_group(text, j)
+                continue
+            if ch == ":":
+                return None, j + 1
+            j += 1
+        return None, hi
+    lm = _LABEL_RE.match(text, i, hi)
+    if lm and lm.group(1) not in _C_KEYWORDS:
+        return None, i + lm.end() - lm.start()
+
+    if word == "do":
+        body, j = _parse_body(text, i + 2, hi, lines)
+        cond = ""
+        wm = re.compile(r"\s*while\s*").match(text, j, hi)
+        if wm:
+            j = wm.end()
+            if j < hi and text[j] == "(":
+                end = match_group(text, j)
+                cond = text[j + 1 : end - 1]
+                j = end
+            sc = text.find(";", j, hi)
+            j = sc + 1 if sc >= 0 else hi
+        return CStmt("loop", lines.at(i), cond, loop_kind="do", body=body), j
+
+    if word in ("if", "for", "while", "switch"):
+        line = lines.at(i)
+        j = i + len(word)
+        while j < hi and text[j].isspace():
+            j += 1
+        hdr = ""
+        if j < hi and text[j] == "(":
+            end = match_group(text, j)
+            hdr = text[j + 1 : end - 1]
+            j = end
+        body, j = _parse_body(text, j, hi, lines)
+        if word == "if":
+            orelse: list[CStmt] = []
+            em = re.compile(r"\s*else\b").match(text, j, hi)
+            if em:
+                orelse, j = _parse_body(text, em.end(), hi, lines)
+            return CStmt("if", line, hdr, body=body, orelse=orelse), j
+        if word == "switch":
+            return CStmt("switch", line, hdr, body=body), j
+        return CStmt("loop", line, hdr, loop_kind=word, body=body), j
+
+    # simple statement: scan to ';' at top level.  Compound literals and
+    # array subscripts are skipped whole, so a ';' can only terminate.
+    j = i
+    while j < hi:
+        ch = text[j]
+        if ch in "\"'":
+            j = _skip_literal(text, j)
+            continue
+        if ch in "([{":
+            j = match_group(text, j)
+            continue
+        if ch == ";":
+            break
+        j += 1
+    return CStmt("expr", lines.at(i), text[i:j].strip()), j + 1
+
+
+def _parse_body(
+    text: str, i: int, hi: int, lines: _Lines
+) -> tuple[list[CStmt], int]:
+    """Parse one statement as a control-flow body; `{...}` yields its
+    inner statement list, a single statement yields a one-element list."""
+    while True:
+        st, i = _parse_stmt(text, i, hi, lines)
+        if st is not None:
+            if st.kind == "block":
+                return st.body, i
+            return [st], i
+        if i >= hi:
+            return [], i
+
+
+def _parse_stmts(text: str, i: int, hi: int, lines: _Lines) -> list[CStmt]:
+    out: list[CStmt] = []
+    while i < hi:
+        st, i = _parse_stmt(text, i, hi, lines)
+        if st is not None:
+            out.append(st)
+    return out
+
+
+def _split_header(hdr: str) -> tuple[str, str, str] | None:
+    """Split a candidate function header `ret name ( params )` into
+    (prefix, name, params); None when it is not function-shaped."""
+    h = hdr.rstrip()
+    if not h.endswith(")"):
+        return None
+    depth = 0
+    j = len(h) - 1
+    while j >= 0:
+        c = h[j]
+        if c == ")":
+            depth += 1
+        elif c == "(":
+            depth -= 1
+            if not depth:
+                break
+        j -= 1
+    if j < 0:
+        return None
+    m = re.search(r"([A-Za-z_][A-Za-z0-9_]*)\s*$", h[:j])
+    if m is None:
+        return None
+    name = m.group(1)
+    if name in _C_KEYWORDS:
+        return None
+    prefix = h[: m.start()]
+    if "=" in prefix or not re.search(r"[A-Za-z_]", prefix):
+        return None
+    return prefix, name, h[j + 1 : -1]
+
+
+def parse_c_functions(source: str) -> list[CFunc]:
+    """Parse every function definition (static and exported) in a C
+    source string into statement trees."""
+    text = strip_comments(source)
+    lines = _Lines(text)
+    funcs: list[CFunc] = []
+    i = 0
+    n = len(text)
+    seg_start = 0
+    while i < n:
+        c = text[i]
+        if c in "\"'":
+            i = _skip_literal(text, i)
+            continue
+        if c == "#":
+            i = _skip_preproc(text, i, n)
+            seg_start = i
+            continue
+        if c in ";}":
+            seg_start = i + 1
+            i += 1
+            continue
+        if c in "([":
+            i = match_group(text, i)
+            continue
+        if c == "{":
+            hdr = text[seg_start:i]
+            end = match_group(text, i)
+            split = _split_header(hdr)
+            if split is not None:
+                prefix, name, params = split
+                funcs.append(
+                    CFunc(
+                        name=name,
+                        line=lines.at(i),
+                        static="static" in prefix.split(),
+                        params=params,
+                        body=_parse_stmts(text, i + 1, end - 1, lines),
+                    )
+                )
+                seg_start = end
+            # non-function `{` (struct/enum/initializer): the tail after
+            # the closing brace (`} name;` / `} = init;`) resets seg at
+            # the next ';'
+            i = end
+            continue
+        i += 1
+    return funcs
+
+
+def parse_c_file(path: Path) -> list[CFunc]:
+    return parse_c_functions(path.read_text())

@@ -18,7 +18,7 @@ import json
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from . import abi, procsafe, purity, ringlint
+from . import abi, procsafe, purity, ringlint, shmlint
 from .findings import Finding
 
 #: the ctypes binding modules the ABI checker must always cover — every
@@ -80,7 +80,9 @@ class Report:
                 "fdtlint: clean "
                 f"({cov.get('abi', {}).get('call_sites', 0)} native call "
                 f"sites, {len(cov.get('ring_files', []))} ring-lint files, "
-                f"{cov.get('hot_functions', 0)} @hot_path functions)"
+                f"{cov.get('hot_functions', 0)} @hot_path functions, "
+                f"{cov.get('shm_effects', 0)} shm effects in "
+                f"{cov.get('shm_functions', 0)} native functions)"
             )
         return "\n".join(str(f) for f in sorted(self.findings))
 
@@ -105,11 +107,22 @@ def run_repo(root: Path | str | None = None) -> Report:
     rep.coverage["abi"] = abi_cov
 
     # -- native C publish discipline (stem-emit-only, ISSUE 15) ----------
+    # -- + C11 shared-memory effects contract (fdtshm, ISSUE 18) ---------
     native_c_files: list[str] = []
+    shm_functions = 0
+    shm_effects = 0
     for p in sorted(native.glob("*.c")):
         native_c_files.append(p.relative_to(root).as_posix())
         rep.findings.extend(ringlint.check_native_c_file(p, rel=root))
+        rep.findings.extend(shmlint.check_native_c_file(p, rel=root))
+        summ = shmlint.file_summary(p)
+        shm_functions += summ["functions"]
+        shm_effects += summ["effects"]
     rep.coverage["native_c_files"] = native_c_files
+    # asserted coverage: a native file whose functions/effects the shm
+    # analyzer cannot see would pass vacuously — counts make that loud
+    rep.coverage["shm_functions"] = shm_functions
+    rep.coverage["shm_effects"] = shm_effects
 
     # -- ring discipline + spawn safety: tiles/ + disco/ -----------------
     proc_safe_files = 0
@@ -175,11 +188,15 @@ def run_paths(paths: list[Path | str]) -> Report:
                         rep.findings.extend(
                             ringlint.check_native_c_file(cp, rel=p)
                         )
+                        rep.findings.extend(
+                            shmlint.check_native_c_file(cp, rel=p)
+                        )
             targets = py_paths
         elif p.suffix == ".c":
-            # C fixture / targeted native-source run: the publish
-            # discipline (stem-emit-only) is the only C-side rule
+            # C fixture / targeted native-source run: publish discipline
+            # (stem-emit-only) + the fdtshm shared-memory contract
             rep.findings.extend(ringlint.check_native_c_file(p))
+            rep.findings.extend(shmlint.check_native_c_file(p))
             targets = []
         elif p.suffix == ".py":
             targets = [p]

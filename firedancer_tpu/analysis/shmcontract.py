@@ -1,0 +1,509 @@
+"""fdtshm concurrency contract: the declared shared-memory discipline of
+tango/native/*.c.
+
+This module is DATA, not analysis: it names every shared word class the
+native layer touches, who may store to each, what memory order a store
+needs, which calls publish frags, which calls re-read credit, and which
+functions run under a crash journal.  shmlint.py extracts per-function
+effects summaries from the C and checks them against these tables; a new
+native handler that touches shared memory in a new way fails the lint
+until its ownership/ordering is declared here — the contract is the
+review artifact.
+
+Word classes (the `cls` strings on effects and in the tables below):
+
+    mcache.seq        per-line seq word (the publish commit word)
+    mcache.seq_prod   producer watermark in the mcache header
+    mcache.line       line payload fields (sig/chunk/sz/ctl/tsorig/tspub)
+    shm.geom          immutable geometry (magic/depth/seq0/map_cnt)
+    fseq.seq          consumer progress word
+    fseq.diag         fseq diagnostic counters
+    cnc.sig           command-and-control signal word
+    cnc.heartbeat     liveness heartbeat word
+    tcache.hdr        tcache ring_cnt/ring_head cursors
+    tcache.ring       tcache eviction ring entries
+    tcache.map        tcache open-addressed key map
+    journal.phase     crash-journal arm words (poh/shred/dedup/bank)
+    journal.data      crash-journal payload words
+    journal.completed bank fused-pipeline completion watermark
+    epoch             runtime epoch word (fdt_upgrade; native read-only)
+    trace.ring.reserve / trace.ring.commit / trace.ring.events
+                      span-ring cursors + event slots (fdttrace)
+    trace.hist        native histogram words (cross-process readable)
+    trace.clock       deterministic-clock words (tests share these)
+    stem.cfg          stem cfg/descriptor words (tile-owned)
+    poh.state / poh.cfg, shred.batch / shred.state, net.state
+                      per-tile persistent state words
+
+Out of scope (deliberately): fdt_bank.c slot fields (state/lamports/
+ver/synced) are CAS-mediated multi-writer words under the claim
+protocol — a different discipline with its own model (fdtmc's bank
+scenarios + the SIGKILL harnesses), not single-writer ring publish.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# word classification
+
+
+@dataclass(frozen=True)
+class WordRule:
+    """Maps an access-expression pattern to a word class.
+
+    pattern  regex searched in the access expression text
+    cls      word class assigned on match
+    files    restrict to these basenames ("" entry = any file)
+    funcs    restrict to functions whose name starts with one of these
+             prefixes (empty = any function)
+    """
+
+    pattern: str
+    cls: str
+    files: tuple[str, ...] = ()
+    funcs: tuple[str, ...] = ()
+
+
+#: ordered: first match wins.  Patterns are scoped by file (and where one
+#: file reuses a variable idiom for two structures, by function prefix)
+#: so e.g. `ring[` means the tcache eviction ring in fdt_tango.c but the
+#: span ring in fdt_trace.c.
+WORD_RULES: tuple[WordRule, ...] = (
+    # -- fdt_tango.c: fseq / cnc (cast-keyed or function-scoped; BEFORE
+    #    the mcache rules, which also match `->seq` / `->sig`)
+    WordRule(r"fdt_fseq_t[^;]*->\s*diag\b", "fseq.diag", ("fdt_tango.c",)),
+    WordRule(r"fdt_fseq_t[^;]*->\s*seq\b", "fseq.seq", ("fdt_tango.c",)),
+    WordRule(r"->\s*diag\b", "fseq.diag", ("fdt_tango.c",), ("fdt_fseq_",)),
+    WordRule(r"->\s*seq\b", "fseq.seq", ("fdt_tango.c",), ("fdt_fseq_",)),
+    WordRule(r"fdt_cnc_t[^;]*->\s*sig\b", "cnc.sig", ("fdt_tango.c",)),
+    WordRule(
+        r"fdt_cnc_t[^;]*->\s*heartbeat\b", "cnc.heartbeat", ("fdt_tango.c",)
+    ),
+    WordRule(r"->\s*sig\b", "cnc.sig", ("fdt_tango.c",), ("fdt_cnc_",)),
+    WordRule(
+        r"->\s*heartbeat\b", "cnc.heartbeat", ("fdt_tango.c",), ("fdt_cnc_",)
+    ),
+    # -- fdt_tango.c: mcache
+    WordRule(
+        r"->\s*seq_prod\b", "mcache.seq_prod", ("fdt_tango.c",), ("fdt_mcache_",)
+    ),
+    WordRule(r"->\s*seq\b", "mcache.seq", ("fdt_tango.c",), ("fdt_mcache_",)),
+    WordRule(
+        r"\bline\[[^\]]*\]\s*\.\s*seq\b",
+        "mcache.seq",
+        ("fdt_tango.c",),
+        ("fdt_mcache_",),
+    ),
+    WordRule(
+        r"->\s*(sig|chunk|sz|ctl|tsorig|tspub)\b",
+        "mcache.line",
+        ("fdt_tango.c",),
+        ("fdt_mcache_",),
+    ),
+    # -- fdt_tango.c: immutable geometry + tcache
+    WordRule(
+        r"->\s*(magic|depth|seq0|map_cnt)\b", "shm.geom", ("fdt_tango.c",)
+    ),
+    WordRule(r"->\s*(ring_cnt|ring_head)\b", "tcache.hdr", ("fdt_tango.c",)),
+    WordRule(
+        r"\bjnl\[\s*[23]\s*\]", "journal.phase", ("fdt_tango.c",)
+    ),
+    WordRule(r"\bjnl\[", "journal.data", ("fdt_tango.c",)),
+    WordRule(
+        r"\bring\[",
+        "tcache.ring",
+        ("fdt_tango.c",),
+        ("fdt_tcache_", "tc_map_", "tc_ring"),
+    ),
+    WordRule(
+        r"\bmap\[",
+        "tcache.map",
+        ("fdt_tango.c",),
+        ("fdt_tcache_", "tc_map_"),
+    ),
+    # -- fdt_stem.c: dedup journal, fused-bank journal, epoch, cfg
+    WordRule(r"\bjnl\[\s*DJ_PHASE\b", "journal.phase", ("fdt_stem.c",)),
+    WordRule(r"\bjnl\[\s*DJ_", "journal.data", ("fdt_stem.c",)),
+    WordRule(
+        r"\bjw\[\s*BJ_COMPLETED\b", "journal.completed", ("fdt_stem.c",)
+    ),
+    WordRule(r"\bjw\[\s*BJ_", "journal.data", ("fdt_stem.c",)),
+    WordRule(r"\bC_EPOCH_PTR\b", "epoch", ("fdt_stem.c",)),
+    WordRule(r"\bcfg\[", "stem.cfg", ("fdt_stem.c",)),
+    # -- fdt_poh.c
+    WordRule(r"\bj\[\s*FDT_POH_J_PHASE\b", "journal.phase", ("fdt_poh.c",)),
+    WordRule(r"\bj\[\s*FDT_POH_J_", "journal.data", ("fdt_poh.c",)),
+    WordRule(
+        r"\bw\[\s*FDT_POH_W_(HASHCNT|TICKS|SLOT|HW0)\b",
+        "poh.state",
+        ("fdt_poh.c",),
+    ),
+    WordRule(r"\bw\[\s*FDT_POH_W_", "poh.cfg", ("fdt_poh.c",)),
+    # -- fdt_shred.c
+    WordRule(
+        r"\bw\[\s*FDT_SHRED_W_J_PHASE\b", "journal.phase", ("fdt_shred.c",)
+    ),
+    WordRule(r"\bw\[\s*FDT_SHRED_W_J_", "journal.data", ("fdt_shred.c",)),
+    WordRule(
+        r"\bw\[\s*FDT_SHRED_W_(BATCH_LEN|HW_ENT)\b",
+        "shred.batch",
+        ("fdt_shred.c",),
+    ),
+    WordRule(r"\bw\[\s*FDT_SHRED_W_", "shred.state", ("fdt_shred.c",)),
+    # -- fdt_bank.c: the per-microbatch undo journal
+    WordRule(r"\bj\[\s*J_PHASE\b", "journal.phase", ("fdt_bank.c",)),
+    WordRule(
+        r"\bj\[\s*J_(TAG|DONE|NUNDO|DPRE|ENT)\b", "journal.data", ("fdt_bank.c",)
+    ),
+    # -- fdt_trace.c
+    WordRule(
+        r"\bring\[\s*RING_W_RESERVE\b", "trace.ring.reserve", ("fdt_trace.c",)
+    ),
+    WordRule(
+        r"\bring\[\s*RING_W_COMMITTED\b", "trace.ring.commit", ("fdt_trace.c",)
+    ),
+    WordRule(r"\bev\[", "trace.ring.events", ("fdt_trace.c",)),
+    WordRule(r"\bh\[", "trace.hist", ("fdt_trace.c",)),
+    WordRule(r"\bc\[\s*[01]\s*\]", "trace.clock", ("fdt_trace.c",)),
+    # -- fdt_net.c
+    WordRule(r"\bw\[\s*FDT_NET_W_", "net.state", ("fdt_net.c",)),
+)
+
+
+def classify(expr: str, file: str, func: str) -> str:
+    """Word class of one access expression ("" = unclassified/local)."""
+    for r in WORD_RULES:
+        if r.files and file not in r.files:
+            continue
+        if r.funcs and not func.startswith(r.funcs):
+            continue
+        if re.search(r.pattern, expr):
+            return r.cls
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# rule 1: single-writer ownership.  Stores (incl. rmw/cas) to a class
+# listed here are legal only from the named functions; classes absent
+# from the table are unconstrained (tile-local words).
+
+SINGLE_WRITER: dict[str, frozenset[str]] = {
+    k: frozenset(v)
+    for k, v in {
+        "mcache.seq": {"fdt_mcache_new", "fdt_mcache_publish"},
+        "mcache.seq_prod": {
+            "fdt_mcache_new",
+            "fdt_mcache_publish",
+            "fdt_mcache_seq_advance",
+        },
+        "mcache.line": {"fdt_mcache_publish"},
+        "shm.geom": {"fdt_mcache_new", "fdt_tcache_new"},
+        "fseq.seq": {"fdt_fseq_new", "fdt_fseq_update"},
+        "fseq.diag": {"fdt_fseq_new", "fdt_fseq_diag_add"},
+        "cnc.sig": {"fdt_cnc_new", "fdt_cnc_signal"},
+        "cnc.heartbeat": {"fdt_cnc_new", "fdt_cnc_heartbeat"},
+        "tcache.hdr": {"fdt_tcache_new", "fdt_tcache_reset", "fdt_tcache_dedup_j"},
+        "tcache.ring": {"fdt_tcache_dedup_j"},
+        "tcache.map": {"tc_map_insert", "tc_map_remove"},
+        "journal.phase": {
+            "fdt_tcache_dedup_j",
+            "h_dedup",
+            "fdt_poh_mixins",
+            "fdt_poh_tick",
+            "fdt_shred_entries",
+            "ov_apply",
+            "journal_rollback",
+        },
+        "journal.data": {
+            "fdt_tcache_dedup_j",
+            "h_dedup",
+            "fdt_poh_mixins",
+            "fdt_poh_tick",
+            "fdt_shred_entries",
+            "ov_apply",
+            "journal_rollback",
+            "fdt_bank_exec",
+            "fdt_bank_pipeline",
+        },
+        "journal.completed": {"fdt_bank_pipeline"},
+        # the epoch word is published by the Python supervisor
+        # (fdt_upgrade); NO native function may store it
+        "epoch": set(),
+        "trace.ring.reserve": {"fdt_trace_span_block"},
+        "trace.ring.commit": {"fdt_trace_span_block"},
+        "trace.ring.events": {"fdt_trace_span_block"},
+        "trace.hist": {"fdt_trace_hist_sample"},
+        "trace.clock": {"fdt_trace_read_clock"},
+        "poh.state": {"fdt_poh_mixins", "fdt_poh_tick"},
+        "poh.cfg": {"fdt_poh_tick"},
+        "shred.batch": {"fdt_shred_entries"},
+        "shred.state": {
+            "fdt_shred_entries",
+            "fdt_shred_sign",
+            "fdt_shred_drain",
+        },
+    }.items()
+}
+
+# ---------------------------------------------------------------------------
+# rule 2: publish ordering.  Minimum memory order for a STORE to each
+# class ("relaxed" = must be atomic, any order).  A "relaxed" store to a
+# release-class word is additionally accepted when a release (or
+# stronger) fence follows later in the same function — the
+# invalidate-then-fence idiom of fdt_mcache_publish.
+
+_ORDER_RANK = {
+    "plain": 0,
+    "relaxed": 1,
+    "acquire": 2,
+    "release": 3,
+    "acq_rel": 4,
+    "seq_cst": 5,
+}
+
+MIN_STORE_ORDER: dict[str, str] = {
+    "mcache.seq": "release",
+    "mcache.seq_prod": "release",
+    "fseq.seq": "release",
+    "fseq.diag": "relaxed",
+    "cnc.sig": "release",
+    "cnc.heartbeat": "relaxed",
+    "journal.phase": "release",
+    "journal.completed": "release",
+    "trace.ring.reserve": "seq_cst",
+    "trace.ring.commit": "release",
+    "trace.hist": "relaxed",
+    "trace.clock": "relaxed",
+}
+
+#: payload class -> commit class: every store to the payload class must
+#: precede the function's final release-ordered store to the commit class
+PUBLISH_PAIRS: tuple[tuple[str, str], ...] = (
+    ("mcache.line", "mcache.seq"),
+    ("trace.ring.events", "trace.ring.commit"),
+)
+
+#: construction/reset paths: memory not yet shared (or caller-serialized
+#: by the reset contract), so plain stores and any order are legal
+INIT_FUNCS = frozenset(
+    {
+        "fdt_mcache_new",
+        "fdt_fseq_new",
+        "fdt_cnc_new",
+        "fdt_tcache_new",
+        "fdt_tcache_reset",
+    }
+)
+
+# ---------------------------------------------------------------------------
+# rule 3: credit dominance.  A call to any PUBLISHING_CALL is a publish
+# site; on the path to it the caller must have re-read credit (a
+# CREDIT_CALL) with at most MAX_LOOPS_BETWEEN loop back-edges between
+# the read and the publish.  Functions in PUBLISHING_CALLS are publish
+# *primitives/wrappers* — their own bodies are exempt (every caller is
+# checked instead); everything else that publishes is checked internally.
+
+CREDIT_CALLS = frozenset(
+    {"fdt_fctl_cr_avail", "fdt_fseq_query", "fdt_stem_out_cr", "stem_min_cr"}
+)
+
+PUBLISHING_CALLS = frozenset(
+    {
+        "fdt_mcache_publish",
+        "fdt_mcache_publish_batch",
+        "stem_emit_common",
+        "fdt_stem_out_emit",
+        "fdt_stem_out_emit_at",
+        "stem_publish",
+        # stem handlers: gated by the burst loop's stem_min_cr sweep
+        "h_dedup",
+        "h_bank",
+        "h_poh",
+        "fdt_poh_mixins",
+    }
+)
+
+#: a credit read may be hoisted out of at most this many enclosing loops
+#: relative to its publish (the per-sweep pattern: read once at the top
+#: of the burst loop, publish per-frag one level down).  Two or more
+#: back-edges means the read goes stale across an outer sweep —
+#: the stem-burst-over-credit / pack-sched-stale-credit /
+#: shred-outq-stale-credit mutant class.
+MAX_LOOPS_BETWEEN = 1
+
+# ---------------------------------------------------------------------------
+# rule 4: journal-armed-before-mutate.  In any function that stores the
+# journal arm word (class journal.phase), the first store to a protected
+# class / call to a protected mutator must come after the first
+# release-ordered journal.phase store.
+
+JOURNAL_PROTECTED_CLASSES = frozenset(
+    {"poh.state", "shred.batch", "tcache.hdr", "tcache.ring", "tcache.map"}
+)
+
+JOURNAL_PROTECTED_CALLS = frozenset(
+    {
+        "fdt_tcache_dedup_j",
+        "tc_map_insert",
+        "tc_map_remove",
+        "fdt_sha256_mix",
+        "fdt_sha256_append",
+        "slot_store",
+        "fdt_bank_exec",
+    }
+)
+
+#: recovery paths replay under a journal the *crashed* writer armed;
+#: they mutate first and disarm last by design
+JOURNAL_ARM_EXEMPT = frozenset({"journal_rollback", "fdt_bank_recover"})
+
+# ---------------------------------------------------------------------------
+# rule 5: epoch check.  Any function draining frags in a loop must have
+# acquire-loaded the runtime epoch word first (fdt_upgrade's ring-ABI
+# handshake: a stale-epoch tile must not touch frags published under a
+# newer ABI).
+
+DRAIN_CALLS = frozenset({"fdt_mcache_drain"})
+EPOCH_MIN_ORDER = "acquire"
+
+
+def order_rank(order: str) -> int:
+    return _ORDER_RANK.get(order, 0)
+
+
+# ---------------------------------------------------------------------------
+# the fdtmc side of the differential: ordered shared accesses of the
+# RingHook micro-step decomposition (analysis/sched.py), extracted from
+# its AST.  tests/test_shmlint.py asserts these match the effects
+# shmlint extracts from fdt_tango.c access-for-access, order-for-order —
+# the model checker provably models what the C does.
+
+#: RingHook method -> native primitive it models
+RINGHOOK_METHODS: dict[str, str] = {
+    "mcache_publish": "fdt_mcache_publish",
+    "mcache_poll": "fdt_mcache_poll",
+    "mcache_seq_query": "fdt_mcache_seq_query",
+    "mcache_seq_advance": "fdt_mcache_seq_advance",
+    "fseq_query": "fdt_fseq_query",
+    "fseq_update": "fdt_fseq_update",
+    "fseq_diag": "fdt_fseq_diag_query",
+    "fseq_diag_add": "fdt_fseq_diag_add",
+    "cr_avail": "fdt_fctl_cr_avail",
+}
+
+#: shadow-attribute -> (object kind, field) for direct subscript accesses
+_SH_FIELDS = {
+    "seq_prod": ("mc", "seq_prod"),
+    "seq": ("fs", "seq"),
+    "diag": ("fs", "diag"),
+}
+#: alias roots: `line = sh.lines[...]` / `v = sh.diag[...].view(...)`
+_ALIAS_ROOTS = {"lines": ("mc", None), "diag": ("fs", "diag")}
+
+
+def _sh_attr(node: ast.AST) -> str | None:
+    """The `sh.<attr>` attribute name at the root of a value chain
+    (descending through calls/subscripts), or None."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "sh":
+                return node.attr
+            node = node.value
+        elif isinstance(node, (ast.Subscript, ast.Call)):
+            node = node.value if isinstance(node, ast.Subscript) else node.func
+        else:
+            return None
+
+
+def ringhook_accesses(sched_path: Path) -> dict[str, list[tuple[str, str, str]]]:
+    """method name -> ordered [(rw, obj, field)] shared accesses, where
+    rw is "r"/"w", obj is "mc"/"fs", and field is the struct field the
+    micro-step touches.  Local buffers (`out`, `tmp`) and the
+    native-passthrough guard are excluded; view/slice creation is
+    aliasing, not an access."""
+    tree = ast.parse(sched_path.read_text())
+    hook = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.ClassDef) and n.name == "RingHook"
+    )
+    out: dict[str, list[tuple[str, str, str]]] = {}
+    for fn in hook.body:
+        if not isinstance(fn, ast.FunctionDef) or fn.name not in RINGHOOK_METHODS:
+            continue
+        acc: list[tuple[str, str, str]] = []
+        aliases: dict[str, tuple[str, str | None]] = {}
+
+        def field_of(sub: ast.Subscript) -> tuple[str, str] | None:
+            base = sub.value
+            # alias["field"] / alias[0]
+            if isinstance(base, ast.Name) and base.id in aliases:
+                obj, fixed = aliases[base.id]
+                if fixed is not None:
+                    return (obj, fixed)
+                if isinstance(sub.slice, ast.Constant) and isinstance(
+                    sub.slice.value, str
+                ):
+                    return (obj, sub.slice.value)
+                return None
+            # sh.seq_prod[0] / sh.seq[0]
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "sh"
+                and base.attr in _SH_FIELDS
+            ):
+                return _SH_FIELDS[base.attr]
+            return None
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.If):
+                # skip the `if not self._scheduled(): return native(...)`
+                # passthrough guard; mutation guards keep their body (the
+                # body IS the unmutated protocol)
+                if "_scheduled" in ast.dump(node.test):
+                    return
+                for st in node.body + node.orelse:
+                    visit(st)
+                return
+            if isinstance(node, ast.Assign):
+                val = node.value
+                root = _sh_attr(val)
+                if (
+                    root in _ALIAS_ROOTS
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    aliases[node.targets[0].id] = _ALIAS_ROOTS[root]
+                    return  # view creation: aliasing, not an access
+                visit(val)  # reads first...
+                for t in node.targets:  # ...then the write
+                    if isinstance(t, ast.Subscript):
+                        f = field_of(t)
+                        if f:
+                            acc.append(("w", f[0], f[1]))
+                            visit(t.value)
+                            continue
+                    visit(t)
+                return
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                f = field_of(node)
+                if f:
+                    acc.append(("r", f[0], f[1]))
+                visit(node.value)
+                visit(node.slice)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for st in fn.body:
+            visit(st)
+        out[fn.name] = acc
+    return out

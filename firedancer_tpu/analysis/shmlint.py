@@ -1,0 +1,557 @@
+"""fdtshm — C11 shared-memory effects analyzer for tango/native/*.c.
+
+Extracts every load/store to shared memory from the native sources —
+atomic ops with their memory_order, plain accesses, and the word class
+each touches — into per-function effects summaries (linearized in
+source order, with the enclosing-loop path of every access), then checks
+them against the declared concurrency contract (shmcontract.py):
+
+    shm-single-writer   stores to an owned word class from a function
+                        outside its declared writer set
+    shm-publish-release a store to a commit/seq-class word below its
+                        minimum memory order, or payload stores that a
+                        release-ordered commit store does not cover
+    shm-stale-credit    a publish with no credit re-read on the path,
+                        or with 2+ loop back-edges since the last one
+    shm-journal-arm     journal-protected state mutated before the
+                        journal arm word's release store
+    shm-epoch-check     a frag-drain loop entered without an acquire
+                        load of the runtime epoch word
+
+The analyzer is deliberately linear (pre-order statement text order, no
+path-sensitivity): the native layer's discipline is *designed* to be
+linearly auditable — arm before mutate, read credit before publish,
+payload before seq — so a linear checker is exact for conforming code
+and anything it cannot prove conforming is worth a human look.  Inline
+`/* fdtlint: allow[rule] why */` pragmas suppress accepted findings.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from . import cparse, shmcontract
+from .findings import Finding, apply_pragmas
+
+
+@dataclass(frozen=True)
+class Effect:
+    """One shared-memory-relevant operation.
+
+    kind   "store" | "load" | "rmw" | "cas" | "fence" | "call"
+    cls    word class from shmcontract.WORD_RULES ("" = none)
+    order  "plain" | relaxed/acquire/release/acq_rel/seq_cst ("" = call)
+    name   callee name for kind=="call"
+    line   source line
+    loops  ids of enclosing loops, outermost first (loop headers count
+           as inside their loop: conditions re-run per iteration)
+    expr   the access/target expression text
+    """
+
+    kind: str
+    cls: str
+    order: str
+    name: str
+    line: int
+    loops: tuple[int, ...]
+    expr: str
+
+
+# ---------------------------------------------------------------------------
+# atomic builtin recognition
+
+#: name -> (kind, target arg index, order arg index, default order)
+_ATOMICS: dict[str, tuple[str, int | None, int | None, str]] = {
+    "atomic_store_explicit": ("store", 0, 2, "seq_cst"),
+    "atomic_load_explicit": ("load", 0, 1, "seq_cst"),
+    "atomic_exchange_explicit": ("rmw", 0, 2, "seq_cst"),
+    "atomic_fetch_add_explicit": ("rmw", 0, 2, "seq_cst"),
+    "atomic_fetch_sub_explicit": ("rmw", 0, 2, "seq_cst"),
+    "atomic_fetch_or_explicit": ("rmw", 0, 2, "seq_cst"),
+    "atomic_fetch_and_explicit": ("rmw", 0, 2, "seq_cst"),
+    "atomic_compare_exchange_strong_explicit": ("cas", 0, 3, "seq_cst"),
+    "atomic_compare_exchange_weak_explicit": ("cas", 0, 3, "seq_cst"),
+    "atomic_thread_fence": ("fence", None, 0, "seq_cst"),
+    "atomic_store": ("store", 0, None, "seq_cst"),
+    "atomic_load": ("load", 0, None, "seq_cst"),
+    "atomic_fetch_add": ("rmw", 0, None, "seq_cst"),
+    "__atomic_store_n": ("store", 0, 2, "seq_cst"),
+    "__atomic_load_n": ("load", 0, 1, "seq_cst"),
+    "__atomic_exchange_n": ("rmw", 0, 2, "seq_cst"),
+    "__atomic_fetch_add": ("rmw", 0, 2, "seq_cst"),
+    "__atomic_add_fetch": ("rmw", 0, 2, "seq_cst"),
+    "__atomic_fetch_sub": ("rmw", 0, 2, "seq_cst"),
+    "__atomic_sub_fetch": ("rmw", 0, 2, "seq_cst"),
+    "__atomic_compare_exchange_n": ("cas", 0, 4, "seq_cst"),
+    "__atomic_thread_fence": ("fence", None, 0, "seq_cst"),
+}
+
+_ORDER_WORD_RE = re.compile(r"(?:memory_order_|__ATOMIC_)([A-Za-z_]+)")
+
+
+def _parse_order(arg: str) -> str | None:
+    m = _ORDER_WORD_RE.search(arg)
+    if not m:
+        return None
+    word = m.group(1).lower()
+    return {"consume": "acquire"}.get(word, word)
+
+
+# ---------------------------------------------------------------------------
+# per-statement effects extraction
+
+_INCDEC_POST_RE = re.compile(
+    r"([A-Za-z_]\w*(?:(?:->|\.)\w+|\[[^\[\]]*\])*)\s*(?:\+\+|--)"
+)
+_INCDEC_PRE_RE = re.compile(
+    r"(?:\+\+|--)\s*([A-Za-z_]\w*(?:(?:->|\.)\w+|\[[^\[\]]*\])*)"
+)
+
+
+def _assignments(text: str, base: int = 0) -> list[tuple[int, int, int]]:
+    """(lhs_start, lhs_end, op_pos) for each assignment (plain, compound,
+    or chained) in a statement text.  Recurses into parenthesized groups
+    so ternary-embedded stores (`x ? ( w[0] = a ) : ...`) are seen;
+    offsets are global via `base`."""
+    out: list[tuple[int, int, int]] = []
+    i = 0
+    n = len(text)
+    seg = 0
+    while i < n:
+        c = text[i]
+        if c in "\"'":
+            i = cparse._skip_literal(text, i)
+            continue
+        if c in "([{":
+            j = cparse.match_group(text, i)
+            out.extend(_assignments(text[i + 1 : j - 1], base + i + 1))
+            i = j
+            continue
+        if c == "=":
+            nxt = text[i + 1] if i + 1 < n else ""
+            prev = text[i - 1] if i else ""
+            prev2 = text[max(0, i - 2) : i]
+            if nxt == "=":  # ==
+                i += 2
+                continue
+            if prev2 in ("<<", ">>"):  # shift-compound
+                out.append((base + seg, base + i - 2, base + i))
+            elif prev in "!<>":  # != <= >=
+                i += 1
+                continue
+            elif prev in "+-*/%&|^":  # compound
+                out.append((base + seg, base + i - 1, base + i))
+            else:
+                out.append((base + seg, base + i, base + i))
+            seg = i + 1
+        i += 1
+    return out
+
+
+def _in_spans(pos: int, spans: list[tuple[int, int]]) -> bool:
+    return any(a <= pos < b for a, b in spans)
+
+
+def _effects_from_text(
+    text: str, line: int, loops: tuple[int, ...], file: str, func: str
+) -> list[Effect]:
+    if not text:
+        return []
+    events: list[tuple[int, Effect]] = []
+    consumed: list[tuple[int, int]] = []  # spans already accounted for
+
+    for name, args, off in cparse.find_calls(text):
+        if _in_spans(off, consumed):
+            continue  # call nested inside an atomic builtin's arguments
+        op = text.index("(", off + len(name))
+        end = cparse.match_group(text, op)
+        spec = _ATOMICS.get(name)
+        if spec is None:
+            events.append(
+                (off, Effect("call", "", "", name, line, loops, name))
+            )
+            continue
+        kind, t_idx, o_idx, default = spec
+        arglist = cparse.split_args(args)
+        order = default
+        if o_idx is not None and o_idx < len(arglist):
+            order = _parse_order(arglist[o_idx]) or default
+        cls = ""
+        tgt = name
+        if t_idx is not None and t_idx < len(arglist):
+            tgt = arglist[t_idx]
+            cls = shmcontract.classify(tgt, file, func)
+        events.append((off, Effect(kind, cls, order, "", line, loops, tgt)))
+        consumed.append((off, end))
+
+    store_spans: list[tuple[int, int]] = []
+    for lo, hi, _op in _assignments(text):
+        if _in_spans(lo, consumed):
+            continue
+        store_spans.append((lo, hi))
+        lhs = text[lo:hi].strip()
+        cls = shmcontract.classify(lhs, file, func)
+        if cls:
+            events.append(
+                (lo, Effect("store", cls, "plain", "", line, loops, lhs))
+            )
+    for rx in (_INCDEC_POST_RE, _INCDEC_PRE_RE):
+        for m in rx.finditer(text):
+            if _in_spans(m.start(1), consumed) or _in_spans(
+                m.start(1), store_spans
+            ):
+                continue
+            lhs = m.group(1)
+            cls = shmcontract.classify(lhs, file, func)
+            if cls:
+                store_spans.append((m.start(1), m.end(1)))
+                events.append(
+                    (
+                        m.start(1),
+                        Effect("store", cls, "plain", "", line, loops, lhs),
+                    )
+                )
+
+    # remaining classified word references are plain loads
+    claimed: list[tuple[int, int]] = []
+    for r in shmcontract.WORD_RULES:
+        if r.files and file not in r.files:
+            continue
+        if r.funcs and not func.startswith(r.funcs):
+            continue
+        for m in re.finditer(r.pattern, text):
+            pos = m.start()
+            if (
+                _in_spans(pos, consumed)
+                or _in_spans(pos, store_spans)
+                or _in_spans(pos, claimed)
+            ):
+                continue
+            claimed.append((pos, m.end()))
+            events.append(
+                (
+                    pos,
+                    Effect("load", r.cls, "plain", "", line, loops, m.group(0)),
+                )
+            )
+
+    events.sort(key=lambda t: t[0])
+    return [e for _, e in events]
+
+
+# ---------------------------------------------------------------------------
+# function walk
+
+def _walk(
+    stmts: list[cparse.CStmt],
+    loops: tuple[int, ...],
+    file: str,
+    func: str,
+    out: list[Effect],
+    counter: list[int],
+) -> None:
+    for st in stmts:
+        if st.kind == "loop":
+            counter[0] += 1
+            inner = loops + (counter[0],)
+            out.extend(_effects_from_text(st.text, st.line, inner, file, func))
+            _walk(st.body, inner, file, func, out, counter)
+        elif st.kind in ("if", "switch"):
+            out.extend(_effects_from_text(st.text, st.line, loops, file, func))
+            _walk(st.body, loops, file, func, out, counter)
+            _walk(st.orelse, loops, file, func, out, counter)
+        elif st.kind == "block":
+            _walk(st.body, loops, file, func, out, counter)
+        else:
+            out.extend(_effects_from_text(st.text, st.line, loops, file, func))
+
+
+#: corpus fixtures declare which real file's classification scope they
+#: exercise via a `/* fdtshm-profile: fdt_tango.c */` comment near the
+#: top; shipped sources classify under their own basename
+_PROFILE_RE = re.compile(r"fdtshm-profile:\s*([\w.]+)")
+
+
+def _effective_file(source: str, file: str) -> str:
+    m = _PROFILE_RE.search(source[:400])
+    return m.group(1) if m else file
+
+
+def analyze_source(source: str, file: str) -> dict[str, list[Effect]]:
+    """file basename + source text -> {function name: ordered effects}."""
+    file = _effective_file(source, file)
+    out: dict[str, list[Effect]] = {}
+    for fn in cparse.parse_c_functions(source):
+        effects: list[Effect] = []
+        _walk(fn.body, (), file, fn.name, effects, [0])
+        out[fn.name] = effects
+    return out
+
+
+def analyze_file(path: Path) -> dict[str, list[Effect]]:
+    return analyze_source(path.read_text(), Path(path).name)
+
+
+# ---------------------------------------------------------------------------
+# contract rules
+
+C = shmcontract
+
+
+def _rule_single_writer(
+    func: str, effects: list[Effect], path: str
+) -> list[Finding]:
+    out = []
+    for e in effects:
+        if e.kind not in ("store", "rmw", "cas"):
+            continue
+        owners = C.SINGLE_WRITER.get(e.cls)
+        if owners is None or func in owners:
+            continue
+        who = ", ".join(sorted(owners)) or "none — never written natively"
+        out.append(
+            Finding(
+                path,
+                e.line,
+                "shm-single-writer",
+                f"{func} stores to {e.cls} (declared writers: {who}): {e.expr}",
+            )
+        )
+    return out
+
+
+def _rule_publish_release(
+    func: str, effects: list[Effect], path: str
+) -> list[Finding]:
+    if func in C.INIT_FUNCS:
+        return []
+    out = []
+    for i, e in enumerate(effects):
+        if e.kind not in ("store", "rmw", "cas"):
+            continue
+        minord = C.MIN_STORE_ORDER.get(e.cls)
+        if minord is None:
+            continue
+        if C.order_rank(e.order) >= C.order_rank(minord):
+            continue
+        if (
+            e.order == "relaxed"
+            and minord == "release"
+            and any(
+                f.kind == "fence"
+                and C.order_rank(f.order) >= C.order_rank("release")
+                for f in effects[i + 1 :]
+            )
+        ):
+            continue  # invalidate-then-release-fence idiom
+        out.append(
+            Finding(
+                path,
+                e.line,
+                "shm-publish-release",
+                f"{e.order} store to {e.cls} needs >= {minord}: {e.expr}",
+            )
+        )
+    for payload_cls, commit_cls in C.PUBLISH_PAIRS:
+        pstores = [
+            i
+            for i, e in enumerate(effects)
+            if e.kind == "store" and e.cls == payload_cls
+        ]
+        if not pstores:
+            continue
+        commits = [
+            i
+            for i, e in enumerate(effects)
+            if e.kind in ("store", "rmw")
+            and e.cls == commit_cls
+            and C.order_rank(e.order) >= C.order_rank("release")
+        ]
+        if not commits:
+            out.append(
+                Finding(
+                    path,
+                    effects[pstores[-1]].line,
+                    "shm-publish-release",
+                    f"{func} stores {payload_cls} payload but no "
+                    f"release-ordered {commit_cls} store publishes it",
+                )
+            )
+        elif max(pstores) > max(commits):
+            out.append(
+                Finding(
+                    path,
+                    effects[max(pstores)].line,
+                    "shm-publish-release",
+                    f"{func} stores {payload_cls} after the final release "
+                    f"{commit_cls} store (torn publish window)",
+                )
+            )
+    return out
+
+
+def _loops_between(
+    publish: tuple[int, ...], credit: tuple[int, ...]
+) -> int:
+    common = 0
+    for a, b in zip(publish, credit):
+        if a != b:
+            break
+        common += 1
+    return len(publish) - common
+
+
+def _rule_stale_credit(
+    func: str, effects: list[Effect], path: str
+) -> list[Finding]:
+    if func in C.PUBLISHING_CALLS or func in C.INIT_FUNCS:
+        return []  # primitive/wrapper: every call site is checked instead
+    out = []
+    last_credit: Effect | None = None
+    for e in effects:
+        if e.kind != "call":
+            continue
+        if e.name in C.CREDIT_CALLS:
+            last_credit = e
+            continue
+        if e.name not in C.PUBLISHING_CALLS:
+            continue
+        if last_credit is None:
+            out.append(
+                Finding(
+                    path,
+                    e.line,
+                    "shm-stale-credit",
+                    f"{func} publishes via {e.name} with no credit "
+                    "re-read (fdt_fctl_cr_avail / fseq query) on the path",
+                )
+            )
+            continue
+        between = _loops_between(e.loops, last_credit.loops)
+        if between > C.MAX_LOOPS_BETWEEN:
+            out.append(
+                Finding(
+                    path,
+                    e.line,
+                    "shm-stale-credit",
+                    f"{func} publishes via {e.name} {between} loop "
+                    "back-edges below the last credit read "
+                    f"(line {last_credit.line}) — the credit goes stale "
+                    f"across the outer sweep(s); max {C.MAX_LOOPS_BETWEEN}",
+                )
+            )
+    return out
+
+
+def _rule_journal_arm(
+    func: str, effects: list[Effect], path: str
+) -> list[Finding]:
+    if func in C.JOURNAL_ARM_EXEMPT:
+        return []
+    writes = ("store", "rmw", "cas")
+    if not any(
+        e.cls == "journal.phase" and e.kind in writes for e in effects
+    ):
+        return []
+    arm = next(
+        (
+            i
+            for i, e in enumerate(effects)
+            if e.cls == "journal.phase"
+            and e.kind in writes
+            and C.order_rank(e.order) >= C.order_rank("release")
+        ),
+        None,
+    )
+    for i, e in enumerate(effects):
+        protected = (
+            e.kind in writes and e.cls in C.JOURNAL_PROTECTED_CLASSES
+        ) or (e.kind == "call" and e.name in C.JOURNAL_PROTECTED_CALLS)
+        if protected and (arm is None or i < arm):
+            what = e.name if e.kind == "call" else f"{e.cls} ({e.expr})"
+            return [
+                Finding(
+                    path,
+                    e.line,
+                    "shm-journal-arm",
+                    f"{func} mutates journal-protected state [{what}] "
+                    "before the journal arm word's release store — a kill "
+                    "here is unrecoverable",
+                )
+            ]
+    return []
+
+
+def _rule_epoch_check(
+    func: str, effects: list[Effect], path: str
+) -> list[Finding]:
+    first_drain = next(
+        (
+            i
+            for i, e in enumerate(effects)
+            if e.kind == "call" and e.name in C.DRAIN_CALLS and e.loops
+        ),
+        None,
+    )
+    if first_drain is None:
+        return []
+    if any(
+        e.kind == "load"
+        and e.cls == "epoch"
+        and C.order_rank(e.order) >= C.order_rank(C.EPOCH_MIN_ORDER)
+        for e in effects[:first_drain]
+    ):
+        return []
+    return [
+        Finding(
+            path,
+            effects[first_drain].line,
+            "shm-epoch-check",
+            f"{func} drains frags in a loop without first acquire-loading "
+            "the runtime epoch word (stale-ABI tile could consume "
+            "new-epoch frags)",
+        )
+    ]
+
+
+_RULES = (
+    _rule_single_writer,
+    _rule_publish_release,
+    _rule_stale_credit,
+    _rule_journal_arm,
+    _rule_epoch_check,
+)
+
+
+def check_source(source: str, file: str, display_path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for func, effects in analyze_source(source, file).items():
+        for rule in _RULES:
+            findings.extend(rule(func, effects, display_path))
+    return apply_pragmas(findings, source.splitlines())
+
+
+def check_native_c_file(path: Path, rel: Path | None = None) -> list[Finding]:
+    """fdtshm pass over one native C source (pragma-aware)."""
+    path = Path(path)
+    display = (
+        path.relative_to(rel).as_posix() if rel is not None else str(path)
+    )
+    return check_source(path.read_text(), path.name, display)
+
+
+def file_summary(path: Path) -> dict:
+    """Coverage accounting for one file: function/effect/class counts."""
+    by_func = analyze_file(path)
+    classes: set[str] = set()
+    n_effects = 0
+    for effects in by_func.values():
+        n_effects += len(effects)
+        classes |= {e.cls for e in effects if e.cls}
+    return {
+        "functions": len(by_func),
+        "effects": n_effects,
+        "classes": sorted(classes),
+    }

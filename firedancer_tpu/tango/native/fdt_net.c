@@ -143,16 +143,22 @@ int64_t fdt_net_rx( uint64_t * args, uint64_t * outs, int64_t n_outs,
   int64_t stride = stride_chunks * (int64_t)FDT_CHUNK_SZ;
   int64_t wmark = (int64_t)ob[ FDT_STEM_O_WMARK ];
 
-  int64_t cr = fdt_stem_out_cr( ob );
   int64_t published = 0;
   uint64_t sig = 0;
   int fds[ 2 ] = { (int)w[ FDT_NET_W_QUIC_FD ],
                    (int)w[ FDT_NET_W_UDP_FD ] };
   uint16_t ctls[ 2 ] = { FDT_NET_CTL_QUIC, FDT_NET_CTL_LEGACY };
   for( int s = 0; s < 2; s++ ) {
-    int64_t take = burst;
-    if( take > cr - published ) take = cr - published;
-    while( take > 0 ) {
+    int64_t want = burst;
+    while( want > 0 ) {
+      /* live credit re-read every recvmmsg round: fdt_stem_out_cr
+         reads the producer seq (already advanced by this sweep's own
+         emits) against fresh consumer fseqs, so a pre-sweep snapshot
+         can never go stale across the burst's back-edges
+         (shm-stale-credit) */
+      int64_t cr = fdt_stem_out_cr( ob );
+      int64_t take = want < cr ? want : cr;
+      if( take <= 0 ) break;
       /* reserve mtu-stride rows at the cursor; wrap when fewer than
          one stride fits before the watermark (the compact-ring rule,
          applied at full-MTU granularity so recvmmsg can write every
@@ -201,7 +207,7 @@ int64_t fdt_net_rx( uint64_t * args, uint64_t * outs, int64_t n_outs,
         ctrs[ FDT_NET_C_RX_BYTES ] += (uint64_t)szs[ i ] - 6UL;
       }
       *cur = (uint64_t)( c + w_idx * stride_chunks );
-      take -= got;
+      want -= got;
       if( got < batch ) break;
     }
   }

@@ -489,8 +489,10 @@ int64_t fdt_bank_pipeline( uint8_t const * mb, int64_t mb_sz,
     out_stats[ 0 ] = 2;
     return 2;
   }
-  /* fully executed: record the completed-seq mark (mark_complete) */
-  jw[ BJ_COMPLETED ] = mb_tag + 1UL;
+  /* fully executed: record the completed-seq mark (mark_complete).
+     Release so a recovery process that reads the mark also sees every
+     slot/journal store this batch made before it */
+  __atomic_store_n( &jw[ BJ_COMPLETED ], mb_tag + 1UL, __ATOMIC_RELEASE );
   out_stats[ 0 ] = 0;
   return 0;
 }

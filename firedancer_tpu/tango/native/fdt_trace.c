@@ -115,9 +115,12 @@ uint32_t fdt_trace_read_clock( uint64_t * tr ) {
   uint64_t cp = tr[ FDT_TRACE_W_CLOCK ];
   if( cp ) {
     uint64_t * c = (uint64_t *)cp;
-    uint32_t v = (uint32_t)c[ 0 ];
-    c[ 0 ] += c[ 1 ];
-    return v;
+    /* the clock words are read cross-process by the test collector:
+       relaxed atomics keep each word untorn (single writer, no
+       ordering needed) */
+    uint64_t cv = __atomic_load_n( &c[ 0 ], __ATOMIC_RELAXED );
+    __atomic_store_n( &c[ 0 ], cv + c[ 1 ], __ATOMIC_RELAXED );
+    return (uint32_t)cv;
   }
   return fdt_trace_now();
 }
@@ -131,9 +134,19 @@ void fdt_trace_hist_sample( uint64_t * h, int64_t nb, int64_t v ) {
   int64_t vv = v < 1 ? 1 : v;
   int64_t b = 63 - __builtin_clzll( (uint64_t)vv );
   if( b > nb - 1 ) b = nb - 1;
-  h[ b ] += 1UL;
-  h[ nb ] += (uint64_t)( v > 0 ? v : 0 );
-  h[ nb + 1 ] += 1UL;
+  /* hist words are scraped live by the Python collector while the
+     tile keeps sampling: relaxed load/store (cheaper than a locked
+     RMW — the tile is the only writer) keeps every word untorn */
+  __atomic_store_n( &h[ b ],
+                    __atomic_load_n( &h[ b ], __ATOMIC_RELAXED ) + 1UL,
+                    __ATOMIC_RELAXED );
+  __atomic_store_n( &h[ nb ],
+                    __atomic_load_n( &h[ nb ], __ATOMIC_RELAXED ) +
+                        (uint64_t)( v > 0 ? v : 0 ),
+                    __ATOMIC_RELAXED );
+  __atomic_store_n( &h[ nb + 1 ],
+                    __atomic_load_n( &h[ nb + 1 ], __ATOMIC_RELAXED ) + 1UL,
+                    __ATOMIC_RELAXED );
 }
 
 /* SpanRing layout (disco/trace.py): header 8 u64 words, 4-word events */

@@ -5,13 +5,16 @@ its .so on first import.  The cache key is a hash of the source text +
 compile flags, so editing a .c file transparently rebuilds.
 
 Sanitizers: `FDT_SAN=1` builds with ASan + UBSan (-O1, frame pointers,
-no-recover) instead of -O3.  The flag participates in the cache key via
-the flag list, so sanitized and production artifacts coexist in the
-cache.  Loading an ASan'd shared library into a stock CPython requires
-the sanitizer runtimes to be preloaded — `sanitizer_preload()` resolves
-the LD_PRELOAD string; tests/test_sanitize.py (pytest -m sanitize, slow
-tier) drives the whole loop: sanitized rebuild in a scratch cache, then
-the tango/pack native test surface re-run under it.
+no-recover) instead of -O3; `FDT_SAN=tsan` builds with ThreadSanitizer
+(mutually exclusive with ASan — the runtimes cannot coexist in one
+process).  Each mode's flags participate in the cache key via the flag
+list and get a distinct artifact suffix (-san / -tsan), so production,
+ASan, and TSan artifacts coexist in the cache.  Loading a sanitized
+shared library into a stock CPython requires the matching runtime to be
+preloaded — `sanitizer_preload()` / `tsan_preload()` resolve the
+LD_PRELOAD string; tests/test_sanitize.py and test_sanitize_tsan.py
+(pytest -m sanitize, slow tier) drive the whole loop: sanitized rebuild
+in a scratch cache, then the native test surface re-run under it.
 """
 
 from __future__ import annotations
@@ -35,10 +38,30 @@ _SAN_FLAGS = [
     "-fsanitize=address,undefined",
     "-fno-sanitize-recover=undefined",
 ]
+#: appended when FDT_SAN=tsan.  -DFDT_TSAN=1 lets sources swap
+#: deliberately-racy idioms (seqlock speculative reads) for
+#: TSan-visible relaxed atomics without changing the production build.
+_TSAN_FLAGS = [
+    "-O1",
+    "-g",
+    "-fno-omit-frame-pointer",
+    "-fsanitize=thread",
+    "-DFDT_TSAN=1",
+]
+
+
+def san_mode() -> str:
+    """"" (off) | "asan" (FDT_SAN=1) | "tsan" (FDT_SAN=tsan)."""
+    v = os.environ.get("FDT_SAN", "")
+    if v == "1":
+        return "asan"
+    if v == "tsan":
+        return "tsan"
+    return ""
 
 
 def sanitize_enabled() -> bool:
-    return os.environ.get("FDT_SAN", "") == "1"
+    return san_mode() != ""
 
 
 def _cache_dir() -> Path:
@@ -69,6 +92,24 @@ def sanitizer_preload() -> str | None:
     # ASan runtime first in the library list aborts at load, so the
     # sanitize test must skip (None) unless BOTH runtimes resolved
     return ":".join(libs) if len(libs) == 2 else None
+
+
+def tsan_preload() -> str | None:
+    """LD_PRELOAD string (libtsan) for running a python that loads
+    FDT_SAN=tsan artifacts, or None when the toolchain has no locatable
+    TSan runtime (the TSan test skips then)."""
+    try:
+        out = subprocess.run(
+            [_CC, "-print-file-name=libtsan.so"],
+            check=True,
+            capture_output=True,
+            text=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):  # pragma: no cover
+        return None
+    if "/" in out and Path(out).exists():
+        return out
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -138,9 +179,13 @@ def read_sidecar(so: Path) -> dict | None:
 def build(name: str, sources: list[Path], extra_flags: list[str] | None = None) -> Path:
     """Compile `sources` into a shared library, returning its path."""
     flags = list(_BASE_FLAGS)
-    if sanitize_enabled():
+    mode = san_mode()
+    if mode == "asan":
         flags += _SAN_FLAGS
         name = f"{name}-san"
+    elif mode == "tsan":
+        flags += _TSAN_FLAGS
+        name = f"{name}-tsan"
     flags += extra_flags or []
     h = hashlib.sha256()
     h.update(" ".join([_CC] + flags).encode())

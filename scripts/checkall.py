@@ -56,6 +56,39 @@ def _stage_lint() -> dict:
     }
 
 
+def _stage_shmlint() -> dict:
+    """fdtshm (ISSUE 18): the C11 shared-memory effects analyzer over
+    tango/native/*.c — single-writer ownership, release-ordered publish,
+    credit dominance, journal-arm-before-mutate, epoch gating — plus the
+    extraction coverage counts.  Also runs inside the full lint stage;
+    this standalone stage keeps the contract check (and its counts)
+    visible even when a full-repo finding elsewhere fails `lint`."""
+    from firedancer_tpu.analysis import shmlint
+
+    t0 = time.perf_counter()
+    native = REPO / "firedancer_tpu" / "tango" / "native"
+    try:
+        findings = []
+        functions = effects = 0
+        files = sorted(native.glob("*.c"))
+        for p in files:
+            findings.extend(shmlint.check_native_c_file(p, rel=REPO))
+            summ = shmlint.file_summary(p)
+            functions += summ["functions"]
+            effects += summ["effects"]
+    except Exception as e:  # noqa: BLE001 — report, don't crash the gate
+        return {"rc": 2, "error": repr(e), "seconds": 0.0}
+    return {
+        "rc": 0 if not findings else 1,
+        "findings": len(findings),
+        "detail": [str(f) for f in findings[:20]],
+        "files": len(files),
+        "functions": functions,
+        "effects": effects,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def _run(cmd: list[str], timeout_s: float, env=None) -> tuple[int, str]:
     try:
         r = subprocess.run(
@@ -289,8 +322,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregated summary as JSON")
     ap.add_argument("--skip", default="",
-                    help="comma list of stages to skip: lint,mc,proc,"
-                         "trace,adversary,elastic,endurance,pytest")
+                    help="comma list of stages to skip: lint,shmlint,mc,"
+                         "proc,trace,adversary,elastic,endurance,pytest")
     ap.add_argument("--mc-budget", type=int, default=64,
                     help="fdtmc schedules per scenario (0 = tier default)")
     ap.add_argument("--mc-timeout", type=float, default=600.0)
@@ -314,7 +347,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
     bad = skip - {
-        "lint", "mc", "proc", "trace", "adversary", "elastic",
+        "lint", "shmlint", "mc", "proc", "trace", "adversary", "elastic",
         "endurance", "pytest",
     }
     if bad:
@@ -328,6 +361,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"checkall lint: rc={stages['lint']['rc']} "
                   f"({stages['lint'].get('findings', '?')} findings, "
                   f"{stages['lint']['seconds']}s)", flush=True)
+    if "shmlint" not in skip:
+        stages["shmlint"] = _stage_shmlint()
+        if not args.json:
+            print(f"checkall shmlint: rc={stages['shmlint']['rc']} "
+                  f"({stages['shmlint'].get('findings', '?')} findings, "
+                  f"{stages['shmlint'].get('effects', '?')} effects in "
+                  f"{stages['shmlint'].get('functions', '?')} fns, "
+                  f"{stages['shmlint']['seconds']}s)", flush=True)
     if "mc" not in skip:
         stages["mc"] = _stage_mc(args.mc_budget, args.mc_timeout)
         if not args.json:

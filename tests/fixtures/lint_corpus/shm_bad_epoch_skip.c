@@ -1,0 +1,18 @@
+/* known-bad (shm-epoch-check): drains frags in the run loop without
+   first acquire-loading the runtime epoch word.  Under fdt_upgrade's
+   ring-ABI handshake a stale-epoch tile that keeps draining consumes
+   frags published under a newer ABI it cannot decode. */
+
+#include <stdint.h>
+
+int64_t fdt_mcache_drain( void * mc, uint64_t * seq, int64_t max );
+
+int64_t fdt_tile_run( void * mc, uint64_t * seq ) {
+  int64_t got = 0;
+  for( ;; ) {
+    int64_t n = fdt_mcache_drain( mc, seq, 64 );
+    if( n <= 0 ) break;
+    got += n;
+  }
+  return got;
+}

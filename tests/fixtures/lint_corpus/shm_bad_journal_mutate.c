@@ -1,0 +1,19 @@
+/* fdtshm-profile: fdt_poh.c
+   known-bad (shm-journal-arm): hashes into the live poh state BEFORE
+   the crash journal's arm word is release-stored.  A SIGKILL between
+   the mutation and the arm leaves state the recovery scan cannot
+   distinguish from a completed tick — the exact window the
+   journal-armed-before-mutate discipline closes. */
+
+#include <stdint.h>
+
+#define FDT_POH_W_HASHCNT 2
+#define FDT_POH_J_PHASE 0
+#define FDT_POH_J_HASHCNT 1
+
+void fdt_poh_mixins( uint64_t * w, uint64_t * j, uint64_t nmix ) {
+  w[ FDT_POH_W_HASHCNT ] += nmix; /* mutate first: unrecoverable */
+  j[ FDT_POH_J_HASHCNT ] = w[ FDT_POH_W_HASHCNT ];
+  __atomic_store_n( &j[ FDT_POH_J_PHASE ], 1UL, __ATOMIC_RELEASE );
+  __atomic_store_n( &j[ FDT_POH_J_PHASE ], 0UL, __ATOMIC_RELEASE );
+}

@@ -1,0 +1,29 @@
+/* fdtshm-profile: fdt_tango.c
+   known-bad (shm-publish-release): publishes a frag line, then commits
+   the seq word with a RELAXED store and no trailing release fence, and
+   bumps the producer watermark with a PLAIN store.  A consumer that
+   acquire-loads the new seq is not guaranteed to see the payload
+   stores — the torn-publish window fdt_mcache_publish's
+   relaxed-invalidate / release-fence / release-commit dance exists to
+   close. */
+
+#include <stdatomic.h>
+#include <stdint.h>
+
+typedef struct {
+  uint64_t seq_prod;
+} fdt_mcache_hdr_t;
+
+typedef struct {
+  _Atomic uint64_t seq;
+  uint64_t sig;
+  uint64_t chunk;
+} fdt_frag_t;
+
+void fdt_mcache_publish( fdt_mcache_hdr_t * h, fdt_frag_t * f, uint64_t seq,
+                         uint64_t sig, uint64_t chunk ) {
+  f->sig = sig;
+  f->chunk = chunk;
+  atomic_store_explicit( &f->seq, seq, memory_order_relaxed );
+  h->seq_prod = seq;
+}

@@ -1,0 +1,18 @@
+/* fdtshm-profile: fdt_tango.c
+   known-bad (shm-single-writer): a receive-side helper "rewinds" the
+   consumer progress word it does not own.  fseq.seq has exactly one
+   declared writer (the consumer's fdt_fseq_update); a second writer
+   races the consumer's own release store and can silently un-credit
+   frags the producer already reused. */
+
+#include <stdatomic.h>
+#include <stdint.h>
+
+typedef struct {
+  _Atomic uint64_t seq;
+} fdt_fseq_t;
+
+void fdt_rx_rewind( void * fseq, uint64_t seq ) {
+  atomic_store_explicit( &( (fdt_fseq_t *)fseq )->seq, seq,
+                         memory_order_release );
+}

@@ -1,0 +1,22 @@
+/* known-bad (shm-stale-credit): the credit snapshot is hoisted above
+   TWO nested sweep loops, so the publishes two back-edges down keep
+   spending a credit count that went stale after the first inner sweep —
+   the stem-burst-over-credit / pack-sched-stale-credit /
+   shred-outq-stale-credit mutant bug class as one dataflow shape. */
+
+#include <stdint.h>
+
+int64_t fdt_stem_out_cr( uint64_t const * ob );
+void fdt_stem_out_emit_at( uint64_t * ob, uint64_t sig, uint32_t chunk );
+
+int64_t fdt_rx_burst( uint64_t * ob, int64_t rounds, int64_t per ) {
+  int64_t cr = fdt_stem_out_cr( ob );
+  int64_t published = 0;
+  for( int64_t r = 0; r < rounds; r++ ) {
+    for( int64_t i = 0; i < per && published < cr; i++ ) {
+      fdt_stem_out_emit_at( ob, (uint64_t)published, 0U );
+      published++;
+    }
+  }
+  return published;
+}

@@ -1,0 +1,68 @@
+/* fdtshm-profile: fdt_tango.c
+   known-good: one deliberate violation of every fdtshm rule, each
+   suppressed with an inline C-pragma — the suppression side of the
+   corpus.  tests/test_shmlint.py asserts this file lints CLEAN (the
+   pragmas work in C comments) and that stripping the pragmas restores
+   every finding. */
+
+#include <stdatomic.h>
+#include <stdint.h>
+
+typedef struct {
+  uint64_t seq_prod;
+} fdt_mcache_hdr_t;
+
+typedef struct {
+  _Atomic uint64_t seq;
+  uint64_t sig;
+} fdt_frag_t;
+
+typedef struct {
+  _Atomic uint64_t seq;
+} fdt_fseq_t;
+
+int64_t fdt_stem_out_cr( uint64_t const * ob );
+void fdt_stem_out_emit( uint64_t * ob, uint64_t sig );
+void fdt_tcache_dedup_j( void * t, uint64_t key );
+int64_t fdt_mcache_drain( void * mc, uint64_t * seq, int64_t max );
+
+void fdt_mcache_publish( fdt_mcache_hdr_t * h, fdt_frag_t * f,
+                         uint64_t seq ) {
+  /* fdtlint: allow[shm-publish-release] fixture: unpublished payload */
+  f->sig = seq;
+  /* fdtlint: allow[shm-publish-release] fixture: relaxed commit */
+  atomic_store_explicit( &f->seq, seq, memory_order_relaxed );
+  /* fdtlint: allow[shm-publish-release] fixture: plain watermark */
+  h->seq_prod = seq;
+}
+
+void fdt_rx_rewind( void * fseq, uint64_t seq ) {
+  /* fdtlint: allow[shm-single-writer] fixture: foreign fseq store */
+  atomic_store_explicit( &( (fdt_fseq_t *)fseq )->seq, seq,
+                         memory_order_release );
+}
+
+void fdt_fixture_burst( uint64_t * ob, int64_t rounds ) {
+  int64_t cr = fdt_stem_out_cr( ob );
+  for( int64_t r = 0; r < rounds; r++ ) {
+    for( int64_t i = 0; i < cr; i++ ) {
+      /* fdtlint: allow[shm-stale-credit] fixture: hoisted snapshot */
+      fdt_stem_out_emit( ob, (uint64_t)i );
+    }
+  }
+}
+
+void h_dedup( uint64_t * jnl, void * t, uint64_t key ) {
+  /* fdtlint: allow[shm-journal-arm] fixture: mutate before arm */
+  fdt_tcache_dedup_j( t, key );
+  __atomic_store_n( &jnl[ 2 ], 1UL, __ATOMIC_RELEASE );
+  __atomic_store_n( &jnl[ 2 ], 0UL, __ATOMIC_RELEASE );
+}
+
+void fdt_fixture_run( void * mc, uint64_t * seq ) {
+  for( ;; ) {
+    /* fdtlint: allow[shm-epoch-check] fixture: no epoch gate */
+    int64_t n = fdt_mcache_drain( mc, seq, 64 );
+    if( n <= 0 ) break;
+  }
+}

@@ -60,6 +60,14 @@ BAD_FIXTURES = {
     "purity_bad_float.py": "purity-float",
     "purity_bad_branch.py": "purity-untraced-branch",
     "metrics_bad_undeclared.py": "metrics-schema",
+    # C-side rules: stem-emit-only (ISSUE 15) + the fdtshm
+    # shared-memory contract (ISSUE 18)
+    "native_bad_raw_publish.c": "stem-emit-only",
+    "shm_bad_missing_release.c": "shm-publish-release",
+    "shm_bad_second_writer.c": "shm-single-writer",
+    "shm_bad_stale_credit.c": "shm-stale-credit",
+    "shm_bad_journal_mutate.c": "shm-journal-arm",
+    "shm_bad_epoch_skip.c": "shm-epoch-check",
 }
 
 ABI_BAD_RULES = {
@@ -291,13 +299,22 @@ def test_stem_emit_only_repo_surface_is_covered(repo_report):
 
 def test_good_fixtures_scan_clean():
     rep = engine.run_paths(
-        [CORPUS / "ring_good.py", CORPUS / "purity_good.py", CORPUS / "abi_good"]
+        [
+            CORPUS / "ring_good.py",
+            CORPUS / "purity_good.py",
+            CORPUS / "abi_good",
+            CORPUS / "shm_good.c",
+        ]
     )
     assert rep.findings == [], "\n" + "\n".join(str(f) for f in rep.findings)
 
 
 def test_every_bad_fixture_on_disk_is_asserted():
-    on_disk = {p.name for p in CORPUS.glob("*_bad_*.py")}
+    on_disk = {
+        p.name
+        for pat in ("*_bad_*.py", "*_bad_*.c")
+        for p in CORPUS.glob(pat)
+    }
     assert on_disk == set(BAD_FIXTURES), (
         "corpus and BAD_FIXTURES table drifted — every known-bad snippet "
         "must be pinned to the rule it exercises"

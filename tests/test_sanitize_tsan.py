@@ -1,0 +1,155 @@
+"""ThreadSanitizer pass over the concurrent native tests (`-m sanitize`).
+
+Rebuilds tango/native with FDT_SAN=tsan into a scratch cache and re-runs
+the tests that exercise real cross-thread interleavings of the ring
+primitives — the native-writer/Python-reader span-ring drain
+(test_fdttrace_native.py), the threaded stem parity/fault surface
+(test_fdt_stem.py), and the rings bindings (test_tango.py) — in a
+subprocess with libtsan preloaded.  This is the dynamic third of the
+three-layer concurrency story: fdtmc schedules the Python loop, fdtshm
+statically checks the C discipline, TSan checks what the hardware
+actually interleaves.
+
+Known instrumentation-boundary false positives live in tests/tsan.supp
+(each entry documents why); the run uses print_suppressions=1 and this
+test reports suppression entries that no longer match anything, so a
+stale entry cannot silently hide a real race added later.
+
+Skips (not fails) when the toolchain cannot produce a runnable
+TSan build: no libtsan runtime, or a compiler without -fsanitize=thread.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import warnings
+from pathlib import Path
+
+import pytest
+
+from firedancer_tpu.utils import cbuild
+
+REPO = Path(__file__).resolve().parent.parent
+SUPP = REPO / "tests" / "tsan.supp"
+
+pytestmark = [pytest.mark.slow, pytest.mark.sanitize]
+
+#: the concurrent native surface: every test here either spawns a
+#: thread/process against shared ring memory or drives the primitives
+#: those tests race on.  Kept deliberately narrower than the ASan
+#: surface so the (slower) TSan leg stays inside the slow-tier budget.
+TSAN_SURFACE = [
+    "tests/test_tango.py",
+    "tests/test_fdt_stem.py",
+    "tests/test_fdttrace_native.py",
+]
+
+
+def _tsan_env(cache_dir: Path, preload: str) -> dict:
+    env = dict(os.environ)
+    env.update(
+        {
+            "FDT_SAN": "tsan",
+            "FDT_CACHE_DIR": str(cache_dir),
+            "LD_PRELOAD": preload,
+            # exitcode=66 turns any UNSUPPRESSED report into a hard
+            # process failure; suppressed reports are counted and
+            # printed (print_suppressions=1) for the staleness check
+            "TSAN_OPTIONS": (
+                f"suppressions={SUPP}:print_suppressions=1:"
+                "halt_on_error=0:exitcode=66"
+            ),
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    return env
+
+
+def _supp_entries() -> list[str]:
+    return [
+        ln.strip()
+        for ln in SUPP.read_text().splitlines()
+        if ln.strip() and not ln.strip().startswith("#")
+    ]
+
+
+def test_concurrent_native_surface_under_tsan(tmp_path):
+    preload = cbuild.tsan_preload()
+    if preload is None:
+        pytest.skip("toolchain has no locatable libtsan runtime")
+
+    # 1. the TSan build itself must succeed (compiler support gate)
+    probe = tmp_path / "probe.c"
+    probe.write_text("int fdt_probe(void){return 7;}\n")
+    env = _tsan_env(tmp_path / "cache", preload)
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from pathlib import Path\n"
+            "from firedancer_tpu.utils import cbuild\n"
+            f"print(cbuild.build('probe', [Path({str(probe)!r})]))",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        env={k: v for k, v in env.items() if k != "LD_PRELOAD"},
+        timeout=120,
+    )
+    # skip ONLY on the compiler's own "no such flag" diagnostic (see
+    # test_sanitize.py for why a broad substring check would self-skip
+    # real build regressions)
+    if r.returncode != 0 and re.search(
+        r"(unrecognized|unknown|unsupported)[^\n]{0,60}(sanitize|thread)",
+        r.stdout + r.stderr,
+    ):
+        pytest.skip(f"compiler rejects -fsanitize=thread: {r.stderr[-500:]}")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "-tsan-" in r.stdout, "FDT_SAN=tsan must produce a distinct artifact"
+
+    # 2. concurrent native tests under the TSan library.  exitcode=66
+    # makes any unsuppressed data race fail this even if pytest passed.
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "-m",
+            "not slow",
+            *TSAN_SURFACE,
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+    )
+    assert r.returncode != 66, (
+        "unsuppressed data race(s) under TSan:\n" + r.stdout[-4000:] + r.stderr[-4000:]
+    )
+    assert r.returncode == 0, (
+        "native tests failed under TSan:\n" + r.stdout[-4000:] + r.stderr[-4000:]
+    )
+    built = list((tmp_path / "cache").glob("fdt_tango-tsan-*.so"))
+    assert built, "TSan run produced no FDT_SAN=tsan fdt_tango artifact"
+
+    # 3. stale-suppression reporting: print_suppressions=1 lists every
+    # matched entry at exit; a tsan.supp entry that matched nothing is
+    # either dead (the false positive was fixed — delete it) or
+    # mistyped (it never suppressed anything — and never will)
+    out = r.stdout + r.stderr
+    matched = set(re.findall(r"^\s*\d+\s+(race\S*|thread\S*|signal\S*)$",
+                             out, re.MULTILINE))
+    for entry in _supp_entries():
+        if entry not in matched:
+            warnings.warn(
+                f"tsan.supp entry {entry!r} matched no report this run — "
+                "stale suppressions hide future races; delete or fix it",
+                stacklevel=1,
+            )

@@ -1,0 +1,396 @@
+"""fdtshm (ISSUE 18): the C11 shared-memory effects analyzer.
+
+Four layers under test:
+
+  1. cparse statement parser on adversarial C — nested macros, do/while,
+     ternary-embedded stores, compound literals, literal-aware brace
+     matching — the foundation the effects extraction walks.
+  2. Effects extraction: atomic builtins with their memory_order, plain
+     stores/loads, word classification, loop-path tracking.
+  3. The fdt_tango-vs-RingHook differential: the effects extracted from
+     the C ring primitives match the `_MC` micro-step decomposition
+     (analysis/sched.py RingHook, installed as tango.rings._MC)
+     access-for-access and order-for-order — the model checker provably
+     models what the C does.
+  4. The contract rules on the shipped surface + pinned mutant flips:
+     the fixed true positives (fdt_stem BJ_COMPLETED release,
+     fdt_trace hist/clock atomics, fdt_net per-round credit re-read)
+     stay fixed — reverting any one of them trips its rule again.
+
+The known-bad corpus detection matrix lives in test_fdtlint.py
+(BAD_FIXTURES); here we assert the suppression side (shm_good.c) and
+the per-rule finding shapes.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+from firedancer_tpu.analysis import cparse, engine, shmcontract, shmlint
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "firedancer_tpu" / "tango" / "native"
+CORPUS = REPO / "tests" / "fixtures" / "lint_corpus"
+SCHED = REPO / "firedancer_tpu" / "analysis" / "sched.py"
+
+
+# ---------------------------------------------------------------------------
+# 1. statement parser on adversarial C
+
+
+ADVERSARIAL_C = r"""
+#define EMIT( x ) do { buf[ n++ ] = ( x ); } while( 0 )
+#define WRAP( a, b ) \
+  EMIT( ( a ) + ( b ) )
+
+struct pt { int x; int y[ 2 ]; };
+
+static int fdt_adversarial( int * buf, int q ) {
+  int n = 0;
+  do {
+    EMIT( WRAP( 1, 2 ) );
+  } while( n < 3 );
+  int x = q > 1 ? ( buf[ 0 ] = 7 ) : ( buf[ 1 ] = 9 );
+  struct pt p = (struct pt){ .x = 1, .y = { 2, 3 } };
+  char * s = "unbalanced ) } in a literal (";
+  for( int i = 0; i < p.x; i++ ) buf[ i ] = i + s[ 0 ];
+  if( x ) { n++; } else n--;
+  switch( x ) { case 1: n = 2; break; default: n = 3; }
+  return n;
+}
+"""
+
+
+def _flatten(stmts):
+    for st in stmts:
+        yield st
+        yield from _flatten(st.body)
+        yield from _flatten(st.orelse)
+
+
+def test_parser_adversarial_structure():
+    fns = cparse.parse_c_functions(ADVERSARIAL_C)
+    assert [f.name for f in fns] == ["fdt_adversarial"]
+    (fn,) = fns
+    flat = list(_flatten(fn.body))
+    kinds = [st.kind for st in fn.body]
+    # do/while, the ternary decl, compound literal decl, string decl,
+    # for, if, switch, return — all at top level
+    assert kinds.count("loop") == 2  # do-while + for
+    loop_kinds = [st.loop_kind for st in fn.body if st.kind == "loop"]
+    assert loop_kinds == ["do", "for"]
+    assert any(st.kind == "if" for st in fn.body)
+    assert any(st.kind == "switch" for st in fn.body)
+    # the do body holds the macro invocation as an expr statement
+    do_stmt = next(st for st in fn.body if st.loop_kind == "do")
+    assert any("EMIT" in st.text for st in do_stmt.body)
+    # do/while condition captured in the loop header text
+    assert "n < 3" in do_stmt.text
+    # unbraced for body still nests
+    for_stmt = next(st for st in fn.body if st.loop_kind == "for")
+    assert len(for_stmt.body) == 1 and "buf[ i ]" in for_stmt.body[0].text
+    # if/else: both branches present
+    if_stmt = next(st for st in fn.body if st.kind == "if")
+    assert if_stmt.body and if_stmt.orelse
+    # case labels are skipped, their statements kept
+    sw = next(st for st in fn.body if st.kind == "switch")
+    assert any("n = 2" in st.text for st in _flatten(sw.body))
+    # nothing in the flattened tree kept a preprocessor line
+    assert not any(st.text.startswith("#") for st in flat)
+
+
+def test_parser_skips_prototypes_and_matches_literal_braces():
+    src = (
+        "int fdt_decl( int a );\n"
+        "static int helper( char c ) { return c == '}' ? 1 : 0; }\n"
+        'int fdt_body( void ) { return helper( \'{\' ) + sizeof ")"; }\n'
+    )
+    fns = cparse.parse_c_functions(src)
+    assert [f.name for f in fns] == ["helper", "fdt_body"]
+    assert fns[0].static and not fns[1].static
+
+
+def test_find_calls_skips_keywords_and_nests():
+    calls = cparse.find_calls(
+        "if( fdt_a( fdt_b( x ), y ) ) while( fdt_c() ) fdt_d( 0 );"
+    )
+    assert [c[0] for c in calls] == ["fdt_a", "fdt_b", "fdt_c", "fdt_d"]
+    assert cparse.split_args("fdt_b( x ), y") == ["fdt_b( x )", "y"]
+
+
+# ---------------------------------------------------------------------------
+# 2. effects extraction
+
+
+def _eff(src: str, file: str, fn: str):
+    return shmlint.analyze_source(src, file)[fn]
+
+
+def test_atomic_orders_and_classification():
+    src = """
+void fdt_mcache_probe( fdt_mcache_hdr_t * h ) {
+  uint64_t v = atomic_load_explicit( &h->seq_prod, memory_order_acquire );
+  atomic_store_explicit( &h->seq_prod, v, memory_order_release );
+  __atomic_fetch_add( &h->seq_prod, 1UL, __ATOMIC_RELAXED );
+  atomic_thread_fence( memory_order_seq_cst );
+}
+"""
+    eff = _eff(src, "fdt_tango.c", "fdt_mcache_probe")
+    got = [(e.kind, e.cls, e.order) for e in eff]
+    assert got == [
+        ("load", "mcache.seq_prod", "acquire"),
+        ("store", "mcache.seq_prod", "release"),
+        ("rmw", "mcache.seq_prod", "relaxed"),
+        ("fence", "", "seq_cst"),
+    ]
+
+
+def test_plain_store_forms_and_loop_paths():
+    src = """
+void fdt_mcache_probe( uint64_t * x, fdt_frag_t * f ) {
+  f->sig = 1;
+  f->sz += 2;
+  f->ctl++;
+  for( int i = 0; i < 4; i++ ) {
+    while( f->chunk ) {
+      f->tsorig = 0;
+    }
+  }
+}
+"""
+    eff = _eff(src, "fdt_tango.c", "fdt_mcache_probe")
+    stores = [(e.expr, e.kind, e.loops) for e in eff if e.cls == "mcache.line"]
+    assert ("f->sig", "store", ()) in stores
+    assert ("f->sz", "store", ()) in stores
+    assert ("f->ctl", "store", ()) in stores
+    # the while-condition load sits inside BOTH loops (headers re-run
+    # per iteration); the innermost store carries the full loop path
+    cond = next(e for e in eff if e.expr == "->chunk")
+    assert len(cond.loops) == 2
+    inner = next(e for e in eff if e.expr == "f->tsorig")
+    assert inner.loops == cond.loops
+
+
+def test_ternary_embedded_store_is_seen():
+    eff = _eff(
+        "void fdt_t( uint64_t * h, int x ) {\n"
+        "  int y = x ? ( h[ 0 ] = 1 ) : ( h[ 1 ] = 2 );\n"
+        "}\n",
+        "fdt_trace.c",
+        "fdt_t",
+    )
+    assert [(e.kind, e.cls) for e in eff if e.cls] == [
+        ("store", "trace.hist"),
+        ("store", "trace.hist"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# 3. the fdt_tango-vs-_MC differential
+
+
+def _c_effects():
+    return shmlint.analyze_file(NATIVE / "fdt_tango.c")
+
+
+def _c_field(e: shmlint.Effect) -> str:
+    if e.cls.startswith("fseq.") and "diag" in e.expr:
+        return "diag"
+    m = re.search(r"->\s*(\w+)", e.expr)
+    assert m, e.expr
+    return m.group(1)
+
+
+def _c_rw(effects) -> tuple[list, list]:
+    """Classified ring accesses of one C primitive as the differential's
+    (writes, reads) field sequences.  An rmw is a write (its read half
+    is the same word, same instruction — not a separate micro-step)."""
+    writes, reads = [], []
+    for e in effects:
+        if not (e.cls.startswith("mcache.") or e.cls.startswith("fseq.")):
+            continue
+        obj = "mc" if e.cls.startswith("mcache.") else "fs"
+        if e.kind in ("store", "rmw", "cas"):
+            writes.append(("w", obj, _c_field(e)))
+        elif e.kind == "load":
+            reads.append(("r", obj, _c_field(e)))
+    return writes, reads
+
+
+def test_differential_tango_matches_mc_decomposition():
+    """Access-for-access: for every RingHook micro-step method, the
+    shared words the Python model writes are EXACTLY the words the C
+    primitive writes, in the same order; for read primitives the read
+    sequences match too.  The model may carry observability-only
+    pre-reads (fseq_update's notify read), so for write primitives the
+    C side's classified reads must be a subset of the model's."""
+    mc = shmcontract.ringhook_accesses(SCHED)
+    ceff = _c_effects()
+    assert set(mc) == set(shmcontract.RINGHOOK_METHODS), sorted(mc)
+    for method, cname in shmcontract.RINGHOOK_METHODS.items():
+        writes, reads = _c_rw(ceff[cname])
+        py = mc[method]
+        py_writes = [a for a in py if a[0] == "w"]
+        py_reads = [a for a in py if a[0] == "r"]
+        assert writes == py_writes, (
+            f"{method} vs {cname}: C writes {writes}, model writes {py_writes}"
+        )
+        if py_writes:
+            # write primitive: C must not read ring words the model
+            # doesn't know about
+            assert set(reads) <= set(py_reads), (method, reads, py_reads)
+        else:
+            assert reads == py_reads, (
+                f"{method} vs {cname}: C reads {reads}, model reads {py_reads}"
+            )
+
+
+def test_differential_order_for_order():
+    """The C11 orders of fdt_tango.c's ring primitives, pinned as the
+    exact classified-effect sequences.  This is the ordering contract
+    the RingHook micro-steps (and fdtmc's interleaving exploration)
+    assume: change the C and this fails until the model is re-derived."""
+    ceff = _c_effects()
+
+    def seq(fn):
+        return [
+            (e.kind, e.cls, e.order)
+            for e in ceff[fn]
+            if e.cls.startswith(("mcache.", "fseq.")) or e.kind == "fence"
+        ]
+
+    line = ("store", "mcache.line", "plain")
+    assert seq("fdt_mcache_publish") == [
+        ("store", "mcache.seq", "relaxed"),  # invalidate
+        ("fence", "", "release"),
+        line, line, line, line, line, line,  # sig/chunk/sz/ctl/tsorig/tspub
+        ("fence", "", "release"),
+        ("store", "mcache.seq", "release"),  # commit
+        ("store", "mcache.seq_prod", "release"),
+    ]
+    rd = ("load", "mcache.line", "plain")
+    assert seq("fdt_mcache_poll") == [
+        ("load", "mcache.seq", "acquire"),
+        rd, rd, rd, rd, rd, rd,
+        ("fence", "", "acquire"),
+        ("load", "mcache.seq", "acquire"),  # seqlock re-check
+    ]
+    assert seq("fdt_mcache_seq_query") == [
+        ("load", "mcache.seq_prod", "acquire")
+    ]
+    assert seq("fdt_mcache_seq_advance") == [
+        ("store", "mcache.seq_prod", "release")
+    ]
+    assert seq("fdt_fseq_query") == [("load", "fseq.seq", "acquire")]
+    assert seq("fdt_fseq_update") == [("store", "fseq.seq", "release")]
+    assert seq("fdt_fseq_diag_query") == [("load", "fseq.diag", "relaxed")]
+    assert seq("fdt_fseq_diag_add") == [("rmw", "fseq.diag", "relaxed")]
+    # pure credit arithmetic: no shared access on either side
+    assert seq("fdt_fctl_cr_avail") == []
+
+
+# ---------------------------------------------------------------------------
+# 4. contract rules: shipped surface clean, suppression, mutant flips
+
+
+def test_shipped_native_surface_is_clean():
+    findings = []
+    for p in sorted(NATIVE.glob("*.c")):
+        findings += shmlint.check_native_c_file(p, rel=REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_shm_good_pragmas_suppress_and_strip_restores():
+    src = (CORPUS / "shm_good.c").read_text()
+    assert shmlint.check_source(src, "shm_good.c", "shm_good.c") == []
+    stripped = "\n".join(
+        ln for ln in src.splitlines() if "fdtlint:" not in ln
+    )
+    rules = {
+        f.rule for f in shmlint.check_source(stripped, "shm_good.c", "shm_good.c")
+    }
+    assert rules == {
+        "shm-publish-release",
+        "shm-single-writer",
+        "shm-stale-credit",
+        "shm-journal-arm",
+        "shm-epoch-check",
+    }, sorted(rules)
+
+
+def _mutate_and_check(path: Path, pattern: str, repl: str, rule: str):
+    src = path.read_text()
+    mutant = re.sub(pattern, repl, src, count=1, flags=re.S)
+    assert mutant != src, f"mutation pattern matched nothing in {path.name}"
+    findings = shmlint.check_source(mutant, path.name, path.name)
+    assert any(f.rule == rule for f in findings), (
+        f"reverting the {path.name} fix no longer trips {rule}: "
+        + "\n".join(str(f) for f in findings)
+    )
+
+
+def test_regression_bank_completed_mark_needs_release():
+    """PINNED (real ordering bug, fixed this PR): fdt_bank_pipeline's
+    completed-seq mark was a plain store; a recovery process could read
+    the new mark without the slot/journal stores it covers.  Reverting
+    to the plain store must trip shm-publish-release forever."""
+    _mutate_and_check(
+        NATIVE / "fdt_stem.c",
+        r"__atomic_store_n\( &jw\[ BJ_COMPLETED \], mb_tag \+ 1UL,\s*"
+        r"__ATOMIC_RELEASE \)",
+        "jw[ BJ_COMPLETED ] = mb_tag + 1UL",
+        "shm-publish-release",
+    )
+
+
+def test_regression_trace_hist_words_stay_atomic():
+    _mutate_and_check(
+        NATIVE / "fdt_trace.c",
+        r"__atomic_store_n\( &h\[ b \],.*?__ATOMIC_RELAXED \)",
+        "h[ b ] += 1UL",
+        "shm-publish-release",
+    )
+
+
+def test_regression_net_rx_credit_stays_in_loop():
+    """Reverting fdt_net_rx to a hoisted credit snapshot (no re-read
+    inside the recvmmsg round loop) must trip shm-stale-credit."""
+    _mutate_and_check(
+        NATIVE / "fdt_net.c",
+        r"int64_t cr = fdt_stem_out_cr\( ob \);",
+        "int64_t cr = burst;",
+        "shm-stale-credit",
+    )
+
+
+# ---------------------------------------------------------------------------
+# 5. coverage floor: a new .c cannot silently skip the scan
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return engine.run_repo()
+
+
+def test_native_files_coverage_floor(repo_report):
+    cov = repo_report.coverage
+    on_disk = sorted(
+        p.relative_to(REPO).as_posix() for p in NATIVE.glob("*.c")
+    )
+    assert cov["native_c_files"] == on_disk
+    # the shm analyzer must actually SEE the surface: every native file
+    # parses to at least one function, and the aggregate counts sit
+    # above a floor a silent parser regression would fall through
+    for p in sorted(NATIVE.glob("*.c")):
+        assert shmlint.analyze_file(p), f"{p.name}: no functions parsed"
+    assert cov["shm_functions"] >= 140, cov["shm_functions"]
+    assert cov["shm_effects"] >= 550, cov["shm_effects"]
+
+
+def test_repo_report_has_no_shm_findings(repo_report):
+    assert not [
+        f for f in repo_report.findings if f.rule.startswith("shm-")
+    ]
